@@ -1,0 +1,23 @@
+"""Test configuration: force an 8-device virtual CPU mesh before jax imports.
+
+Mirrors the reference's serial-fallback testing posture (mpisppy/MPI.py mock):
+all logic tests run without TPU hardware; multi-device sharding is exercised on
+a virtual CPU mesh (xla_force_host_platform_device_count), per the build brief.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # the driver env presets axon (TPU)
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_ENABLE_X64"] = "1"
+
+# jax may already have been imported by a pytest plugin; set configs directly
+# (safe as long as no computation has run yet, which is the case at collection).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

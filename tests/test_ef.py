@@ -1,0 +1,80 @@
+"""EF golden-value tests (cf. mpisppy/tests/test_ef_ph.py pattern of rounded
+significant-digit asserts against known objectives)."""
+
+import numpy as np
+import pytest
+
+from tpusppy.ef import build_ef, solve_ef
+from tpusppy.ir import ScenarioBatch
+from tpusppy.models import farmer
+
+
+def round_pos_sig(x, sig=1):
+    """Round to sig significant digits (test_ef_ph.py helper semantics)."""
+    from math import floor, log10
+
+    return round(x, -int(floor(log10(abs(x)))) + (sig - 1))
+
+
+def make_farmer_batch(num_scens=3, **kw):
+    names = farmer.scenario_names_creator(num_scens)
+    probs = {"num_scens": num_scens}
+    problems = [farmer.scenario_creator(nm, **probs, **kw) for nm in names]
+    return ScenarioBatch.from_problems(problems)
+
+
+class TestFarmerEF:
+    def test_golden_objective_3scen(self):
+        batch = make_farmer_batch(3)
+        obj, xs = solve_ef(batch, solver="highs")
+        assert obj == pytest.approx(-108390.0, abs=1.0)
+
+    def test_first_stage_identical(self):
+        batch = make_farmer_batch(3)
+        _, xs = solve_ef(batch, solver="highs")
+        nonants = xs[:, batch.tree.nonant_indices]
+        assert np.allclose(nonants[0], nonants[1])
+        assert np.allclose(nonants[0], nonants[2])
+        # classic optimal acreage: wheat 170, corn 80, beets 250
+        assert np.allclose(sorted(nonants[0]), [80.0, 170.0, 250.0], atol=1e-4)
+
+    def test_more_scenarios(self):
+        batch = make_farmer_batch(9)
+        obj, _ = solve_ef(batch, solver="highs")
+        # 9 scenarios with perturbed groups: objective near the classic value
+        assert -140000 < obj < -80000
+
+    def test_integer_farmer(self):
+        batch = make_farmer_batch(3, use_integer=True)
+        obj, xs = solve_ef(batch, solver="highs")
+        nonants = xs[:, batch.tree.nonant_indices]
+        assert np.allclose(nonants, np.round(nonants), atol=1e-6)
+        assert obj == pytest.approx(-108390.0, rel=1e-3)
+
+    def test_crops_multiplier(self):
+        batch = make_farmer_batch(3, crops_multiplier=2)
+        obj, _ = solve_ef(batch, solver="highs")
+        assert obj == pytest.approx(2 * -108390.0, rel=1e-6)
+
+    def test_ef_objective_consistency(self):
+        # probability-weighted recomputation matches the solver's objective
+        batch = make_farmer_batch(6)
+        obj, xs = solve_ef(batch, solver="highs")
+        recomputed = float(batch.probs @ batch.objective(xs))
+        assert obj == pytest.approx(recomputed, rel=1e-9)
+
+
+class TestEFStructure:
+    def test_column_merging(self):
+        batch = make_farmer_batch(3)
+        ef = build_ef(batch)
+        S, n = batch.num_scenarios, batch.num_vars
+        K = batch.tree.num_nonants
+        # shared first-stage columns + private leaf columns
+        assert ef.c.shape[0] == K + S * (n - K)
+
+    def test_probability_default_uniform(self):
+        names = farmer.scenario_names_creator(4)
+        problems = [farmer.scenario_creator(nm) for nm in names]
+        batch = ScenarioBatch.from_problems(problems)
+        assert np.allclose(batch.probs, 0.25)

@@ -1,0 +1,85 @@
+"""PH correctness: farmer PH converges to the EF solution (the reference's
+core regression pattern, test_ef_ph.py)."""
+
+import numpy as np
+import pytest
+
+from tpusppy.ef import solve_ef
+from tpusppy.models import farmer
+from tpusppy.opt.ph import PH
+
+
+def make_ph(num_scens=3, rho=1.0, iters=60, **opts):
+    options = {
+        "defaultPHrho": rho,
+        "PHIterLimit": iters,
+        "convthresh": 1e-7,
+        "display_progress": False,
+        **opts,
+    }
+    return PH(
+        options,
+        farmer.scenario_names_creator(num_scens),
+        farmer.scenario_creator,
+        scenario_creator_kwargs={"num_scens": num_scens},
+    )
+
+
+class TestFarmerPH:
+    def test_trivial_bound_below_ef(self):
+        ph = make_ph(3, iters=2)
+        conv, eobj, tbound = ph.ph_main()
+        # wait-and-see bound must be <= EF optimum for minimization
+        assert tbound <= -108390.0 + 1.0
+
+    def test_ph_converges_to_ef(self):
+        ph = make_ph(3, rho=1.0, iters=150)
+        conv, eobj, tbound = ph.ph_main()
+        assert conv < 1e-2
+        # xbar should be near the EF first stage: wheat 170, corn 80, beets 250
+        xbar = ph.xbars[0]
+        assert np.allclose(sorted(xbar), [80.0, 170.0, 250.0], atol=2.0)
+        assert eobj == pytest.approx(-108390.0, rel=2e-3)
+
+    def test_w_sums_to_zero(self):
+        ph = make_ph(3, iters=10)
+        ph.ph_main()
+        # E[W] = 0 per nonant slot is the PH dual invariant
+        wbar = ph.probs @ ph.W
+        assert np.allclose(wbar, 0.0, atol=1e-6)
+
+    def test_more_scenarios(self):
+        ph = make_ph(9, rho=1.0, iters=120)
+        conv, eobj, tbound = ph.ph_main()
+        obj_ef, _ = solve_ef(ph.batch, solver="highs")
+        assert tbound <= obj_ef + 1.0
+        assert eobj == pytest.approx(obj_ef, rel=5e-3)
+
+    def test_extension_callouts(self):
+        from tpusppy.extensions.extension import Extension
+
+        calls = []
+
+        class Recorder(Extension):
+            def pre_iter0(self):
+                calls.append("pre_iter0")
+
+            def post_iter0(self):
+                calls.append("post_iter0")
+
+            def miditer(self):
+                calls.append("miditer")
+
+            def enditer(self):
+                calls.append("enditer")
+
+            def post_everything(self):
+                calls.append("post_everything")
+
+        ph = make_ph(3, iters=3)
+        ph.extobject = Recorder(ph)
+        ph.ph_main()
+        assert calls[0] == "pre_iter0"
+        assert calls[1] == "post_iter0"
+        assert calls.count("miditer") == calls.count("enditer") >= 1
+        assert calls[-1] == "post_everything"

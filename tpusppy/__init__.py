@@ -1,0 +1,37 @@
+"""tpusppy: a TPU-native framework for scenario-based optimization under uncertainty.
+
+Re-implements the capabilities of mpi-sppy (Progressive Hedging and friends with an
+asynchronous hub-and-spoke bound architecture) on top of JAX/XLA: scenario subproblems
+are an HBM-resident batch solved by a vmapped first-order proximal QP solver,
+nonanticipative reductions are ``jax.lax.psum`` over a device mesh, and cross-cylinder
+exchange is a write-id-versioned host mailbox.
+
+Reference architecture surveyed in SURVEY.md (mpi-sppy mounted at /root/reference).
+This module mirrors ``mpisppy/__init__.py:1-13`` (global_toc timestamped logging).
+"""
+
+import time as _time
+
+__version__ = "0.1.0"
+
+_T0 = _time.time()
+_toc_enabled = True
+
+
+def global_toc(msg, cond=True):
+    """Timestamped progress message (analogue of mpisppy.global_toc).
+
+    The reference uses Pyomo's TicTocTimer; here a plain monotonic stamp.
+    """
+    if cond and _toc_enabled:
+        print(f"[{_time.time() - _T0:10.2f}] {msg}", flush=True)
+
+
+def disable_tictoc_output():
+    global _toc_enabled
+    _toc_enabled = False
+
+
+def reenable_tictoc_output():
+    global _toc_enabled
+    _toc_enabled = True

@@ -1,0 +1,17 @@
+"""Converger ABC (mpisppy/convergers/converger.py:18-41).
+
+A converger is a hub-internal stopping rule consulted each PH iteration
+(phbase.py:925-934), distinct from the cross-cylinder gap-based termination.
+"""
+
+
+class Converger:
+    def __init__(self, opt):
+        self.opt = opt
+        self.conv_value = None
+
+    def convergence_value(self):
+        return self.conv_value
+
+    def is_converged(self) -> bool:
+        raise NotImplementedError

@@ -1,0 +1,127 @@
+"""Extensive-form assembly and solve.
+
+Reference semantics: ``sputils.create_EF / _create_EF_from_scen_dict``
+(sputils.py:127-341) make each scenario a sub-block of one model with a
+probability-weighted objective and nonanticipativity equalities.  Here nonant
+variables that share a tree node are *merged into one column* (equivalent to the
+reference's reference-variable + equality formulation, but smaller), and the EF
+is solved either by the HiGHS validation backend or by the TPU ADMM solver.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .ir import ScenarioBatch
+from .solvers import scipy_backend
+
+
+@dataclasses.dataclass
+class EFProblem:
+    """Monolithic EF in canonical form, plus the column maps back to scenarios."""
+
+    c: np.ndarray
+    q2: np.ndarray
+    A: np.ndarray
+    cl: np.ndarray
+    cu: np.ndarray
+    lb: np.ndarray
+    ub: np.ndarray
+    is_int: np.ndarray
+    const: float
+    col_of: np.ndarray       # (S, n) scenario-var -> EF column
+    batch: ScenarioBatch
+
+    def split_solution(self, x_ef: np.ndarray) -> np.ndarray:
+        """(S, n) per-scenario solution from an EF solution vector."""
+        return x_ef[self.col_of]
+
+
+def build_ef(batch: ScenarioBatch) -> EFProblem:
+    S, n = batch.num_scenarios, batch.num_vars
+    tree = batch.tree
+    nonant_idx = tree.nonant_indices            # (K,) var slots
+    K = nonant_idx.shape[0]
+
+    # EF column map: one column per (node, nonant-slot-within-stage); leaf vars
+    # get a private column per scenario.
+    col_of = -np.ones((S, n), dtype=np.int64)
+    node_slot_col: dict[tuple[int, int], int] = {}
+    ncols = 0
+    for s in range(S):
+        for k in range(K):
+            stage = tree.nonant_stage[k]
+            node = int(tree.scen_node_ids[s, stage - 1])
+            key = (node, k)
+            if key not in node_slot_col:
+                node_slot_col[key] = ncols
+                ncols += 1
+            col_of[s, nonant_idx[k]] = node_slot_col[key]
+    for s in range(S):
+        for j in range(n):
+            if col_of[s, j] < 0:
+                col_of[s, j] = ncols
+                ncols += 1
+
+    probs = batch.probs
+    c = np.zeros(ncols)
+    q2 = np.zeros(ncols)
+    lb = np.full(ncols, -np.inf)
+    ub = np.full(ncols, np.inf)
+    is_int = np.zeros(ncols, dtype=bool)
+    for s in range(S):
+        cols = col_of[s]
+        np.add.at(c, cols, probs[s] * batch.c[s])
+        np.add.at(q2, cols, probs[s] * batch.q2[s])
+        lb[cols] = np.maximum(lb[cols], batch.lb[s])
+        ub[cols] = np.minimum(ub[cols], batch.ub[s])
+        is_int[cols] |= batch.is_int
+
+    m = batch.num_rows
+    A = np.zeros((S * m, ncols))
+    cl = np.zeros(S * m)
+    cu = np.zeros(S * m)
+    for s in range(S):
+        rows = slice(s * m, (s + 1) * m)
+        np.add.at(A[rows], (slice(None), col_of[s]), batch.A[s])
+        cl[rows] = batch.cl[s]
+        cu[rows] = batch.cu[s]
+
+    return EFProblem(
+        c=c, q2=q2, A=A, cl=cl, cu=cu, lb=lb, ub=ub, is_int=is_int,
+        const=float(probs @ batch.const), col_of=col_of, batch=batch,
+    )
+
+
+def solve_ef(batch: ScenarioBatch, solver="highs", mip=True, **kw):
+    """Solve the EF; returns (objective, per-scenario solutions (S, n)).
+
+    ``solver='highs'`` is the validation path (external-solver analogue,
+    ef.py:66-93); ``solver='admm'`` runs the TPU-native batched solver on the
+    single monolithic problem.
+    """
+    ef = build_ef(batch)
+    if solver == "highs":
+        res = scipy_backend.solve_lp(
+            ef.c, ef.A, ef.cl, ef.cu, ef.lb, ef.ub,
+            is_int=ef.is_int if mip else None, const=ef.const, **kw,
+        )
+        if not res.feasible:
+            raise RuntimeError(f"EF infeasible or solver failure: {res.status}")
+        return res.obj, ef.split_solution(res.x)
+    elif solver == "admm":
+        from .solvers import admm
+
+        if mip and np.any(ef.is_int):
+            raise NotImplementedError(
+                "solver='admm' solves the continuous relaxation only; pass "
+                "mip=False explicitly, or use solver='highs' for integer EFs"
+            )
+        sol = admm.solve_single(
+            c=ef.c, q2=ef.q2, A=ef.A, cl=ef.cl, cu=ef.cu, lb=ef.lb, ub=ef.ub, **kw
+        )
+        obj = float(ef.c @ sol.x + 0.5 * ef.q2 @ (sol.x * sol.x) + ef.const)
+        return obj, ef.split_solution(np.asarray(sol.x))
+    raise ValueError(f"unknown EF solver {solver!r}")

@@ -1,0 +1,188 @@
+"""PHBase: Progressive Hedging state and iteration.
+
+TPU-native analogue of ``mpisppy/phbase.py:176-1050``.  PH state — duals W,
+penalty rho, node averages xbar — are (S, K) arrays over the packed nonant
+layout.  The two global reductions of the reference become tensor contractions:
+
+* ``Compute_Xbar`` (phbase.py:27-107): per-tree-node probability-weighted means
+  via a one-hot node-membership contraction (replacing one Allreduce per node on
+  per-node communicators), sharding-ready (psum over the scenario mesh axis).
+* ``convergence_diff`` (phbase.py:321-343): scaled L1 deviation from xbar.
+
+The augmented objective (attach_PH_to_objective, phbase.py:617-699)
+``obj += W_on * W.x + prox_on * (rho/2)(x^2 - 2 xbar x + xbar^2)`` never touches
+a model: it is just a (q, q2) override for the batched ADMM solve, and the prox
+term needs no linearization cuts (prox_approx.py) because the solver is a QP
+solver natively.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import global_toc
+from .spopt import SPOpt
+from .extensions.extension import Extension
+
+
+class PHBase(SPOpt):
+    """PH state + iteration drivers (Iter0 / iterk_loop / post_loops)."""
+
+    def __init__(self, *args, extensions=None, extension_kwargs=None,
+                 ph_converger=None, rho_setter=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._options_check(["defaultPHrho", "PHIterLimit"], self.options)
+        K = self.nonant_length
+        S = self.batch.num_scenarios
+
+        self.W = np.zeros((S, K))
+        self.xbars = np.zeros((S, K))       # per-scenario view of node xbar
+        self.xsqbars = np.zeros((S, K))
+        self.rho = self._initial_rho(rho_setter)
+        self.W_on = True
+        self.prox_on = True
+        self.conv = None
+        self._iter = 0
+        self.best_bound = -np.inf if self.is_minimizing else np.inf
+
+        ext_cls = extensions if extensions is not None else Extension
+        self.extobject = ext_cls(self, **(extension_kwargs or {})) \
+            if extension_kwargs else ext_cls(self)
+        self.ph_converger = ph_converger(self) if ph_converger else None
+        self.spcomm = None
+
+        # Precompute node-membership one-hot for xbar contraction: (S, K) -> N
+        N = self.tree.num_nodes
+        self._onehot = np.zeros((S, K, N))
+        sidx = np.arange(S)[:, None]
+        kidx = np.arange(K)[None, :]
+        self._onehot[sidx, kidx, self.nid_sk] = 1.0
+
+    @property
+    def is_minimizing(self):
+        return True  # IR is always stated as minimization (negate costs to max)
+
+    def _initial_rho(self, rho_setter):
+        K = self.nonant_length
+        S = self.batch.num_scenarios
+        rho = np.full((S, K), float(self.options["defaultPHrho"]))
+        if rho_setter is not None:
+            # rho_setter(batch) -> (K,) or (S, K) array (cf. phbase.py rho_setter)
+            r = np.asarray(rho_setter(self.batch), dtype=float)
+            rho = np.broadcast_to(r, (S, K)).copy() if r.ndim == 1 else r.copy()
+        return rho
+
+    # ---- reductions ---------------------------------------------------------
+    def Compute_Xbar(self, verbose=False):
+        """Per-node weighted averages of nonants (phbase.py:27-107)."""
+        xk = self.nonants_of(self.local_x)                      # (S, K)
+        p = self.probs[:, None]                                  # (S, 1)
+        num = np.einsum("skn,sk->nk", self._onehot, p * xk)      # (N, K)
+        sqnum = np.einsum("skn,sk->nk", self._onehot, p * xk * xk)
+        den = np.einsum("skn,sk->nk", self._onehot, np.broadcast_to(p, xk.shape))
+        den = np.maximum(den, 1e-300)
+        xbar_nk = num / den
+        xsqbar_nk = sqnum / den
+        kidx = np.arange(self.nonant_length)[None, :]
+        self.xbars = xbar_nk[self.nid_sk, kidx]
+        self.xsqbars = xsqbar_nk[self.nid_sk, kidx]
+        if verbose:
+            global_toc(f"xbar[:8]={self.xbars[0][:8]}")
+
+    def Update_W(self, verbose=False):
+        """Dual update W += rho (x - xbar) (phbase.py:293-318)."""
+        xk = self.nonants_of(self.local_x)
+        self.W = self.W + self.rho * (xk - self.xbars)
+        if verbose:
+            global_toc(f"W[0][:8]={self.W[0][:8]}")
+
+    def convergence_diff(self) -> float:
+        """Scaled norm of x - xbar (phbase.py:321-343)."""
+        xk = self.nonants_of(self.local_x)
+        dev = np.abs(xk - self.xbars).mean(axis=1)
+        return float(self.probs @ dev)
+
+    # ---- augmented objective ------------------------------------------------
+    def _augmented_q(self):
+        """(q, q2) for the PH subproblem (attach_PH_to_objective)."""
+        b = self.batch
+        idx = self.tree.nonant_indices
+        q = np.array(b.c, copy=True)
+        q2 = np.array(b.q2, copy=True)
+        if self.W_on:
+            q[:, idx] += self.W
+        if self.prox_on:
+            q[:, idx] += -self.rho * self.xbars
+            q2[:, idx] += self.rho
+        return q, q2
+
+    def solve_ph_subproblems(self):
+        self.extobject.pre_solve_loop()
+        q, q2 = self._augmented_q()
+        self.solve_loop(q=q, q2=q2)
+        self.extobject.post_solve_loop()
+
+    # ---- drivers ------------------------------------------------------------
+    def Iter0(self) -> float:
+        """Initial solves with W=prox off; returns the trivial bound
+        (phbase.py:758-872)."""
+        self.extobject.pre_iter0()
+        self._iter = 0
+        self.solve_loop()  # plain objective
+        feas = self.feas_prob()
+        if feas < 1.0 - 1e-6:
+            raise RuntimeError(
+                f"Infeasibility detected at iter0; feasible mass {feas:.4f} "
+                "(cf. phbase.py:818-823 hard quit)"
+            )
+        self.trivial_bound = self.Ebound()
+        self.best_bound = self.trivial_bound
+        self.Compute_Xbar()
+        self.Update_W()
+        self.conv = self.convergence_diff()
+        self.extobject.post_iter0()
+        if self.spcomm is not None:
+            self.spcomm.sync()
+            self.extobject.post_iter0_after_sync()
+        global_toc(
+            f"Iter0 trivial bound {self.trivial_bound:.4f} conv {self.conv:.3e}",
+            self.options.get("display_progress", False),
+        )
+        return self.trivial_bound
+
+    def iterk_loop(self):
+        """Main PH loop (phbase.py:875-979)."""
+        convthresh = self.options.get("convthresh", 0.0)
+        max_iters = self.options["PHIterLimit"]
+        for k in range(1, max_iters + 1):
+            self._iter = k
+            self.extobject.miditer()
+            self.solve_ph_subproblems()
+            self.Compute_Xbar()
+            self.Update_W()
+            self.conv = self.convergence_diff()
+            self.extobject.enditer()
+            if self.spcomm is not None:
+                self.spcomm.sync()
+                self.extobject.enditer_after_sync()
+                if self.spcomm.is_converged():
+                    global_toc("Cylinder termination", True)
+                    break
+            global_toc(
+                f"PH iter {k} conv {self.conv:.6e} Eobj {self.Eobjective():.4f}",
+                self.options.get("display_progress", False),
+            )
+            if self.conv is not None and self.conv < convthresh:
+                global_toc(
+                    f"Convergence threshold {convthresh} reached at iter {k}",
+                    self.options.get("display_progress", False),
+                )
+                break
+            if self.ph_converger is not None and self.ph_converger.is_converged():
+                global_toc(f"User converger triggered at iter {k}", True)
+                break
+
+    def post_loops(self) -> float:
+        """Final expected objective (phbase.py:982-1037)."""
+        self.extobject.post_everything()
+        return self.Eobjective()

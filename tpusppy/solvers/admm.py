@@ -1,0 +1,431 @@
+"""Batched OSQP-style ADMM QP/LP solver in JAX — the TPU-native subproblem engine.
+
+This replaces the reference's external-MIP-solver hot loop (``solve_one`` /
+``solve_loop``, spopt.py:85-307, and the persistent-solver objective refresh at
+spopt.py:129-144): the entire local scenario batch is solved by ONE device
+program — batched dense Cholesky factorizations ride the MXU, the ADMM sweep is a
+``lax.while_loop``, and PH's per-iteration objective update is just new (q, rho)
+tensors plus a warm start.
+
+Canonical form per scenario (see :mod:`tpusppy.ir`):
+
+    minimize    0.5 x' diag(q2) x + c' x
+    subject to  cl <= A x <= cu,   lb <= x <= ub
+
+Splitting (OSQP, Stellato et al.): introduce z_a = A x and z_x = x; the
+variable-bound block is an implicit identity that never gets materialized — it
+contributes only diagonal terms to the KKT system:
+
+    (diag(q2) + sigma I + A' R_a A + R_x) x~ =
+        sigma x - q + A'(R_a z_a - y_a) + (R_x z_x - y_x)
+
+with per-row penalties R (equality rows boosted, free rows damped).  Ruiz
+equilibration preconditions the batch; adaptive-rho restarts refactorize (cheap
+for the dense sizes scenarios have).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BIG = 1e20  # stand-in for +inf inside kernels (keeps arithmetic finite)
+
+
+@dataclasses.dataclass(frozen=True)
+class ADMMSettings:
+    sigma: float = 1e-6
+    alpha: float = 1.6
+    rho: float = 0.1
+    rho_eq_scale: float = 1e3
+    rho_min: float = 1e-6
+    rho_max: float = 1e6
+    max_iter: int = 1000          # inner iterations per rho setting
+    restarts: int = 4             # rho-adaptation refactorizations
+    eps_abs: float = 1e-8
+    eps_rel: float = 1e-8
+    scaling_iters: int = 10
+    polish: bool = True           # active-set KKT polish (OSQP-style)
+    polish_passes: int = 4        # active-set correction passes
+    polish_delta: float = 1e-8
+    dtype: str = "float64"
+
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+
+class BatchSolution(NamedTuple):
+    x: jax.Array       # (S, n)
+    z: jax.Array       # (S, m) constraint-row auxiliaries
+    y: jax.Array       # (S, m) constraint-row duals
+    yx: jax.Array      # (S, n) variable-bound duals
+    pri_res: jax.Array  # (S,)
+    dua_res: jax.Array  # (S,)
+    iters: jax.Array   # (S,) total inner iterations used (same for all)
+
+
+class _Scaling(NamedTuple):
+    D: jax.Array       # (S, n) column scaling
+    E: jax.Array       # (S, m) row scaling
+    cost: jax.Array    # (S,) objective scaling
+
+
+def _clean_bounds(lo, hi):
+    lo = jnp.nan_to_num(lo, nan=-BIG, neginf=-BIG, posinf=BIG)
+    hi = jnp.nan_to_num(hi, nan=BIG, neginf=-BIG, posinf=BIG)
+    return jnp.maximum(lo, -BIG), jnp.minimum(hi, BIG)
+
+
+def _ruiz(A, q2, iters):
+    """Ruiz equilibration of [P A'; A 0] restricted to diagonal scalings.
+
+    Returns (D, E) with the scaled matrix E A D having ~unit inf-norm rows/cols.
+    Batched over the leading axis by construction (all ops are elementwise or
+    row/col reductions).
+    """
+    S, m, n = A.shape
+    D = jnp.ones((S, n), A.dtype)
+    E = jnp.ones((S, m), A.dtype)
+
+    def body(_, DE):
+        D, E = DE
+        As = A * E[:, :, None] * D[:, None, :]
+        Ps = q2 * D * D
+        col = jnp.maximum(jnp.max(jnp.abs(As), axis=1), jnp.abs(Ps))
+        row = jnp.max(jnp.abs(As), axis=2)
+        D = D / jnp.sqrt(jnp.maximum(col, 1e-12))
+        E = E / jnp.sqrt(jnp.maximum(row, 1e-12))
+        return D, E
+
+    D, E = jax.lax.fori_loop(0, iters, body, (D, E))
+    return D, E
+
+
+def _factor(q2, A, rho_a, rho_x, sigma):
+    """Cholesky of K = diag(q2) + sigma I + A' diag(rho_a) A + diag(rho_x).
+
+    Returns (L, K); K is kept for iterative refinement of the triangular
+    solves — essential in float32, where cond(K) ~ 1/sigma * rho_eq_scale
+    otherwise stalls ADMM around 1e-2 residuals.
+    """
+    n = A.shape[-1]
+    K = jnp.einsum("smn,sm,smk->snk", A, rho_a, A)
+    K = K + jnp.eye(n, dtype=A.dtype)[None] * sigma
+    K = K + jax.vmap(jnp.diag)(q2 + rho_x)
+    return jnp.linalg.cholesky(K), K
+
+
+def _tri_solve(L, b):
+    t = jax.scipy.linalg.solve_triangular(L, b[..., None], lower=True)
+    return jax.scipy.linalg.solve_triangular(
+        L, t, lower=True, trans=1
+    )[..., 0]
+
+
+def _chol_solve(LK, b, refine=2):
+    L, K = LK
+    x = _tri_solve(L, b)
+    for _ in range(refine):
+        r = b - jnp.einsum("snk,sk->sn", K, x)
+        x = x + _tri_solve(L, r)
+    return x
+
+
+class _IterState(NamedTuple):
+    x: jax.Array
+    z: jax.Array   # (S, m)
+    zx: jax.Array  # (S, n)
+    y: jax.Array
+    yx: jax.Array
+    pri: jax.Array
+    dua: jax.Array
+    prinorm: jax.Array
+    duanorm: jax.Array
+    k: jax.Array
+
+
+def _admm_core(q, q2, A, cl, cu, lb, ub, state, LK, rho_a, rho_x, st: ADMMSettings):
+    """Inner ADMM sweep at fixed rho. Returns final state."""
+    sigma, alpha = st.sigma, st.alpha
+
+    def step(s: _IterState) -> _IterState:
+        rhs = (
+            sigma * s.x - q
+            + jnp.einsum("smn,sm->sn", A, rho_a * s.z - s.y)
+            + (rho_x * s.zx - s.yx)
+        )
+        xt = _chol_solve(LK, rhs)
+        Axt = jnp.einsum("smn,sn->sm", A, xt)
+        x_new = alpha * xt + (1 - alpha) * s.x
+
+        za_arg = alpha * Axt + (1 - alpha) * s.z + s.y / rho_a
+        z_new = jnp.clip(za_arg, cl, cu)
+        y_new = s.y + rho_a * (alpha * Axt + (1 - alpha) * s.z - z_new)
+
+        zx_arg = alpha * xt + (1 - alpha) * s.zx + s.yx / rho_x
+        zx_new = jnp.clip(zx_arg, lb, ub)
+        yx_new = s.yx + rho_x * (alpha * xt + (1 - alpha) * s.zx - zx_new)
+
+        Ax = jnp.einsum("smn,sn->sm", A, x_new)
+        pri = jnp.maximum(
+            jnp.max(jnp.abs(Ax - z_new), axis=1),
+            jnp.max(jnp.abs(x_new - zx_new), axis=1),
+        )
+        Aty = jnp.einsum("smn,sm->sn", A, y_new)
+        dua = jnp.max(jnp.abs(q2 * x_new + q + Aty + yx_new), axis=1)
+        # OSQP-normalized residual scales, for tolerances and rho adaptation
+        prinorm = jnp.maximum(
+            jnp.max(jnp.abs(Ax), axis=1), jnp.max(jnp.abs(z_new), axis=1)
+        )
+        duanorm = jnp.maximum(
+            jnp.maximum(
+                jnp.max(jnp.abs(q2 * x_new), axis=1),
+                jnp.max(jnp.abs(Aty), axis=1),
+            ),
+            jnp.max(jnp.abs(q), axis=1),
+        )
+        return _IterState(x_new, z_new, zx_new, y_new, yx_new, pri, dua,
+                          prinorm, duanorm, s.k + 1)
+
+    def cont(s: _IterState):
+        # OSQP termination: eps_abs + eps_rel * residual-scale norms
+        eps_pri = st.eps_abs + st.eps_rel * jnp.maximum(s.prinorm, 1.0)
+        eps_dua = st.eps_abs + st.eps_rel * jnp.maximum(s.duanorm, 1.0)
+        done = (s.pri < eps_pri) & (s.dua < eps_dua)
+        return (s.k < st.max_iter) & ~jnp.all(done)
+
+    return jax.lax.while_loop(cont, step, state)
+
+
+def _solve_scaled(q, q2, A, cl, cu, lb, ub, warm, st: ADMMSettings):
+    """Adaptive-rho outer loop; everything already Ruiz-scaled."""
+    S, m, n = A.shape
+    dt = A.dtype
+    eq = jnp.abs(cu - cl) < 1e-10
+    loose = (cl <= -BIG / 2) & (cu >= BIG / 2)
+
+    def rho_vec(base):
+        r = jnp.where(eq, base * st.rho_eq_scale, base)
+        return jnp.where(loose, st.rho_min, r)
+
+    if warm is None:
+        x0 = jnp.zeros((S, n), dt)
+        z0 = jnp.clip(jnp.zeros((S, m), dt), cl, cu)
+        zx0 = jnp.clip(x0, lb, ub)
+        y0 = jnp.zeros((S, m), dt)
+        yx0 = jnp.zeros((S, n), dt)
+    else:
+        x0, z0, y0, yx0 = warm
+        zx0 = jnp.clip(x0, lb, ub)
+
+    base0 = jnp.full((S,), st.rho, dt)
+    inf = jnp.full((S,), jnp.inf, dt)
+    one = jnp.ones((S,), dt)
+    state0 = _IterState(x0, z0, zx0, y0, yx0, inf, inf, one, one,
+                        jnp.zeros((), jnp.int32))
+
+    def outer(carry, _):
+        state, base, total = carry
+        rho_a = rho_vec(base[:, None])
+        rho_x = jnp.broadcast_to(base[:, None], (S, n))
+        LK = _factor(q2, A, rho_a, rho_x, st.sigma)
+        state = _admm_core(
+            q, q2, A, cl, cu, lb, ub,
+            state._replace(k=jnp.zeros((), jnp.int32)),
+            LK, rho_a, rho_x, st,
+        )
+        # OSQP rho adaptation on NORMALIZED residuals (raw residual ratios
+        # push rho the wrong way when primal/dual scales differ)
+        pri_rel = state.pri / jnp.maximum(state.prinorm, 1e-10)
+        dua_rel = state.dua / jnp.maximum(state.duanorm, 1e-10)
+        ratio = jnp.sqrt(
+            jnp.maximum(pri_rel, 1e-12) / jnp.maximum(dua_rel, 1e-12)
+        )
+        base = jnp.clip(base * jnp.clip(ratio, 0.1, 10.0), st.rho_min, st.rho_max)
+        return (state, base, total + state.k), None
+
+    (state, _, total), _ = jax.lax.scan(
+        outer, (state0, base0, jnp.zeros((), jnp.int32)), None, length=st.restarts
+    )
+    return state, total
+
+
+def _polish(state: _IterState, q, q2, A, cl, cu, lb, ub, st: ADMMSettings):
+    """OSQP-style polish: guess the active set from dual signs + slacks, solve
+    the resulting equality-constrained KKT system exactly, and accept per
+    scenario only where it improves the worst residual.
+
+    The KKT system is built at FIXED shape (no per-scenario gather): inactive
+    rows contribute the trivial equation nu_i = 0, inactive bounds mu_j = 0, so
+    the whole batch is one vmapped dense solve — vertex-exact LP solutions from
+    mediocre ADMM iterates, replacing thousands of extra sweeps.
+    """
+    S, m, n = A.shape
+    dt = A.dtype
+    # Per-side activity tolerances; an infinite side is never active.
+    fin_cl, fin_cu = cl > -BIG / 2, cu < BIG / 2
+    tol_cl = 1e-6 * (1.0 + jnp.where(fin_cl, jnp.abs(cl), 0.0))
+    tol_cu = 1e-6 * (1.0 + jnp.where(fin_cu, jnp.abs(cu), 0.0))
+    ytol = 1e-6 * jnp.maximum(jnp.max(jnp.abs(state.y), axis=1, keepdims=True), 1.0)
+    act_lo = ((state.y < -ytol) | (state.z < cl + tol_cl)) & fin_cl
+    act_up = ((state.y > ytol) | (state.z > cu - tol_cu)) & fin_cu
+
+    fin_lb, fin_ub = lb > -BIG / 2, ub < BIG / 2
+    tol_lb = 1e-6 * (1.0 + jnp.where(fin_lb, jnp.abs(lb), 0.0))
+    tol_ub = 1e-6 * (1.0 + jnp.where(fin_ub, jnp.abs(ub), 0.0))
+    yxtol = 1e-6 * jnp.maximum(jnp.max(jnp.abs(state.yx), axis=1, keepdims=True), 1.0)
+    v_lo = ((state.yx < -yxtol) | (state.zx < lb + tol_lb)) & fin_lb
+    v_up = ((state.yx > yxtol) | (state.zx > ub - tol_ub)) & fin_ub
+
+    eq = jnp.abs(cu - cl) < 1e-10
+
+    N = n + m + n
+    eye_n = jnp.eye(n, dtype=dt)[None]
+    eye_m = jnp.eye(m, dtype=dt)[None]
+    ftol = 1e-7
+
+    def kkt_solve(act_lo, act_up, v_lo, v_up):
+        row_act = act_lo | act_up
+        row_b = jnp.where(act_up, cu, cl)
+        var_act = v_lo | v_up
+        var_b = jnp.where(v_up, ub, lb)
+        M = jnp.zeros((S, N, N), dt)
+        rhs = jnp.zeros((S, N), dt)
+        # stationarity: Q x + A' nu + mu = -q
+        M = M.at[:, :n, :n].set(jax.vmap(jnp.diag)(q2) + st.polish_delta * eye_n)
+        M = M.at[:, :n, n:n + m].set(jnp.swapaxes(A, 1, 2))
+        M = M.at[:, :n, n + m:].set(eye_n)
+        rhs = rhs.at[:, :n].set(-q)
+        # rows: active -> A_i x = b_i (regularized), inactive -> nu_i = 0
+        ra = row_act[:, :, None]
+        M = M.at[:, n:n + m, :n].set(jnp.where(ra, A, 0.0))
+        M = M.at[:, n:n + m, n:n + m].set(
+            jnp.where(ra, -st.polish_delta * eye_m, eye_m)
+        )
+        rhs = rhs.at[:, n:n + m].set(jnp.where(row_act, row_b, 0.0))
+        # bounds: active -> x_j = bound, inactive -> mu_j = 0
+        va = var_act[:, :, None]
+        M = M.at[:, n + m:, :n].set(jnp.where(va, eye_n, 0.0))
+        M = M.at[:, n + m:, n + m:].set(
+            jnp.where(va, -st.polish_delta * eye_n, eye_n)
+        )
+        rhs = rhs.at[:, n + m:].set(jnp.where(var_act, var_b, 0.0))
+        sol = jnp.linalg.solve(M, rhs[..., None])[..., 0]
+        return sol[:, :n], sol[:, n:n + m], sol[:, n + m:]
+
+    def refine_sets(xp, yp, yxp, sets):
+        """Add violated rows at the violated side; drop wrong-sign duals."""
+        act_lo, act_up, v_lo, v_up = sets
+        Ax = jnp.einsum("smn,sn->sm", A, xp)
+        act_lo = (act_lo & ~(yp > ftol)) | (Ax < cl - ftol)
+        act_up = (act_up & ~(yp < -ftol)) | (Ax > cu + ftol)
+        # equality rows are always active on both sides
+        act_lo = act_lo | eq
+        act_up = act_up | eq
+        v_lo = ((v_lo & ~(yxp > ftol)) | (xp < lb - ftol)) & (lb > -BIG / 2)
+        v_up = ((v_up & ~(yxp < -ftol)) | (xp > ub + ftol)) & (ub < BIG / 2)
+        return act_lo, act_up, v_lo, v_up
+
+    sets = (act_lo | eq, act_up | eq, v_lo, v_up)
+    xp, yp, yxp = kkt_solve(*sets)
+    for _ in range(st.polish_passes):
+        sets = refine_sets(xp, yp, yxp, sets)
+        xp, yp, yxp = kkt_solve(*sets)
+
+    Ax = jnp.einsum("smn,sn->sm", A, xp)
+    zp = jnp.clip(Ax, cl, cu)
+    zxp = jnp.clip(xp, lb, ub)
+    pri = jnp.maximum(
+        jnp.max(jnp.abs(Ax - zp), axis=1), jnp.max(jnp.abs(xp - zxp), axis=1)
+    )
+    Aty = jnp.einsum("smn,sm->sn", A, yp)
+    dua = jnp.max(jnp.abs(q2 * xp + q + Aty + yxp), axis=1)
+
+    better = jnp.maximum(pri, dua) < jnp.maximum(state.pri, state.dua)
+    pick = lambda a, b: jnp.where(better[:, None], a, b)
+    return state._replace(
+        x=pick(xp, state.x), z=pick(zp, state.z), zx=pick(zxp, state.zx),
+        y=pick(yp, state.y), yx=pick(yxp, state.yx),
+        pri=jnp.where(better, pri, state.pri),
+        dua=jnp.where(better, dua, state.dua),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("settings",))
+def solve_batch(c, q2, A, cl, cu, lb, ub, settings: ADMMSettings = ADMMSettings(),
+                warm=None) -> BatchSolution:
+    """Solve a batch of box-QP/LPs. All arrays (S, ...) as in ScenarioBatch.
+
+    ``warm``: optional (x, z, y, yx) from a previous call — PH's persistent-solver
+    analogue (spopt.py:129-144): between PH iterations only (q, rho-terms) change,
+    so the previous primal/dual iterates are excellent starts.
+
+    On TPU, float32 matmuls default to bf16 MXU accumulation, which stalls ADMM
+    below ~1e-3 residuals; trace everything at highest available precision
+    (f32 full-precision passes on the MXU — still fast at these sizes).
+    """
+    with jax.default_matmul_precision("highest"):
+        return _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm)
+
+
+def _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm) -> BatchSolution:
+    dt = settings.jdtype()
+    c, q2, A = (jnp.asarray(v, dt) for v in (c, q2, A))
+    cl, cu = _clean_bounds(jnp.asarray(cl, dt), jnp.asarray(cu, dt))
+    lb, ub = _clean_bounds(jnp.asarray(lb, dt), jnp.asarray(ub, dt))
+
+    D, E = _ruiz(A, q2, settings.scaling_iters)
+    As = A * E[:, :, None] * D[:, None, :]
+    q2s = q2 * D * D
+    qs = c * D
+    cost = 1.0 / jnp.maximum(jnp.max(jnp.abs(qs), axis=1), 1e-8)
+    qs = qs * cost[:, None]
+    q2s = q2s * cost[:, None]
+    cls, cus = cl * E, cu * E
+    lbs, ubs = lb / D, ub / D
+
+    if warm is not None:
+        x0, z0, y0, yx0 = warm
+        warm = (
+            jnp.asarray(x0, dt) / D,
+            jnp.asarray(z0, dt) * E,
+            jnp.asarray(y0, dt) / E * cost[:, None],
+            jnp.asarray(yx0, dt) * D * cost[:, None],
+        )
+
+    state, total = _solve_scaled(qs, q2s, As, cls, cus, lbs, ubs, warm, settings)
+    if settings.polish:
+        state = _polish(state, qs, q2s, As, cls, cus, lbs, ubs, settings)
+
+    x = state.x * D
+    z = state.z / E
+    y = state.y * E / cost[:, None]
+    yx = state.yx / D / cost[:, None]
+    S = A.shape[0]
+    return BatchSolution(
+        x=x, z=z, y=y, yx=yx,
+        pri_res=state.pri, dua_res=state.dua,
+        iters=jnp.broadcast_to(total, (S,)),
+    )
+
+
+class SingleSolution(NamedTuple):
+    x: jax.Array
+    y: jax.Array
+    pri_res: jax.Array
+    dua_res: jax.Array
+
+
+def solve_single(c, q2, A, cl, cu, lb, ub, settings: ADMMSettings = ADMMSettings(),
+                 **kw) -> SingleSolution:
+    """Convenience wrapper: one problem as a batch of 1 (EF solves)."""
+    sol = solve_batch(
+        c[None], q2[None], A[None], cl[None], cu[None], lb[None], ub[None],
+        settings=settings, **kw,
+    )
+    return SingleSolution(sol.x[0], sol.y[0], sol.pri_res[0], sol.dua_res[0])

@@ -1,0 +1,105 @@
+"""HiGHS (scipy) validation backend.
+
+The reference delegates every subproblem/EF solve to an external commercial
+solver through Pyomo's SolverFactory (spopt.py:839-903).  tpusppy's primary
+solver is the TPU-native batched ADMM (:mod:`tpusppy.solvers.admm`); this module
+is the analogue of the external-solver path — a CPU LP/MILP solve via
+scipy's vendored HiGHS — used for golden-value tests and as a fallback backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import scipy.optimize as sopt
+import scipy.sparse as sp
+
+
+@dataclasses.dataclass
+class SolveResult:
+    x: np.ndarray
+    obj: float
+    duals: np.ndarray | None
+    status: str
+    feasible: bool
+
+
+def solve_lp(c, A, cl, cu, lb, ub, is_int=None, q2=None, const=0.0,
+             mip_rel_gap=None, time_limit=None) -> SolveResult:
+    """Solve one canonical-form problem with HiGHS.
+
+    Quadratic objectives are not supported by scipy's HiGHS wrapper; callers
+    with q2 != 0 must use the ADMM backend (this mirrors the reference, where
+    solver capability gates algorithm choice, e.g. sc.py:18-21).
+    """
+    if q2 is not None and np.any(q2 != 0):
+        raise NotImplementedError("HiGHS backend is LP/MILP only; use admm for QP")
+    m, n = A.shape
+    constraints = sopt.LinearConstraint(sp.csr_matrix(A), cl, cu) if m else ()
+    integrality = None
+    if is_int is not None and np.any(is_int):
+        integrality = np.where(is_int, 1, 0)
+    options = {}
+    if mip_rel_gap is not None:
+        options["mip_rel_gap"] = mip_rel_gap
+    if time_limit is not None:
+        options["time_limit"] = time_limit
+    res = sopt.milp(
+        c=c,
+        constraints=constraints,
+        integrality=integrality,
+        bounds=sopt.Bounds(lb, ub),
+        options=options,
+    )
+    # milp status: 0 optimal, 1 iteration/time limit (may carry an incumbent),
+    # 2 infeasible, 3 unbounded, 4 other
+    feasible = res.x is not None and res.status in (0, 1)
+    x = res.x if res.x is not None else np.zeros(n)
+    obj = float(c @ x + const) if res.x is not None else np.inf
+    # scipy.milp does not expose duals; LP duals come from linprog when needed.
+    return SolveResult(x=x, obj=obj, duals=None, status=str(res.status),
+                       feasible=feasible)
+
+
+def solve_lp_with_duals(c, A, cl, cu, lb, ub, const=0.0) -> SolveResult:
+    """Continuous LP with row duals via linprog (for Benders/Lagrangian checks)."""
+    # linprog wants A_ub x <= b_ub and A_eq x = b_eq; split rows.
+    eq = np.isfinite(cl) & np.isfinite(cu) & (cl == cu)
+    ub_rows = np.isfinite(cu) & ~eq
+    lb_rows = np.isfinite(cl) & ~eq
+    A_ub = np.vstack([A[ub_rows], -A[lb_rows]]) if (ub_rows.any() or lb_rows.any()) else None
+    b_ub = np.concatenate([cu[ub_rows], -cl[lb_rows]]) if A_ub is not None else None
+    A_eq = A[eq] if eq.any() else None
+    b_eq = cl[eq] if eq.any() else None
+    res = sopt.linprog(c, A_ub=A_ub, b_ub=b_ub, A_eq=A_eq, b_eq=b_eq,
+                       bounds=np.stack([lb, ub], axis=1), method="highs")
+    duals = None
+    if res.status == 0:
+        duals = np.zeros(A.shape[0])
+        if A_eq is not None:
+            duals[np.flatnonzero(eq)] = res.eqlin.marginals
+        k = 0
+        for rows, sign in ((ub_rows, 1.0), (lb_rows, -1.0)):
+            cnt = int(rows.sum())
+            if cnt:
+                duals[np.flatnonzero(rows)] += sign * res.ineqlin.marginals[k:k + cnt]
+                k += cnt
+    x = res.x if res.x is not None else np.zeros(A.shape[1])
+    return SolveResult(x=x, obj=float(res.fun + const) if res.status == 0 else np.inf,
+                       duals=duals, status=str(res.status), feasible=res.status == 0)
+
+
+def solve_batch(batch, mip=True, **kw):
+    """Solve every scenario of a ScenarioBatch independently (validation path)."""
+    out = []
+    for s in range(batch.num_scenarios):
+        out.append(
+            solve_lp(
+                batch.c[s], batch.A[s], batch.cl[s], batch.cu[s],
+                batch.lb[s], batch.ub[s],
+                is_int=batch.is_int if mip else None,
+                q2=batch.q2[s], const=batch.const[s], **kw,
+            )
+        )
+    return out

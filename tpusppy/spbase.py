@@ -1,0 +1,116 @@
+"""SPBase: scenario ownership, probabilities, options — the runtime root.
+
+TPU-native analogue of ``mpisppy/spbase.py:22-651``.  Where the reference
+instantiates one Pyomo model per scenario on each MPI rank and splits
+communicators per tree node (spbase.py:255-291, 333-375), this class builds the
+whole local scenario set as ONE :class:`~tpusppy.ir.ScenarioBatch` and
+precomputes the node-grouping index arrays that replace per-node communicators:
+node-grouped weighted averages become one-hot matmuls + (when sharded) ``psum``
+over the mesh scenario axis.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import global_toc
+from .ir import ScenarioBatch
+from .solvers.admm import ADMMSettings
+
+
+class SPBase:
+    """Base class for scenario-programming objects.
+
+    Args:
+      options: dict of options (reference option names honored:
+        ``defaultPHrho``, ``convthresh``, ``PHIterLimit``, ``verbose``,
+        ``display_progress``, ``solver_options`` ...).
+      all_scenario_names: list of scenario names.
+      scenario_creator: callable(name, **kwargs) -> ScenarioProblem
+        (the IR analogue of the reference's Pyomo scenario_creator).
+      scenario_creator_kwargs: kwargs passed through.
+      mesh: optional jax Mesh for sharded operation (None => single device).
+      scenario_axis: mesh axis name holding the scenario shard.
+    """
+
+    def __init__(
+        self,
+        options,
+        all_scenario_names,
+        scenario_creator,
+        scenario_creator_kwargs=None,
+        all_nodenames=None,
+        mesh=None,
+        scenario_axis="scen",
+        variable_probability=None,
+    ):
+        self.options = dict(options or {})
+        self.all_scenario_names = list(all_scenario_names)
+        self.scenario_creator = scenario_creator
+        self.scenario_creator_kwargs = dict(scenario_creator_kwargs or {})
+        self.mesh = mesh
+        self.scenario_axis = scenario_axis
+        self.verbose = self.options.get("verbose", False)
+
+        problems = [
+            scenario_creator(name, **self.scenario_creator_kwargs)
+            for name in self.all_scenario_names
+        ]
+        self.batch = ScenarioBatch.from_problems(problems)
+        self.tree = self.batch.tree
+        global_toc(
+            f"Built scenario batch: {self.batch.num_scenarios} scenarios, "
+            f"{self.batch.num_vars} vars, {self.batch.num_rows} rows, "
+            f"{self.tree.num_nonants} nonants, {self.tree.num_stages} stages",
+            self.verbose,
+        )
+
+        # Node-grouping arrays (replace per-node comm.Split, spbase.py:333-375):
+        # nid_sk[s, k] = node-id owning nonant slot k in scenario s.
+        K = self.tree.num_nonants
+        S = self.batch.num_scenarios
+        stages = self.tree.nonant_stage  # (K,) 1-based
+        self.nid_sk = np.take_along_axis(
+            self.tree.scen_node_ids,
+            np.broadcast_to(stages[None, :] - 1, (S, K)),
+            axis=1,
+        ).astype(np.int32)
+
+        self.admm_settings = self._make_admm_settings()
+
+    # ---- options ------------------------------------------------------------
+    def _make_admm_settings(self) -> ADMMSettings:
+        so = dict(self.options.get("solver_options") or {})
+        allowed = {f.name for f in ADMMSettings.__dataclass_fields__.values()}
+        return ADMMSettings(**{k: v for k, v in so.items() if k in allowed})
+
+    def _options_check(self, required, options=None):
+        """Hard check for required options (spbase.py:524-531)."""
+        options = self.options if options is None else options
+        missing = [k for k in required if k not in options]
+        if missing:
+            raise RuntimeError(f"Missing required options: {missing}")
+
+    # ---- probabilities ------------------------------------------------------
+    @property
+    def probs(self) -> np.ndarray:
+        return self.tree.scen_prob
+
+    @property
+    def nonant_length(self) -> int:
+        return self.tree.num_nonants
+
+    def nonants_of(self, x) -> np.ndarray:
+        """Gather packed nonant vector(s) (…, K) from full x (…, n)."""
+        return np.asarray(x)[..., self.tree.nonant_indices]
+
+    # ---- reporting ----------------------------------------------------------
+    def report_var_values_at_rank0(self, x, max_rows=40):
+        """Pretty table of nonant values (spbase.py:584-616)."""
+        xn = self.nonants_of(x)
+        print(f"{'scenario':>12} " + " ".join(
+            f"nonant[{k}]" for k in range(min(self.nonant_length, 8))
+        ))
+        for s, name in enumerate(self.all_scenario_names[:max_rows]):
+            vals = " ".join(f"{v:9.4f}" for v in xn[s][:8])
+            print(f"{name:>12} {vals}")
