@@ -1,0 +1,104 @@
+"""Benchmark: PH iterations/sec on a 1000-scenario farmer via batched ADMM.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The workload mirrors the reference's headline shape (SURVEY §6: PH iters/sec /
+wall-clock to gap on scenario ladders up to 1000 scenarios).  ``vs_baseline``
+measures against the reference *architecture* on this host: a serial
+one-LP-per-scenario PH iteration through an external simplex solver (HiGHS via
+scipy — the stand-in for the Gurobi/CPLEX per-rank solve loop of
+``spopt.py:226-307``), extrapolated from a timed sample of scenarios.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+
+    import tpusppy
+
+    tpusppy.disable_tictoc_output()
+    from tpusppy.ir import ScenarioBatch
+    from tpusppy.models import farmer
+    from tpusppy.parallel import sharded
+    from tpusppy.solvers import scipy_backend
+    from tpusppy.solvers.admm import ADMMSettings
+
+    S = int(os.environ.get("BENCH_SCENS", "1000"))
+    mult = int(os.environ.get("BENCH_CROPS_MULT", "4"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform not in ("cpu",)
+    dtype = "float32" if on_tpu else "float64"
+    if dtype == "float64":
+        jax.config.update("jax_enable_x64", True)
+    eps = 1e-5 if dtype == "float32" else 1e-8
+    settings = ADMMSettings(
+        dtype=dtype, eps_abs=eps, eps_rel=eps, max_iter=200, restarts=2,
+        scaling_iters=6,
+    )
+
+    log(f"platform={platform} S={S} crops_mult={mult} dtype={dtype}")
+    names = farmer.scenario_names_creator(S)
+    batch = ScenarioBatch.from_problems([
+        farmer.scenario_creator(nm, num_scens=S, crops_multiplier=mult)
+        for nm in names
+    ])
+    log(f"batch: {batch.num_scenarios} x ({batch.num_rows} rows, "
+        f"{batch.num_vars} vars)")
+
+    mesh = sharded.make_mesh()
+    arr = sharded.shard_batch(batch, mesh)
+    step = sharded.make_ph_step(batch.tree.nonant_indices, settings)
+    state = sharded.init_state(arr, 1.0, settings)
+
+    # warmup/compile + Iter0
+    t0 = time.time()
+    state, out = step(state, arr, 0.0)
+    jax.block_until_ready(out.conv)
+    log(f"compile+iter0: {time.time() - t0:.1f}s eobj={float(out.eobj):.2f}")
+
+    t0 = time.time()
+    for _ in range(iters):
+        state, out = step(state, arr, 1.0)
+    jax.block_until_ready(out.conv)
+    dt_ours = (time.time() - t0) / iters
+    iters_per_sec = 1.0 / dt_ours
+    log(f"tpusppy: {iters_per_sec:.3f} PH iters/sec "
+        f"(conv={float(out.conv):.3e}, eobj={float(out.eobj):.2f})")
+
+    # Baseline: serial per-scenario LP loop through HiGHS (reference
+    # architecture), timed on a sample and extrapolated to all S scenarios.
+    sample = min(24, S)
+    t0 = time.time()
+    for s in range(sample):
+        scipy_backend.solve_lp(
+            batch.c[s], batch.A[s], batch.cl[s], batch.cu[s],
+            batch.lb[s], batch.ub[s],
+        )
+    t_per_scen = (time.time() - t0) / sample
+    baseline_iters_per_sec = 1.0 / (t_per_scen * S)
+    log(f"baseline (serial HiGHS loop): {t_per_scen * 1e3:.2f} ms/scenario "
+        f"=> {baseline_iters_per_sec:.4f} PH iters/sec")
+
+    print(json.dumps({
+        "metric": f"ph_iters_per_sec_farmer{S}",
+        "value": round(iters_per_sec, 4),
+        "unit": "iter/s",
+        "vs_baseline": round(iters_per_sec / baseline_iters_per_sec, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

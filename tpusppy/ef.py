@@ -106,7 +106,7 @@ def solve_ef(batch: ScenarioBatch, solver="highs", mip=True, **kw):
     if solver == "highs":
         res = scipy_backend.solve_lp(
             ef.c, ef.A, ef.cl, ef.cu, ef.lb, ef.ub,
-            is_int=ef.is_int if mip else None, const=ef.const, **kw,
+            is_int=ef.is_int if mip else None, q2=ef.q2, const=ef.const, **kw,
         )
         if not res.feasible:
             raise RuntimeError(f"EF infeasible or solver failure: {res.status}")

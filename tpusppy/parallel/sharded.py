@@ -1,0 +1,227 @@
+"""Scenario-sharded PH over a `jax.sharding.Mesh` — the multi-chip path.
+
+This is the TPU-native replacement for the reference's rank-level scenario
+parallelism (P1/P2 in SURVEY §2.12): scenarios are block-partitioned over MPI
+ranks there (``spbase.py:184-216``, ``sputils.py:774-840``) with per-tree-node
+``Allreduce`` reductions (``phbase.py:27-107``, ``spbase.py:333-375``).  Here
+the whole scenario batch is sharded over a named mesh axis (``"scen"``); each
+device solves its local shard of subproblems inside ONE jitted program, and the
+per-node weighted averages are a one-hot contraction whose scenario-axis
+reduction XLA lowers to a psum over ICI.  No explicit communicator management:
+the mesh + sharding annotations replace ``comm.Split``.
+
+The functional core (:func:`make_ph_step`) is also the single-chip fast path:
+the same compiled step runs on one device with a trivial mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..solvers import admm
+from ..solvers.admm import ADMMSettings
+
+
+class PHArrays(NamedTuple):
+    """Device-resident, scenario-sharded problem data + tree indexing.
+
+    Leading axis S is sharded over the mesh ``scen`` axis; everything else is
+    replicated.  ``onehot`` is (S, K, N) node membership (nid one-hot), the
+    matmul form of per-node sub-communicators.
+    """
+
+    c: jax.Array        # (S, n)
+    q2: jax.Array       # (S, n)
+    A: jax.Array        # (S, m, n)
+    cl: jax.Array       # (S, m)
+    cu: jax.Array       # (S, m)
+    lb: jax.Array       # (S, n)
+    ub: jax.Array       # (S, n)
+    const: jax.Array    # (S,)
+    probs: jax.Array    # (S,)
+    onehot: jax.Array   # (S, K, N)
+    nid_sk: jax.Array   # (S, K) node id per nonant slot
+
+
+class PHState(NamedTuple):
+    """Per-iteration PH carry (all scenario-sharded)."""
+
+    W: jax.Array        # (S, K)
+    xbars: jax.Array    # (S, K)
+    rho: jax.Array      # (S, K)
+    x: jax.Array        # (S, n) last solution
+    z: jax.Array        # (S, m) ADMM aux
+    y: jax.Array        # (S, m) ADMM dual
+    yx: jax.Array       # (S, n) bound dual
+
+
+class PHStepOut(NamedTuple):
+    conv: jax.Array       # scalar: prob-weighted L1 deviation from xbar
+    eobj: jax.Array       # scalar: expected objective at current x
+    pri_res: jax.Array    # (S,)
+    dua_res: jax.Array    # (S,)
+
+
+def _node_xbar(onehot, probs, xk):
+    """Per-node weighted mean of nonants; per-scenario gather back.
+
+    The contraction over the scenario axis is the Allreduce analogue
+    (phbase.py:75-87): under a sharded-in jit, XLA emits one psum per einsum.
+    """
+    p = probs[:, None]
+    num = jnp.einsum("skn,sk->nk", onehot, p * xk)
+    sqnum = jnp.einsum("skn,sk->nk", onehot, p * xk * xk)
+    den = jnp.einsum("skn,sk->nk", onehot, jnp.broadcast_to(p, xk.shape))
+    den = jnp.maximum(den, 1e-300)
+    return num / den, sqnum / den
+
+
+def _gather_per_scenario(xbar_nk, nid_sk):
+    K = nid_sk.shape[1]
+    kidx = jnp.arange(K)[None, :]
+    return xbar_nk[nid_sk, kidx]
+
+
+def make_ph_step(nonant_idx: np.ndarray, settings: ADMMSettings):
+    """Build the jitted PH iteration: augmented-objective batch solve,
+    node-grouped xbar reduction, dual update, convergence metric.
+
+    ``nonant_idx`` is closed over (trace-time constant).  One compiled program
+    per (shapes, settings); PH iterations re-enter it with new state only —
+    the persistent-solver analogue (spopt.py:129-144).
+    """
+    idx = jnp.asarray(nonant_idx)
+
+    @jax.jit
+    def step(state: PHState, arr: PHArrays, prox_on):
+        dt = settings.jdtype()
+        W, xbars, rho = state.W.astype(dt), state.xbars.astype(dt), state.rho.astype(dt)
+        prox_on = jnp.asarray(prox_on, dt)
+        # attach_PH_to_objective (phbase.py:617-699) as a (q, q2) override;
+        # Iter0 solves with the plain objective (prox_on=0, W=0) but still
+        # performs the full xbar/W update afterwards (phbase.py:758-872).
+        q = arr.c.astype(dt).at[:, idx].add(W - prox_on * rho * xbars)
+        q2 = arr.q2.astype(dt).at[:, idx].add(prox_on * rho)
+        warm = (state.x, state.z, state.y, state.yx)
+        with jax.default_matmul_precision("highest"):
+            sol = admm._solve_impl(
+                q, q2, arr.A, arr.cl, arr.cu, arr.lb, arr.ub, settings, warm
+            )
+        xk = sol.x[:, idx]
+        xbar_nk, _ = _node_xbar(arr.onehot, arr.probs, xk)
+        new_xbars = _gather_per_scenario(xbar_nk, arr.nid_sk)
+        new_W = W + rho * (xk - new_xbars)
+        dev = jnp.abs(xk - new_xbars).mean(axis=1)
+        conv = arr.probs @ dev
+        lin = jnp.einsum("sn,sn->s", arr.c, sol.x)
+        quad = 0.5 * jnp.einsum("sn,sn->s", arr.q2, sol.x * sol.x)
+        eobj = arr.probs @ (lin + quad + arr.const)
+        new_state = PHState(
+            W=new_W, xbars=new_xbars, rho=rho,
+            x=sol.x, z=sol.z, y=sol.y, yx=sol.yx,
+        )
+        return new_state, PHStepOut(conv, eobj, sol.pri_res, sol.dua_res)
+
+    return step
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "scen") -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def shard_batch(batch, mesh: Mesh, axis: str = "scen") -> PHArrays:
+    """Place a :class:`~tpusppy.ir.ScenarioBatch` on the mesh, scenario-sharded.
+
+    Pads S up to a multiple of the mesh axis size with zero-probability copies
+    of scenario 0 — inert in every reduction (the batched analogue of uneven
+    scenario-to-rank maps, sputils.py:807-812).
+    """
+    S = batch.num_scenarios
+    nsh = mesh.shape[axis]
+    pad = (-S) % nsh
+    K = batch.tree.num_nonants
+    N = batch.tree.num_nodes
+    nid_sk = batch.tree.nid_sk()
+    probs = batch.probs
+
+    def padded(a):
+        if pad == 0:
+            return a
+        return np.concatenate([a, np.repeat(a[:1], pad, axis=0)], axis=0)
+
+    probs_p = np.concatenate([probs, np.zeros(pad)]) if pad else probs
+    nid_p = padded(nid_sk)
+    onehot = batch.tree.onehot_sk_n()
+    if pad:
+        # padded scenarios get zero membership so they never perturb reductions
+        onehot = np.concatenate([onehot, np.zeros((pad, K, N))], axis=0)
+
+    shard = NamedSharding(mesh, P(axis))
+
+    def put(a, spec=shard):
+        return jax.device_put(jnp.asarray(a), spec)
+
+    return PHArrays(
+        c=put(padded(batch.c)),
+        q2=put(padded(batch.q2)),
+        A=put(padded(batch.A)),
+        cl=put(padded(batch.cl)),
+        cu=put(padded(batch.cu)),
+        lb=put(padded(batch.lb)),
+        ub=put(padded(batch.ub)),
+        const=put(padded(batch.const)),
+        probs=put(probs_p),
+        onehot=put(onehot),
+        nid_sk=put(nid_p),
+    )
+
+
+def init_state(arr: PHArrays, default_rho: float, settings: ADMMSettings) -> PHState:
+    dt = settings.jdtype()
+    S, n = arr.c.shape
+    m = arr.cl.shape[1]
+    K = arr.nid_sk.shape[1]
+    shardS = lambda shape: jnp.zeros(shape, dt)
+    state = PHState(
+        W=shardS((S, K)),
+        xbars=shardS((S, K)),
+        rho=jnp.full((S, K), default_rho, dt),
+        x=shardS((S, n)),
+        z=shardS((S, m)),
+        y=shardS((S, m)),
+        yx=shardS((S, n)),
+    )
+    # match the data shardings so the first step doesn't reshard
+    like = jax.tree.map(
+        lambda a: a.sharding,
+        PHState(arr.nid_sk, arr.nid_sk, arr.nid_sk, arr.c, arr.cl, arr.cl, arr.c),
+    )
+    return jax.tree.map(lambda a, s: jax.device_put(a, s), state, like)
+
+
+def run_ph(batch, mesh: Mesh, iters: int, default_rho: float = 1.0,
+           settings: ADMMSettings | None = None, axis: str = "scen"):
+    """Sharded PH driver: Iter0 (plain objective via rho=W=0 warmup step
+    semantics) + ``iters`` PH iterations.  Returns (state, last PHStepOut).
+
+    Used by ``__graft_entry__.dryrun_multichip`` and ``bench.py``; the class
+    API (:class:`tpusppy.opt.ph.PH`) remains the feature-complete host path.
+    """
+    settings = settings or ADMMSettings()
+    arr = shard_batch(batch, mesh, axis)
+    step = make_ph_step(batch.tree.nonant_indices, settings)
+    state = init_state(arr, default_rho, settings)
+    # Iter0: W=0, prox off, cf. phbase.py:758-872
+    state, out = step(state, arr, 0.0)
+    for _ in range(iters):
+        state, out = step(state, arr, 1.0)
+    return state, out

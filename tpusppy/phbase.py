@@ -52,11 +52,7 @@ class PHBase(SPOpt):
         self.spcomm = None
 
         # Precompute node-membership one-hot for xbar contraction: (S, K) -> N
-        N = self.tree.num_nodes
-        self._onehot = np.zeros((S, K, N))
-        sidx = np.arange(S)[:, None]
-        kidx = np.arange(K)[None, :]
-        self._onehot[sidx, kidx, self.nid_sk] = 1.0
+        self._onehot = self.tree.onehot_sk_n()
 
     @property
     def is_minimizing(self):

@@ -112,6 +112,28 @@ class TreeInfo:
     def num_nonants(self) -> int:
         return int(self.nonant_indices.shape[0])
 
+    def nid_sk(self) -> np.ndarray:
+        """(S, K) node-id owning each packed nonant slot, per scenario.
+
+        The single source of truth for the node-grouping index used by host PH
+        (Compute_Xbar), the sharded jitted step, and EF column merging."""
+        S = self.scen_node_ids.shape[0]
+        K = self.num_nonants
+        return np.take_along_axis(
+            self.scen_node_ids,
+            np.broadcast_to(self.nonant_stage[None, :] - 1, (S, K)),
+            axis=1,
+        ).astype(np.int32)
+
+    def onehot_sk_n(self) -> np.ndarray:
+        """(S, K, N) one-hot of :meth:`nid_sk` — the matmul form of per-node
+        sub-communicators (replaces one Allreduce per node, phbase.py:75-87)."""
+        nid = self.nid_sk()
+        S, K = nid.shape
+        oh = np.zeros((S, K, self.num_nodes))
+        oh[np.arange(S)[:, None], np.arange(K)[None, :], nid] = 1.0
+        return oh
+
     def membership_matrix(self) -> np.ndarray:
         """(N, S) 0/1 node-membership over scenarios, any stage.
 

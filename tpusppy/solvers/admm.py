@@ -75,6 +75,16 @@ class _Scaling(NamedTuple):
     cost: jax.Array    # (S,) objective scaling
 
 
+class _BoundMasks(NamedTuple):
+    """Finiteness/equality classification of the UNSCALED bounds."""
+
+    fin_cl: jax.Array  # (S, m) lower row bound finite
+    fin_cu: jax.Array  # (S, m) upper row bound finite
+    fin_lb: jax.Array  # (S, n) lower var bound finite
+    fin_ub: jax.Array  # (S, n) upper var bound finite
+    eq: jax.Array      # (S, m) equality row
+
+
 def _clean_bounds(lo, hi):
     lo = jnp.nan_to_num(lo, nan=-BIG, neginf=-BIG, posinf=BIG)
     hi = jnp.nan_to_num(hi, nan=BIG, neginf=-BIG, posinf=BIG)
@@ -202,12 +212,15 @@ def _admm_core(q, q2, A, cl, cu, lb, ub, state, LK, rho_a, rho_x, st: ADMMSettin
     return jax.lax.while_loop(cont, step, state)
 
 
-def _solve_scaled(q, q2, A, cl, cu, lb, ub, warm, st: ADMMSettings):
-    """Adaptive-rho outer loop; everything already Ruiz-scaled."""
+def _solve_scaled(q, q2, A, cl, cu, lb, ub, warm, masks, st: ADMMSettings):
+    """Adaptive-rho outer loop; everything already Ruiz-scaled.
+
+    ``masks`` carries finiteness/equality classifications computed from the
+    UNSCALED bounds (scaling can shrink +/-BIG below the BIG/2 test)."""
     S, m, n = A.shape
     dt = A.dtype
-    eq = jnp.abs(cu - cl) < 1e-10
-    loose = (cl <= -BIG / 2) & (cu >= BIG / 2)
+    eq = masks.eq
+    loose = ~masks.fin_cl & ~masks.fin_cu
 
     def rho_vec(base):
         r = jnp.where(eq, base * st.rho_eq_scale, base)
@@ -255,7 +268,8 @@ def _solve_scaled(q, q2, A, cl, cu, lb, ub, warm, st: ADMMSettings):
     return state, total
 
 
-def _polish(state: _IterState, q, q2, A, cl, cu, lb, ub, st: ADMMSettings):
+def _polish(state: _IterState, q, q2, A, cl, cu, lb, ub, masks,
+            st: ADMMSettings):
     """OSQP-style polish: guess the active set from dual signs + slacks, solve
     the resulting equality-constrained KKT system exactly, and accept per
     scenario only where it improves the worst residual.
@@ -268,21 +282,22 @@ def _polish(state: _IterState, q, q2, A, cl, cu, lb, ub, st: ADMMSettings):
     S, m, n = A.shape
     dt = A.dtype
     # Per-side activity tolerances; an infinite side is never active.
-    fin_cl, fin_cu = cl > -BIG / 2, cu < BIG / 2
+    # Finiteness comes from the UNSCALED bounds via ``masks``.
+    fin_cl, fin_cu = masks.fin_cl, masks.fin_cu
     tol_cl = 1e-6 * (1.0 + jnp.where(fin_cl, jnp.abs(cl), 0.0))
     tol_cu = 1e-6 * (1.0 + jnp.where(fin_cu, jnp.abs(cu), 0.0))
     ytol = 1e-6 * jnp.maximum(jnp.max(jnp.abs(state.y), axis=1, keepdims=True), 1.0)
     act_lo = ((state.y < -ytol) | (state.z < cl + tol_cl)) & fin_cl
     act_up = ((state.y > ytol) | (state.z > cu - tol_cu)) & fin_cu
 
-    fin_lb, fin_ub = lb > -BIG / 2, ub < BIG / 2
+    fin_lb, fin_ub = masks.fin_lb, masks.fin_ub
     tol_lb = 1e-6 * (1.0 + jnp.where(fin_lb, jnp.abs(lb), 0.0))
     tol_ub = 1e-6 * (1.0 + jnp.where(fin_ub, jnp.abs(ub), 0.0))
     yxtol = 1e-6 * jnp.maximum(jnp.max(jnp.abs(state.yx), axis=1, keepdims=True), 1.0)
     v_lo = ((state.yx < -yxtol) | (state.zx < lb + tol_lb)) & fin_lb
     v_up = ((state.yx > yxtol) | (state.zx > ub - tol_ub)) & fin_ub
 
-    eq = jnp.abs(cu - cl) < 1e-10
+    eq = masks.eq
 
     N = n + m + n
     eye_n = jnp.eye(n, dtype=dt)[None]
@@ -327,8 +342,8 @@ def _polish(state: _IterState, q, q2, A, cl, cu, lb, ub, st: ADMMSettings):
         # equality rows are always active on both sides
         act_lo = act_lo | eq
         act_up = act_up | eq
-        v_lo = ((v_lo & ~(yxp > ftol)) | (xp < lb - ftol)) & (lb > -BIG / 2)
-        v_up = ((v_up & ~(yxp < -ftol)) | (xp > ub + ftol)) & (ub < BIG / 2)
+        v_lo = ((v_lo & ~(yxp > ftol)) | (xp < lb - ftol)) & fin_lb
+        v_up = ((v_up & ~(yxp < -ftol)) | (xp > ub + ftol)) & fin_ub
         return act_lo, act_up, v_lo, v_up
 
     sets = (act_lo | eq, act_up | eq, v_lo, v_up)
@@ -378,6 +393,11 @@ def _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm) -> BatchSolution:
     c, q2, A = (jnp.asarray(v, dt) for v in (c, q2, A))
     cl, cu = _clean_bounds(jnp.asarray(cl, dt), jnp.asarray(cu, dt))
     lb, ub = _clean_bounds(jnp.asarray(lb, dt), jnp.asarray(ub, dt))
+    masks = _BoundMasks(
+        fin_cl=cl > -BIG / 2, fin_cu=cu < BIG / 2,
+        fin_lb=lb > -BIG / 2, fin_ub=ub < BIG / 2,
+        eq=jnp.abs(cu - cl) < 1e-10,
+    )
 
     D, E = _ruiz(A, q2, settings.scaling_iters)
     As = A * E[:, :, None] * D[:, None, :]
@@ -398,9 +418,10 @@ def _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm) -> BatchSolution:
             jnp.asarray(yx0, dt) * D * cost[:, None],
         )
 
-    state, total = _solve_scaled(qs, q2s, As, cls, cus, lbs, ubs, warm, settings)
+    state, total = _solve_scaled(qs, q2s, As, cls, cus, lbs, ubs, warm, masks,
+                                 settings)
     if settings.polish:
-        state = _polish(state, qs, q2s, As, cls, cus, lbs, ubs, settings)
+        state = _polish(state, qs, q2s, As, cls, cus, lbs, ubs, masks, settings)
 
     x = state.x * D
     z = state.z / E

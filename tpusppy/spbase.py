@@ -67,14 +67,7 @@ class SPBase:
 
         # Node-grouping arrays (replace per-node comm.Split, spbase.py:333-375):
         # nid_sk[s, k] = node-id owning nonant slot k in scenario s.
-        K = self.tree.num_nonants
-        S = self.batch.num_scenarios
-        stages = self.tree.nonant_stage  # (K,) 1-based
-        self.nid_sk = np.take_along_axis(
-            self.tree.scen_node_ids,
-            np.broadcast_to(stages[None, :] - 1, (S, K)),
-            axis=1,
-        ).astype(np.int32)
+        self.nid_sk = self.tree.nid_sk()
 
         self.admm_settings = self._make_admm_settings()
 

@@ -12,7 +12,6 @@ psums on the scenario axis.
 from __future__ import annotations
 
 import numpy as np
-import jax.numpy as jnp
 
 from .spbase import SPBase
 from .solvers import admm
