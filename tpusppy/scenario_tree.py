@@ -62,6 +62,17 @@ def attach_root_node(problem, nonant_indices, cost_coeffs=None):
     return problem
 
 
+def create_nodenames_from_branching_factors(branching_factors) -> list:
+    """All nonleaf node names of a balanced tree (cf. sputils.py
+    create_nodenames_from_BFs): ROOT plus ROOT_i..., excluding leaves."""
+    names = ["ROOT"]
+    frontier = ["ROOT"]
+    for bf in branching_factors[:-1]:
+        frontier = [f"{p}_{i}" for p in frontier for i in range(bf)]
+        names.extend(frontier)
+    return names
+
+
 def extract_num(name: str) -> int:
     """Scrape trailing digits off a scenario name (cf. sputils.extract_num)."""
     m = re.search(r"(\d+)$", name)
