@@ -1,0 +1,28 @@
+"""XhatClosest: evaluate the scenario whose nonants are closest to xbar.
+
+Analogue of ``mpisppy/extensions/xhatclosest.py``: pick the scenario minimizing
+||x_s - xbar||^2 over the nonant slots and try it as the donor.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .xhatbase import XhatBase
+
+
+class XhatClosest(XhatBase):
+    def _try(self):
+        opt = self.opt
+        xbars = getattr(opt, "xbars", None)
+        if xbars is None:
+            return None
+        xk = opt.nonants_of(opt.local_x)
+        dist = ((xk - xbars) ** 2).sum(axis=1)
+        return self.try_scenario(int(np.argmin(dist)))
+
+    def post_iter0(self):
+        self._try()
+
+    def enditer(self):
+        self._try()

@@ -1,0 +1,39 @@
+"""XhatSpecific: evaluate a fixed scenario-per-node candidate each iteration.
+
+Analogue of ``mpisppy/extensions/xhatspecific.py`` (and the spoke at
+cylinders/xhatspecific_bounder.py): the user names one donor scenario per
+nonleaf tree node (``xhat_specific_dict``: {node_name: scenario name or
+index}); each callout evaluates that candidate.
+"""
+
+from __future__ import annotations
+
+from .xhatbase import XhatBase, donor_cache
+
+
+class XhatSpecific(XhatBase):
+    def __init__(self, spopt_object):
+        super().__init__(spopt_object)
+        spec = self.opt.options.get("xhat_specific_options", {}).get(
+            "xhat_specific_dict"
+        ) or self.opt.options.get("xhat_specific_dict")
+        if spec is None:
+            raise RuntimeError("XhatSpecific requires options['xhat_specific_dict']")
+        names = self.opt.all_scenario_names
+        self.donors = {
+            node: (names.index(s) if isinstance(s, str) else int(s))
+            for node, s in spec.items()
+        }
+
+    def _try(self):
+        xk = self.opt.nonants_of(self.opt.local_x)
+        cache = donor_cache(self.opt, xk, self.donors)
+        obj = self._try_one(cache)
+        self._update_if_improving(obj, cache)
+        return obj
+
+    def post_iter0(self):
+        self._try()
+
+    def enditer(self):
+        self._try()

@@ -70,14 +70,19 @@ def scenario_creator(scenario_name, branching_factors=None, data_path=None):
     if branching_factors is None:
         branching_factors = [3, 3]
     b1, b2 = branching_factors
+    if b1 > len(INFLOW_STAGE2) or b2 > len(INFLOW_STAGE3):
+        raise ValueError(
+            f"hydro has {len(INFLOW_STAGE2)}x{len(INFLOW_STAGE3)} inflow "
+            f"realizations; branching_factors {branching_factors} unsupported"
+        )
     snum = extract_num(scenario_name)             # 1-based
     branch = (snum - 1) // b2                     # stage-2 node index
     leaf = (snum - 1) % b2                        # stage-3 branch index
 
     inflow = np.array([
         INFLOW_STAGE1,
-        INFLOW_STAGE2[branch % len(INFLOW_STAGE2)],
-        INFLOW_STAGE3[leaf % len(INFLOW_STAGE3)],
+        INFLOW_STAGE2[branch],
+        INFLOW_STAGE3[leaf],
     ])
 
     b = LinearModelBuilder(scenario_name)

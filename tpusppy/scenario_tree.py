@@ -63,11 +63,12 @@ def attach_root_node(problem, nonant_indices, cost_coeffs=None):
 
 
 def create_nodenames_from_branching_factors(branching_factors) -> list:
-    """All nonleaf node names of a balanced tree (cf. sputils.py
-    create_nodenames_from_BFs): ROOT plus ROOT_i..., excluding leaves."""
+    """All node names of a balanced tree, leaves included — same semantics as
+    the reference's ``sputils.create_nodenames_from_BFs`` (sputils.py:934).
+    Callers wanting only nonleaf names drop the last level themselves."""
     names = ["ROOT"]
     frontier = ["ROOT"]
-    for bf in branching_factors[:-1]:
+    for bf in branching_factors:
         frontier = [f"{p}_{i}" for p in frontier for i in range(bf)]
         names.extend(frontier)
     return names

@@ -1,0 +1,73 @@
+"""Xhat_Eval: fix-and-evaluate candidate first-stage solutions.
+
+TPU-native analogue of ``mpisppy/utils/xhat_eval.py:29-434``.  The reference
+fixes the nonant Pyomo variables to a candidate and re-solves every scenario
+through the external solver (``evaluate`` / ``evaluate_one``,
+xhat_eval.py:261-330).  Here "fixing" is a bound clamp on the nonant columns of
+the HBM-resident batch (lb = ub = candidate) and the evaluation is one batched
+ADMM solve — so trying a candidate costs a single device program, which is what
+makes the inner-bound spokes (xhatshuffle et al.) cheap.
+
+Feasibility of the fixed problem is judged by the solver's primal residual
+(the analogue of spopt.py:175-195 solver-status checks); an infeasible
+candidate evaluates to +inf (for minimization).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .spopt import SPOpt
+
+
+class Xhat_Eval(SPOpt):
+    """An SPOpt that evaluates fixed first-stage candidates.
+
+    Typical use (mirrors xhat_eval.py:261-330)::
+
+        ev = Xhat_Eval(options, names, scenario_creator, ...)
+        z_hat = ev.evaluate(nonant_cache)   # expected objective, or +inf
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.tee_rank0_solves = False
+
+    def _fix_and_solve(self, nonant_cache):
+        """Clamp nonants to the candidate and solve the whole batch.
+
+        ``nonant_cache``: (K,) single candidate shared by all scenarios, or
+        (S, K) per-scenario (multistage xhats fix per-node values; scenarios of
+        one node must carry identical values there).
+        """
+        self.fix_nonants(nonant_cache)
+        try:
+            # cold start: the clamped problem's geometry differs enough that
+            # stale warm duals slow ADMM down rather than help
+            x = self.solve_loop(warm=False)
+        finally:
+            self.restore_nonants()
+        return x
+
+    def evaluate_one(self, nonant_cache, scenario_index: int) -> float:
+        """Objective of ONE scenario at the fixed candidate
+        (xhat_eval.py:261-292)."""
+        x = self._fix_and_solve(nonant_cache)
+        if self.pri_res is not None:
+            tol = self.options.get("feas_tol", 1e-3)
+            if self.pri_res[scenario_index] > tol:
+                return np.inf
+        return float(self.batch.objective(x)[scenario_index])
+
+    def evaluate(self, nonant_cache) -> float:
+        """Expected objective at the fixed candidate; +inf if any scenario is
+        infeasible (xhat_eval.py:293-330 + feas_prob check)."""
+        x = self._fix_and_solve(nonant_cache)
+        if self.feas_prob() < 1.0 - 1e-9:
+            return np.inf
+        return float(self.probs @ self.batch.objective(x))
+
+    def objective_values(self, nonant_cache) -> np.ndarray:
+        """(S,) per-scenario objectives at the fixed candidate."""
+        x = self._fix_and_solve(nonant_cache)
+        return self.batch.objective(x)
