@@ -60,7 +60,7 @@ def main():
 
     mesh = sharded.make_mesh()
     arr = sharded.shard_batch(batch, mesh)
-    step = sharded.make_ph_step(batch.tree.nonant_indices, settings)
+    step = sharded.make_ph_step(batch.tree.nonant_indices, settings, mesh)
     state = sharded.init_state(arr, 1.0, settings)
 
     # warmup/compile + Iter0
@@ -69,9 +69,12 @@ def main():
     jax.block_until_ready(out.conv)
     log(f"compile+iter0: {time.time() - t0:.1f}s eobj={float(out.eobj):.2f}")
 
+    window = sharded.dispatch_window(mesh)
     t0 = time.time()
-    for _ in range(iters):
+    for i in range(iters):
         state, out = step(state, arr, 1.0)
+        if (i + 1) % window == 0:
+            jax.block_until_ready(out.conv)
     jax.block_until_ready(out.conv)
     dt_ours = (time.time() - t0) / iters
     iters_per_sec = 1.0 / dt_ours

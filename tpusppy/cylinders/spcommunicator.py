@@ -1,0 +1,143 @@
+"""Cylinder communication fabric: versioned mailboxes + SPCommunicator base.
+
+TPU-native analogue of ``mpisppy/cylinders/spcommunicator.py:21-120``.  The
+reference exchanges flat float64 vectors between cylinder process groups
+through one-sided MPI RMA windows whose last slot is a monotone **write_id**;
+readers accept a payload only when the id is fresh, and id ``-1`` is the kill
+signal (hub.py:370-450, spoke.py:60-118).
+
+Here cylinders are host *threads* of one process (each driving its own jitted
+device programs; a single TPU mesh is time-sliced through the device queue),
+so the RMA window becomes a lock-guarded in-memory :class:`Mailbox` with
+identical write-id semantics.  The protocol — not the transport — is the
+contract: the planned C++ shared-memory window service (for multi-process /
+multi-host cylinders over DCN) implements this same class interface, which is
+why reads return ``(data, write_id)`` pairs instead of sharing state.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+KILL_ID = -1
+
+
+class Mailbox:
+    """A versioned one-writer many-reader buffer (the RMA-window analogue).
+
+    The payload is ``length`` float64 slots; a trailing write-id slot is kept
+    internally (buf[-1]), exactly mirroring ``_make_window``'s +1 layout
+    (spcommunicator.py:93-120).
+    """
+
+    def __init__(self, length: int, name: str = ""):
+        self.name = name
+        self.length = int(length)
+        self._buf = np.zeros(self.length + 1)
+        self._lock = threading.Lock()
+
+    def put(self, values) -> int:
+        """Owner-side Put: write payload, bump write_id (spoke.py:60-82)."""
+        values = np.asarray(values, dtype=np.float64)
+        if values.shape != (self.length,):
+            raise RuntimeError(
+                f"Mailbox {self.name}: putting length {values.shape} into "
+                f"buffer of length {self.length}"
+            )
+        with self._lock:
+            if int(self._buf[-1]) == KILL_ID:
+                # the kill sentinel is terminal: a late writer must not
+                # resurrect the mailbox (readers treat -1 as final)
+                return KILL_ID
+            new_id = int(self._buf[-1]) + 1
+            self._buf[:-1] = values
+            self._buf[-1] = new_id
+        return new_id
+
+    def get(self) -> tuple[np.ndarray, int]:
+        """Reader-side Get: snapshot (payload copy, write_id)."""
+        with self._lock:
+            return self._buf[:-1].copy(), int(self._buf[-1])
+
+    def kill(self):
+        """Write the termination sentinel (write_id = -1, hub.py:438-450)."""
+        with self._lock:
+            self._buf[:-1] = 0.0
+            self._buf[-1] = KILL_ID
+
+    @property
+    def write_id(self) -> int:
+        with self._lock:
+            return int(self._buf[-1])
+
+
+class WindowFabric:
+    """The set of hub<->spoke mailboxes for one wheel (the star graph).
+
+    For each spoke strata rank i (1-based, hub is 0): ``to_spoke[i]`` is the
+    hub-owned outbound window, ``to_hub[i]`` the spoke-owned inbound one —
+    matching the reference's per-spoke window pairs (hub.py:345-368,
+    spoke.py:34-58).
+    """
+
+    def __init__(self):
+        self.to_spoke: dict[int, Mailbox] = {}
+        self.to_hub: dict[int, Mailbox] = {}
+
+    def add_spoke(self, strata_rank: int, hub_to_spoke_len: int,
+                  spoke_to_hub_len: int):
+        self.to_spoke[strata_rank] = Mailbox(
+            hub_to_spoke_len, f"hub->spoke{strata_rank}"
+        )
+        self.to_hub[strata_rank] = Mailbox(
+            spoke_to_hub_len, f"spoke{strata_rank}->hub"
+        )
+
+    @property
+    def n_spokes(self) -> int:
+        return len(self.to_spoke)
+
+    def send_terminate(self):
+        for mb in self.to_spoke.values():
+            mb.kill()
+
+
+class SPCommunicator:
+    """Base for hub/spoke communicators (spcommunicator.py:21-92).
+
+    Owns the opt object (an SPBase derivative) and its strata position.
+    Subclasses implement ``main``; ``sync``/``is_converged``/``finalize`` are
+    optional hooks invoked by the opt object's iteration loop.
+    """
+
+    def __init__(self, spbase_object, strata_rank: int, fabric: WindowFabric,
+                 options=None):
+        self.opt = spbase_object
+        self.strata_rank = int(strata_rank)
+        self.fabric = fabric
+        self.options = dict(options or {})
+        self.inst_time = time.time()
+        self.opt.spcomm = self
+
+    @property
+    def n_spokes(self) -> int:
+        return self.fabric.n_spokes
+
+    def main(self):
+        raise NotImplementedError
+
+    def sync(self):
+        pass
+
+    def is_converged(self):
+        return False
+
+    def finalize(self):
+        """Optional final calculations after convergence."""
+        pass
+
+    def hub_finalize(self):
+        pass

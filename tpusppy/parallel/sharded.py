@@ -88,15 +88,44 @@ def _gather_per_scenario(xbar_nk, nid_sk):
     return xbar_nk[nid_sk, kidx]
 
 
-def make_ph_step(nonant_idx: np.ndarray, settings: ADMMSettings):
+def make_ph_step(nonant_idx: np.ndarray, settings: ADMMSettings,
+                 mesh: Mesh | None = None, axis: str = "scen"):
     """Build the jitted PH iteration: augmented-objective batch solve,
     node-grouped xbar reduction, dual update, convergence metric.
 
     ``nonant_idx`` is closed over (trace-time constant).  One compiled program
     per (shapes, settings); PH iterations re-enter it with new state only —
     the persistent-solver analogue (spopt.py:129-144).
+
+    When ``mesh`` is given, the ADMM solve runs under ``jax.shard_map`` so its
+    data-dependent ``while_loop`` terminates on *device-local* residuals only —
+    the solve is embarrassingly scenario-parallel, and keeping collectives out
+    of the loop predicate means no cross-device rendezvous per inner iteration
+    (which both deadlocks XLA's CPU in-process collectives when trip counts
+    diverge and would serialize ICI traffic on real meshes).  The only
+    collective left is the psum XLA inserts for the node-grouped xbar
+    contraction below — exactly the reference's one-Allreduce-per-node
+    structure (phbase.py:75-87).
     """
     idx = jnp.asarray(nonant_idx)
+
+    def local_solve(q, q2, A, cl, cu, lb, ub, x, z, y, yx):
+        with jax.default_matmul_precision("highest"):
+            return admm._solve_impl(
+                q, q2, A, cl, cu, lb, ub, settings, (x, z, y, yx)
+            )
+
+    if mesh is not None:
+        sp = jax.sharding.PartitionSpec(axis)
+        sharded_solve = jax.shard_map(
+            local_solve, mesh=mesh, in_specs=(sp,) * 11,
+            out_specs=admm.BatchSolution(*([sp] * 7)),
+            # the solver seeds loop carries with literals (ones/zeros); skip
+            # the varying-manual-axes typecheck rather than pcast each one
+            check_vma=False,
+        )
+    else:
+        sharded_solve = local_solve
 
     @jax.jit
     def step(state: PHState, arr: PHArrays, prox_on):
@@ -108,11 +137,10 @@ def make_ph_step(nonant_idx: np.ndarray, settings: ADMMSettings):
         # performs the full xbar/W update afterwards (phbase.py:758-872).
         q = arr.c.astype(dt).at[:, idx].add(W - prox_on * rho * xbars)
         q2 = arr.q2.astype(dt).at[:, idx].add(prox_on * rho)
-        warm = (state.x, state.z, state.y, state.yx)
-        with jax.default_matmul_precision("highest"):
-            sol = admm._solve_impl(
-                q, q2, arr.A, arr.cl, arr.cu, arr.lb, arr.ub, settings, warm
-            )
+        sol = sharded_solve(
+            q, q2, arr.A, arr.cl, arr.cu, arr.lb, arr.ub,
+            state.x, state.z, state.y, state.yx,
+        )
         xk = sol.x[:, idx]
         xbar_nk, _ = _node_xbar(arr.onehot, arr.probs, xk)
         new_xbars = _gather_per_scenario(xbar_nk, arr.nid_sk)
@@ -129,6 +157,19 @@ def make_ph_step(nonant_idx: np.ndarray, settings: ADMMSettings):
         return new_state, PHStepOut(conv, eobj, sol.pri_res, sol.dua_res)
 
     return step
+
+
+def dispatch_window(mesh: Mesh) -> int:
+    """How many step dispatches may be in flight before blocking.
+
+    XLA's CPU in-process collectives have a hard 40s rendezvous timeout, and
+    dozens of queued multi-device runs on an oversubscribed host starve a
+    given run's all-reduce past it (observed as "Expected 8 threads to join
+    ... only 7 arrived" aborts).  A small window keeps device/host overlap
+    without unbounded queueing; single-device meshes have no rendezvous and
+    can pipeline deep.
+    """
+    return 4 if len(mesh.devices.flat) > 1 else 64
 
 
 def make_mesh(n_devices: int | None = None, axis: str = "scen") -> Mesh:
@@ -218,10 +259,13 @@ def run_ph(batch, mesh: Mesh, iters: int, default_rho: float = 1.0,
     """
     settings = settings or ADMMSettings()
     arr = shard_batch(batch, mesh, axis)
-    step = make_ph_step(batch.tree.nonant_indices, settings)
+    step = make_ph_step(batch.tree.nonant_indices, settings, mesh, axis)
     state = init_state(arr, default_rho, settings)
+    window = dispatch_window(mesh)
     # Iter0: W=0, prox off, cf. phbase.py:758-872
     state, out = step(state, arr, 0.0)
-    for _ in range(iters):
+    for i in range(iters):
         state, out = step(state, arr, 1.0)
+        if (i + 1) % window == 0:
+            jax.block_until_ready(out.conv)
     return state, out
