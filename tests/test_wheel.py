@@ -1,0 +1,110 @@
+"""Cylinder fabric: mailbox protocol + a full hub/spoke wheel on farmer.
+
+Mirrors the reference's integration posture (SURVEY §4: cylinder drivers are
+exercised end-to-end and judged by exit status / gap), plus protocol unit
+tests for the write-id mailbox (the analogue of mpi_one_sided_test.py).
+"""
+
+import numpy as np
+import pytest
+
+from tpusppy.cylinders import (
+    KILL_ID,
+    LagrangianOuterBound,
+    Mailbox,
+    PHHub,
+    XhatShuffleInnerBound,
+)
+from tpusppy.models import farmer
+from tpusppy.opt.ph import PH
+from tpusppy.phbase import PHBase
+from tpusppy.spin_the_wheel import WheelSpinner
+from tpusppy.xhat_eval import Xhat_Eval
+
+
+def test_mailbox_write_id_protocol():
+    mb = Mailbox(3)
+    data, wid = mb.get()
+    assert wid == 0
+    assert mb.put(np.array([1.0, 2.0, 3.0])) == 1
+    data, wid = mb.get()
+    assert wid == 1 and np.array_equal(data, [1.0, 2.0, 3.0])
+    assert mb.put(np.array([4.0, 5.0, 6.0])) == 2
+    mb.kill()
+    data, wid = mb.get()
+    assert wid == KILL_ID
+    # the kill sentinel is terminal: a late put must not resurrect the box
+    assert mb.put(np.array([7.0, 8.0, 9.0])) == KILL_ID
+    _, wid = mb.get()
+    assert wid == KILL_ID
+
+
+def test_mailbox_length_check():
+    mb = Mailbox(2)
+    with pytest.raises(RuntimeError):
+        mb.put(np.zeros(3))
+
+
+def _farmer_opt_kwargs(n, iters=40):
+    return {
+        "options": {
+            "defaultPHrho": 1.0,
+            "PHIterLimit": iters,
+            "convthresh": -1.0,
+            "xhat_looper_options": {"scen_limit": 3},
+        },
+        "all_scenario_names": farmer.scenario_names_creator(n),
+        "scenario_creator": farmer.scenario_creator,
+        "scenario_creator_kwargs": {"num_scens": n},
+    }
+
+
+def test_wheel_farmer_lagrangian_xhatshuffle():
+    """PH hub + Lagrangian outer + XhatShuffle inner: the minimum full wheel
+    (the farmer_cylinders.py analogue).  Certified gap must close."""
+    n = 3
+    hub_dict = {
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": {"rel_gap": 1e-3, "abs_gap": 1.0}},
+        "opt_class": PH,
+        "opt_kwargs": _farmer_opt_kwargs(n),
+    }
+    lagrangian = {
+        "spoke_class": LagrangianOuterBound,
+        "spoke_kwargs": {},
+        "opt_class": PHBase,
+        "opt_kwargs": _farmer_opt_kwargs(n),
+    }
+    xhat = {
+        "spoke_class": XhatShuffleInnerBound,
+        "spoke_kwargs": {},
+        "opt_class": Xhat_Eval,
+        "opt_kwargs": _farmer_opt_kwargs(n),
+    }
+    ws = WheelSpinner(hub_dict, [lagrangian, xhat]).spin()
+
+    ef_obj = -108390.0
+    assert ws.BestInnerBound == pytest.approx(ef_obj, rel=2e-3)
+    assert ws.BestOuterBound <= ws.BestInnerBound + 1e-6
+    # outer bound must at least reach the trivial (wait-and-see) bound level
+    assert ws.BestOuterBound >= -115405.6
+    gap = ws.BestInnerBound - ws.BestOuterBound
+    assert gap <= max(1.0, 1e-3 * abs(ws.BestOuterBound))
+    # solution cache: root-stage acres sum to <= 500 (farmer land)
+    cache = ws.local_nonant_cache
+    assert cache is not None
+    assert cache[0].sum() <= 500 + 1e-4
+
+
+def test_wheel_hub_only():
+    """A wheel with no spokes degrades to plain PH (serial fallback posture)."""
+    n = 3
+    hub_dict = {
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": {}},
+        "opt_class": PH,
+        "opt_kwargs": _farmer_opt_kwargs(n, iters=5),
+    }
+    ws = WheelSpinner(hub_dict, []).spin()
+    assert ws.spun
+    assert np.isfinite(ws.spcomm.BestOuterBound)

@@ -1,0 +1,26 @@
+"""Cylinder (hub/spoke) fabric — versioned mailboxes, hubs, spokes.
+
+TPU-native analogue of ``mpisppy/cylinders/`` (SURVEY §1 L4).
+"""
+
+from .spcommunicator import KILL_ID, Mailbox, SPCommunicator, WindowFabric
+from .spoke import (
+    ConvergerSpokeType,
+    InnerBoundNonantSpoke,
+    InnerBoundSpoke,
+    OuterBoundNonantSpoke,
+    OuterBoundSpoke,
+    OuterBoundWSpoke,
+    Spoke,
+)
+from .hub import Hub, PHHub
+from .lagrangian_bounder import LagrangianOuterBound
+from .xhatshufflelooper_bounder import ScenarioCycler, XhatShuffleInnerBound
+
+__all__ = [
+    "KILL_ID", "Mailbox", "SPCommunicator", "WindowFabric",
+    "ConvergerSpokeType", "Spoke", "InnerBoundSpoke", "OuterBoundSpoke",
+    "OuterBoundWSpoke", "InnerBoundNonantSpoke", "OuterBoundNonantSpoke",
+    "Hub", "PHHub", "LagrangianOuterBound", "ScenarioCycler",
+    "XhatShuffleInnerBound",
+]

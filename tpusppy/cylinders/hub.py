@@ -1,0 +1,270 @@
+"""Hub communicators: bound bookkeeping, gap termination, spoke traffic.
+
+TPU-native analogue of ``mpisppy/cylinders/hub.py:23-771``.  The hub owns the
+optimization object (PH here), pushes W / nonant / bound payloads into the
+per-spoke outbound mailboxes each ``sync()`` (hub.py:501-514), pulls spoke
+bounds with write-id freshness checks (hub.py:174-200,396-436), tracks the
+best inner/outer bounds, and terminates the wheel on ``rel_gap`` / ``abs_gap``
+/ ``max_stalled_iters`` (hub.py:77-161) by broadcasting the kill sentinel
+(hub.py:438-450).
+"""
+
+from __future__ import annotations
+
+from math import inf
+
+import numpy as np
+
+from .. import global_toc
+from .spcommunicator import SPCommunicator
+from .spoke import ConvergerSpokeType
+
+
+class Hub(SPCommunicator):
+    """Base hub (hub.py:23-450)."""
+
+    def __init__(self, spbase_object, strata_rank, fabric, spokes,
+                 options=None):
+        super().__init__(spbase_object, strata_rank, fabric, options)
+        self.spokes = list(spokes)           # list of dicts with spoke_class
+        self.remote_write_ids = {}           # spoke idx -> last accepted id
+        self.latest_ib_char = None
+        self.latest_ob_char = None
+        self.print_init = True
+        self.stalled_iter_cnt = 0
+        self.last_gap = inf
+
+    # ---- spoke typing (hub.py:297-344) --------------------------------------
+    def initialize_spoke_indices(self):
+        self.outerbound_spoke_indices = set()
+        self.innerbound_spoke_indices = set()
+        self.nonant_spoke_indices = set()
+        self.w_spoke_indices = set()
+        self.outerbound_spoke_chars = {}
+        self.innerbound_spoke_chars = {}
+        for i, spoke in enumerate(self.spokes):
+            cls = spoke["spoke_class"]
+            for cst in getattr(cls, "converger_spoke_types", ()):
+                if cst == ConvergerSpokeType.OUTER_BOUND:
+                    self.outerbound_spoke_indices.add(i + 1)
+                    self.outerbound_spoke_chars[i + 1] = cls.converger_spoke_char
+                elif cst == ConvergerSpokeType.INNER_BOUND:
+                    self.innerbound_spoke_indices.add(i + 1)
+                    self.innerbound_spoke_chars[i + 1] = cls.converger_spoke_char
+                elif cst == ConvergerSpokeType.W_GETTER:
+                    self.w_spoke_indices.add(i + 1)
+                elif cst == ConvergerSpokeType.NONANT_GETTER:
+                    self.nonant_spoke_indices.add(i + 1)
+        self.bounds_only_indices = (
+            (self.outerbound_spoke_indices | self.innerbound_spoke_indices)
+            - (self.w_spoke_indices | self.nonant_spoke_indices)
+        )
+        self.has_outerbound_spokes = bool(self.outerbound_spoke_indices)
+        self.has_innerbound_spokes = bool(self.innerbound_spoke_indices)
+        self.has_nonant_spokes = bool(self.nonant_spoke_indices)
+        self.has_w_spokes = bool(self.w_spoke_indices)
+        self.has_bounds_only_spokes = bool(self.bounds_only_indices)
+
+    def initialize_bound_values(self):
+        if self.opt.is_minimizing:
+            self.BestInnerBound, self.BestOuterBound = inf, -inf
+            self._ib_better = lambda new, old: new < old
+            self._ob_better = lambda new, old: new > old
+        else:
+            self.BestInnerBound, self.BestOuterBound = -inf, inf
+            self._ib_better = lambda new, old: new > old
+            self._ob_better = lambda new, old: new < old
+
+    # ---- gap / termination (hub.py:77-161) ----------------------------------
+    def compute_gaps(self):
+        if self.opt.is_minimizing:
+            abs_gap = self.BestInnerBound - self.BestOuterBound
+        else:
+            abs_gap = self.BestOuterBound - self.BestInnerBound
+        if np.isfinite(abs_gap) and self.BestOuterBound not in (0.0,) \
+                and np.isfinite(self.BestOuterBound):
+            rel_gap = abs_gap / abs(self.BestOuterBound)
+        else:
+            rel_gap = inf
+        return abs_gap, rel_gap
+
+    def determine_termination(self) -> bool:
+        opts = self.options
+        if not any(k in opts for k in ("rel_gap", "abs_gap",
+                                       "max_stalled_iters")):
+            return False
+        abs_gap, rel_gap = self.compute_gaps()
+        rel_ok = "rel_gap" in opts and rel_gap <= opts["rel_gap"]
+        abs_ok = "abs_gap" in opts and abs_gap <= opts["abs_gap"]
+        stalled = False
+        if "max_stalled_iters" in opts:
+            if abs_gap < self.last_gap:
+                self.last_gap = abs_gap
+                self.stalled_iter_cnt = 0
+            else:
+                self.stalled_iter_cnt += 1
+                stalled = self.stalled_iter_cnt >= opts["max_stalled_iters"]
+        if abs_ok:
+            global_toc(f"Terminating: absolute gap {abs_gap:.4f}", True)
+        if rel_ok:
+            global_toc(f"Terminating: relative gap {rel_gap * 100:.3f}%", True)
+        if stalled:
+            global_toc(f"Terminating: stalled {self.stalled_iter_cnt} iters", True)
+        return abs_ok or rel_ok or stalled
+
+    # ---- screen trace (hub.py:111-123) --------------------------------------
+    def _update_string(self):
+        ob = self.latest_ob_char or ' '
+        ib = self.latest_ib_char or ' '
+        return f"{ob} {ib}"
+
+    def screen_trace(self):
+        it = self.current_iteration()
+        abs_gap, rel_gap = self.compute_gaps()
+        if self.print_init:
+            global_toc(
+                f'{"Iter.":>5s}     {"Best Bound":>14s}  {"Best Incumbent":>14s}'
+                f'  {"Rel. Gap":>12s}  {"Abs. Gap":>14s}', True)
+            self.print_init = False
+        global_toc(
+            f"{it:5d} {self._update_string()} {self.BestOuterBound:14.4f}  "
+            f"{self.BestInnerBound:14.4f}  {rel_gap * 100:12.3f}%  "
+            f"{abs_gap:14.4f}", True)
+        self.latest_ib_char = None
+        self.latest_ob_char = None
+
+    # ---- mailbox traffic (hub.py:370-436) -----------------------------------
+    def hub_to_spoke(self, values, idx: int):
+        self.fabric.to_spoke[idx].put(values)
+
+    def hub_from_spoke(self, idx: int):
+        """Returns (payload, True) when the spoke's write-id is fresh."""
+        data, wid = self.fabric.to_hub[idx].get()
+        last = self.remote_write_ids.get(idx, 0)
+        if wid > last or wid < 0:
+            self.remote_write_ids[idx] = wid
+            return data, True
+        return data, False
+
+    def receive_outerbounds(self):
+        for idx in self.outerbound_spoke_indices:
+            data, is_new = self.hub_from_spoke(idx)
+            if is_new:
+                self.OuterBoundUpdate(float(data[0]), idx)
+
+    def receive_innerbounds(self):
+        for idx in self.innerbound_spoke_indices:
+            data, is_new = self.hub_from_spoke(idx)
+            if is_new:
+                self.InnerBoundUpdate(float(data[0]), idx)
+
+    def OuterBoundUpdate(self, new_bound, idx=None, char='*'):
+        if self._ob_better(new_bound, self.BestOuterBound):
+            self.latest_ob_char = (
+                char if idx is None else self.outerbound_spoke_chars[idx]
+            )
+            self.BestOuterBound = new_bound
+        return self.BestOuterBound
+
+    def InnerBoundUpdate(self, new_bound, idx=None, char='*'):
+        if self._ib_better(new_bound, self.BestInnerBound):
+            self.latest_ib_char = (
+                char if idx is None else self.innerbound_spoke_chars[idx]
+            )
+            self.BestInnerBound = new_bound
+        return self.BestInnerBound
+
+    def send_terminate(self):
+        self.fabric.send_terminate()
+
+    def hub_finalize(self):
+        if self.has_outerbound_spokes:
+            self.receive_outerbounds()
+        if self.has_innerbound_spokes:
+            self.receive_innerbounds()
+        self.print_init = True
+        global_toc("Statistics at termination", True)
+        self.screen_trace()
+
+    def current_iteration(self):
+        raise NotImplementedError
+
+
+class PHHub(Hub):
+    """PH-flavored hub (hub.py:453-598): sends W and nonants, receives bounds.
+
+    Payload layouts (flat float64, mirroring the reference buffers):
+      W spokes:       [W.ravel() (S*K), BestOuterBound, BestInnerBound]
+      nonant spokes:  [xk.ravel() (S*K), BestOuterBound, BestInnerBound]
+      bounds-only:    [BestOuterBound, BestInnerBound]
+    """
+
+    def setup_hub(self):
+        self.initialize_spoke_indices()
+        self.initialize_bound_values()
+        if self.outerbound_spoke_indices & self.innerbound_spoke_indices:
+            raise RuntimeError(
+                "A spoke providing both inner and outer bounds is unsupported"
+            )
+        if self.w_spoke_indices & self.nonant_spoke_indices:
+            raise RuntimeError(
+                "A spoke needing both Ws and nonants is unsupported"
+            )
+
+    def sync(self):
+        if self.has_w_spokes:
+            self.send_ws()
+        if self.has_nonant_spokes:
+            self.send_nonants()
+        if self.has_bounds_only_spokes:
+            self.send_boundsout()
+        if self.has_outerbound_spokes:
+            self.receive_outerbounds()
+        if self.has_innerbound_spokes:
+            self.receive_innerbounds()
+
+    sync_with_spokes = sync
+
+    def is_converged(self):
+        if self.opt._iter == 1:
+            self.OuterBoundUpdate(self.opt.trivial_bound, char='T')
+        # in-hub xhat extensions land their incumbents on the opt object
+        bib = getattr(self.opt, "best_inner_bound", None)
+        if bib is not None and np.isfinite(bib):
+            self.InnerBoundUpdate(float(bib), char='X')
+        self.screen_trace()
+        if not self.has_innerbound_spokes and not np.isfinite(
+                self.BestInnerBound):
+            return False
+        return self.determine_termination()
+
+    def current_iteration(self):
+        return self.opt._iter
+
+    def main(self):
+        self.opt.ph_main(finalize=False)
+
+    def finalize(self):
+        return self.opt.post_loops()
+
+    def send_ws(self):
+        payload = np.concatenate(
+            [np.asarray(self.opt.W, dtype=np.float64).ravel(),
+             [self.BestOuterBound, self.BestInnerBound]]
+        )
+        for idx in self.w_spoke_indices:
+            self.hub_to_spoke(payload, idx)
+
+    def send_nonants(self):
+        xk = self.opt.nonants_of(self.opt.local_x)
+        payload = np.concatenate(
+            [np.asarray(xk, dtype=np.float64).ravel(),
+             [self.BestOuterBound, self.BestInnerBound]]
+        )
+        for idx in self.nonant_spoke_indices:
+            self.hub_to_spoke(payload, idx)
+
+    def send_boundsout(self):
+        payload = np.array([self.BestOuterBound, self.BestInnerBound])
+        for idx in self.bounds_only_indices:
+            self.hub_to_spoke(payload, idx)

@@ -1,0 +1,68 @@
+"""Lagrangian outer-bound spoke.
+
+TPU-native analogue of ``mpisppy/cylinders/lagrangian_bounder.py:5-95``: take
+the hub's PH dual weights W, solve every scenario subproblem with W active and
+the prox term OFF, and report the weighted sum of subproblem objectives — a
+valid lower (outer) bound for minimization since PH keeps the probability-
+weighted W summing to zero per node.  One batched ADMM call per fresh W.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .spoke import OuterBoundWSpoke
+
+
+class LagrangianOuterBound(OuterBoundWSpoke):
+    """'L' spoke: Lagrangian dual bound from hub Ws
+    (lagrangian_bounder.py:5-95)."""
+
+    converger_spoke_char = 'L'
+
+    def lagrangian_prep(self):
+        """The reference's PH_Prep(attach_prox=False) + _reenable_W
+        (lagrangian_bounder.py:9-17): our opt object needs no model surgery —
+        just force the W-on/prox-off objective mode."""
+        self.opt.W_on = True
+        self.opt.prox_on = False
+
+    def lagrangian(self) -> float:
+        """Solve the W-augmented batch and return the dual bound
+        (lagrangian_bounder.py:19-56): E[obj + W·x_nonant].
+
+        The objective comes from the opt object's own ``_augmented_q`` (with
+        W on, prox off per ``lagrangian_prep``) so the assembly stays single-
+        sourced with PH."""
+        opt = self.opt
+        q, q2 = opt._augmented_q()
+        x = opt.solve_loop(q=q, q2=q2)
+        xk = opt.nonants_of(x)
+        extra = np.einsum("sk,sk->s", opt.W, xk)
+        return opt.Ebound(extra_obj=extra)
+
+    def _set_weights_and_solve(self) -> float:
+        self.opt.W = np.asarray(self.localWs, dtype=float).copy()
+        return self.lagrangian()
+
+    def main(self):
+        self.lagrangian_prep()
+        self.opt.W = np.zeros(
+            (self.opt.batch.num_scenarios, self.opt.nonant_length)
+        )
+        self.trivial_bound = self.lagrangian()
+        self.bound = self.trivial_bound
+        self.dk_iter = 1
+        while not self.got_kill_signal():
+            if self.new_Ws:
+                bound = self._set_weights_and_solve()
+                if bound is not None and np.isfinite(bound):
+                    self.bound = bound
+                self.dk_iter += 1
+
+    def finalize(self):
+        """One final pass with the last Ws (lagrangian_bounder.py:85-95)."""
+        self.final_bound = self._set_weights_and_solve()
+        if np.isfinite(self.final_bound):
+            self.bound = self.final_bound
+        return self.final_bound

@@ -63,9 +63,14 @@ class Mailbox:
             return self._buf[:-1].copy(), int(self._buf[-1])
 
     def kill(self):
-        """Write the termination sentinel (write_id = -1, hub.py:438-450)."""
+        """Write the termination sentinel (write_id = -1, hub.py:438-450).
+
+        Deviation from the reference (which Puts zero dummies): the last
+        payload is preserved, so spokes that finalize with "the last hub data"
+        (e.g. the Lagrangian's final-Ws pass, lagrangian_bounder.py:85-95)
+        really do use the last data rather than zeros.
+        """
         with self._lock:
-            self._buf[:-1] = 0.0
             self._buf[-1] = KILL_ID
 
     @property

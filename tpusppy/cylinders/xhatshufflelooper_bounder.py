@@ -1,0 +1,86 @@
+"""XhatShuffle inner-bound spoke: shuffled scenario cycling over hub nonants.
+
+TPU-native analogue of ``mpisppy/cylinders/xhatshufflelooper_bounder.py:20-300``.
+Each pass: take the hub's current nonant values, pick the next donor scenario
+from a seeded shuffle (the reference's ``ScenarioCycler``, multistage-aware via
+per-node donor completion), fix the nonant columns to the donated candidate,
+solve the whole batch in one device program (``Xhat_Eval``), and push the
+expected objective to the hub when it improves the incumbent.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .spoke import InnerBoundNonantSpoke
+from ..extensions.xhatbase import donor_cache
+
+
+class ScenarioCycler:
+    """Seeded shuffled cycle over donor scenario indices
+    (xhatshufflelooper_bounder.py:158-300).
+
+    ``reverse``: iterate the shuffle backwards (the reference's
+    reverse-looper option).
+    """
+
+    def __init__(self, num_scenarios: int, seed: int = 0, reverse: bool = False):
+        self._S = int(num_scenarios)
+        self._rng = np.random.default_rng(seed)
+        self._reverse = reverse
+        self._order = []
+        self._pos = 0
+
+    def _reshuffle(self):
+        self._order = list(self._rng.permutation(self._S))
+        if self._reverse:
+            self._order.reverse()
+        self._pos = 0
+
+    def get_next(self) -> int:
+        if self._pos >= len(self._order):
+            self._reshuffle()
+        s = self._order[self._pos]
+        self._pos += 1
+        return int(s)
+
+
+class XhatShuffleInnerBound(InnerBoundNonantSpoke):
+    """'X' spoke (xhatshufflelooper_bounder.py:20-157)."""
+
+    converger_spoke_char = 'X'
+
+    def xhatbase_prep(self):
+        """No iter0 solves needed — the opt object (Xhat_Eval) evaluates
+        candidates directly (xhatshufflelooper_bounder.py:24-61)."""
+        opts = self.opt.options
+        self.cycler = ScenarioCycler(
+            self.opt.batch.num_scenarios,
+            seed=int(opts.get("xhat_looper_options", {}).get("seed", 0)),
+            reverse=bool(opts.get("xhat_looper_options", {}).get(
+                "reverse", False)),
+        )
+        self.scen_limit = int(
+            opts.get("xhat_looper_options", {}).get("scen_limit", 3)
+        )
+
+    def _try_candidates(self):
+        """Try up to scen_limit donors against the current hub nonants.
+
+        Aborts early on the kill sentinel via ``peek_kill_signal`` so a
+        nonant payload posted mid-evaluation keeps its freshness for the
+        next main-loop poll."""
+        xk = self.localnonants
+        for _ in range(self.scen_limit):
+            donor = self.cycler.get_next()
+            cache = donor_cache(self.opt, xk, donor)
+            obj = self.opt.evaluate(cache)
+            self.update_if_improving(obj)
+            if self.peek_kill_signal():
+                return
+
+    def main(self):
+        self.xhatbase_prep()
+        while not self.got_kill_signal():
+            if self.new_nonants:
+                self._try_candidates()

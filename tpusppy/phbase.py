@@ -54,10 +54,6 @@ class PHBase(SPOpt):
         # Precompute node-membership one-hot for xbar contraction: (S, K) -> N
         self._onehot = self.tree.onehot_sk_n()
 
-    @property
-    def is_minimizing(self):
-        return True  # IR is always stated as minimization (negate costs to max)
-
     def _initial_rho(self, rho_setter):
         K = self.nonant_length
         S = self.batch.num_scenarios

@@ -84,6 +84,10 @@ class SPBase:
         if missing:
             raise RuntimeError(f"Missing required options: {missing}")
 
+    @property
+    def is_minimizing(self):
+        return True  # the IR is always stated as minimization (negate to max)
+
     # ---- probabilities ------------------------------------------------------
     @property
     def probs(self) -> np.ndarray:

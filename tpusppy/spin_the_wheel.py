@@ -1,0 +1,174 @@
+"""WheelSpinner: launch a hub and its spokes and spin until termination.
+
+TPU-native analogue of ``mpisppy/spin_the_wheel.py:12-237``.  The reference
+splits ``COMM_WORLD`` into strata/cylinder process groups and runs one opt
+object per rank (spin_the_wheel.py:219-237).  Here each cylinder is a host
+thread driving its own jitted device programs (batched solves share the device
+through the run queue — algorithm parallelism P3 of SURVEY §2.12), and the
+cross-cylinder fabric is the write-id versioned mailbox set
+(:mod:`tpusppy.cylinders.spcommunicator`).
+
+Call sequence mirrors the reference: construct opt + communicator per cylinder,
+make windows, ``setup_hub``, run all mains, hub sends the kill sentinel,
+spokes finalize, hub_finalize (spin_the_wheel.py:119-144).
+"""
+
+from __future__ import annotations
+
+import csv
+import threading
+
+import numpy as np
+
+from . import global_toc
+from .cylinders.spcommunicator import WindowFabric
+
+
+class WheelSpinner:
+    """Spin a hub and list of spokes (spin_the_wheel.py:12-159)."""
+
+    def __init__(self, hub_dict, list_of_spoke_dict):
+        self.hub_dict = dict(hub_dict)
+        self.list_of_spoke_dict = [dict(d) for d in (list_of_spoke_dict or [])]
+        self.on_hub = True  # single-process: we always see the hub
+        self.spun = False
+
+    def spin(self, comm_world=None):
+        """comm_world accepted for reference API parity; unused in-process."""
+        return self.run()
+
+    def run(self):
+        fabric = WindowFabric()
+
+        # Hub opt + communicator (spin_the_wheel.py:92-116)
+        hub = self.hub_dict
+        hub_opt = hub["opt_class"](**hub["opt_kwargs"])
+        hub_comm = hub["hub_class"](
+            hub_opt, 0, fabric, spokes=self.list_of_spoke_dict,
+            **hub.get("hub_kwargs", {}),
+        )
+
+        # Spoke opts + communicators; negotiate mailbox lengths
+        spoke_comms = []
+        for i, sd in enumerate(self.list_of_spoke_dict):
+            opt = sd["opt_class"](**sd["opt_kwargs"])
+            comm = sd["spoke_class"](
+                opt, i + 1, fabric, **sd.get("spoke_kwargs", {}),
+            )
+            to_hub_len, to_spoke_len = comm.buffer_lengths()
+            fabric.add_spoke(i + 1, to_spoke_len, to_hub_len)
+            spoke_comms.append(comm)
+
+        hub_comm.setup_hub()
+
+        # Run spokes on threads, hub on this thread (role dispatch analogue of
+        # spin_the_wheel.py:119-127)
+        threads = []
+        errors = []
+
+        def spoke_runner(comm):
+            try:
+                comm.main()
+            except Exception as e:          # surface spoke crashes at join
+                errors.append((comm.__class__.__name__, e))
+
+        for comm in spoke_comms:
+            t = threading.Thread(
+                target=spoke_runner, args=(comm,),
+                name=comm.__class__.__name__, daemon=True,
+            )
+            t.start()
+            threads.append(t)
+
+        try:
+            hub_comm.main()
+        finally:
+            hub_comm.send_terminate()
+        for t in threads:
+            t.join(timeout=300)
+        hung = [t.name for t in threads if t.is_alive()]
+        if hung:
+            raise RuntimeError(
+                f"Spoke threads did not terminate within timeout: {hung}"
+            )
+        if errors:
+            raise RuntimeError(f"Spoke failures: {errors}")
+
+        # finalize: each cylinder flushes, then the hub collects (131-144)
+        hub_comm.finalize()
+        for comm in spoke_comms:
+            comm.finalize()
+        hub_comm.hub_finalize()
+
+        self.spcomm = hub_comm
+        self.opt = hub_opt
+        self.spoke_comms = spoke_comms
+        self.spun = True
+
+        # post-run caches (spin_the_wheel.py:166-217)
+        self.BestInnerBound = hub_comm.BestInnerBound
+        self.BestOuterBound = hub_comm.BestOuterBound
+        self.local_nonant_cache = self._best_nonant_cache()
+        return self
+
+    # ---- solution access (spin_the_wheel.py:166-217) ------------------------
+    def _best_nonant_cache(self):
+        """(S, K) nonants of the best incumbent seen anywhere in the wheel."""
+        best = getattr(self.opt, "best_xhat_cache", None)  # in-hub xhat ext
+        best_val = getattr(self.opt, "best_inner_bound", np.inf)
+        for comm in self.spoke_comms:
+            cand = getattr(comm, "best_solution_cache", None)
+            v = getattr(comm, "best_inner_bound", np.inf)
+            if cand is not None and v < best_val:
+                best_val = v
+                best = self.opt.nonants_of(cand)
+        if best is None and self.opt.local_x is not None:
+            best = self.opt.nonants_of(self.opt.local_x)
+        return None if best is None else np.asarray(best)
+
+    def write_first_stage_solution(self, solution_file_name: str):
+        """CSV (or .npy) of root-stage nonant values (sputils.py:37-68)."""
+        cache = self.local_nonant_cache
+        if cache is None:
+            raise RuntimeError("No solution available to write")
+        tree = self.opt.tree
+        root_slots = np.where(tree.nonant_stage == 1)[0]
+        vals = cache[0, root_slots]
+        if solution_file_name.endswith(".npy"):
+            np.save(solution_file_name, vals)
+            return
+        names = self.opt.batch.names
+        var_names = (
+            self.opt.scenario_creator(
+                names[0], **self.opt.scenario_creator_kwargs
+            ).var_names
+        )
+        idx = tree.nonant_indices[root_slots]
+        with open(solution_file_name, "w", newline="") as f:
+            w = csv.writer(f)
+            for j, v in zip(idx, vals):
+                nm = var_names[j] if var_names else f"x[{j}]"
+                w.writerow([nm, repr(float(v))])
+
+    def write_tree_solution(self, directory_name: str):
+        """Per-scenario nonant CSVs (spin_the_wheel.py:199-217)."""
+        import os
+
+        os.makedirs(directory_name, exist_ok=True)
+        cache = self.local_nonant_cache
+        if cache is None:
+            raise RuntimeError("No solution available to write")
+        for s, name in enumerate(self.opt.all_scenario_names):
+            with open(os.path.join(directory_name, f"{name}.csv"), "w",
+                      newline="") as f:
+                w = csv.writer(f)
+                for k in range(cache.shape[1]):
+                    w.writerow([f"nonant[{k}]", repr(float(cache[s, k]))])
+
+
+def spin_the_wheel(hub_dict, list_of_spoke_dict, comm_world=None):
+    """Functional alias kept for reference parity (deprecated there too)."""
+    ws = WheelSpinner(hub_dict, list_of_spoke_dict)
+    ws.spin(comm_world)
+    global_toc("Spinning complete", True)
+    return ws
