@@ -108,3 +108,44 @@ def test_wheel_hub_only():
     ws = WheelSpinner(hub_dict, []).spin()
     assert ws.spun
     assert np.isfinite(ws.spcomm.BestOuterBound)
+
+
+def test_wheel_many_spokes():
+    """All spoke families at once: lagrangian, lagranger, xhatshuffle,
+    xhatlooper, xhatxbar, slam max/min (the run_all.py posture)."""
+    from tpusppy.cylinders import (
+        LagrangerOuterBound,
+        SlamMaxHeuristic,
+        SlamMinHeuristic,
+        XhatLooperInnerBound,
+        XhatXbarInnerBound,
+    )
+
+    n = 3
+    hub_dict = {
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": {"rel_gap": 1e-3}},
+        "opt_class": PH,
+        "opt_kwargs": _farmer_opt_kwargs(n, iters=30),
+    }
+    spokes = [
+        {"spoke_class": LagrangianOuterBound, "opt_class": PHBase,
+         "opt_kwargs": _farmer_opt_kwargs(n)},
+        {"spoke_class": LagrangerOuterBound, "opt_class": PHBase,
+         "opt_kwargs": _farmer_opt_kwargs(n)},
+        {"spoke_class": XhatShuffleInnerBound, "opt_class": Xhat_Eval,
+         "opt_kwargs": _farmer_opt_kwargs(n)},
+        {"spoke_class": XhatLooperInnerBound, "opt_class": Xhat_Eval,
+         "opt_kwargs": _farmer_opt_kwargs(n)},
+        {"spoke_class": XhatXbarInnerBound, "opt_class": Xhat_Eval,
+         "opt_kwargs": _farmer_opt_kwargs(n)},
+        {"spoke_class": SlamMaxHeuristic, "opt_class": Xhat_Eval,
+         "opt_kwargs": _farmer_opt_kwargs(n)},
+        {"spoke_class": SlamMinHeuristic, "opt_class": Xhat_Eval,
+         "opt_kwargs": _farmer_opt_kwargs(n)},
+    ]
+    ws = WheelSpinner(hub_dict, spokes).spin()
+    ef_obj = -108390.0
+    assert ws.BestInnerBound == pytest.approx(ef_obj, rel=5e-3)
+    assert ws.BestOuterBound <= ws.BestInnerBound + 1e-6
+    assert ws.BestOuterBound >= -115405.6

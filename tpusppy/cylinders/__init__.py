@@ -15,12 +15,19 @@ from .spoke import (
 )
 from .hub import Hub, PHHub
 from .lagrangian_bounder import LagrangianOuterBound
+from .lagranger_bounder import LagrangerOuterBound
+from .slam_heuristic import SlamMaxHeuristic, SlamMinHeuristic
+from .xhatlooper_bounder import XhatLooperInnerBound
 from .xhatshufflelooper_bounder import ScenarioCycler, XhatShuffleInnerBound
+from .xhatspecific_bounder import XhatSpecificInnerBound
+from .xhatxbar_bounder import XhatXbarInnerBound
 
 __all__ = [
     "KILL_ID", "Mailbox", "SPCommunicator", "WindowFabric",
     "ConvergerSpokeType", "Spoke", "InnerBoundSpoke", "OuterBoundSpoke",
     "OuterBoundWSpoke", "InnerBoundNonantSpoke", "OuterBoundNonantSpoke",
-    "Hub", "PHHub", "LagrangianOuterBound", "ScenarioCycler",
-    "XhatShuffleInnerBound",
+    "Hub", "PHHub", "LagrangianOuterBound", "LagrangerOuterBound",
+    "SlamMaxHeuristic", "SlamMinHeuristic", "ScenarioCycler",
+    "XhatLooperInnerBound", "XhatShuffleInnerBound",
+    "XhatSpecificInnerBound", "XhatXbarInnerBound",
 ]

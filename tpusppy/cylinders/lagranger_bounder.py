@@ -1,0 +1,82 @@
+"""Lagranger outer-bound spoke: independent Lagrangian from hub nonants.
+
+TPU-native analogue of ``mpisppy/cylinders/lagranger_bounder.py:11-119``: takes
+the hub's **x** values (not its Ws), runs its own xbar/W updates at possibly
+rescaled rho, and reports the Lagrangian bound of its own duals.  This gives a
+second, independently-weighted outer bound stream ('A' vs the 'L' spoke).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .spoke import OuterBoundNonantSpoke
+
+
+class LagrangerOuterBound(OuterBoundNonantSpoke):
+    """'A' spoke (lagranger_bounder.py:11-119)."""
+
+    converger_spoke_char = 'A'
+
+    def lagrangian_prep(self):
+        self.opt.W_on = True
+        self.opt.prox_on = False
+        # per-iteration rho rescale schedule {iter: factor}; factors ACCUMULATE
+        # (lagranger_bounder.py:55-60)
+        sched = self.opt.options.get("lagranger_rho_rescale_factors")
+        json_path = self.opt.options.get("lagranger_rho_rescale_factors_json")
+        if sched is None and json_path is not None:
+            import json
+
+            with open(json_path) as fin:
+                sched = {int(k): float(v) for k, v in json.load(fin).items()}
+        self.rho_rescale_factors = (
+            {int(k): float(v) for k, v in sched.items()} if sched else None
+        )
+
+    def _lagrangian(self, iternum) -> float:
+        if self.rho_rescale_factors is not None \
+                and iternum in self.rho_rescale_factors:
+            self.opt.rho = self.opt.rho * self.rho_rescale_factors[iternum]
+        q, q2 = self.opt._augmented_q()
+        x = self.opt.solve_loop(q=q, q2=q2)
+        xk = self.opt.nonants_of(x)
+        extra = np.einsum("sk,sk->s", self.opt.W, xk)
+        return self.opt.Ebound(extra_obj=extra)
+
+    def _update_weights_and_solve(self, iternum) -> float:
+        """Adopt hub x, recompute own xbar/W, solve
+        (lagranger_bounder.py:85-93)."""
+        opt = self.opt
+        # hub nonants define the "current x" for the xbar/W update
+        xfull = np.array(opt.batch.lb, copy=True) * 0.0
+        if opt.local_x is not None:
+            xfull = np.array(opt.local_x, copy=True)
+        xfull[:, opt.tree.nonant_indices] = self.localnonants
+        opt.local_x = xfull
+        opt.Compute_Xbar()
+        opt.Update_W()
+        return self._lagrangian(iternum)
+
+    def main(self):
+        self.lagrangian_prep()
+        self.A_iter = 1
+        self._ever_nonants = False
+        self.trivial_bound = self._lagrangian(0)
+        self.bound = self.trivial_bound
+        while not self.got_kill_signal():
+            if self.new_nonants:
+                self._ever_nonants = True
+                bound = self._update_weights_and_solve(self.A_iter)
+                if np.isfinite(bound):
+                    self.bound = bound
+                self.A_iter += 1
+
+    def finalize(self):
+        """One final pass with the last nonants (lagranger_bounder.py:108-119)."""
+        if not getattr(self, "_ever_nonants", False):
+            return None
+        self.final_bound = self._update_weights_and_solve(self.A_iter)
+        if np.isfinite(self.final_bound):
+            self.bound = self.final_bound
+        return self.final_bound

@@ -1,0 +1,40 @@
+"""Slam heuristic inner-bound spokes: per-variable max/min candidates.
+
+TPU-native analogue of ``mpisppy/cylinders/slam_heuristic.py:24-125``
+(two-stage only there; here the per-node aggregation in
+:func:`tpusppy.extensions.xhatbase.slam_cache` generalizes to multistage for
+free): the candidate slams every nonant to the max (or min) over scenarios —
+an integer-friendly incumbent guess evaluated in one batched solve.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .spoke import InnerBoundNonantSpoke
+from ..extensions.xhatbase import slam_cache
+
+
+class _SlamHeuristic(InnerBoundNonantSpoke):
+    converger_spoke_char = 'S'
+    how = None  # "max" / "min"
+
+    def main(self):
+        ints = self.opt.batch.is_int[self.opt.tree.nonant_indices]
+        while not self.got_kill_signal():
+            if self.new_nonants:
+                cand = slam_cache(self.opt, self.localnonants, how=self.how)
+                if ints.any():
+                    cand = np.where(ints[None, :], np.round(cand), cand)
+                obj = self.opt.evaluate(cand)
+                self.update_if_improving(obj)
+
+
+class SlamMaxHeuristic(_SlamHeuristic):
+    """'S' spoke slamming to the per-node max (slam_heuristic.py:107-115)."""
+    how = "max"
+
+
+class SlamMinHeuristic(_SlamHeuristic):
+    """'S' spoke slamming to the per-node min (slam_heuristic.py:117-125)."""
+    how = "min"

@@ -1,0 +1,37 @@
+"""XhatLooper inner-bound spoke: in-order scenario cycling.
+
+TPU-native analogue of ``mpisppy/cylinders/xhatlooper_bounder.py:12-77``:
+like XhatShuffle but tries donor scenarios in their natural order, up to
+``xhat_looper_options["scen_limit"]`` per fresh hub payload.
+"""
+
+from __future__ import annotations
+
+from .spoke import InnerBoundNonantSpoke
+from ..extensions.xhatbase import donor_cache
+
+
+class XhatLooperInnerBound(InnerBoundNonantSpoke):
+    """'X' spoke (xhatlooper_bounder.py:12-77)."""
+
+    converger_spoke_char = 'X'
+
+    def xhatlooper_prep(self):
+        opts = self.opt.options.get("xhat_looper_options", {})
+        self.scen_limit = int(opts.get("scen_limit", 3))
+        self._next = 0
+
+    def main(self):
+        self.xhatlooper_prep()
+        S = self.opt.batch.num_scenarios
+        while not self.got_kill_signal():
+            if self.new_nonants:
+                xk = self.localnonants
+                for _ in range(self.scen_limit):
+                    donor = self._next % S
+                    self._next += 1
+                    cache = donor_cache(self.opt, xk, donor)
+                    obj = self.opt.evaluate(cache)
+                    self.update_if_improving(obj)
+                    if self.peek_kill_signal():
+                        return

@@ -1,0 +1,41 @@
+"""XhatSpecific inner-bound spoke: a fixed scenario-per-node candidate.
+
+TPU-native analogue of ``mpisppy/cylinders/xhatspecific_bounder.py`` (102 LoC):
+the user names one donor scenario per tree node
+(``options["xhat_specific_options"]["xhat_scenario_dict"]``, mapping node name
+to scenario name); every fresh hub payload is completed from those donors and
+evaluated.
+"""
+
+from __future__ import annotations
+
+from .spoke import InnerBoundNonantSpoke
+from ..extensions.xhatbase import donor_cache
+
+
+class XhatSpecificInnerBound(InnerBoundNonantSpoke):
+    """'X' spoke (xhatspecific_bounder.py)."""
+
+    converger_spoke_char = 'X'
+
+    def xhatspecific_prep(self):
+        xs_opts = self.opt.options.get("xhat_specific_options", {})
+        sdict = xs_opts.get("xhat_scenario_dict")
+        if sdict is None:
+            raise RuntimeError(
+                "XhatSpecific needs options['xhat_specific_options']"
+                "['xhat_scenario_dict'] ({node_name: scenario_name})"
+            )
+        name_to_idx = {nm: i for i, nm in enumerate(self.opt.all_scenario_names)}
+        self.donors = {
+            node: name_to_idx[scen] if isinstance(scen, str) else int(scen)
+            for node, scen in sdict.items()
+        }
+
+    def main(self):
+        self.xhatspecific_prep()
+        while not self.got_kill_signal():
+            if self.new_nonants:
+                cache = donor_cache(self.opt, self.localnonants, self.donors)
+                obj = self.opt.evaluate(cache)
+                self.update_if_improving(obj)

@@ -1,0 +1,42 @@
+"""XhatXbar inner-bound spoke: round the per-node average and evaluate it.
+
+TPU-native analogue of ``mpisppy/cylinders/xhatxbar_bounder.py:31-118``: the
+candidate is the probability-weighted per-node mean of the hub's nonants
+(xbar), with integer slots rounded — automatically nonanticipative, and often
+good once PH is nearly converged.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .spoke import InnerBoundNonantSpoke
+
+
+def xbar_candidate(opt, xk: np.ndarray) -> np.ndarray:
+    """(S, K) per-node weighted mean of xk, integer slots rounded
+    (xhatxbar_bounder.py:31-80 semantics on the batched layout)."""
+    onehot = opt.tree.onehot_sk_n()           # (S, K, N)
+    p = opt.probs[:, None]
+    num = np.einsum("skn,sk->nk", onehot, p * xk)
+    den = np.einsum("skn,sk->nk", onehot, np.broadcast_to(p, xk.shape))
+    xbar_nk = num / np.maximum(den, 1e-300)
+    kidx = np.arange(xk.shape[1])[None, :]
+    cand = xbar_nk[opt.nid_sk, kidx]
+    ints = opt.batch.is_int[opt.tree.nonant_indices]
+    if ints.any():
+        cand = np.where(ints[None, :], np.round(cand), cand)
+    return cand
+
+
+class XhatXbarInnerBound(InnerBoundNonantSpoke):
+    """'X' spoke (xhatxbar_bounder.py:31-118)."""
+
+    converger_spoke_char = 'X'
+
+    def main(self):
+        while not self.got_kill_signal():
+            if self.new_nonants:
+                cand = xbar_candidate(self.opt, self.localnonants)
+                obj = self.opt.evaluate(cand)
+                self.update_if_improving(obj)
