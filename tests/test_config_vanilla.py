@@ -1,0 +1,109 @@
+"""Config / cfg_vanilla / Amalgamator driver layer.
+
+Mirrors the reference's driver-assembly posture (SURVEY §1 L6): a Config is
+populated by feature groups + model inparser_adder, parsed from argv, turned
+into hub/spoke dicts by vanilla factories or run declaratively by the
+Amalgamator.
+"""
+
+import pytest
+
+from tpusppy.models import farmer
+from tpusppy.utils import cfg_vanilla as vanilla
+from tpusppy.utils.amalgamator import Amalgamator_parser, from_module
+from tpusppy.utils.config import Config
+from tpusppy.utils.solver_spec import option_string_to_dict, solver_specification
+
+
+def test_config_groups_and_argparse():
+    cfg = Config()
+    cfg.popular_args()
+    cfg.two_sided_args()
+    cfg.ph_args()
+    cfg.lagrangian_args()
+    cfg.xhatshuffle_args()
+    cfg.num_scens_required()
+    cfg.parse_command_line("tester", args=[
+        "--num-scens", "3", "--max-iterations", "12", "--default-rho", "1.5",
+        "--rel-gap", "0.001", "--lagrangian", "--xhatshuffle",
+        "--solver-options", "max_iter=500 dtype=float64",
+    ])
+    assert cfg.num_scens == 3
+    assert cfg.max_iterations == 12
+    assert cfg.default_rho == 1.5
+    assert cfg.rel_gap == 0.001
+    assert cfg.lagrangian and cfg.xhatshuffle
+    assert not cfg.get("verbose")
+
+
+def test_config_duplicate_raises():
+    cfg = Config()
+    cfg.popular_args()
+    with pytest.raises(RuntimeError):
+        cfg.add_to_config("max_iterations", "dup", int, 9)
+    # quick_assign does not raise
+    cfg.quick_assign("max_iterations", int, 9)
+    assert cfg.max_iterations == 9
+
+
+def test_solver_spec():
+    assert option_string_to_dict("mipgap=0.01 threads=2 flag") == {
+        "mipgap": 0.01, "threads": 2, "flag": None,
+    }
+    cfg = Config()
+    cfg.add_solver_specs(prefix="EF")
+    cfg.EF_solver_name = None
+    cfg.quick_assign("solver_name", str, "admm")
+    name, opts = solver_specification(cfg, ["EF", ""])
+    assert name == "admm"
+
+
+def test_vanilla_factories_build_dicts():
+    cfg = Config()
+    cfg.popular_args()
+    cfg.two_sided_args()
+    cfg.num_scens_optional()
+    cfg.num_scens = 3
+    cfg.max_iterations = 10
+    cfg.default_rho = 1.0
+    cfg.rel_gap = 0.01
+    names = farmer.scenario_names_creator(3)
+    kw = {"num_scens": 3}
+    hub = vanilla.ph_hub(cfg, farmer.scenario_creator,
+                         all_scenario_names=names,
+                         scenario_creator_kwargs=kw)
+    assert hub["opt_kwargs"]["options"]["PHIterLimit"] == 10
+    assert hub["hub_kwargs"]["options"]["rel_gap"] == 0.01
+    lag = vanilla.lagrangian_spoke(cfg, farmer.scenario_creator,
+                                   all_scenario_names=names,
+                                   scenario_creator_kwargs=kw)
+    xs = vanilla.xhatshuffle_spoke(cfg, farmer.scenario_creator,
+                                   all_scenario_names=names,
+                                   scenario_creator_kwargs=kw)
+    assert lag["spoke_class"].converger_spoke_char == 'L'
+    assert xs["opt_kwargs"]["options"]["xhat_looper_options"]["scen_limit"] == 3
+
+
+def test_amalgamator_ef():
+    """Declarative EF run on farmer (amalgamator.py __main__ analogue)."""
+    cfg = Config()
+    cfg.add_and_assign("EF_2stage", "2stage EF", bool, None, True)
+    ama = from_module("tpusppy.models.farmer", cfg,
+                      args=["--num-scens", "3", "--EF-solver-name", "admm"])
+    ama.run()
+    assert ama.EF_Obj == pytest.approx(-108390.0, rel=1e-4)
+    assert len(ama.first_stage_solution["ROOT"]) == 3
+
+
+def test_amalgamator_wheel():
+    """Declarative cylinder run: PH hub + lagrangian + xhatshuffle."""
+    cfg = Config()
+    cfg.add_and_assign("2stage", "2stage", bool, None, True)
+    cfg.quick_assign("cylinders", list, ["ph", "lagrangian", "xhatshuffle"])
+    ama = from_module("tpusppy.models.farmer", cfg, args=[
+        "--num-scens", "3", "--max-iterations", "20", "--default-rho", "1.0",
+        "--rel-gap", "0.005", "--lagrangian", "--xhatshuffle",
+    ])
+    ama.run()
+    assert ama.best_inner_bound == pytest.approx(-108390.0, rel=5e-3)
+    assert ama.best_outer_bound <= ama.best_inner_bound + 1e-6

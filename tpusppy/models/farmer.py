@@ -47,6 +47,8 @@ def kw_creator(cfg=None, **kwargs):
 
 
 def inparser_adder(cfg):
+    if "num_scens" not in cfg:
+        cfg.num_scens_required()
     cfg.add_to_config("crops_multiplier", description="farmer crop multiplier",
                       domain=int, default=1)
     cfg.add_to_config("use_integer", description="integer acreage",

@@ -43,6 +43,7 @@ class SPBase:
         mesh=None,
         scenario_axis="scen",
         variable_probability=None,
+        scenario_denouement=None,
     ):
         self.options = dict(options or {})
         self.all_scenario_names = list(all_scenario_names)
@@ -51,6 +52,9 @@ class SPBase:
         self.mesh = mesh
         self.scenario_axis = scenario_axis
         self.verbose = self.options.get("verbose", False)
+        # called per scenario after a run completes (spbase.py scenario
+        # denouement protocol); signature (rank, scenario_name, scenario)
+        self.scenario_denouement = scenario_denouement
 
         problems = [
             scenario_creator(name, **self.scenario_creator_kwargs)

@@ -1,0 +1,258 @@
+"""Amalgamator: fully declarative entry — a model module becomes a run.
+
+TPU-native analogue of ``mpisppy/utils/amalgamator.py:100-451``.  A model
+module exporting ``scenario_creator``, ``scenario_names_creator``,
+``inparser_adder`` and ``kw_creator`` (checked at amalgamator.py:123-135) is
+turned into either a direct EF solve or a full wheel spin, driven by a
+:class:`~tpusppy.utils.config.Config`:
+
+* ``cfg["EF_2stage"] / cfg["EF_mstage"]`` -> batched-ADMM EF solve;
+* otherwise ``cfg["cylinders"]`` names the hub + spokes, each gated by its
+  boolean flag (``cfg["lagrangian"]`` etc.), assembled via
+  :mod:`tpusppy.utils.cfg_vanilla`.
+"""
+
+from __future__ import annotations
+
+import copy
+import importlib
+import inspect
+
+from .. import global_toc
+from ..ef import solve_ef
+from ..ir import ScenarioBatch
+from ..scenario_tree import create_nodenames_from_branching_factors
+from ..spin_the_wheel import WheelSpinner
+from . import cfg_vanilla as vanilla
+from .config import Config
+
+# hub / spoke registries (amalgamator.py:60-99); multistage compatibility flags
+hubs_and_multi_compatibility = {"ph": True, "aph": True, "lshaped": False}
+spokes_and_multi_compatibility = {
+    "fwph": False,
+    "lagrangian": True,
+    "lagranger": True,
+    "xhatlooper": False,
+    "xhatshuffle": True,
+    "xhatspecific": True,
+    "xhatxbar": True,
+    "xhatlshaped": False,
+    "slammax": False,
+    "slammin": False,
+    "cross_scenario_cuts": False,
+}
+default_unused_spokes = ["xhatlooper", "xhatspecific"]
+
+extensions_classes = {}  # name -> add_<name> handled via vanilla when present
+
+
+def _bool_option(cfg, oname):
+    return oname in cfg and bool(cfg.get(oname))
+
+
+def find_hub(cylinders, is_multi=False) -> str:
+    hubs = set(cylinders) & set(hubs_and_multi_compatibility)
+    if len(hubs) != 1:
+        raise RuntimeError("There must be exactly one hub among cylinders")
+    hub = hubs.pop()
+    if is_multi and not hubs_and_multi_compatibility[hub]:
+        raise RuntimeError(f"The hub {hub} does not work with multistage")
+    return hub
+
+
+def find_spokes(cylinders, is_multi=False) -> list:
+    spokes = []
+    for c in cylinders:
+        if c in hubs_and_multi_compatibility:
+            continue
+        if c not in spokes_and_multi_compatibility:
+            raise RuntimeError(f"Unknown cylinder {c}")
+        if is_multi and not spokes_and_multi_compatibility[c]:
+            raise RuntimeError(f"The spoke {c} does not work with multistage")
+        if c in default_unused_spokes:
+            print(f"{c} is unused by default; set --{c} to activate it")
+        spokes.append(c)
+    return spokes
+
+
+def check_module_ama(module):
+    """(amalgamator.py:123-135)"""
+    missing = [
+        e for e in ("scenario_names_creator", "scenario_creator",
+                    "inparser_adder", "kw_creator")
+        if not hasattr(module, e)
+    ]
+    if missing:
+        raise RuntimeError(
+            f"Module {module} not complete for from_module: missing {missing}"
+        )
+
+
+def Amalgamator_parser(cfg, inparser_adder, extraargs_fct=None,
+                       use_command_line=True, args=None):
+    """Populate cfg with the right option groups (amalgamator.py:183-250)."""
+    if use_command_line:
+        if _bool_option(cfg, "EF_2stage"):
+            cfg.EF2()
+        elif _bool_option(cfg, "EF_mstage"):
+            cfg.EF_multistage()
+            cfg.add_branching_factors()
+        else:
+            if _bool_option(cfg, "2stage"):
+                cfg.popular_args()
+            elif _bool_option(cfg, "mstage"):
+                cfg.multistage()
+            else:
+                raise RuntimeError(
+                    "The problem type (2stage or mstage) must be specified"
+                )
+            cfg.two_sided_args()
+            cfg.mip_options()
+            if "cylinders" not in cfg:
+                raise RuntimeError("A cylinder list must be specified")
+            for cylinder in cfg["cylinders"]:
+                args_fct = getattr(cfg, cylinder + "_args", None)
+                if args_fct is not None:
+                    args_fct()
+            for extension in cfg.get("extensions") or []:
+                args_fct = getattr(cfg, extension + "_args", None)
+                if args_fct is not None:
+                    args_fct()
+        inparser_adder(cfg)
+        if extraargs_fct is not None:
+            extraargs_fct()
+        cfg.parse_command_line(cfg.get("program_name"), args=args)
+    else:
+        if not (_bool_option(cfg, "EF_2stage")
+                or _bool_option(cfg, "EF_mstage")
+                or "cylinders" in cfg):
+            raise RuntimeError(
+                "Bypassing the command line requires EF flags or cylinders"
+            )
+        if _bool_option(cfg, "EF_mstage") and "branching_factors" not in cfg:
+            raise RuntimeError(
+                "Multistage problems need cfg['branching_factors']"
+            )
+    return cfg
+
+
+def from_module(mname, cfg, extraargs_fct=None, use_command_line=True,
+                args=None):
+    """(amalgamator.py:139-176).  ``args``: optional argv for testing."""
+    if not isinstance(cfg, Config):
+        raise RuntimeError(f"from_module bad cfg type={type(cfg)}")
+    m = mname if inspect.ismodule(mname) else importlib.import_module(mname)
+    check_module_ama(m)
+    cfg = Amalgamator_parser(cfg, m.inparser_adder,
+                             extraargs_fct=extraargs_fct,
+                             use_command_line=use_command_line, args=args)
+    if cfg.get("num_scens") is not None:
+        cfg.add_and_assign("_mpisppy_probability", "Uniform prob.", float,
+                           None, 1.0 / cfg["num_scens"])
+    start = cfg.get("start") or 0
+    sn = m.scenario_names_creator(cfg["num_scens"], start=start)
+    dn = getattr(m, "scenario_denouement", None)
+    return Amalgamator(cfg, sn, m.scenario_creator, m.kw_creator,
+                       scenario_denouement=dn)
+
+
+class Amalgamator:
+    """(amalgamator.py:253-451)"""
+
+    def __init__(self, cfg, scenario_names, scenario_creator, kw_creator,
+                 scenario_denouement=None, verbose=True):
+        self.cfg = cfg
+        self.scenario_names = list(scenario_names)
+        self.scenario_creator = scenario_creator
+        self.scenario_denouement = scenario_denouement
+        self.kw_creator = kw_creator
+        self.kwargs = kw_creator(cfg)
+        self.verbose = verbose
+        self.is_EF = _bool_option(cfg, "EF_2stage") or _bool_option(
+            cfg, "EF_mstage")
+        self.is_multi = _bool_option(cfg, "EF_mstage") or _bool_option(
+            cfg, "mstage")
+        if self.is_multi and "all_nodenames" not in cfg:
+            if "branching_factors" in cfg and cfg["branching_factors"]:
+                ndnms = create_nodenames_from_branching_factors(
+                    cfg["branching_factors"]
+                )
+                self.cfg.quick_assign("all_nodenames", list, ndnms)
+            else:
+                raise RuntimeError(
+                    "Multistage needs branching_factors or all_nodenames"
+                )
+
+    def _build_batch(self) -> ScenarioBatch:
+        return ScenarioBatch.from_problems([
+            self.scenario_creator(nm, **(self.kwargs or {}))
+            for nm in self.scenario_names
+        ])
+
+    def run(self):
+        """Top-level execution (amalgamator.py:292-411)."""
+        if self.is_EF:
+            batch = self._build_batch()
+            if self.verbose:
+                global_toc("Starting EF solve")
+            obj, x = solve_ef(batch, solver="admm")
+            if self.verbose:
+                global_toc("Completed EF solve")
+            self.EF_Obj = obj
+            self.is_minimizing = True
+            self.best_outer_bound = obj
+            self.best_inner_bound = obj
+            self.ef = (batch, x)
+            # nonant cache per node, like sputils.nonant_cache_from_ef
+            tree = batch.tree
+            root_slots = tree.nonant_indices[tree.nonant_stage == 1]
+            self.xhats = {"ROOT": x[0][root_slots]}
+            self.local_xhats = self.xhats
+            self.first_stage_solution = {"ROOT": self.xhats["ROOT"]}
+            return self
+
+        hub_name = find_hub(self.cfg["cylinders"], self.is_multi)
+        hub_creator = getattr(vanilla, hub_name + "_hub")
+        beans = {
+            "cfg": self.cfg,
+            "scenario_creator": self.scenario_creator,
+            "scenario_denouement": self.scenario_denouement,
+            "all_scenario_names": self.scenario_names,
+            "scenario_creator_kwargs": self.kwargs,
+        }
+        if self.is_multi:
+            beans["all_nodenames"] = self.cfg["all_nodenames"]
+        hub_dict = hub_creator(**beans)
+
+        for extension in self.cfg.get("extensions") or []:
+            extension_creator = getattr(vanilla, "add_" + extension, None)
+            if extension_creator is not None:
+                hub_dict = extension_creator(hub_dict, self.cfg)
+
+        potential = find_spokes(self.cfg["cylinders"], self.is_multi)
+        spokes = [s for s in potential if self.cfg.get(s)]
+        list_of_spoke_dict = []
+        for spoke in spokes:
+            spoke_creator = getattr(vanilla, spoke + "_spoke")
+            spoke_beans = copy.copy(beans)
+            if spoke == "xhatspecific":
+                spoke_beans["xhat_scenario_dict"] = self.cfg["scenario_dict"]
+            list_of_spoke_dict.append(spoke_creator(**spoke_beans))
+
+        ws = WheelSpinner(hub_dict, list_of_spoke_dict)
+        ws.run()
+        self.opt = ws.opt
+        self.on_hub = True
+        self.best_inner_bound = ws.BestInnerBound
+        self.best_outer_bound = ws.BestOuterBound
+        if "first_stage_solution_csv" in self.cfg:
+            ws.write_first_stage_solution(self.cfg["first_stage_solution_csv"])
+        if "tree_solution_csv" in self.cfg:
+            ws.write_tree_solution(self.cfg["tree_solution_csv"])
+        self.local_xhats = ws.local_nonant_cache
+        if ws.local_nonant_cache is not None:
+            tree = self.opt.tree
+            self.first_stage_solution = {
+                "ROOT": ws.local_nonant_cache[0][tree.nonant_stage == 1]
+            }
+        return self

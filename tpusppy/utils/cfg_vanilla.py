@@ -1,0 +1,338 @@
+"""cfg_vanilla: Config -> hub/spoke dict factories.
+
+TPU-native analogue of ``mpisppy/utils/cfg_vanilla.py:41-637``: every factory
+returns the dict a :class:`~tpusppy.spin_the_wheel.WheelSpinner` consumes.
+Names and structure mirror the reference so driver scripts port mechanically:
+``ph_hub``, ``lagrangian_spoke``, ``lagranger_spoke``, ``xhatlooper_spoke``,
+``xhatshuffle_spoke``, ``xhatxbar_spoke``, ``xhatspecific_spoke``,
+``slammax_spoke``, ``slammin_spoke``, plus ``extension_adder``.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from ..cylinders import (
+    LagrangerOuterBound,
+    LagrangianOuterBound,
+    PHHub,
+    SlamMaxHeuristic,
+    SlamMinHeuristic,
+    XhatLooperInnerBound,
+    XhatShuffleInnerBound,
+    XhatSpecificInnerBound,
+    XhatXbarInnerBound,
+)
+from ..extensions.extension import MultiExtension
+from ..opt.ph import PH
+from ..phbase import PHBase
+from ..xhat_eval import Xhat_Eval
+from .solver_spec import option_string_to_dict
+
+
+def _hasit(cfg, name):
+    return name in cfg and cfg.get(name) is not None
+
+
+def _admm_solver_options(cfg) -> dict:
+    """Translate Config solver knobs into ADMMSettings-shaped options.
+
+    ``solver_options`` strings may carry ADMMSettings field names directly
+    (e.g. 'max_iter=500 dtype=float32'); the admm_* fields map onto them.
+    """
+    so = option_string_to_dict(cfg.get("solver_options"))
+    if _hasit(cfg, "admm_dtype"):
+        so.setdefault("dtype", cfg.admm_dtype)
+    if _hasit(cfg, "admm_max_iter"):
+        so.setdefault("max_iter", cfg.admm_max_iter)
+    if _hasit(cfg, "admm_restarts"):
+        so.setdefault("restarts", cfg.admm_restarts)
+    if _hasit(cfg, "admm_eps"):
+        so.setdefault("eps_abs", cfg.admm_eps)
+        so.setdefault("eps_rel", cfg.admm_eps)
+    return so
+
+
+def shared_options(cfg) -> dict:
+    """The option dict every cylinder starts from (cfg_vanilla.py:41-63)."""
+    shoptions = {
+        "solver_name": cfg.get("solver_name"),
+        "solver_options": _admm_solver_options(cfg),
+        "defaultPHrho": cfg.get("default_rho"),
+        "convthresh": 0,
+        "PHIterLimit": cfg.get("max_iterations", 1),
+        "verbose": cfg.get("verbose", False),
+        "display_progress": cfg.get("display_progress", False),
+        "display_convergence_detail": cfg.get(
+            "display_convergence_detail", False),
+        "tee-rank0-solves": cfg.get("tee_rank0_solves", False),
+        "trace_prefix": cfg.get("trace_prefix"),
+    }
+    return shoptions
+
+
+def add_multistage_options(cylinder_dict, all_nodenames, branching_factors):
+    """(cfg_vanilla.py:64-75)"""
+    cylinder_dict = copy.deepcopy(cylinder_dict)
+    if branching_factors is not None:
+        cylinder_dict["opt_kwargs"].setdefault("options", {})[
+            "branching_factors"] = branching_factors
+        if all_nodenames is None:
+            from ..scenario_tree import create_nodenames_from_branching_factors
+
+            all_nodenames = create_nodenames_from_branching_factors(
+                branching_factors[:-1]
+            )
+    if all_nodenames is not None:
+        cylinder_dict["opt_kwargs"]["all_nodenames"] = all_nodenames
+    return cylinder_dict
+
+
+def ph_hub(
+    cfg,
+    scenario_creator,
+    scenario_denouement=None,
+    all_scenario_names=None,
+    scenario_creator_kwargs=None,
+    ph_extensions=None,
+    extension_kwargs=None,
+    ph_converger=None,
+    rho_setter=None,
+    variable_probability=None,
+    all_nodenames=None,
+):
+    """(cfg_vanilla.py:77-127)"""
+    options = shared_options(cfg)
+    options["convthresh"] = cfg.get("intra_hub_conv_thresh", 1e-10)
+    options["bundles_per_rank"] = cfg.get("bundles_per_rank", 0)
+    hub_dict = {
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": {
+            "rel_gap": cfg.get("rel_gap"),
+            "abs_gap": cfg.get("abs_gap"),
+            "max_stalled_iters": cfg.get("max_stalled_iters"),
+        }},
+        "opt_class": PH,
+        "opt_kwargs": {
+            "options": options,
+            "all_scenario_names": all_scenario_names,
+            "scenario_creator": scenario_creator,
+            "scenario_creator_kwargs": scenario_creator_kwargs,
+            "scenario_denouement": scenario_denouement,
+            "rho_setter": rho_setter,
+            "variable_probability": variable_probability,
+            "extensions": ph_extensions,
+            "extension_kwargs": extension_kwargs,
+            "ph_converger": ph_converger,
+            "all_nodenames": all_nodenames,
+        },
+    }
+    # drop gap options the cfg does not carry (hub ignores missing keys)
+    hub_dict["hub_kwargs"]["options"] = {
+        k: v for k, v in hub_dict["hub_kwargs"]["options"].items()
+        if v is not None
+    }
+    return hub_dict
+
+
+def extension_adder(hub_dict, ext_class):
+    """Attach an extension class, composing with MultiExtension when several
+    are requested (cfg_vanilla.py:164-190)."""
+    ok = hub_dict["opt_kwargs"]
+    cur = ok.get("extensions")
+    if cur is None:
+        ok["extensions"] = ext_class
+    elif cur is MultiExtension:
+        kws = ok.setdefault("extension_kwargs", {"ext_classes": []})
+        if ext_class not in kws["ext_classes"]:
+            kws["ext_classes"].append(ext_class)
+    else:
+        first = cur
+        ok["extensions"] = MultiExtension
+        ok["extension_kwargs"] = {"ext_classes": [first, ext_class]}
+    return hub_dict
+
+
+def _spoke_opt_kwargs(cfg, scenario_creator, all_scenario_names,
+                      scenario_creator_kwargs, all_nodenames, options):
+    return {
+        "options": options,
+        "all_scenario_names": all_scenario_names,
+        "scenario_creator": scenario_creator,
+        "scenario_creator_kwargs": scenario_creator_kwargs,
+        "all_nodenames": all_nodenames,
+    }
+
+
+def lagrangian_spoke(
+    cfg,
+    scenario_creator,
+    scenario_denouement=None,
+    all_scenario_names=None,
+    scenario_creator_kwargs=None,
+    rho_setter=None,
+    all_nodenames=None,
+):
+    """(cfg_vanilla.py:320-355)"""
+    options = shared_options(cfg)
+    return {
+        "spoke_class": LagrangianOuterBound,
+        "spoke_kwargs": {},
+        "opt_class": PHBase,
+        "opt_kwargs": {
+            **_spoke_opt_kwargs(cfg, scenario_creator, all_scenario_names,
+                                scenario_creator_kwargs, all_nodenames,
+                                options),
+            "rho_setter": rho_setter,
+        },
+    }
+
+
+def lagranger_spoke(
+    cfg,
+    scenario_creator,
+    scenario_denouement=None,
+    all_scenario_names=None,
+    scenario_creator_kwargs=None,
+    rho_setter=None,
+    all_nodenames=None,
+):
+    """(cfg_vanilla.py:356-392)"""
+    options = shared_options(cfg)
+    if _hasit(cfg, "lagranger_rho_rescale_factors_json"):
+        options["lagranger_rho_rescale_factors_json"] = \
+            cfg.lagranger_rho_rescale_factors_json
+    return {
+        "spoke_class": LagrangerOuterBound,
+        "spoke_kwargs": {},
+        "opt_class": PHBase,
+        "opt_kwargs": {
+            **_spoke_opt_kwargs(cfg, scenario_creator, all_scenario_names,
+                                scenario_creator_kwargs, all_nodenames,
+                                options),
+            "rho_setter": rho_setter,
+        },
+    }
+
+
+def _xhat_spoke(cfg, spoke_class, scenario_creator, all_scenario_names,
+                scenario_creator_kwargs, all_nodenames, extra_options=None):
+    options = shared_options(cfg)
+    options.update(extra_options or {})
+    return {
+        "spoke_class": spoke_class,
+        "spoke_kwargs": {},
+        "opt_class": Xhat_Eval,
+        "opt_kwargs": _spoke_opt_kwargs(
+            cfg, scenario_creator, all_scenario_names,
+            scenario_creator_kwargs, all_nodenames, options),
+    }
+
+
+def xhatlooper_spoke(
+    cfg,
+    scenario_creator,
+    scenario_denouement=None,
+    all_scenario_names=None,
+    scenario_creator_kwargs=None,
+    all_nodenames=None,
+):
+    """(cfg_vanilla.py:393-423)"""
+    return _xhat_spoke(
+        cfg, XhatLooperInnerBound, scenario_creator, all_scenario_names,
+        scenario_creator_kwargs, all_nodenames,
+        {"xhat_looper_options": {
+            "xhat_solver_options": {},
+            "scen_limit": cfg.get("xhat_scen_limit", 3),
+            "dump_prefix": "delme",
+            "csvname": "looper.csv",
+        }},
+    )
+
+
+def xhatshuffle_spoke(
+    cfg,
+    scenario_creator,
+    scenario_denouement=None,
+    all_scenario_names=None,
+    scenario_creator_kwargs=None,
+    all_nodenames=None,
+):
+    """(cfg_vanilla.py:457-494)"""
+    return _xhat_spoke(
+        cfg, XhatShuffleInnerBound, scenario_creator, all_scenario_names,
+        scenario_creator_kwargs, all_nodenames,
+        {"xhat_looper_options": {
+            "xhat_solver_options": {},
+            "scen_limit": cfg.get("xhat_scen_limit", 3),
+            "reverse": cfg.get("add_reversed_shuffle", False),
+            "iter_step": cfg.get("xhatshuffle_iter_step"),
+        }},
+    )
+
+
+def xhatspecific_spoke(
+    cfg,
+    scenario_creator,
+    xhat_scenario_dict,
+    scenario_denouement=None,
+    all_scenario_names=None,
+    scenario_creator_kwargs=None,
+    all_nodenames=None,
+):
+    """(cfg_vanilla.py:495-528)"""
+    return _xhat_spoke(
+        cfg, XhatSpecificInnerBound, scenario_creator, all_scenario_names,
+        scenario_creator_kwargs, all_nodenames,
+        {"xhat_specific_options": {
+            "xhat_solver_options": {},
+            "xhat_scenario_dict": xhat_scenario_dict,
+            "csvname": "specific.csv",
+        }},
+    )
+
+
+def xhatxbar_spoke(
+    cfg,
+    scenario_creator,
+    scenario_denouement=None,
+    all_scenario_names=None,
+    scenario_creator_kwargs=None,
+    all_nodenames=None,
+):
+    """(cfg_vanilla.py:424-456)"""
+    return _xhat_spoke(
+        cfg, XhatXbarInnerBound, scenario_creator, all_scenario_names,
+        scenario_creator_kwargs, all_nodenames,
+        {"xhat_xbar_options": {"xhat_solver_options": {}, "csvname": "xbar.csv"}},
+    )
+
+
+def slammax_spoke(
+    cfg,
+    scenario_creator,
+    scenario_denouement=None,
+    all_scenario_names=None,
+    scenario_creator_kwargs=None,
+    all_nodenames=None,
+):
+    """(cfg_vanilla.py:554-577)"""
+    return _xhat_spoke(
+        cfg, SlamMaxHeuristic, scenario_creator, all_scenario_names,
+        scenario_creator_kwargs, all_nodenames,
+    )
+
+
+def slammin_spoke(
+    cfg,
+    scenario_creator,
+    scenario_denouement=None,
+    all_scenario_names=None,
+    scenario_creator_kwargs=None,
+    all_nodenames=None,
+):
+    """(cfg_vanilla.py:578-601)"""
+    return _xhat_spoke(
+        cfg, SlamMinHeuristic, scenario_creator, all_scenario_names,
+        scenario_creator_kwargs, all_nodenames,
+    )

@@ -1,0 +1,57 @@
+"""Solver-spec resolution: prefixed names + option-string parsing.
+
+TPU-native analogue of ``mpisppy/utils/solver_spec.py:34-68``: a config may
+carry ``solver_name``/``solver_options`` under several prefixes (e.g.
+``EF_solver_name``); the first prefix in ``prefixes`` that has a name wins.
+Option strings are space-delimited ``key=value`` pairs (config.py solver
+options convention); values parse as int/float/bool when they look like one.
+"""
+
+from __future__ import annotations
+
+
+def _coerce(v: str):
+    low = v.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    try:
+        return int(v)
+    except ValueError:
+        pass
+    try:
+        return float(v)
+    except ValueError:
+        return v
+
+
+def option_string_to_dict(ostr) -> dict:
+    """'mipgap=0.01 threads=2' -> {'mipgap': 0.01, 'threads': 2}
+    (sputils option_string_to_dict semantics)."""
+    if not ostr:
+        return {}
+    if isinstance(ostr, dict):
+        return dict(ostr)
+    out = {}
+    for tok in str(ostr).split():
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            out[k] = _coerce(v)
+        else:
+            out[tok] = None
+    return out
+
+
+def solver_specification(cfg, prefixes=("",)) -> tuple:
+    """(solver_name, solver_options dict) from the first matching prefix
+    (solver_spec.py:34-68)."""
+    if isinstance(prefixes, str):
+        prefixes = (prefixes,)
+    for p in prefixes:
+        root = f"{p}_solver" if p else "solver"
+        name = cfg.get(f"{root}_name")
+        if name is not None:
+            return name, option_string_to_dict(cfg.get(f"{root}_options"))
+    # fall back to unprefixed
+    return cfg.get("solver_name"), option_string_to_dict(
+        cfg.get("solver_options")
+    )
