@@ -1,0 +1,123 @@
+"""Extensions + convergers: rho updaters, fixer, gapper, trackers, convergers.
+
+Mirrors the reference's extension callout contract (extension.py:12-110,
+called from phbase Iter0/iterk loops) and converger consultation
+(phbase.py:925-934).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tpusppy.convergers.fracintsnotconv import FractionalConverger
+from tpusppy.convergers.norm_rho_converger import NormRhoConverger
+from tpusppy.convergers.primal_dual_converger import PrimalDualConverger
+from tpusppy.extensions.avgminmaxer import MinMaxAvg
+from tpusppy.extensions.diagnoser import Diagnoser
+from tpusppy.extensions.extension import MultiExtension
+from tpusppy.extensions.fixer import Fixer, Fixer_tuple
+from tpusppy.extensions.mipgapper import Gapper
+from tpusppy.extensions.mult_rho_updater import MultRhoUpdater
+from tpusppy.extensions.norm_rho_updater import NormRhoUpdater
+from tpusppy.extensions.wtracker_extension import Wtracker_extension
+from tpusppy.models import farmer
+from tpusppy.opt.ph import PH
+
+
+def _ph(n=3, iters=5, extensions=None, extension_kwargs=None,
+        ph_converger=None, extra_options=None, **fkw):
+    opts = {"defaultPHrho": 1.0, "PHIterLimit": iters, "convthresh": -1.0}
+    opts.update(extra_options or {})
+    return PH(opts, farmer.scenario_names_creator(n), farmer.scenario_creator,
+              scenario_creator_kwargs={"num_scens": n, **fkw},
+              extensions=extensions, extension_kwargs=extension_kwargs,
+              ph_converger=ph_converger)
+
+
+def test_norm_rho_updater_changes_rho():
+    ph = _ph(iters=12, extensions=NormRhoUpdater, extra_options={
+        "norm_rho_options": {"convergence_tolerance": 1e-6,
+                             "primal_dual_difference_factor": 2.0}})
+    rho0 = ph.rho.copy()
+    ph.ph_main(finalize=False)
+    assert not np.allclose(ph.rho, rho0)  # farmer's primal residuals move rho
+
+
+def test_norm_rho_converger_requires_updater():
+    ph = _ph(ph_converger=NormRhoConverger)
+    with pytest.raises(RuntimeError):
+        ph.ph_main(finalize=False)
+
+
+def test_norm_rho_with_converger_runs():
+    ph = _ph(extensions=NormRhoUpdater, ph_converger=NormRhoConverger,
+             extra_options={"convthresh": -50.0})
+    ph.ph_main(finalize=False)  # converger consulted without error
+    assert ph.ph_converger.conv is not None
+
+
+def test_mult_rho_updater():
+    ph = _ph(extensions=MultRhoUpdater, iters=6, extra_options={
+        "mult_rho_options": {"rho_update_start_iteration": 2}})
+    ph.ph_main(finalize=False)
+    # rho tracks first_rho * first_conv / conv; conv decreases => rho grows
+    assert ph.rho.mean() >= 1.0
+
+
+def test_primal_dual_converger_stops():
+    ph = _ph(iters=200, ph_converger=PrimalDualConverger, extra_options={
+        "primal_dual_converger_options": {"tol": 50.0}})
+    ph.ph_main(finalize=False)
+    assert ph._iter < 200  # stopped by the converger, not the limit
+
+
+def test_fractional_converger_continuous_is_zero():
+    ph = _ph(ph_converger=FractionalConverger,
+             extra_options={"convthresh": -1.0})
+    ph.ph_main(finalize=False)
+    assert ph.ph_converger.conv == 0.0  # no integers in continuous farmer
+
+
+def test_fixer_fixes_converged_slots():
+    fo = {"fixeroptions": {
+        "boundtol": 1e-3,
+        "id_fix_list_fct": lambda batch: (
+            [], [Fixer_tuple(k, th=1e-2, nb=2) for k in range(3)]),
+    }}
+    ph = _ph(iters=80, extensions=Fixer, extra_options=fo)
+    ph.ph_main(finalize=False)
+    fixer = ph.extobject
+    assert fixer.fixed_so_far > 0
+    # fixed slots really are clamped in the batch bounds
+    idx = ph.tree.nonant_indices[fixer.fixed]
+    assert np.allclose(ph.batch.lb[:, idx], ph.batch.ub[:, idx])
+
+
+def test_gapper_schedule():
+    go = {"gapperoptions": {"mipgapdict": {0: 1e-5, 3: 1e-6}}}
+    ph = _ph(iters=4, extensions=Gapper, extra_options=go)
+    ph.ph_main(finalize=False)
+    assert ph.admm_settings.eps_rel == 1e-6
+
+
+def test_wtracker_and_multi_extension(tmp_path, capsys):
+    ph = _ph(iters=6, extensions=MultiExtension,
+             extension_kwargs={"ext_classes": [Wtracker_extension, MinMaxAvg]},
+             extra_options={
+                 "wtracker_options": {"wlen": 3},
+                 "avgminmax_name": "objective",
+             })
+    ph.ph_main(finalize=True)
+    out = capsys.readouterr().out
+    assert "WTracker report" in out
+    assert "objective final" in out
+
+
+def test_diagnoser_writes(tmp_path):
+    d = str(tmp_path / "diag")
+    ph = _ph(iters=2, extensions=Diagnoser,
+             extra_options={"diagnoser_options": {"diagnoser_outdir": d}})
+    ph.ph_main(finalize=False)
+    files = os.listdir(d)
+    assert "diagnose_iter0.csv" in files and "diagnose_iter2.csv" in files

@@ -1,0 +1,37 @@
+"""FractionalConverger: fraction of non-converged integer nonants.
+
+TPU-native analogue of ``mpisppy/convergers/fracintsnotconv.py:13-77``: an
+integer nonant slot is "converged" when its scenarios agree, i.e. when
+xbar^2 == xsqbar within tolerance; the metric is the fraction that are not.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .converger import Converger
+
+
+class FractionalConverger(Converger):
+    def __init__(self, opt):
+        super().__init__(opt)
+        self.name = "fractintsnotconv"
+        self.verbose = opt.options.get("verbose", False)
+
+    def _convergence_value(self) -> float:
+        opt = self.opt
+        ints = opt.batch.is_int[opt.tree.nonant_indices]      # (K,)
+        numints = int(ints.sum()) * opt.batch.num_scenarios
+        if numints == 0:
+            return 0.0
+        xb = opt.xbars[:, ints]
+        xsq = opt.xsqbars[:, ints]
+        conv = np.isclose(xb * xb, xsq, atol=1e-9)
+        return 1.0 - float(conv.sum()) / numints
+
+    def is_converged(self) -> bool:
+        self.conv = self._convergence_value()
+        self.conv_value = self.conv
+        if self.verbose:
+            print(f"{self.name}: convergence value={self.conv}")
+        return self.conv < self.opt.options["convthresh"]

@@ -1,0 +1,38 @@
+"""NormRhoConverger: stop when log of the probability-weighted rho norm drops.
+
+TPU-native analogue of ``mpisppy/convergers/norm_rho_converger.py:12-56``.
+Only meaningful with :class:`~tpusppy.extensions.norm_rho_updater.NormRhoUpdater`
+active (which shrinks rho as residuals converge).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .converger import Converger
+
+
+class NormRhoConverger(Converger):
+    def __init__(self, opt):
+        super().__init__(opt)
+        nro = opt.options.get("norm_rho_converger_options", {})
+        self._verbose = bool(nro.get("verbose", False))
+
+    def _compute_rho_norm(self) -> float:
+        opt = self.opt
+        return float(opt.probs @ opt.rho.sum(axis=1))
+
+    def is_converged(self) -> bool:
+        if not getattr(self.opt, "_norm_rho_update_inuse", False):
+            raise RuntimeError(
+                "NormRhoConverger can only be used if NormRhoUpdater is"
+            )
+        log_rho_norm = math.log(max(self._compute_rho_norm(), 1e-300))
+        self.conv = log_rho_norm
+        self.conv_value = log_rho_norm
+        ret = log_rho_norm < self.opt.options["convthresh"]
+        if self._verbose:
+            print(f"log(|rho|) = {log_rho_norm}")
+        return ret

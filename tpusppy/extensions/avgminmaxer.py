@@ -1,0 +1,50 @@
+"""MinMaxAvg: report avg/min/max of a quantity across scenarios.
+
+TPU-native analogue of ``mpisppy/extensions/avgminmaxer.py`` (39 LoC).  The
+reference evaluates a named Pyomo component per scenario; here
+``options["avgminmax_name"]`` may be "objective" or a variable name from the
+model's ``var_names``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .extension import Extension
+
+
+class MinMaxAvg(Extension):
+    def __init__(self, opt, compstr=None):
+        super().__init__(opt)
+        self.compstr = compstr or opt.options.get("avgminmax_name",
+                                                  "objective")
+
+    def _values(self) -> np.ndarray:
+        opt = self.opt
+        if opt.local_x is None:
+            return np.zeros(opt.batch.num_scenarios)
+        if self.compstr == "objective":
+            return opt.batch.objective(opt.local_x)
+        var_names = getattr(opt, "_var_names", None)
+        if var_names is None:
+            p0 = opt.scenario_creator(
+                opt.all_scenario_names[0], **opt.scenario_creator_kwargs
+            )
+            var_names = p0.var_names or []
+            opt._var_names = var_names
+        j = var_names.index(self.compstr)
+        return np.asarray(opt.local_x)[:, j]
+
+    def _report(self, when):
+        v = self._values()
+        print(f"  {self.compstr} {when}: avg={v.mean():.6g} "
+              f"min={v.min():.6g} max={v.max():.6g}")
+
+    def post_iter0(self):
+        self._report("post iter0")
+
+    def enditer(self):
+        self._report(f"iter {self.opt._iter}")
+
+    def post_everything(self):
+        self._report("final")

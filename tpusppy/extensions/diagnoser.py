@@ -1,0 +1,44 @@
+"""Diagnoser: per-scenario per-iteration diagnostic dumps.
+
+TPU-native analogue of ``mpisppy/extensions/diagnoser.py`` (71 LoC): writes a
+CSV per iteration with per-scenario objective, primal/dual residuals, and
+deviation from xbar, into ``options["diagnoser_options"]["diagnoser_outdir"]``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .extension import Extension
+
+
+class Diagnoser(Extension):
+    def __init__(self, opt):
+        super().__init__(opt)
+        do = opt.options.get("diagnoser_options", {})
+        self.outdir = do.get("diagnoser_outdir", "diagnoser_out")
+
+    def _write(self, tag):
+        opt = self.opt
+        if opt.local_x is None:
+            return
+        os.makedirs(self.outdir, exist_ok=True)
+        objs = opt.batch.objective(opt.local_x)
+        xk = opt.nonants_of(opt.local_x)
+        dev = np.abs(xk - opt.xbars).mean(axis=1) if hasattr(opt, "xbars") \
+            else np.zeros_like(objs)
+        path = os.path.join(self.outdir, f"diagnose_{tag}.csv")
+        with open(path, "w") as f:
+            f.write("scenario,objective,pri_res,dua_res,mean_dev_from_xbar\n")
+            for s, name in enumerate(opt.all_scenario_names):
+                pri = opt.pri_res[s] if opt.pri_res is not None else np.nan
+                dua = opt.dua_res[s] if opt.dua_res is not None else np.nan
+                f.write(f"{name},{objs[s]!r},{pri!r},{dua!r},{dev[s]!r}\n")
+
+    def post_iter0(self):
+        self._write("iter0")
+
+    def enditer(self):
+        self._write(f"iter{self.opt._iter}")

@@ -1,0 +1,121 @@
+"""Fixer: WW-style fixing of (integer) nonants on convergence signatures.
+
+TPU-native analogue of ``mpisppy/extensions/fixer.py:20-330``.  A slot is a
+candidate when sqrt|xsqbar - xbar^2| < threshold (scenarios agree); counts of
+consecutive converged iterations drive fixing (nb), with variants requiring
+the value to also sit at the variable's lower (lb) or upper (ub) bound.
+Fixing is a persistent clamp of the batch bound columns (lb = ub = value) —
+the batched analogue of ``xvar.fix()``.
+
+Options (``opt.options["fixeroptions"]``):
+  id_fix_list_fct: callable(batch) -> (iter0_tuples, iterk_tuples), each a
+    list of ``(slot, th, nb, lb, ub)`` over *nonant slot indices* (the IR
+    analogue of Pyomo var ids); or pass the lists directly as
+    ``iter0_fixer_tuples`` / ``fixer_tuples``.
+  boundtol: tolerance for "at its bound".
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .extension import Extension
+
+
+def Fixer_tuple(slot, th=None, nb=None, lb=None, ub=None):
+    """Self-documenting tuple maker (fixer.py:20-48); ``slot`` is a nonant
+    slot index (reference passes id(xvar))."""
+    if th is None and nb is None and lb is None and ub is None:
+        print(f"warning: Fixer_tuple called for slot={slot} "
+              "but no arguments were given")
+    return (int(slot), 0.0 if th is None else th, nb, lb, ub)
+
+
+class Fixer(Extension):
+    def __init__(self, opt):
+        super().__init__(opt)
+        fo = opt.options["fixeroptions"]
+        self.verbose = opt.options.get("verbose", False) or fo.get(
+            "verbose", False)
+        self.boundtol = fo["boundtol"]
+        if "id_fix_list_fct" in fo and fo["id_fix_list_fct"] is not None:
+            self.iter0_tuples, self.iterk_tuples = fo["id_fix_list_fct"](
+                opt.batch)
+        else:
+            self.iter0_tuples = fo.get("iter0_fixer_tuples") or []
+            self.iterk_tuples = fo.get("fixer_tuples") or []
+        K = opt.nonant_length
+        self.conv_iter_count = np.zeros(K, dtype=np.int64)
+        self.fixed = np.zeros(K, dtype=bool)
+        self.fixed_so_far = 0
+
+    # ---- the fixing primitive ----------------------------------------------
+    def _fix_slots(self, slots: np.ndarray, values: np.ndarray):
+        """Persistently clamp nonant slots across all scenarios
+        (fixer.py _update_fix_counts/_fix_loop collapsed to one clamp)."""
+        opt = self.opt
+        idx = opt.tree.nonant_indices[slots]
+        ints = opt.batch.is_int[idx]
+        values = np.where(ints, np.round(values), values)
+        # respect original bounds
+        values = np.clip(values, opt.batch.lb[:, idx], opt.batch.ub[:, idx])
+        opt.batch.lb[:, idx] = values
+        opt.batch.ub[:, idx] = values
+        self.fixed[slots] = True
+        self.fixed_so_far += len(slots)
+        if self.verbose:
+            print(f"Fixer: fixed slots {list(slots)} "
+                  f"(total {self.fixed_so_far})")
+
+    def _sqrt_dev(self) -> np.ndarray:
+        """(S, K) sqrt|xsqbar - xbar^2| — the WW convergence signature."""
+        opt = self.opt
+        return np.sqrt(np.abs(opt.xsqbars - opt.xbars * opt.xbars))
+
+    def _apply_tuples(self, tuples, use_counts: bool):
+        opt = self.opt
+        dev = self._sqrt_dev().max(axis=0)          # (K,) worst over scenarios
+        xbar = opt.xbars[0]                          # nonanticipative per node
+        idx = opt.tree.nonant_indices
+        varlb = opt.batch.lb[0, idx]
+        varub = opt.batch.ub[0, idx]
+        to_fix, fix_vals = [], []
+        for (slot, th, nb, lb, ub) in tuples:
+            if self.fixed[slot]:
+                continue
+            conv = dev[slot] <= th
+            at_lb = conv and abs(xbar[slot] - varlb[slot]) <= self.boundtol
+            at_ub = conv and abs(xbar[slot] - varub[slot]) <= self.boundtol
+            if use_counts:
+                self.conv_iter_count[slot] = (
+                    self.conv_iter_count[slot] + 1 if conv else 0
+                )
+                cnt = self.conv_iter_count[slot]
+                trigger = (
+                    (nb is not None and conv and cnt >= nb)
+                    or (lb is not None and at_lb and cnt >= lb)
+                    or (ub is not None and at_ub and cnt >= ub)
+                )
+            else:
+                trigger = (
+                    (nb is not None and conv)
+                    or (lb is not None and at_lb)
+                    or (ub is not None and at_ub)
+                )
+            if trigger:
+                to_fix.append(slot)
+                fix_vals.append(xbar[slot])
+        if to_fix:
+            self._fix_slots(np.asarray(to_fix), np.asarray(fix_vals))
+
+    def post_iter0(self):
+        if self.iter0_tuples:
+            self._apply_tuples(self.iter0_tuples, use_counts=False)
+
+    def miditer(self):
+        if self.iterk_tuples:
+            self._apply_tuples(self.iterk_tuples, use_counts=True)
+
+    def post_everything(self):
+        if self.verbose:
+            print(f"Fixer: {self.fixed_so_far} slots fixed in total")

@@ -1,0 +1,41 @@
+"""Gapper: solver-tolerance schedule by iteration.
+
+TPU-native analogue of ``mpisppy/extensions/mipgapper.py:11-57``.  The
+reference schedules the external MIP solver's relative gap; here the analogue
+knob is the batched ADMM solver's relative tolerance (loose early iterations
+are cheaper, exactly the trick the mipgap schedule plays).
+
+Options: ``opt.options["gapperoptions"] = {"mipgapdict": {iter: gap}, ...}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .extension import Extension
+
+
+class Gapper(Extension):
+    def __init__(self, opt):
+        super().__init__(opt)
+        go = opt.options["gapperoptions"]
+        self.mipgapdict = go["mipgapdict"]
+        self.verbose = opt.options.get("verbose", False) or go.get(
+            "verbose", False)
+
+    def set_mipgap(self, mipgap):
+        old = self.opt.admm_settings.eps_rel
+        self.opt.admm_settings = dataclasses.replace(
+            self.opt.admm_settings, eps_rel=float(mipgap),
+        )
+        if self.verbose:
+            print(f"mipgapper: changing solver eps_rel from {old} "
+                  f"to {mipgap}")
+
+    def pre_iter0(self):
+        if self.mipgapdict and 0 in self.mipgapdict:
+            self.set_mipgap(self.mipgapdict[0])
+
+    def miditer(self):
+        if self.mipgapdict and self.opt._iter in self.mipgapdict:
+            self.set_mipgap(self.mipgapdict[self.opt._iter])

@@ -1,0 +1,63 @@
+"""MultRhoUpdater: hold rho at a constant multiple of the convergence metric.
+
+TPU-native analogue of ``mpisppy/extensions/mult_rho_updater.py:29-106``:
+rho_k = rho0_k * conv0 / conv_t, updated only when convergence improves, with
+optional start/stop iteration gates.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .extension import Extension
+
+_mult_rho_defaults = {
+    "convergence_tolerance": 1e-4,
+    "rho_update_stop_iteration": None,
+    "rho_update_start_iteration": None,
+    "verbose": False,
+}
+
+
+class MultRhoUpdater(Extension):
+    def __init__(self, opt):
+        super().__init__(opt)
+        options = opt.options.get("mult_rho_options", {})
+        g = lambda k: options.get(k, _mult_rho_defaults[k])
+        self._tol = g("convergence_tolerance")
+        self._stop_iter = g("rho_update_stop_iteration")
+        self._start_iter = g("rho_update_start_iteration")
+        self._verbose = g("verbose")
+        self._first_rho = None
+        self.first_c = None
+        self.best_conv = float("inf")
+
+    def _conv(self):
+        conv_obj = getattr(self.opt, "ph_converger", None)
+        if conv_obj is not None and getattr(conv_obj, "conv", None) is not None:
+            return conv_obj.conv
+        return self.opt.conv
+
+    def miditer(self):
+        opt = self.opt
+        it = opt._iter
+        if (self._stop_iter is not None and it > self._stop_iter) or \
+                (self._start_iter is not None and it < self._start_iter):
+            return
+        conv = self._conv()
+        if conv is None:
+            return
+        if conv < self.best_conv:
+            self.best_conv = conv
+        else:
+            return  # only act on a new best
+        if self._first_rho is None:
+            if conv == self._tol:
+                return
+            self.first_c = conv
+            self._first_rho = np.array(opt.rho, copy=True)
+        elif conv != 0:
+            opt.rho = self._first_rho * (self.first_c / conv)
+            if self._verbose:
+                print(f"MultRhoUpdater iter={it}; rho[0,0] now "
+                      f"{opt.rho[0, 0]}")

@@ -1,0 +1,83 @@
+"""NormRhoUpdater: adaptive per-slot rho from primal/dual residual balance.
+
+TPU-native analogue of ``mpisppy/extensions/norm_rho_updater.py:33-164``
+(adapted there from PySP's adaptive_rho_converger).  Per nonant slot:
+primal residual = sum_s p_s |x_sk - xbar_sk| (node-grouped), dual residual =
+rho * |xbar_t - xbar_{t-1}|; rho is increased when primal dominates, decreased
+when dual dominates, gently decreased when both are converged.  All slots
+update in one vectorized sweep.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .extension import Extension
+
+_norm_rho_defaults = {
+    "convergence_tolerance": 1e-4,
+    "rho_decrease_multiplier": 2.0,
+    "rho_increase_multiplier": 2.0,
+    "primal_dual_difference_factor": 100.0,
+    "iterations_converged_before_decrease": 0,
+    "rho_converged_decrease_multiplier": 1.1,
+    "rho_update_stop_iterations": None,
+    "verbose": False,
+}
+
+
+class NormRhoUpdater(Extension):
+    def __init__(self, opt):
+        super().__init__(opt)
+        options = opt.options.get("norm_rho_options", {})
+        g = lambda k: options.get(k, _norm_rho_defaults[k])
+        self._tol = g("convergence_tolerance")
+        self._rho_decrease = g("rho_decrease_multiplier")
+        self._rho_increase = g("rho_increase_multiplier")
+        self._pd_factor = g("primal_dual_difference_factor")
+        self._required_converged_before_decrease = g(
+            "iterations_converged_before_decrease")
+        self._rho_converged_residual_decrease = g(
+            "rho_converged_decrease_multiplier")
+        self._stop_iter_rho_update = g("rho_update_stop_iterations")
+        self._verbose = g("verbose")
+        self._prev_avg = None
+        opt._norm_rho_update_inuse = True   # allow NormRhoConverger
+
+    def _primal_residuals(self) -> np.ndarray:
+        """(S, K): per-slot node-grouped weighted L1 residual, broadcast back
+        to every member scenario (norm_rho_updater.py:55-97)."""
+        opt = self.opt
+        xk = opt.nonants_of(opt.local_x)
+        onehot = opt.tree.onehot_sk_n()
+        p = opt.probs[:, None]
+        resid_nk = np.einsum("skn,sk->nk", onehot, p * np.abs(xk - opt.xbars))
+        kidx = np.arange(xk.shape[1])[None, :]
+        return resid_nk[opt.nid_sk, kidx]
+
+    def miditer(self):
+        opt = self.opt
+        if self._stop_iter_rho_update is not None and \
+                opt._iter > self._stop_iter_rho_update:
+            return
+        if self._prev_avg is None:
+            self._prev_avg = np.array(opt.xbars, copy=True)
+            return
+        primal = self._primal_residuals()
+        dual = opt.rho * np.abs(opt.xbars - self._prev_avg)
+        self._prev_avg = np.array(opt.xbars, copy=True)
+
+        inc = (primal > self._pd_factor * dual) & (primal > self._tol)
+        dec = (dual > self._pd_factor * primal) & (dual > self._tol) & (
+            opt._iter >= self._required_converged_before_decrease)
+        conv = (primal < self._tol) & (dual < self._tol)
+        rho = opt.rho
+        rho = np.where(inc, rho * self._rho_increase, rho)
+        rho = np.where(~inc & dec, rho / self._rho_decrease, rho)
+        rho = np.where(~inc & ~dec & conv,
+                       rho / self._rho_converged_residual_decrease, rho)
+        opt.rho = rho
+        if self._verbose:
+            n_inc, n_dec = int(inc.sum()), int((~inc & dec).sum())
+            print(f"NormRhoUpdater iter={opt._iter}: "
+                  f"increased {n_inc}, decreased {n_dec} rho entries")

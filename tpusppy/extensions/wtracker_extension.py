@@ -1,0 +1,33 @@
+"""Wtracker extension: record Ws each iteration, report at the end.
+
+TPU-native analogue of ``mpisppy/extensions/wtracker_extension.py`` (53 LoC).
+Options (``opt.options["wtracker_options"]``): wlen, reportlen, stdevthresh,
+file_prefix.
+"""
+
+from __future__ import annotations
+
+from .extension import Extension
+from ..utils.wtracker import WTracker
+
+
+class Wtracker_extension(Extension):
+    def __init__(self, opt):
+        super().__init__(opt)
+        wo = opt.options.get("wtracker_options", {})
+        self.wlen = wo.get("wlen", 20)
+        self.reportlen = wo.get("reportlen", 100)
+        self.stdevthresh = wo.get("stdevthresh")
+        self.file_prefix = wo.get("file_prefix", "")
+        self.wtracker = WTracker(opt)
+
+    def enditer(self):
+        self.wtracker.grab_local_Ws()
+
+    def post_everything(self):
+        if self.file_prefix:
+            self.wtracker.write_or_append_to_csv(
+                f"{self.file_prefix}_wtracker.csv")
+        self.wtracker.report_by_moving_stats(
+            self.wlen, reportlen=self.reportlen,
+            stdevthresh=self.stdevthresh)

@@ -82,9 +82,12 @@ class SPOpt(SPBase):
         scenarios whose ADMM primal residual is within tolerance.
 
         Default tolerance 1e-3 (option "feas_tol"): the float32 TPU path
-        floors its scaled primal residual around 1e-4."""
+        floors its scaled primal residual around 1e-4.  A solver run at loose
+        eps (e.g. via the Gapper schedule) cannot certify feasibility tighter
+        than its own tolerance, so the floor scales with eps_rel."""
         if tol is None:
-            tol = self.options.get("feas_tol", 1e-3)
+            tol = max(self.options.get("feas_tol", 1e-3),
+                      10.0 * self.admm_settings.eps_rel)
         if self.pri_res is None:
             return 1.0
         return float(self.probs @ (self.pri_res < tol))

@@ -1,0 +1,60 @@
+"""WTracker: moving-window statistics of the PH dual weights.
+
+TPU-native analogue of ``mpisppy/utils/wtracker.py:18-203``: records W each
+iteration and reports per-slot moving-window mean/stdev — a practical
+stall/oscillation diagnostic for PH duals.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class WTracker:
+    def __init__(self, opt):
+        self.opt = opt
+        self.iter_Ws = {}          # iteration -> (S, K) W snapshot
+
+    def grab_local_Ws(self):
+        """Snapshot current Ws (wtracker.py grab_local_Ws)."""
+        self.iter_Ws[self.opt._iter] = np.array(self.opt.W, copy=True)
+
+    def compute_moving_stats(self, wlen: int):
+        """((S, K) mean, (S, K) stdev) over the trailing window."""
+        if not self.iter_Ws:
+            raise RuntimeError("WTracker has no W history")
+        iters = sorted(self.iter_Ws)[-wlen:]
+        stack = np.stack([self.iter_Ws[i] for i in iters])
+        return stack.mean(axis=0), stack.std(axis=0)
+
+    def report_by_moving_stats(self, wlen: int, reportlen=None,
+                               stdevthresh=None, file=None):
+        """Print slots whose windowed stdev exceeds the threshold
+        (wtracker.py report_by_moving_stats)."""
+        import sys
+
+        out = file or sys.stdout
+        if len(self.iter_Ws) < wlen:
+            print(f"WTracker: only {len(self.iter_Ws)} iterations recorded, "
+                  f"window is {wlen}; no report", file=out)
+            return
+        mean, std = self.compute_moving_stats(wlen)
+        thresh = 0.0 if stdevthresh is None else stdevthresh
+        bad = np.argwhere(std > thresh)
+        print(f"WTracker report (window={wlen}): "
+              f"{len(bad)} (scenario, slot) pairs above stdev "
+              f"threshold {thresh}", file=out)
+        for row in bad[: (reportlen or 100)]:
+            s, k = row
+            print(f"  scen {s} slot {k}: mean {mean[s, k]:.6g} "
+                  f"stdev {std[s, k]:.6g}", file=out)
+
+    def write_or_append_to_csv(self, fname: str):
+        arrs = sorted(self.iter_Ws)
+        with open(fname, "w") as f:
+            f.write("iteration," + ",".join(
+                f"w_{s}_{k}" for s in range(self.opt.W.shape[0])
+                for k in range(self.opt.W.shape[1])) + "\n")
+            for it in arrs:
+                f.write(f"{it}," + ",".join(
+                    repr(v) for v in self.iter_Ws[it].ravel()) + "\n")
