@@ -13,6 +13,7 @@ from .spoke import (
     OuterBoundWSpoke,
     Spoke,
 )
+from .fwph_spoke import FrankWolfeOuterBound
 from .hub import Hub, PHHub
 from .lagrangian_bounder import LagrangianOuterBound
 from .lagranger_bounder import LagrangerOuterBound
@@ -26,6 +27,7 @@ __all__ = [
     "KILL_ID", "Mailbox", "SPCommunicator", "WindowFabric",
     "ConvergerSpokeType", "Spoke", "InnerBoundSpoke", "OuterBoundSpoke",
     "OuterBoundWSpoke", "InnerBoundNonantSpoke", "OuterBoundNonantSpoke",
+    "FrankWolfeOuterBound",
     "Hub", "PHHub", "LagrangianOuterBound", "LagrangerOuterBound",
     "SlamMaxHeuristic", "SlamMinHeuristic", "ScenarioCycler",
     "XhatLooperInnerBound", "XhatShuffleInnerBound",

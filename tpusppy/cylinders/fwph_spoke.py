@@ -1,0 +1,35 @@
+"""FWPH outer-bound spoke.
+
+TPU-native analogue of ``mpisppy/cylinders/fwph_spoke.py:5-33``: wraps an
+:class:`~tpusppy.fwph.FWPH` opt object; the algorithm drives itself and the
+spoke pushes ``opt._local_bound`` on each sync.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .spoke import OuterBoundSpoke
+
+
+class FrankWolfeOuterBound(OuterBoundSpoke):
+    converger_spoke_char = 'F'
+
+    def main(self):
+        self.opt.fwph_main()
+
+    def is_converged(self):
+        return self.got_kill_signal()
+
+    def sync(self):
+        bound = getattr(self.opt, "_local_bound", None)
+        if bound is not None and np.isfinite(bound):
+            self.bound = bound
+
+    def finalize(self):
+        bound = getattr(self.opt, "_local_bound", None)
+        if bound is None:
+            return None
+        self.bound = bound
+        self.final_bound = bound
+        return self.final_bound

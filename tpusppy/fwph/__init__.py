@@ -1,0 +1,3 @@
+from .fwph import FWPH
+
+__all__ = ["FWPH"]

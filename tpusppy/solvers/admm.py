@@ -116,17 +116,21 @@ def _ruiz(A, q2, iters):
     return D, E
 
 
-def _factor(q2, A, rho_a, rho_x, sigma):
-    """Cholesky of K = diag(q2) + sigma I + A' diag(rho_a) A + diag(rho_x).
+def _factor(q2, A, rho_a, rho_x, sigma, P=None):
+    """Cholesky of K = P + diag(q2) + sigma I + A' diag(rho_a) A + diag(rho_x).
 
-    Returns (L, K); K is kept for iterative refinement of the triangular
-    solves — essential in float32, where cond(K) ~ 1/sigma * rho_eq_scale
-    otherwise stalls ADMM around 1e-2 residuals.
+    ``P`` is an optional dense (S, n, n) quadratic term (FWPH's simplex QP and
+    other column-space problems need one); the diagonal-only path stays the
+    default.  Returns (L, K); K is kept for iterative refinement of the
+    triangular solves — essential in float32, where cond(K) ~ 1/sigma *
+    rho_eq_scale otherwise stalls ADMM around 1e-2 residuals.
     """
     n = A.shape[-1]
     K = jnp.einsum("smn,sm,smk->snk", A, rho_a, A)
     K = K + jnp.eye(n, dtype=A.dtype)[None] * sigma
     K = K + jax.vmap(jnp.diag)(q2 + rho_x)
+    if P is not None:
+        K = K + P
     return jnp.linalg.cholesky(K), K
 
 
@@ -159,9 +163,16 @@ class _IterState(NamedTuple):
     k: jax.Array
 
 
-def _admm_core(q, q2, A, cl, cu, lb, ub, state, LK, rho_a, rho_x, st: ADMMSettings):
+def _admm_core(q, q2, A, cl, cu, lb, ub, state, LK, rho_a, rho_x,
+               st: ADMMSettings, P=None):
     """Inner ADMM sweep at fixed rho. Returns final state."""
     sigma, alpha = st.sigma, st.alpha
+
+    def Px(x):
+        base = q2 * x
+        if P is not None:
+            base = base + jnp.einsum("snk,sk->sn", P, x)
+        return base
 
     def step(s: _IterState) -> _IterState:
         rhs = (
@@ -187,14 +198,14 @@ def _admm_core(q, q2, A, cl, cu, lb, ub, state, LK, rho_a, rho_x, st: ADMMSettin
             jnp.max(jnp.abs(x_new - zx_new), axis=1),
         )
         Aty = jnp.einsum("smn,sm->sn", A, y_new)
-        dua = jnp.max(jnp.abs(q2 * x_new + q + Aty + yx_new), axis=1)
+        dua = jnp.max(jnp.abs(Px(x_new) + q + Aty + yx_new), axis=1)
         # OSQP-normalized residual scales, for tolerances and rho adaptation
         prinorm = jnp.maximum(
             jnp.max(jnp.abs(Ax), axis=1), jnp.max(jnp.abs(z_new), axis=1)
         )
         duanorm = jnp.maximum(
             jnp.maximum(
-                jnp.max(jnp.abs(q2 * x_new), axis=1),
+                jnp.max(jnp.abs(Px(x_new)), axis=1),
                 jnp.max(jnp.abs(Aty), axis=1),
             ),
             jnp.max(jnp.abs(q), axis=1),
@@ -212,7 +223,8 @@ def _admm_core(q, q2, A, cl, cu, lb, ub, state, LK, rho_a, rho_x, st: ADMMSettin
     return jax.lax.while_loop(cont, step, state)
 
 
-def _solve_scaled(q, q2, A, cl, cu, lb, ub, warm, masks, st: ADMMSettings):
+def _solve_scaled(q, q2, A, cl, cu, lb, ub, warm, masks, st: ADMMSettings,
+                  P=None):
     """Adaptive-rho outer loop; everything already Ruiz-scaled.
 
     ``masks`` carries finiteness/equality classifications computed from the
@@ -246,11 +258,11 @@ def _solve_scaled(q, q2, A, cl, cu, lb, ub, warm, masks, st: ADMMSettings):
         state, base, total = carry
         rho_a = rho_vec(base[:, None])
         rho_x = jnp.broadcast_to(base[:, None], (S, n))
-        LK = _factor(q2, A, rho_a, rho_x, st.sigma)
+        LK = _factor(q2, A, rho_a, rho_x, st.sigma, P)
         state = _admm_core(
             q, q2, A, cl, cu, lb, ub,
             state._replace(k=jnp.zeros((), jnp.int32)),
-            LK, rho_a, rho_x, st,
+            LK, rho_a, rho_x, st, P,
         )
         # OSQP rho adaptation on NORMALIZED residuals (raw residual ratios
         # push rho the wrong way when primal/dual scales differ)
@@ -269,7 +281,7 @@ def _solve_scaled(q, q2, A, cl, cu, lb, ub, warm, masks, st: ADMMSettings):
 
 
 def _polish(state: _IterState, q, q2, A, cl, cu, lb, ub, masks,
-            st: ADMMSettings):
+            st: ADMMSettings, P=None):
     """OSQP-style polish: guess the active set from dual signs + slacks, solve
     the resulting equality-constrained KKT system exactly, and accept per
     scenario only where it improves the worst residual.
@@ -312,7 +324,10 @@ def _polish(state: _IterState, q, q2, A, cl, cu, lb, ub, masks,
         M = jnp.zeros((S, N, N), dt)
         rhs = jnp.zeros((S, N), dt)
         # stationarity: Q x + A' nu + mu = -q
-        M = M.at[:, :n, :n].set(jax.vmap(jnp.diag)(q2) + st.polish_delta * eye_n)
+        Qblock = jax.vmap(jnp.diag)(q2) + st.polish_delta * eye_n
+        if P is not None:
+            Qblock = Qblock + P
+        M = M.at[:, :n, :n].set(Qblock)
         M = M.at[:, :n, n:n + m].set(jnp.swapaxes(A, 1, 2))
         M = M.at[:, :n, n + m:].set(eye_n)
         rhs = rhs.at[:, :n].set(-q)
@@ -359,7 +374,8 @@ def _polish(state: _IterState, q, q2, A, cl, cu, lb, ub, masks,
         jnp.max(jnp.abs(Ax - zp), axis=1), jnp.max(jnp.abs(xp - zxp), axis=1)
     )
     Aty = jnp.einsum("smn,sm->sn", A, yp)
-    dua = jnp.max(jnp.abs(q2 * xp + q + Aty + yxp), axis=1)
+    Pxp = q2 * xp if P is None else q2 * xp + jnp.einsum("snk,sk->sn", P, xp)
+    dua = jnp.max(jnp.abs(Pxp + q + Aty + yxp), axis=1)
 
     better = jnp.maximum(pri, dua) < jnp.maximum(state.pri, state.dua)
     pick = lambda a, b: jnp.where(better[:, None], a, b)
@@ -373,24 +389,29 @@ def _polish(state: _IterState, q, q2, A, cl, cu, lb, ub, masks,
 
 @functools.partial(jax.jit, static_argnames=("settings",))
 def solve_batch(c, q2, A, cl, cu, lb, ub, settings: ADMMSettings = ADMMSettings(),
-                warm=None) -> BatchSolution:
+                warm=None, P=None) -> BatchSolution:
     """Solve a batch of box-QP/LPs. All arrays (S, ...) as in ScenarioBatch.
 
     ``warm``: optional (x, z, y, yx) from a previous call — PH's persistent-solver
     analogue (spopt.py:129-144): between PH iterations only (q, rho-terms) change,
     so the previous primal/dual iterates are excellent starts.
 
+    ``P``: optional dense (S, n, n) quadratic term added to diag(q2) — used by
+    FWPH's simplex QPs; omit for the separable scenario subproblems.
+
     On TPU, float32 matmuls default to bf16 MXU accumulation, which stalls ADMM
     below ~1e-3 residuals; trace everything at highest available precision
     (f32 full-precision passes on the MXU — still fast at these sizes).
     """
     with jax.default_matmul_precision("highest"):
-        return _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm)
+        return _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm, P)
 
 
-def _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm) -> BatchSolution:
+def _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm, P=None) -> BatchSolution:
     dt = settings.jdtype()
     c, q2, A = (jnp.asarray(v, dt) for v in (c, q2, A))
+    if P is not None:
+        P = jnp.asarray(P, dt)
     cl, cu = _clean_bounds(jnp.asarray(cl, dt), jnp.asarray(cu, dt))
     lb, ub = _clean_bounds(jnp.asarray(lb, dt), jnp.asarray(ub, dt))
     masks = _BoundMasks(
@@ -406,6 +427,9 @@ def _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm) -> BatchSolution:
     cost = 1.0 / jnp.maximum(jnp.max(jnp.abs(qs), axis=1), 1e-8)
     qs = qs * cost[:, None]
     q2s = q2s * cost[:, None]
+    Ps = None
+    if P is not None:
+        Ps = P * D[:, :, None] * D[:, None, :] * cost[:, None, None]
     cls, cus = cl * E, cu * E
     lbs, ubs = lb / D, ub / D
 
@@ -419,9 +443,10 @@ def _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm) -> BatchSolution:
         )
 
     state, total = _solve_scaled(qs, q2s, As, cls, cus, lbs, ubs, warm, masks,
-                                 settings)
+                                 settings, Ps)
     if settings.polish:
-        state = _polish(state, qs, q2s, As, cls, cus, lbs, ubs, masks, settings)
+        state = _polish(state, qs, q2s, As, cls, cus, lbs, ubs, masks,
+                        settings, Ps)
 
     x = state.x * D
     z = state.z / E

@@ -13,6 +13,7 @@ from __future__ import annotations
 import copy
 
 from ..cylinders import (
+    FrankWolfeOuterBound,
     LagrangerOuterBound,
     LagrangianOuterBound,
     PHHub,
@@ -161,6 +162,41 @@ def _spoke_opt_kwargs(cfg, scenario_creator, all_scenario_names,
         "scenario_creator": scenario_creator,
         "scenario_creator_kwargs": scenario_creator_kwargs,
         "all_nodenames": all_nodenames,
+    }
+
+
+def fwph_spoke(
+    cfg,
+    scenario_creator,
+    scenario_denouement=None,
+    all_scenario_names=None,
+    scenario_creator_kwargs=None,
+    all_nodenames=None,
+):
+    """(cfg_vanilla.py:277-319)"""
+    from ..fwph import FWPH
+
+    options = shared_options(cfg)
+    fw_options = {
+        "FW_iter_limit": cfg.get("fwph_iter_limit", 10),
+        "FW_weight": cfg.get("fwph_weight", 0.0),
+        "FW_conv_thresh": cfg.get("fwph_conv_thresh", 1e-4),
+        "stop_check_tol": cfg.get("fwph_stop_check_tol", 1e-4),
+        "solver_name": cfg.get("solver_name"),
+        "FW_verbose": cfg.get("verbose", False),
+    }
+    return {
+        "spoke_class": FrankWolfeOuterBound,
+        "spoke_kwargs": {},
+        "opt_class": FWPH,
+        "opt_kwargs": {
+            "options": options,
+            "FW_options": fw_options,
+            "all_scenario_names": all_scenario_names,
+            "scenario_creator": scenario_creator,
+            "scenario_creator_kwargs": scenario_creator_kwargs,
+            "all_nodenames": all_nodenames,
+        },
     }
 
 
