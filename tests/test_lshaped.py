@@ -1,0 +1,64 @@
+"""L-shaped (Benders) method: standalone convergence + wheel integration."""
+
+import numpy as np
+import pytest
+
+from tpusppy.cylinders import LShapedHub, XhatLShapedInnerBound
+from tpusppy.models import farmer
+from tpusppy.opt.lshaped import LShapedMethod
+from tpusppy.spin_the_wheel import WheelSpinner
+from tpusppy.xhat_eval import Xhat_Eval
+
+EF_OBJ = -108390.0
+
+
+def _ls_kwargs(n, iters=40):
+    return {
+        "options": {"max_iter": iters, "tol": 1e-6},
+        "all_scenario_names": farmer.scenario_names_creator(n),
+        "scenario_creator": farmer.scenario_creator,
+        "scenario_creator_kwargs": {"num_scens": n},
+    }
+
+
+def test_lshaped_farmer_converges():
+    ls = LShapedMethod(**_ls_kwargs(3))
+    ls.lshaped_algorithm()
+    assert ls.inner_bound == pytest.approx(EF_OBJ, rel=1e-4)
+    assert ls.outer_bound == pytest.approx(EF_OBJ, rel=1e-3)
+    np.testing.assert_allclose(ls.root_x, [170.0, 80.0, 250.0], atol=1.0)
+
+
+def test_lshaped_rejects_multistage():
+    from tpusppy.models import hydro
+
+    with pytest.raises(RuntimeError, match="two-stage"):
+        LShapedMethod(
+            {"max_iter": 5},
+            hydro.scenario_names_creator(9),
+            hydro.scenario_creator,
+            scenario_creator_kwargs={"branching_factors": [3, 3]},
+        )
+
+
+def test_lshaped_hub_with_xhat_spoke():
+    n = 3
+    hub_dict = {
+        "hub_class": LShapedHub,
+        "hub_kwargs": {"options": {"rel_gap": 1e-4}},
+        "opt_class": LShapedMethod,
+        "opt_kwargs": _ls_kwargs(n),
+    }
+    xhat = {
+        "spoke_class": XhatLShapedInnerBound,
+        "opt_class": Xhat_Eval,
+        "opt_kwargs": {
+            "options": {},
+            "all_scenario_names": farmer.scenario_names_creator(n),
+            "scenario_creator": farmer.scenario_creator,
+            "scenario_creator_kwargs": {"num_scens": n},
+        },
+    }
+    ws = WheelSpinner(hub_dict, [xhat]).spin()
+    assert ws.BestInnerBound == pytest.approx(EF_OBJ, rel=1e-3)
+    assert ws.BestOuterBound <= ws.BestInnerBound + 10.0

@@ -14,8 +14,9 @@ from .spoke import (
     Spoke,
 )
 from .fwph_spoke import FrankWolfeOuterBound
-from .hub import Hub, PHHub
+from .hub import Hub, LShapedHub, PHHub
 from .lagrangian_bounder import LagrangianOuterBound
+from .lshaped_bounder import XhatLShapedInnerBound
 from .lagranger_bounder import LagrangerOuterBound
 from .slam_heuristic import SlamMaxHeuristic, SlamMinHeuristic
 from .xhatlooper_bounder import XhatLooperInnerBound
@@ -28,8 +29,10 @@ __all__ = [
     "ConvergerSpokeType", "Spoke", "InnerBoundSpoke", "OuterBoundSpoke",
     "OuterBoundWSpoke", "InnerBoundNonantSpoke", "OuterBoundNonantSpoke",
     "FrankWolfeOuterBound",
-    "Hub", "PHHub", "LagrangianOuterBound", "LagrangerOuterBound",
+    "Hub", "LShapedHub", "PHHub", "LagrangianOuterBound",
+    "LagrangerOuterBound",
     "SlamMaxHeuristic", "SlamMinHeuristic", "ScenarioCycler",
-    "XhatLooperInnerBound", "XhatShuffleInnerBound",
-    "XhatSpecificInnerBound", "XhatXbarInnerBound",
+    "XhatLooperInnerBound", "XhatLShapedInnerBound",
+    "XhatShuffleInnerBound", "XhatSpecificInnerBound",
+    "XhatXbarInnerBound",
 ]

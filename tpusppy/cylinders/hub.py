@@ -268,3 +268,62 @@ class PHHub(Hub):
         payload = np.array([self.BestOuterBound, self.BestInnerBound])
         for idx in self.bounds_only_indices:
             self.hub_to_spoke(payload, idx)
+
+
+class LShapedHub(Hub):
+    """L-shaped-flavored hub (hub.py:600-689): nonant-only sync, outer bound
+    from the Benders root objective."""
+
+    def setup_hub(self):
+        self.initialize_spoke_indices()
+        self.initialize_bound_values()
+        if self.has_w_spokes:
+            raise RuntimeError("LShaped hub does not compute dual weights (Ws)")
+        if self.outerbound_spoke_indices & self.innerbound_spoke_indices:
+            raise RuntimeError(
+                "A spoke providing both inner and outer bounds is unsupported"
+            )
+        self._iter_count = 0
+
+    def sync(self, send_nonants=True):
+        self._iter_count += 1
+        if send_nonants and self.has_nonant_spokes:
+            self.send_nonants()
+        if self.has_bounds_only_spokes:
+            self.send_boundsout()
+        if self.has_outerbound_spokes:
+            self.receive_outerbounds()
+        if self.has_innerbound_spokes:
+            self.receive_innerbounds()
+
+    def is_converged(self):
+        # the Benders root objective is itself a valid outer bound
+        ob = getattr(self.opt, "outer_bound", None)
+        if ob is not None and np.isfinite(ob):
+            self.OuterBoundUpdate(float(ob), char='B')
+        ib = getattr(self.opt, "inner_bound", None)
+        if ib is not None and np.isfinite(ib):
+            self.InnerBoundUpdate(float(ib), char='B')
+        self.screen_trace()
+        return self.determine_termination()
+
+    def current_iteration(self):
+        return self._iter_count
+
+    def main(self):
+        self.opt.lshaped_algorithm()
+
+    def send_nonants(self):
+        """Broadcast the root x to nonant spokes (every scenario row gets the
+        same candidate — it is already nonanticipative)."""
+        x = self.opt.root_x
+        if x is None:
+            return
+        S = self.opt.batch.num_scenarios
+        xk = np.broadcast_to(np.asarray(x, dtype=np.float64),
+                             (S, x.shape[0]))
+        payload = np.concatenate(
+            [xk.ravel(), [self.BestOuterBound, self.BestInnerBound]]
+        )
+        for idx in self.nonant_spoke_indices:
+            self.hub_to_spoke(payload, idx)

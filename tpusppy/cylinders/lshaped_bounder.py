@@ -1,0 +1,20 @@
+"""XhatLShaped inner-bound spoke: evaluate the Benders root x.
+
+TPU-native analogue of ``mpisppy/cylinders/lshaped_bounder.py:15-74``: the
+L-shaped hub's root solution is already a complete nonanticipative candidate,
+so the spoke just fixes and evaluates it (one batched solve per fresh payload).
+"""
+
+from __future__ import annotations
+
+from .spoke import InnerBoundNonantSpoke
+
+
+class XhatLShapedInnerBound(InnerBoundNonantSpoke):
+    converger_spoke_char = 'X'
+
+    def main(self):
+        while not self.got_kill_signal():
+            if self.new_nonants:
+                obj = self.opt.evaluate(self.localnonants)
+                self.update_if_improving(obj)

@@ -108,8 +108,13 @@ def _ruiz(A, q2, iters):
         Ps = q2 * D * D
         col = jnp.maximum(jnp.max(jnp.abs(As), axis=1), jnp.abs(Ps))
         row = jnp.max(jnp.abs(As), axis=2)
-        D = D / jnp.sqrt(jnp.maximum(col, 1e-12))
-        E = E / jnp.sqrt(jnp.maximum(row, 1e-12))
+        # empty rows/columns (e.g. cut slots not yet populated, objective-only
+        # variables) must keep unit scaling: dividing by sqrt(eps) each sweep
+        # compounds into astronomically wrong D/E otherwise
+        col = jnp.where(col < 1e-12, 1.0, col)
+        row = jnp.where(row < 1e-12, 1.0, row)
+        D = D / jnp.sqrt(col)
+        E = E / jnp.sqrt(row)
         return D, E
 
     D, E = jax.lax.fori_loop(0, iters, body, (D, E))

@@ -55,6 +55,7 @@ class SPBase:
         # called per scenario after a run completes (spbase.py scenario
         # denouement protocol); signature (rank, scenario_name, scenario)
         self.scenario_denouement = scenario_denouement
+        self.spcomm = None  # attached by an SPCommunicator when in a wheel
 
         problems = [
             scenario_creator(name, **self.scenario_creator_kwargs)

@@ -136,6 +136,56 @@ def ph_hub(
     return hub_dict
 
 
+def lshaped_hub(
+    cfg,
+    scenario_creator,
+    scenario_denouement=None,
+    all_scenario_names=None,
+    scenario_creator_kwargs=None,
+    all_nodenames=None,
+):
+    """(cfg_vanilla.py lshaped_hub semantics): two-stage Benders hub."""
+    from ..cylinders import LShapedHub
+    from ..opt.lshaped import LShapedMethod
+
+    options = shared_options(cfg)
+    options["max_iter"] = cfg.get("max_iterations", 50)
+    options["tol"] = cfg.get("intra_hub_conv_thresh", 1e-7)
+    return {
+        "hub_class": LShapedHub,
+        "hub_kwargs": {"options": {
+            k: v for k, v in {
+                "rel_gap": cfg.get("rel_gap"),
+                "abs_gap": cfg.get("abs_gap"),
+            }.items() if v is not None
+        }},
+        "opt_class": LShapedMethod,
+        "opt_kwargs": {
+            "options": options,
+            "all_scenario_names": all_scenario_names,
+            "scenario_creator": scenario_creator,
+            "scenario_creator_kwargs": scenario_creator_kwargs,
+        },
+    }
+
+
+def xhatlshaped_spoke(
+    cfg,
+    scenario_creator,
+    scenario_denouement=None,
+    all_scenario_names=None,
+    scenario_creator_kwargs=None,
+    all_nodenames=None,
+):
+    """(cfg_vanilla.py:529-553)"""
+    from ..cylinders import XhatLShapedInnerBound
+
+    return _xhat_spoke(
+        cfg, XhatLShapedInnerBound, scenario_creator, all_scenario_names,
+        scenario_creator_kwargs, all_nodenames,
+    )
+
+
 def extension_adder(hub_dict, ext_class):
     """Attach an extension class, composing with MultiExtension when several
     are requested (cfg_vanilla.py:164-190)."""
