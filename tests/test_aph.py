@@ -1,0 +1,72 @@
+"""APH: projective hedging convergence, fractional dispatch, wheel hub.
+
+Mirrors the reference test posture (mpisppy/tests/test_aph.py): farmer runs
+with full and partial dispatch fractions converge to the EF objective.
+"""
+
+import numpy as np
+import pytest
+
+from tpusppy.cylinders import APHHub, LagrangianOuterBound, XhatShuffleInnerBound
+from tpusppy.models import farmer
+from tpusppy.opt.aph import APH
+from tpusppy.phbase import PHBase
+from tpusppy.spin_the_wheel import WheelSpinner
+from tpusppy.xhat_eval import Xhat_Eval
+
+EF_OBJ = -108390.0
+
+
+def _kwargs(n, iters=150, **opts):
+    return {
+        "options": {"defaultPHrho": 1.0, "PHIterLimit": iters,
+                    "convthresh": 1e-6, **opts},
+        "all_scenario_names": farmer.scenario_names_creator(n),
+        "scenario_creator": farmer.scenario_creator,
+        "scenario_creator_kwargs": {"num_scens": n},
+    }
+
+
+def test_aph_farmer_full_dispatch():
+    aph = APH(**_kwargs(3, dispatch_frac=1.0))
+    conv, eobj, triv = aph.APH_main()
+    assert conv < 1e-5
+    assert eobj == pytest.approx(EF_OBJ, rel=1e-4)
+    assert triv == pytest.approx(-115405.54, rel=1e-4)
+
+
+def test_aph_farmer_fractional_dispatch():
+    """dispatch_frac=0.5: only half the batch re-solves per pass, the rest
+    stays stale (the asynchrony that gives APH its name)."""
+    aph = APH(**_kwargs(3, iters=400, dispatch_frac=0.5))
+    conv, eobj, _ = aph.APH_main()
+    assert eobj == pytest.approx(EF_OBJ, rel=1e-3)
+    # fractional dispatch really dispatched fractional batches
+    assert aph._scnt == 2
+
+
+def test_aph_theta_bounded():
+    aph = APH(**_kwargs(3, iters=20, dispatch_frac=1.0))
+    aph.APH_main(finalize=False)
+    assert np.isfinite(aph.theta)
+    assert aph.global_tau >= 0
+
+
+def test_aph_hub_wheel():
+    n = 3
+    hub_dict = {
+        "hub_class": APHHub,
+        "hub_kwargs": {"options": {"rel_gap": 0.005}},
+        "opt_class": APH,
+        "opt_kwargs": _kwargs(n, iters=200, dispatch_frac=1.0,
+                              convthresh=-1.0),
+    }
+    spokes = [
+        {"spoke_class": LagrangianOuterBound, "opt_class": PHBase,
+         "opt_kwargs": _kwargs(n, iters=50)},
+        {"spoke_class": XhatShuffleInnerBound, "opt_class": Xhat_Eval,
+         "opt_kwargs": _kwargs(n)},
+    ]
+    ws = WheelSpinner(hub_dict, spokes).spin()
+    assert ws.BestInnerBound == pytest.approx(EF_OBJ, rel=5e-3)
+    assert ws.BestOuterBound <= ws.BestInnerBound + 1e-6

@@ -14,7 +14,7 @@ from .spoke import (
     Spoke,
 )
 from .fwph_spoke import FrankWolfeOuterBound
-from .hub import Hub, LShapedHub, PHHub
+from .hub import APHHub, Hub, LShapedHub, PHHub
 from .lagrangian_bounder import LagrangianOuterBound
 from .lshaped_bounder import XhatLShapedInnerBound
 from .lagranger_bounder import LagrangerOuterBound
@@ -28,7 +28,7 @@ __all__ = [
     "KILL_ID", "Mailbox", "SPCommunicator", "WindowFabric",
     "ConvergerSpokeType", "Spoke", "InnerBoundSpoke", "OuterBoundSpoke",
     "OuterBoundWSpoke", "InnerBoundNonantSpoke", "OuterBoundNonantSpoke",
-    "FrankWolfeOuterBound",
+    "APHHub", "FrankWolfeOuterBound",
     "Hub", "LShapedHub", "PHHub", "LagrangianOuterBound",
     "LagrangerOuterBound",
     "SlamMaxHeuristic", "SlamMinHeuristic", "ScenarioCycler",

@@ -270,6 +270,18 @@ class PHHub(Hub):
             self.hub_to_spoke(payload, idx)
 
 
+class APHHub(PHHub):
+    """APH-flavored hub (hub.py:691-771).  The reference's variant skips
+    cylinder barriers in Put/Get; our mailboxes are barrier-free already, so
+    only the driver differs."""
+
+    def main(self):
+        self.opt.APH_main(spcomm=self, finalize=False)
+
+    def finalize(self):
+        return self.opt.post_loops()
+
+
 class LShapedHub(Hub):
     """L-shaped-flavored hub (hub.py:600-689): nonant-only sync, outer bound
     from the Benders root objective."""

@@ -1,0 +1,235 @@
+"""APH: Asynchronous Projective Hedging (Eckstein et al.) — batched.
+
+TPU-native analogue of ``mpisppy/opt/aph.py:46-982``.  The reference overlaps
+a listener thread doing background MPI Allreduces with workers that dispatch
+only a *fraction* of subproblems per pass, chosen by (staleness, phi)
+(aph.py:198-330, 554-668).  In the batched runtime the reductions are cheap
+einsums over device arrays, so the listener/Synchronizer machinery collapses
+to synchronous host code (its ``async_frac_needed`` vote is trivially
+satisfied by the single controller), while the *algorithmic* asynchrony —
+stale subproblem solutions, fractional dispatch — is preserved exactly:
+
+* ``dispatch_frac`` selects scnt = max(1, round(S*frac)) scenarios by the
+  reference's (last-dispatch-iteration, phi) sort (aph.py:602-657);
+* the dispatched rows are gathered into a COMPACT sub-batch of fixed shape
+  (scnt is constant), solved in one device program with prox center z, and
+  scattered back — non-dispatched scenarios keep their stale x, exactly the
+  APH semantics, and the device does scnt/S of the work.
+
+State arrays, all (S, K): x (stale solutions' nonants), z (projective
+center), W (duals), y (subgradient estimates), u = x - xbar, plus the scalar
+tau/phi/theta and the four probability-weighted norms driving the convergence
+metric (aph.py:332-553).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import global_toc
+from ..phbase import PHBase
+
+
+class APH(PHBase):
+    """(aph.py:46-143 constructor semantics; options: APHgamma, APHnu,
+    async_frac_needed, dispatch_frac, async_sleep_secs)."""
+
+    def __init__(self, options, all_scenario_names, scenario_creator,
+                 **kwargs):
+        super().__init__(options, all_scenario_names, scenario_creator,
+                         **kwargs)
+        self.APHgamma = float(self.options.get("APHgamma", 1.0))
+        self.nu = float(self.options.get("APHnu", 1.0))
+        self.dispatch_frac = float(self.options.get("dispatch_frac", 1.0))
+        self.use_lag = bool(self.options.get("APHuse_lag", False))
+        S = self.batch.num_scenarios
+        K = self.nonant_length
+        self.z = np.zeros((S, K))
+        self.y = np.zeros((S, K))
+        self.ybars = np.zeros((S, K))
+        self.uk = np.zeros((S, K))
+        self.phis = np.zeros(S)
+        self.theta = 0.0
+        self.global_tau = 0.0
+        self.global_phi = 0.0
+        self.tau_summand = 0.0
+        self.local_pwsqnorm = 0.0
+        self.local_pzsqnorm = 0.0
+        self.global_pusqnorm = 0.0
+        self.global_pvsqnorm = 0.0
+        self.global_pwsqnorm = 0.0
+        self.global_pzsqnorm = 0.0
+        # dispatch record: (last iteration dispatched, jittered start order)
+        rng = np.random.default_rng(self.options.get("seed", 1134))
+        self._last_dispatch = rng.random(S) * 1e-3
+        self._scnt = max(1, round(S * self.dispatch_frac))
+
+    # ---- node-grouped averages (Compute_Averages, aph.py:332-453) -----------
+    def _node_avg(self, arr_sk: np.ndarray) -> np.ndarray:
+        """Per-node probability-weighted mean, broadcast back to (S, K)."""
+        p = self.probs[:, None]
+        num = np.einsum("skn,sk->nk", self._onehot, p * arr_sk)
+        den = np.einsum("skn,sk->nk", self._onehot,
+                        np.broadcast_to(p, arr_sk.shape))
+        avg_nk = num / np.maximum(den, 1e-300)
+        kidx = np.arange(self.nonant_length)[None, :]
+        return avg_nk[self.nid_sk, kidx]
+
+    def Update_y(self, dispatched: np.ndarray):
+        """y_s = W_s + rho (x_s - z_s) on dispatched rows (aph.py:151-182);
+        all-zero at the first pass."""
+        if self._iter == 1:
+            self.y[:] = 0.0
+            return
+        xk = self.nonants_of(self.local_x)
+        newy = self.W + self.rho * (xk - self.z)
+        self.y[dispatched] = newy[dispatched]
+
+    def Compute_Averages(self):
+        """xbar, xsqbar, ybar + the u/v/tau/phi side-gig (aph.py:198-330)."""
+        xk = self.nonants_of(self.local_x)
+        self.Compute_Xbar()                       # xbars, xsqbars
+        self.ybars = self._node_avg(self.y)
+        self.uk = xk - self.xbars
+        p = self.probs
+        usq = (self.uk * self.uk).sum(axis=1)
+        vsq = (self.ybars * self.ybars).sum(axis=1)
+        self.global_pusqnorm = float(p @ usq)
+        self.global_pvsqnorm = float(p @ vsq)
+        self.tau_summand = float(p @ (usq + vsq / self.APHgamma))
+        self.global_tau = self.tau_summand
+        # phi summand (aph.py:185-196)
+        self.phis = p * np.einsum("sk,sk->s", self.z - xk, self.W - self.y)
+        self.global_phi = float(self.phis.sum())
+
+    def Update_theta_zw(self):
+        """theta from phi/tau; W += theta u; z step toward ybar
+        (aph.py:453-498)."""
+        if self.global_tau <= 0 or self.global_phi <= 0:
+            self.theta = 0.0
+        else:
+            self.theta = self.global_phi * self.nu / self.global_tau
+        self.W = self.W + self.theta * self.uk
+        if self._iter != 1:
+            self.z = self.z + (self.theta / self.APHgamma) * self.ybars
+        else:
+            self.z = np.array(self.xbars, copy=True)
+        p = self.probs
+        self.global_pwsqnorm = float(p @ (self.W * self.W).sum(axis=1))
+        self.global_pzsqnorm = float(p @ (self.z * self.z).sum(axis=1))
+
+    def Compute_Convergence(self):
+        """conv = punorm/pwnorm + pvnorm/pznorm (aph.py:499-528)."""
+        pw = np.sqrt(self.global_pwsqnorm)
+        pz = np.sqrt(self.global_pzsqnorm)
+        if pw > 0 and pz > 0:
+            self.conv = (np.sqrt(self.global_pusqnorm) / pw
+                         + np.sqrt(self.global_pvsqnorm) / pz)
+        return self.conv
+
+    # ---- fractional dispatch (APH_solve_loop, aph.py:554-668) ---------------
+    def _dispatch_rows(self) -> np.ndarray:
+        """scnt scenario indices by (staleness, phi) sort."""
+        order = np.lexsort((self.phis, self._last_dispatch))
+        rows = order[: self._scnt]
+        self._last_dispatch[rows] = self._iter
+        return rows
+
+    def APH_solve_loop(self) -> np.ndarray:
+        """Solve the dispatched sub-batch with prox center z; scatter back.
+
+        Returns the dispatched row indices."""
+        from ..solvers import admm
+
+        rows = self._dispatch_rows()
+        b = self.batch
+        idx = self.tree.nonant_indices
+        q = np.array(b.c[rows], copy=True)
+        q2 = np.array(b.q2[rows], copy=True)
+        q[:, idx] += self.W[rows] - self.rho[rows] * self.z[rows]
+        q2[:, idx] += self.rho[rows]
+        warm = None
+        if self._warm is not None:
+            warm = tuple(np.asarray(w)[rows] for w in self._warm)
+        sol = admm.solve_batch(
+            q, q2, b.A[rows], b.cl[rows], b.cu[rows], b.lb[rows], b.ub[rows],
+            settings=self.admm_settings, warm=warm,
+        )
+        if self.local_x is None:
+            self.local_x = np.zeros((b.num_scenarios, b.num_vars))
+        elif not self.local_x.flags.writeable:
+            self.local_x = np.array(self.local_x)
+        self.local_x[rows] = np.asarray(sol.x)
+        if self._warm is None:
+            S = b.num_scenarios
+            self._warm = (
+                np.zeros((S, b.num_vars)), np.zeros((S, b.num_rows)),
+                np.zeros((S, b.num_rows)), np.zeros((S, b.num_vars)),
+            )
+        warm_full = tuple(np.array(w) for w in self._warm)
+        for wf, part in zip(warm_full, (sol.x, sol.z, sol.y, sol.yx)):
+            wf[rows] = np.asarray(part)
+        self._warm = warm_full
+        if self.pri_res is None:
+            self.pri_res = np.zeros(b.num_scenarios)
+            self.dua_res = np.zeros(b.num_scenarios)
+        elif not self.pri_res.flags.writeable:
+            self.pri_res = np.array(self.pri_res)
+            self.dua_res = np.array(self.dua_res)
+        self.pri_res[rows] = np.asarray(sol.pri_res)
+        self.dua_res[rows] = np.asarray(sol.dua_res)
+        return rows
+
+    # ---- driver (APH_main, aph.py:820-982) ----------------------------------
+    def APH_main(self, spcomm=None, finalize=True):
+        if spcomm is not None:
+            self.spcomm = spcomm
+        self.extobject.pre_iter0()
+        self._iter = 0
+        self.solve_loop()                       # iter0: plain objective
+        feas = self.feas_prob()
+        if feas < 1.0 - 1e-6:
+            raise RuntimeError(
+                f"Infeasibility detected at APH iter0; mass {feas:.4f}"
+            )
+        self.trivial_bound = self.Ebound()
+        self.best_bound = self.trivial_bound
+        self.extobject.post_iter0()
+        if self.spcomm is not None:
+            self.spcomm.sync()
+
+        conv = None
+        dispatched = np.arange(self.batch.num_scenarios)
+        for it in range(1, int(self.options["PHIterLimit"]) + 1):
+            self._iter = it
+            self.Update_y(dispatched)
+            self.Compute_Averages()
+            self.Update_theta_zw()
+            conv = self.Compute_Convergence()
+            self.extobject.miditer()
+            dispatched = self.APH_solve_loop()
+            self.extobject.enditer()
+            if self.spcomm is not None:
+                self.spcomm.sync()
+                if self.spcomm.is_converged():
+                    global_toc("APH cylinder termination", True)
+                    break
+            global_toc(
+                f"APH iter {it} theta {self.theta:.4f} "
+                f"phi {self.global_phi:.4e} tau {self.global_tau:.4e} "
+                f"conv {self.conv if self.conv is None else round(self.conv, 8)}",
+                self.options.get("display_progress", False),
+            )
+            if self.conv is not None and \
+                    self.conv < self.options.get("convthresh", 0.0):
+                break
+            if self.ph_converger is not None \
+                    and self.ph_converger.is_converged():
+                break
+        self.extobject.post_everything()
+        eobj = self.Eobjective() if finalize else None
+        return self.conv, eobj, self.trivial_bound
+
+    # hub-facing alias used by APHHub
+    def ph_main(self, finalize=False):
+        return self.APH_main(finalize=finalize)

@@ -136,6 +136,40 @@ def ph_hub(
     return hub_dict
 
 
+def aph_hub(
+    cfg,
+    scenario_creator,
+    scenario_denouement=None,
+    all_scenario_names=None,
+    scenario_creator_kwargs=None,
+    ph_extensions=None,
+    extension_kwargs=None,
+    rho_setter=None,
+    variable_probability=None,
+    all_nodenames=None,
+):
+    """(cfg_vanilla.py:128-163): ph_hub with the APH classes and options."""
+    from ..cylinders import APHHub
+    from ..opt.aph import APH
+
+    hub_dict = ph_hub(
+        cfg, scenario_creator, scenario_denouement, all_scenario_names,
+        scenario_creator_kwargs=scenario_creator_kwargs,
+        ph_extensions=ph_extensions, extension_kwargs=extension_kwargs,
+        rho_setter=rho_setter, variable_probability=variable_probability,
+        all_nodenames=all_nodenames,
+    )
+    hub_dict["hub_class"] = APHHub
+    hub_dict["opt_class"] = APH
+    opts = hub_dict["opt_kwargs"]["options"]
+    opts["APHgamma"] = cfg.get("aph_gamma", 1.0)
+    opts["APHnu"] = cfg.get("aph_nu", 1.0)
+    opts["async_frac_needed"] = cfg.get("aph_frac_needed", 1.0)
+    opts["dispatch_frac"] = cfg.get("aph_dispatch_frac", 1.0)
+    opts["async_sleep_secs"] = cfg.get("aph_sleep_seconds", 0.01)
+    return hub_dict
+
+
 def lshaped_hub(
     cfg,
     scenario_creator,
