@@ -1,0 +1,88 @@
+"""Model zoo: sizes, apl1p, aircond — EF cross-checks (ADMM vs HiGHS) and PH.
+
+Mirrors the reference's golden-objective testing style (test_ef_ph.py): the
+LP relaxations are certified against an independent simplex solver, and PH
+converges to the EF objective.
+"""
+
+import numpy as np
+import pytest
+
+from tpusppy.ef import solve_ef
+from tpusppy.ir import ScenarioBatch
+from tpusppy.models import aircond, apl1p, sizes
+from tpusppy.opt.ph import PH
+
+
+def _batch(mod, names, **kw):
+    return ScenarioBatch.from_problems(
+        [mod.scenario_creator(nm, **kw) for nm in names]
+    )
+
+
+def test_sizes3_ef_matches_highs():
+    batch = _batch(sizes, sizes.scenario_names_creator(3), scenario_count=3)
+    obj_h, _ = solve_ef(batch, solver="highs")
+    obj_a, x = solve_ef(batch, solver="admm")
+    assert obj_a == pytest.approx(obj_h, rel=1e-4)
+    # LP relaxation lower-bounds the integer golden (~224,000 => 220,000 at
+    # 2 sig figs in the reference tests)
+    assert obj_h <= 224000.0
+
+
+def test_sizes3_ph():
+    names = sizes.scenario_names_creator(3)
+    batch = _batch(sizes, names, scenario_count=3)
+    obj_h, _ = solve_ef(batch, solver="highs")
+    ph = PH({"defaultPHrho": 0.01, "PHIterLimit": 100, "convthresh": 1e-5},
+            names, sizes.scenario_creator,
+            scenario_creator_kwargs={"scenario_count": 3})
+    conv, eobj, triv = ph.ph_main()
+    assert triv <= obj_h + 1.0
+    assert eobj == pytest.approx(obj_h, rel=5e-3)
+
+
+def test_sizes_rho_setter_and_fixer_tuples():
+    batch = _batch(sizes, sizes.scenario_names_creator(3), scenario_count=3)
+    rho = sizes._rho_setter(batch)
+    assert rho.shape == (10 + 55,)
+    i0, ik = sizes.id_fix_list_fct(batch)
+    assert len(i0) == len(ik) == 65
+
+
+def test_apl1p_ef():
+    names = apl1p.scenario_names_creator(6)
+    batch = _batch(apl1p, names, num_scens=6)
+    obj_h, _ = solve_ef(batch, solver="highs")
+    obj_a, _ = solve_ef(batch, solver="admm")
+    assert obj_a == pytest.approx(obj_h, rel=1e-4)
+    assert obj_h > 0
+
+
+def test_aircond_multistage_ef_and_ph():
+    bf = [3, 3]
+    kw = aircond.kw_creator(optionsin={"branching_factors": bf})
+    names = aircond.scenario_names_creator(9)
+    batch = _batch(aircond, names, **kw)
+    assert batch.tree.num_stages == 3
+    assert batch.tree.num_nonants == 4  # (reg, ot) x 2 nonleaf stages
+    obj_h, _ = solve_ef(batch, solver="highs")
+    obj_a, _ = solve_ef(batch, solver="admm")
+    assert obj_a == pytest.approx(obj_h, rel=1e-3)
+
+    ph = PH({"defaultPHrho": 1.0, "PHIterLimit": 100, "convthresh": 1e-5},
+            names, aircond.scenario_creator, scenario_creator_kwargs=kw)
+    conv, eobj, triv = ph.ph_main()
+    assert eobj == pytest.approx(obj_h, rel=1e-2)
+
+
+def test_aircond_demands_node_consistent():
+    """Scenarios sharing a stage-2 node must share stage-2 demand (seeded by
+    node_idx, aircond.py:37-68)."""
+    bf = [3, 3]
+    kw = aircond.kw_creator(optionsin={"branching_factors": bf})
+    d0, _ = aircond._demands_creator("scen0", bf, **kw)
+    d1, _ = aircond._demands_creator("scen1", bf, **kw)
+    d3, _ = aircond._demands_creator("scen3", bf, **kw)
+    assert d0[1] == d1[1]       # same ROOT_0 node
+    assert d0[1] != d3[1]       # different stage-2 nodes

@@ -1,0 +1,256 @@
+"""Aircond: scalable multistage production/inventory model.
+
+Behavioral port of ``mpisppy/tests/examples/aircond.py`` (602 LoC): per stage,
+regular and overtime production with capacity, inventory carried between
+stages split into positive/negative parts with asymmetric costs (negative =
+backorders; the LAST stage rewards positive inventory with a negative cost),
+and per-node demand following a clipped random walk whose per-node seeds come
+from ``start_seed + node_idx(path, branching_factors)`` — so demands are
+node-consistent across the scenarios through a node, exactly as the
+reference's ``_demands_creator`` (aircond.py:37-68).
+
+Nonanticipative variables per nonleaf stage t: (RegularProd_t,
+OvertimeProd_t) (MakeNodesforScen, aircond.py:251-302).  ``start_ups`` adds a
+per-stage binary with a big-M linking constraint (MIP mode).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ir import LinearModelBuilder
+from ..scenario_tree import ScenarioNode, extract_num
+
+parms = {
+    "mu_dev": (float, 0.0),
+    "sigma_dev": (float, 40.0),
+    "start_ups": (bool, False),
+    "StartUpCost": (float, 300.0),
+    "start_seed": (int, 1134),
+    "min_d": (float, 0.0),
+    "max_d": (float, 400.0),
+    "starting_d": (float, 200.0),
+    "BeginInventory": (float, 200.0),
+    "InventoryCost": (float, 0.5),
+    "LastInventoryCost": (float, -0.8),
+    "Capacity": (float, 200.0),
+    "RegularProdCost": (float, 1.0),
+    "OvertimeProdCost": (float, 3.0),
+    "NegInventoryCost": (float, 5.0),
+    "QuadShortCoeff": (float, 0.0),
+}
+
+MAX_T = 25
+
+
+def _nodenum_before_stage(t, branching_factors):
+    total = 0
+    prod = 1
+    for i in range(t - 1):
+        prod *= branching_factors[i]
+        total += prod
+    return 1 + total - prod if t > 0 else 0
+
+
+def node_idx(node_path, branching_factors):
+    """Unique id of a tree node from its path (sputils.py:492-520)."""
+    if not node_path:
+        return 0
+    stage_id = 0
+    for t in range(len(node_path)):
+        stage_id = node_path[t] + branching_factors[t] * stage_id
+    before = 1
+    prod = 1
+    for i in range(len(node_path) - 1):
+        prod *= branching_factors[i]
+        before += prod
+    return before + stage_id
+
+
+def _demands_creator(sname, sample_branching_factors, root_name="ROOT",
+                     **kwargs):
+    """(aircond.py:37-68): clipped random walk with node-indexed seeds."""
+    branching_factors = sample_branching_factors
+    kwargs.pop("branching_factors", None)
+    start_seed = kwargs["start_seed"]
+    max_d = kwargs.get("max_d", 400)
+    min_d = kwargs.get("min_d", 0)
+    mu_dev = kwargs.get("mu_dev", 0.0)
+    sigma_dev = kwargs.get("sigma_dev", 40.0)
+
+    scennum = extract_num(sname)
+    prod = int(np.prod(branching_factors))
+    s = int(scennum % prod)
+    d = kwargs.get("starting_d", 200)
+    demands = [d]
+    nodenames = [root_name]
+    for bf in branching_factors:
+        prod = prod // bf
+        nodenames.append(str(s // prod))
+        s = s % prod
+    stagelist = [int(x) for x in nodenames[1:]]
+    stream = np.random.RandomState()
+    for t in range(1, len(nodenames)):
+        stream.seed(start_seed + node_idx(stagelist[:t], branching_factors))
+        d = min(max_d, max(min_d, d + stream.normal(mu_dev, sigma_dev)))
+        demands.append(d)
+    return demands, nodenames
+
+
+def scenario_names_creator(num_scens, start=None):
+    start = start or 0
+    return [f"scen{i}" for i in range(start, start + num_scens)]
+
+
+def inparser_adder(cfg):
+    if "num_scens" not in cfg:
+        cfg.num_scens_required()
+    if "branching_factors" not in cfg:
+        cfg.add_branching_factors()
+    for name, (dom, dflt) in parms.items():
+        if name not in cfg:
+            cfg.add_to_config(name, f"aircond {name} (default {dflt})",
+                              dom, dflt)
+
+
+def kw_creator(cfg=None, optionsin=None, **kwonly):
+    options = optionsin or {}
+    if "kwargs" in options:
+        return options["kwargs"]
+    cfg = cfg or {}
+    get = cfg.get if hasattr(cfg, "get") else lambda k, d=None: getattr(cfg, k, d)
+    kwargs = {"branching_factors": options.get(
+        "branching_factors", kwonly.get("branching_factors",
+                                        get("branching_factors")))}
+    for name, (dom, dflt) in parms.items():
+        v = options.get(name, kwonly.get(name, get(name)))
+        kwargs[name] = dflt if v is None else v
+    return kwargs
+
+
+def aircond_model_creator(demands, sname="scen0", **kwargs):
+    """Build the per-scenario LP/MIP over all stages (aircond.py:88-249).
+
+    Returns (builder, per-stage var index lists)."""
+    g = lambda k: kwargs.get(k, parms[k][1])
+    start_ups = g("start_ups")
+    T = len(demands)
+    if T > MAX_T:
+        raise RuntimeError(f"The number of stages exceeds {MAX_T}")
+    bigM = g("Capacity") * MAX_T
+
+    b = LinearModelBuilder(sname)
+    reg, ot, posI, negI, su = [], [], [], [], []
+    for t in range(T):
+        last = t == T - 1
+        reg.append(b.add_var(f"RegularProd[{t}]", lb=0.0, ub=bigM,
+                             cost=g("RegularProdCost")))
+        ot.append(b.add_var(f"OvertimeProd[{t}]", lb=0.0, ub=bigM,
+                            cost=g("OvertimeProdCost")))
+        inv_cost = g("LastInventoryCost") if last else g("InventoryCost")
+        posI.append(b.add_var(f"posInventory[{t}]", lb=0.0, ub=bigM,
+                              cost=inv_cost))
+        quad = 2.0 * g("QuadShortCoeff") if (g("QuadShortCoeff") > 0
+                                             and not last) else 0.0
+        negI.append(b.add_var(f"negInventory[{t}]", lb=0.0, ub=bigM,
+                              cost=g("NegInventoryCost"), quad=quad))
+        if start_ups:
+            su.append(b.add_var(f"StartUp[{t}]", lb=0.0, ub=1.0,
+                                cost=g("StartUpCost"), integer=True))
+        # capacity on regular production
+        b.add_le({reg[t]: 1.0}, g("Capacity"))
+        if start_ups:
+            b.add_le({reg[t]: 1.0, ot[t]: 1.0, su[t]: -bigM}, 0.0)
+        # material balance: I_{t-1} + reg + ot - I_t = demand_t
+        coeffs = {reg[t]: 1.0, ot[t]: 1.0,
+                  posI[t]: -1.0, negI[t]: 1.0}
+        rhs = float(demands[t])
+        if t == 0:
+            rhs -= g("BeginInventory")
+        else:
+            coeffs[posI[t - 1]] = 1.0
+            coeffs[negI[t - 1]] = -1.0
+        b.add_eq(coeffs, rhs)
+    return b, reg, ot
+
+
+def scenario_creator(sname, **kwargs):
+    if "branching_factors" not in kwargs or \
+            kwargs["branching_factors"] is None:
+        raise RuntimeError(
+            "scenario_creator for aircond needs branching_factors in kwargs"
+        )
+    branching_factors = list(kwargs["branching_factors"])
+    kwargs.setdefault("start_seed", parms["start_seed"][1])
+    demands, nodenames = _demands_creator(sname, branching_factors, **kwargs)
+
+    b, reg, ot = aircond_model_creator(demands, sname=sname, **kwargs)
+    T = len(demands)
+    # nonleaf nodes: stages 1..T-1 (MakeNodesforScen skips the leaf)
+    nodes = []
+    ndn = "ROOT"
+    for stage in range(1, T):
+        if stage == 1:
+            cond = 1.0
+        else:
+            ndn = ndn + "_" + nodenames[stage - 1]
+            cond = 1.0 / branching_factors[stage - 2]
+        nodes.append(ScenarioNode(
+            ndn, cond, stage,
+            np.asarray([reg[stage - 1], ot[stage - 1]], dtype=np.int32),
+        ))
+    p = b.build()
+    p.prob = 1.0 / float(np.prod(branching_factors))
+    p.nodes = nodes
+    return p
+
+
+def sample_tree_scen_creator(sname, stage, sample_branching_factors, seed,
+                             given_scenario=None, **scenario_creator_kwargs):
+    """Sample-tree scenario for the CI machinery (aircond.py:332-377):
+    demands before ``stage`` come from ``given_scenario`` (a ScenarioProblem
+    carrying ``_demands``), later stages are redrawn with the dynamic seed."""
+    kwargs = dict(scenario_creator_kwargs)
+    kwargs["start_seed"] = seed
+    starting_d = kwargs.get("starting_d", parms["starting_d"][1])
+    if given_scenario is None:
+        if stage != 1:
+            raise RuntimeError(
+                "sample_tree_scen_creator needs given_scenario for stage > 1"
+            )
+        past_demands = [starting_d]
+    else:
+        past_demands = list(given_scenario._demands[:stage])
+    future_demands, nodenames = _demands_creator(
+        sname, sample_branching_factors,
+        root_name="ROOT" + "_0" * (stage - 1), **kwargs)
+    demands = past_demands + future_demands[1:]
+
+    b, reg, ot = aircond_model_creator(demands, sname=sname,
+                                       **scenario_creator_kwargs)
+    T = len(demands)
+    nodes = []
+    ndn = "ROOT"
+    bf_offset = stage  # stages 2..stage ride fixed '_0' nodes
+    for st in range(1, T):
+        if st == 1:
+            cond = 1.0
+        elif st <= stage:
+            ndn = ndn + "_0"
+            cond = 1.0
+        else:
+            ndn = ndn + "_" + nodenames[st - stage]
+            cond = 1.0 / sample_branching_factors[st - stage - 1]
+        nodes.append(ScenarioNode(
+            ndn, cond, st,
+            np.asarray([reg[st - 1], ot[st - 1]], dtype=np.int32),
+        ))
+    p = b.build()
+    p.prob = 1.0 / float(np.prod(sample_branching_factors))
+    p.nodes = nodes
+    p._demands = demands
+    return p
+
+
+def scenario_denouement(rank, scenario_name, scenario):
+    pass

@@ -1,0 +1,91 @@
+"""APL1P: two-stage stochastic capacity expansion (Infanger 1992).
+
+Behavioral port of ``mpisppy/tests/examples/apl1p.py``: two generators with
+random availability, three demand levels with random demand; first stage
+chooses generator capacities (the nonants), second stage dispatches
+operation and unserved demand.  Randomness comes from a per-scenario seeded
+RandomState drawing the same outcome tables as the reference (costs from
+Bailey/Jensen/Morton, 10x Infanger).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ir import LinearModelBuilder
+from ..scenario_tree import ScenarioNode, extract_num
+
+AVAIL_OUTCOME = ([1.0, 0.9, 0.5, 0.1], [1.0, 0.9, 0.7, 0.1, 0.0])
+AVAIL_PROB = ([0.2, 0.3, 0.4, 0.1], [0.1, 0.2, 0.5, 0.1, 0.1])
+CMIN = 1000.0
+INVEST = np.array([4.0, 2.5])
+OP_COST = np.array([[4.3, 2.0, 0.5], [8.7, 4.0, 1.0]])
+DEMAND_OUTCOME = [900.0, 1000.0, 1100.0, 1200.0]
+DEMAND_PROB = [0.15, 0.45, 0.25, 0.15]
+UNSERVED_COST = 10.0
+
+
+def scenario_names_creator(num_scens, start=None):
+    start = start or 0
+    return [f"scen{i}" for i in range(start, start + num_scens)]
+
+
+def kw_creator(cfg=None, **kwargs):
+    cfg = cfg or {}
+    get = cfg.get if hasattr(cfg, "get") else lambda k, d=None: getattr(cfg, k, d)
+    return {"num_scens": kwargs.get("num_scens", get("num_scens"))}
+
+
+def inparser_adder(cfg):
+    if "num_scens" not in cfg:
+        cfg.num_scens_required()
+
+
+def scenario_creator(sname, num_scens=None):
+    scennum = extract_num(sname)
+    stream = np.random.RandomState(scennum)
+    rand = stream.rand(6)
+
+    # index discipline from the reference: availability for generator g in
+    # {1,2} draws random_array[g]; demand level dl in {1,2,3} draws
+    # random_array[2+dl]
+    avail = np.empty(2)
+    avail[0] = AVAIL_OUTCOME[0][int(np.searchsorted(np.cumsum(AVAIL_PROB[0]),
+                                                    rand[1]))]
+    avail[1] = AVAIL_OUTCOME[1][int(np.searchsorted(np.cumsum(AVAIL_PROB[1]),
+                                                    rand[2]))]
+    dcum = np.cumsum(DEMAND_PROB)
+    demand = np.array([
+        DEMAND_OUTCOME[int(np.searchsorted(dcum, rand[2 + dl]))]
+        for dl in (1, 2, 3)
+    ])
+
+    b = LinearModelBuilder(sname)
+    cap = b.add_vars("CapacityGenerators", 2, lb=0.0)
+    for g in range(2):
+        b.set_cost(cap[g], INVEST[g])
+    op = {}
+    for g in range(2):
+        for dl in range(3):
+            op[g, dl] = b.add_var(f"OperationLevel[{g},{dl}]", lb=0.0,
+                                  cost=OP_COST[g, dl])
+    unserved = b.add_vars("UnservedDemand", 3, lb=0.0, cost=UNSERVED_COST)
+
+    for g in range(2):
+        b.add_ge({cap[g]: 1.0}, CMIN)                       # min capacity
+        coeffs = {op[g, dl]: 1.0 for dl in range(3)}
+        coeffs[cap[g]] = -avail[g]
+        b.add_le(coeffs, 0.0)                               # max operating
+    for dl in range(3):
+        coeffs = {op[g, dl]: 1.0 for g in range(2)}
+        coeffs[unserved[dl]] = 1.0
+        b.add_ge(coeffs, float(demand[dl]))                 # satisfy demand
+
+    p = b.build()
+    p.prob = None if num_scens is None else 1.0 / num_scens
+    p.nodes = [ScenarioNode("ROOT", 1.0, 1, np.asarray(cap, dtype=np.int32))]
+    return p
+
+
+def scenario_denouement(rank, scenario_name, scenario):
+    pass
