@@ -86,3 +86,44 @@ def test_aircond_demands_node_consistent():
     d3, _ = aircond._demands_creator("scen3", bf, **kw)
     assert d0[1] == d1[1]       # same ROOT_0 node
     assert d0[1] != d3[1]       # different stage-2 nodes
+
+
+def test_sslp_ef_and_ph():
+    from tpusppy.models import sslp
+
+    names = sslp.scenario_names_creator(4)
+    kw = {"num_servers": 4, "num_clients": 8}
+    batch = _batch(sslp, names, **kw)
+    obj_h, _ = solve_ef(batch, solver="highs")
+    obj_a, _ = solve_ef(batch, solver="admm")
+    assert obj_a == pytest.approx(obj_h, rel=1e-4, abs=1e-3)
+    ph = PH({"defaultPHrho": 100.0, "PHIterLimit": 150, "convthresh": 1e-6},
+            names, sslp.scenario_creator, scenario_creator_kwargs=kw)
+    conv, eobj, triv = ph.ph_main()
+    assert eobj == pytest.approx(obj_h, rel=1e-2, abs=1.0)
+
+
+def test_netdes_ef():
+    from tpusppy.models import netdes
+
+    names = netdes.scenario_names_creator(4)
+    kw = {"num_nodes": 8, "num_scens": 4}
+    batch = _batch(netdes, names, **kw)
+    obj_h, _ = solve_ef(batch, solver="highs")
+    obj_a, _ = solve_ef(batch, solver="admm")
+    assert obj_a == pytest.approx(obj_h, rel=1e-3, abs=1e-2)
+
+
+def test_uc_lite_ef_and_ph():
+    from tpusppy.models import uc_lite
+
+    names = uc_lite.scenario_names_creator(3)
+    kw = {"num_gens": 3, "horizon": 6, "num_scens": 3}
+    batch = _batch(uc_lite, names, **kw)
+    obj_h, _ = solve_ef(batch, solver="highs")
+    obj_a, _ = solve_ef(batch, solver="admm")
+    assert obj_a == pytest.approx(obj_h, rel=1e-3)
+    ph = PH({"defaultPHrho": 10.0, "PHIterLimit": 60, "convthresh": 1e-5},
+            names, uc_lite.scenario_creator, scenario_creator_kwargs=kw)
+    conv, eobj, triv = ph.ph_main()
+    assert eobj == pytest.approx(obj_h, rel=1e-2)
