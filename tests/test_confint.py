@@ -1,0 +1,103 @@
+"""Confidence intervals: gap estimators, MMW, zhat4xhat, sequential sampling.
+
+Mirrors the reference posture (tests/test_conf_int_farmer.py): small CI runs
+on farmer with the batched evaluator.
+"""
+
+import numpy as np
+import pytest
+
+from tpusppy.confidence_intervals import ciutils
+from tpusppy.confidence_intervals.mmw_ci import MMWConfidenceIntervals
+from tpusppy.confidence_intervals.seqsampling import (
+    SeqSampling,
+    xhat_generator_farmer,
+)
+from tpusppy.utils.config import Config
+
+FARMER = "tpusppy.models.farmer"
+OPT_X = np.array([170.0, 80.0, 250.0])  # farmer EF optimum first stage
+
+
+def _cfg2():
+    cfg = Config()
+    cfg.add_and_assign("EF_2stage", "2stage", bool, None, True)
+    cfg.quick_assign("EF_solver_name", str, "admm")
+    cfg.quick_assign("num_scens", int, 6)
+    return cfg
+
+
+def test_branching_factor_arithmetic():
+    assert ciutils.branching_factors_from_numscens(12, 3) is not None
+    bfs = ciutils.branching_factors_from_numscens(12, 3)
+    assert int(np.prod(bfs)) >= 12 or int(np.prod(bfs)) == 12
+    assert ciutils.number_of_nodes([3, 3]) == 4  # ROOT + 3 stage-2 nodes
+
+
+def test_xhat_roundtrip(tmp_path):
+    path = str(tmp_path / "xhat.npy")
+    ciutils.write_xhat({"ROOT": OPT_X}, path)
+    back = ciutils.read_xhat(path)
+    np.testing.assert_allclose(back["ROOT"], OPT_X)
+
+
+def test_gap_estimator_at_optimum_is_small():
+    names = [f"scen{i}" for i in range(6)]
+    estim = ciutils.gap_estimators(
+        {"ROOT": OPT_X}, FARMER, solving_type="EF_2stage",
+        scenario_names=names, cfg=_cfg2(), solver_name="admm")
+    # the true optimum of the base 3-scenario fan: gap estimate stays modest
+    # relative to the ~1e5 objective scale
+    assert estim["G"] >= 0
+    assert estim["G"] < 5000
+    assert estim["s"] >= 0
+
+
+def test_gap_estimator_bad_candidate_is_large():
+    names = [f"scen{i}" for i in range(6)]
+    bad = np.array([500.0, 0.0, 0.0])
+    estim = ciutils.gap_estimators(
+        {"ROOT": bad}, FARMER, solving_type="EF_2stage",
+        scenario_names=names, cfg=_cfg2(), solver_name="admm")
+    assert estim["G"] > 1000
+
+
+def test_mmw_runs():
+    cfg = _cfg2()
+    mmw = MMWConfidenceIntervals(FARMER, cfg, {"ROOT": OPT_X},
+                                 num_batches=3, batch_size=6, start=12,
+                                 verbose=False)
+    result = mmw.run(confidence_level=0.9)
+    assert result["gap_inner_bound"] >= result["Gbar"]
+    assert len(result["Glist"]) == 3
+    assert result["Gbar"] < 10000
+
+
+def test_zhat4xhat(tmp_path):
+    from tpusppy.confidence_intervals import zhat4xhat
+
+    path = str(tmp_path / "xhat.npy")
+    ciutils.write_xhat({"ROOT": OPT_X}, path)
+    cfg = _cfg2()
+    cfg.quick_assign("model_module_name", str, FARMER)
+    cfg.quick_assign("xhatpath", str, path)
+    cfg.quick_assign("num_samples", int, 4)
+    zhatbar, eps = zhat4xhat.run_samples(cfg)
+    # E[z] at the optimal xhat over perturbed samples stays in the right range
+    assert -130000 < zhatbar < -80000
+    assert eps >= 0
+
+
+def test_seqsampling_bpl_farmer():
+    cfg = Config()
+    cfg.quick_assign("solver_name", str, "admm")
+    cfg.quick_assign("BPL_eps", float, 2000.0)
+    cfg.quick_assign("BPL_c0", int, 12)
+    cfg.quick_assign("xhat_gen_kwargs", dict, {"crops_multiplier": 1})
+    ss = SeqSampling(FARMER, xhat_generator_farmer, cfg,
+                     stochastic_sampling=False, stopping_criterion="BPL",
+                     solving_type="EF_2stage")
+    res = ss.run(maxit=8)
+    assert res["CI"][1] == 2000.0
+    assert "ROOT" in res["Candidate_solution"]
+    assert res["T"] <= 8

@@ -1,0 +1,230 @@
+"""ciutils: seed/branching-factor arithmetic, xhat (de)serialization, gap
+estimators.
+
+TPU-native analogue of ``mpisppy/confidence_intervals/ciutils.py`` (427 LoC).
+The workhorse is :func:`gap_estimators` — the Bayraksan-Morton G and s
+estimators at a candidate xhat over a fresh sample, built on the batched
+Amalgamator EF solve + Xhat_Eval (one device program each, replacing the
+per-scenario Pyomo solves).
+"""
+
+from __future__ import annotations
+
+import importlib
+
+import numpy as np
+
+from .. import global_toc
+from ..utils import amalgamator as ama
+from ..xhat_eval import Xhat_Eval
+
+
+def _prime_factors(n: int) -> dict:
+    """{prime: exponent} factorization (ciutils.py:21-52)."""
+    factors = {}
+    d = 2
+    while n > 1:
+        while n % d == 0:
+            factors[d] = factors.get(d, 0) + 1
+            n //= d
+        d += 1
+        if d * d > n and n > 1:
+            factors[n] = factors.get(n, 0) + 1
+            break
+    return factors
+
+
+def branching_factors_from_numscens(numscens, num_stages):
+    """Branching factors for a balanced tree with ~numscens leaves
+    (ciutils.py:54-84)."""
+    if num_stages == 2:
+        return None
+    spread = num_stages - 1
+    factors = _prime_factors(numscens)
+    primes = sorted(
+        [p for p, e in factors.items() for _ in range(e)], reverse=True)
+    if len(primes) < spread:
+        # grow numscens until it factors into enough pieces
+        return branching_factors_from_numscens(numscens + 1, num_stages)
+    bfs = [1] * spread
+    for i, p in enumerate(primes):
+        bfs[i % spread] *= p
+    return bfs
+
+
+def number_of_nodes(branching_factors) -> int:
+    """Number of nonleaf nodes of a balanced tree (sputils analogue)."""
+    total = 1
+    prod = 1
+    for bf in branching_factors[:-1]:
+        prod *= bf
+        total += prod
+    return total
+
+
+def writetxt_xhat(xhat, path="xhat.txt", num_stages=2):
+    np.savetxt(path, np.asarray(xhat["ROOT"]))
+
+
+def readtxt_xhat(path="xhat.txt", num_stages=2, delete_file=False):
+    xhat = {"ROOT": np.loadtxt(path)}
+    if delete_file:
+        import os
+
+        os.remove(path)
+    return xhat
+
+
+def write_xhat(xhat, path="xhat.npy", num_stages=2):
+    np.save(path, np.asarray(xhat["ROOT"]))
+
+
+def read_xhat(path="xhat.npy", num_stages=2, delete_file=False):
+    xhat = {"ROOT": np.load(path)}
+    if delete_file:
+        import os
+
+        os.remove(path)
+    return xhat
+
+
+def correcting_numeric(G, cfg=None, relative_error=True, threshold=1e-4,
+                       objfct=None):
+    """Clamp small negative gap estimates caused by solver noise
+    (ciutils.py:185-206)."""
+    if relative_error:
+        if objfct is None:
+            raise RuntimeError(
+                "objfct must be specified for relative error correction")
+        if objfct == 0:
+            return G
+        if G / abs(objfct) < -threshold:
+            global_toc(f"WARNING: negative gap estimate {G}", True)
+        return max(G, 0.0)
+    if G < -threshold:
+        global_toc(f"WARNING: negative gap estimate {G}", True)
+    return max(G, 0.0)
+
+
+def gap_estimators(xhat_one, mname, solving_type="EF_2stage",
+                   scenario_names=None, sample_options=None, ArRP=1,
+                   cfg=None, scenario_denouement=None, solver_name=None,
+                   solver_options=None, verbose=False):
+    """Bayraksan-Morton G and s at xhat over a fresh sample
+    (ciutils.py:208-450).
+
+    Two-stage: solve the sampled EF (zn*), then evaluate xhat and x* per
+    scenario with one batched fix-and-solve each; G = E[f(xhat) - f(x*)],
+    s = unbiased sample stdev of the per-scenario gaps.
+    Multistage: the sampled problem is a sample subtree and xhat policies come
+    from :func:`tpusppy.confidence_intervals.sample_tree.walking_tree_xhats`.
+    """
+    from ..utils.config import Config
+
+    is_multi = solving_type == "EF_mstage"
+    m = importlib.import_module(mname) if isinstance(mname, str) else mname
+    ama.check_module_ama(m)
+
+    if is_multi:
+        branching_factors = sample_options["branching_factors"]
+        start = sample_options["seed"]
+    else:
+        from ..scenario_tree import extract_num
+
+        start = extract_num(scenario_names[0])
+
+    if ArRP > 1:
+        if is_multi:
+            raise RuntimeError("Pooled estimators require two-stage")
+        n = len(scenario_names)
+        if n % ArRP != 0:
+            n = n - n % ArRP
+        Gs, ss = [], []
+        for k in range(ArRP):
+            part = scenario_names[k * (n // ArRP):(k + 1) * (n // ArRP)]
+            tmp = gap_estimators(
+                xhat_one, mname, solving_type=solving_type,
+                scenario_names=part, ArRP=1, cfg=cfg,
+                scenario_denouement=scenario_denouement,
+                solver_name=solver_name, solver_options=solver_options)
+            Gs.append(tmp["G"])
+            ss.append(tmp["s"])
+        return {"G": float(np.mean(Gs)),
+                "s": float(np.linalg.norm(ss) / np.sqrt(n // ArRP)),
+                "seed": start}
+
+    if is_multi:
+        from . import sample_tree
+
+        samp_tree = sample_tree.SampleSubtree(
+            mname, xhats=[], root_scen=None, starting_stage=1,
+            branching_factors=branching_factors, seed=start, cfg=cfg,
+            solver_name=solver_name, solver_options=solver_options)
+        samp_tree.run()
+        start += number_of_nodes(branching_factors)
+        scenario_names = samp_tree.scenario_names
+        scenario_creator = samp_tree.scenario_creator
+        scenario_creator_kwargs = samp_tree.scenario_creator_kwargs
+        xstars = {"ROOT": samp_tree.root_xstar}
+        zn_star = samp_tree.ef_obj
+        xhats, start = sample_tree.walking_tree_xhats(
+            mname, samp_tree, xhat_one["ROOT"], branching_factors, start,
+            cfg, solver_name=solver_name, solver_options=solver_options)
+        ev = Xhat_Eval(
+            {"solver_options": solver_options or {}},
+            scenario_names, scenario_creator,
+            scenario_creator_kwargs=scenario_creator_kwargs)
+        objs_at_xhat = ev.objective_values(xhats)
+        objs_at_xstar = ev.objective_values(samp_tree.xstar_cache)
+    else:
+        ama_cfg = Config()
+        ama_cfg.add_and_assign(solving_type, "solving type", bool, None, True)
+        ama_cfg.quick_assign("EF_solver_name", str, solver_name or "admm")
+        ama_cfg.quick_assign("num_scens", int, len(scenario_names))
+        ama_cfg.quick_assign("start", int, start)
+        if cfg is not None:
+            for k, v in cfg.items():
+                if k not in ama_cfg:
+                    ama_cfg.add_and_assign(k, f"copied {k}", object, None, v)
+        ama_object = ama.from_module(m, ama_cfg, use_command_line=False)
+        ama_object.scenario_names = scenario_names
+        ama_object.verbose = False
+        ama_object.run()
+        start += len(scenario_names)
+        zn_star = ama_object.best_outer_bound
+        xstars = {"ROOT": ama_object.xhats["ROOT"]}
+
+        scenario_creator_kwargs = ama_object.kwargs
+        ev = Xhat_Eval(
+            {"solver_options": (solver_options or {})},
+            scenario_names, ama_object.scenario_creator,
+            scenario_creator_kwargs=scenario_creator_kwargs)
+        xhats = _root_cache_to_full(ev, xhat_one)
+        objs_at_xhat = ev.objective_values(xhats)
+        objs_at_xstar = ev.objective_values(_root_cache_to_full(ev, xstars))
+
+    probs = ev.probs
+    scen_gaps = np.asarray(objs_at_xhat) - np.asarray(objs_at_xstar)
+    G = float(scen_gaps @ probs)
+    ssq = float((scen_gaps ** 2) @ probs)
+    prob_sqnorm = float(np.linalg.norm(probs) ** 2)
+    obj_at_xhat = float(np.asarray(objs_at_xhat) @ probs)
+    sample_var = max((ssq - G ** 2) / max(1.0 - prob_sqnorm, 1e-12), 0.0)
+    s = float(np.sqrt(sample_var))
+    G = correcting_numeric(G, cfg, objfct=obj_at_xhat,
+                           relative_error=(abs(zn_star) > 1))
+    if verbose:
+        global_toc(f"G = {G}, s = {s}")
+    return {"G": G, "s": s, "seed": start}
+
+
+def _root_cache_to_full(ev, xhat_dict) -> np.ndarray:
+    """(K,) candidate over the packed nonant layout from a ROOT-only cache
+    (two-stage: the root IS the whole nonant vector)."""
+    root = np.asarray(xhat_dict["ROOT"], dtype=float)
+    K = ev.nonant_length
+    if root.shape[0] == K:
+        return root
+    out = np.zeros(K)
+    out[: root.shape[0]] = root
+    return out

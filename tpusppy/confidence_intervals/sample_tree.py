@@ -1,0 +1,108 @@
+"""Sample trees for multistage confidence intervals.
+
+TPU-native analogue of ``mpisppy/confidence_intervals/sample_tree.py:18-313``:
+``SampleSubtree`` samples a subtree via the model's
+``sample_tree_scen_creator``, solves its EF as one batched problem, and
+exposes the stage-``starting_stage`` policy; ``walking_tree_xhats`` produces a
+feasible nonanticipative policy for every nonleaf node given a root xhat (the
+reference walks the tree resolving stage by stage; here one EF solve with the
+root clamped yields the same node-consistent policy because the EF couples
+all nodes).
+"""
+
+from __future__ import annotations
+
+import importlib
+
+import numpy as np
+
+from ..ef import solve_ef
+from ..ir import ScenarioBatch
+from ..xhat_eval import Xhat_Eval
+
+
+class SampleSubtree:
+    """(sample_tree.py:18-150)"""
+
+    def __init__(self, mname, xhats, root_scen, starting_stage,
+                 branching_factors, seed, cfg, solver_name=None,
+                 solver_options=None):
+        self.mname = mname
+        self.model = (importlib.import_module(mname)
+                      if isinstance(mname, str) else mname)
+        self.xhats = xhats          # fixed nonants for stages < starting_stage
+        self.root_scen = root_scen
+        self.stage = starting_stage
+        self.branching_factors = list(branching_factors)
+        self.seed = seed
+        self.cfg = cfg
+        self.solver_name = solver_name or "admm"
+        self.solver_options = solver_options or {}
+        self.scenario_creator_kwargs = self.model.kw_creator(cfg)
+        self.scenario_creator_kwargs["branching_factors"] = \
+            self.branching_factors
+
+    def _create_scenarios(self):
+        prod = int(np.prod(self.branching_factors))
+        self.scenario_names = self.model.scenario_names_creator(prod)
+        self.problems = [
+            self.model.sample_tree_scen_creator(
+                nm, self.stage, self.branching_factors, self.seed,
+                given_scenario=self.root_scen,
+                **self.scenario_creator_kwargs)
+            for nm in self.scenario_names
+        ]
+
+    def scenario_creator(self, sname, **kwargs):
+        """Re-create one of the sampled scenarios (for Xhat_Eval reuse)."""
+        return self.model.sample_tree_scen_creator(
+            sname, self.stage, self.branching_factors, self.seed,
+            given_scenario=self.root_scen, **self.scenario_creator_kwargs)
+
+    def run(self):
+        self._create_scenarios()
+        batch = ScenarioBatch.from_problems(self.problems)
+        self.batch = batch
+        if self.xhats:
+            # clamp earlier-stage nonants to the provided xhats
+            flat = np.concatenate([np.asarray(x) for x in self.xhats])
+            idx = batch.tree.nonant_indices[: flat.shape[0]]
+            batch.lb[:, idx] = flat[None, :]
+            batch.ub[:, idx] = flat[None, :]
+        self.ef_obj, x = solve_ef(batch, solver="admm")
+        self.ef_x = x
+        # policy at the starting stage: nonant slots of that stage
+        stage_slots = np.where(batch.tree.nonant_stage == self.stage)[0]
+        self.xhat_at_stage = x[0][batch.tree.nonant_indices[stage_slots]]
+        root_slots = np.where(batch.tree.nonant_stage == 1)[0]
+        self.root_xstar = x[0][batch.tree.nonant_indices[root_slots]]
+        # full (S, K) caches for evaluation
+        self.xstar_cache = x[:, batch.tree.nonant_indices]
+        return self.ef_obj
+
+
+def walking_tree_xhats(mname, samp_tree, xhat_one, branching_factors, start,
+                       cfg, solver_name=None, solver_options=None):
+    """Feasible per-node policy given the root xhat (sample_tree.py:151-313).
+
+    One EF solve with the root clamped: the EF's nonanticipativity structure
+    makes every node's solution a valid policy for that node.
+    Returns ((S, K) cache, updated seed).
+    """
+    batch = samp_tree.batch
+    tree = batch.tree
+    root_slots = np.where(tree.nonant_stage == 1)[0]
+    root = np.asarray(xhat_one, dtype=float)
+    lb = np.array(batch.lb, copy=True)
+    ub = np.array(batch.ub, copy=True)
+    idx = tree.nonant_indices[root_slots]
+    lb[:, idx] = root[None, :]
+    ub[:, idx] = root[None, :]
+    import dataclasses
+
+    clamped = dataclasses.replace(batch, lb=lb, ub=ub)
+    _, x = solve_ef(clamped, solver="admm")
+    xhats = x[:, tree.nonant_indices]
+    xhats[:, root_slots] = root[None, :]
+    start += int(np.prod(branching_factors))
+    return xhats, start

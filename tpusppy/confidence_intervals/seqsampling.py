@@ -1,0 +1,233 @@
+"""Sequential sampling to a fixed-width optimality-gap CI.
+
+TPU-native analogue of ``mpisppy/confidence_intervals/seqsampling.py:110-560``:
+Bayraksan-Morton ("BM") and Bayraksan-Pierre-Louis ("BPL", optionally
+stochastic/FSP) procedures — grow the sample until the gap estimate at a
+freshly computed xhat passes the stopping rule, then report the CI.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+import numpy as np
+import scipy.stats
+
+from .. import global_toc
+from ..utils.config import Config
+from ..utils import amalgamator
+from . import ciutils
+
+
+def xhat_generator_farmer(scenario_names, solver_name=None,
+                          solver_options=None, crops_multiplier=1):
+    """Sample-average xhat for farmer (seqsampling.py:64-108)."""
+    cfg = Config()
+    cfg.add_and_assign("EF_2stage", "2stage EF", bool, None, True)
+    cfg.quick_assign("EF_solver_name", str, solver_name or "admm")
+    cfg.quick_assign("num_scens", int, len(scenario_names))
+    cfg.quick_assign("crops_multiplier", int, crops_multiplier)
+    ama = amalgamator.from_module("tpusppy.models.farmer", cfg,
+                                  use_command_line=False)
+    ama.scenario_names = scenario_names
+    ama.verbose = False
+    ama.run()
+    return {"ROOT": ama.xhats["ROOT"]}
+
+
+class SeqSampling:
+    """(seqsampling.py:110-560)"""
+
+    def __init__(self, refmodel, xhat_generator, cfg,
+                 stochastic_sampling=False, stopping_criterion="BM",
+                 solving_type="EF_2stage"):
+        if not isinstance(cfg, Config):
+            raise RuntimeError(f"SeqSampling bad cfg type={type(cfg)}")
+        self.refmodel = (importlib.import_module(refmodel)
+                         if isinstance(refmodel, str) else refmodel)
+        self.refmodelname = refmodel
+        self.xhat_generator = xhat_generator
+        self.cfg = cfg
+        self.stochastic_sampling = stochastic_sampling
+        self.stopping_criterion = stopping_criterion
+        self.solving_type = solving_type
+        self.multistage = solving_type == "EF_mstage"
+        self.sample_size_ratio = cfg.get("sample_size_ratio", 1)
+        self.xhat_gen_kwargs = cfg.get("xhat_gen_kwargs") or {}
+        self.ArRP = cfg.get("ArRP", 1)
+        self.kf_Gs = cfg.get("kf_Gs", 1)
+        self.kf_xhat = cfg.get("kf_xhat", 1)
+        self.confidence_level = cfg.get("confidence_level", 0.95)
+        self.solver_name = cfg.get("solver_name") or "admm"
+        self.solver_options = {}
+        for name in ("BM_eps_prime", "BM_hprime", "BM_eps", "BM_h", "BM_p",
+                     "BM_q", "BPL_eps", "BPL_c0", "BPL_c1", "BPL_n0min"):
+            setattr(self, name, cfg.get(name))
+        if self.stopping_criterion == "BM":
+            needed = ["BM_eps_prime", "BM_hprime", "BM_eps", "BM_h", "BM_p"]
+        elif self.stopping_criterion == "BPL":
+            needed = ["BPL_eps"]
+        else:
+            raise RuntimeError("Only BM and BPL criteria are supported")
+        missing = [n for n in needed if getattr(self, n) is None]
+        if missing:
+            raise RuntimeError(f"SeqSampling needs options {missing}")
+        if self.BPL_c1 is None:
+            self.BPL_c1 = 2
+        self.ScenCount = 0
+        self.SeedCount = 0
+
+        if self.stopping_criterion == "BM":
+            self.stop_criterion = self._bm_stopping_criterion
+            self.sample_size = self._bm_sampsize
+        else:
+            self.stop_criterion = self._bpl_stopping_criterion
+            self.sample_size = (self._stochastic_sampsize
+                                if stochastic_sampling
+                                else self._bpl_fsp_sampsize)
+
+    # ---- stopping rules (seqsampling.py:265-330) ----------------------------
+    def _bm_stopping_criterion(self, G, s, nk):
+        return G > self.BM_hprime * s + self.BM_eps_prime
+
+    def _bpl_stopping_criterion(self, G, s, nk):
+        t = scipy.stats.t.ppf(self.confidence_level, nk - 1)
+        return G + t * s / np.sqrt(nk) + 1 / np.sqrt(nk) > self.BPL_eps
+
+    def _bm_sampsize(self, k, G, s, nk_m1, r=2):
+        p, q = self.BM_p, self.BM_q
+        h, hprime = self.BM_h, self.BM_hprime
+        j = np.arange(1, 1000)
+        if q is None:
+            ssum = np.sum(np.power(j.astype(float), -p * np.log(j)))
+            c = max(1, 2 * np.log(
+                ssum / (np.sqrt(2 * np.pi) * (1 - self.confidence_level))))
+            lower_bound = (c + 2 * p * np.log(k) ** 2) / ((h - hprime) ** 2)
+        else:
+            ssum = np.sum(np.exp(-p * np.power(j, 2 * q / r)))
+            c = max(1, 2 * np.log(
+                ssum / (np.sqrt(2 * np.pi) * (1 - self.confidence_level))))
+            lower_bound = (c + 2 * p * np.power(k, 2 * q / r)) / (
+                (h - hprime) ** 2)
+        return int(np.ceil(lower_bound))
+
+    def _bpl_fsp_sampsize(self, k, G, s, nk_m1):
+        growth = (self.cfg.get("functions_dict") or
+                  {"growth_function": lambda x: x - 1})["growth_function"]
+        c0 = self.BPL_c0 if self.BPL_c0 is not None else 50
+        return int(np.ceil(c0 + self.BPL_c1 * growth(k)))
+
+    def _stochastic_sampsize(self, k, G, s, nk_m1):
+        if k == 1:
+            n0min = self.BPL_n0min if self.BPL_n0min is not None else 50
+            return int(np.ceil(max(n0min, np.log(1 / self.BPL_eps))))
+        t = scipy.stats.t.ppf(self.confidence_level, nk_m1 - 1)
+        a = -self.BPL_eps
+        bq = 1 + t * s
+        cq = nk_m1 * G
+        maxroot = -(np.sqrt(bq ** 2 - 4 * a * cq) + bq) / (2 * a)
+        return int(np.ceil(maxroot ** 2))
+
+    # ---- the sequential loop (seqsampling.py:331-523) -----------------------
+    def run(self, maxit=200):
+        refmodel = self.refmodel
+        mult = self.sample_size_ratio
+        k = 1
+        lower_bound_k = self.sample_size(k, None, None, None)
+
+        mk = int(np.floor(mult * lower_bound_k))
+        xhat_scenario_names = refmodel.scenario_names_creator(
+            mk, start=self.ScenCount)
+        self.ScenCount += mk
+        xgo = dict(self.xhat_gen_kwargs)
+        for drop in ("solver_name", "solver_options", "scenario_names"):
+            xgo.pop(drop, None)
+        xhat_k = self.xhat_generator(
+            xhat_scenario_names, solver_name=self.solver_name,
+            solver_options=self.solver_options, **xgo)
+
+        Gk, sk, nk = self._estimate(xhat_k, lower_bound_k)
+
+        while self.stop_criterion(Gk, sk, nk) and k < maxit:
+            k += 1
+            nk_m1, mk_m1 = nk, mk
+            lower_bound_k = self.sample_size(k, Gk, sk, nk_m1)
+            mk = max(int(np.floor(mult * lower_bound_k)), mk_m1)
+            if k % self.kf_xhat == 0:
+                xhat_scenario_names = refmodel.scenario_names_creator(
+                    mk, start=self.ScenCount)
+                self.ScenCount += mk
+            else:
+                xhat_scenario_names += refmodel.scenario_names_creator(
+                    mk - mk_m1, start=self.ScenCount)
+                self.ScenCount += mk - mk_m1
+            xhat_k = self.xhat_generator(
+                xhat_scenario_names, solver_name=self.solver_name,
+                solver_options=self.solver_options, **xgo)
+
+            Gk, sk, nk = self._estimate(xhat_k, lower_bound_k, nk_min=nk_m1)
+
+        if k == maxit:
+            raise RuntimeError(
+                f"The loop terminated after {maxit} iteration with no "
+                "acceptable solution")
+        T = k
+        if self.stopping_criterion == "BM":
+            upper_bound = self.BM_h * sk + self.BM_eps
+        else:
+            upper_bound = self.BPL_eps
+        CI = [0, upper_bound]
+        global_toc(
+            f"G={Gk} sk={sk}; xhat has been computed with {nk * mult} "
+            "observations.", True)
+        return {"T": T, "Candidate_solution": xhat_k, "CI": CI}
+
+    def _estimate(self, xhat_k, lower_bound_k, nk_min=0):
+        """Compute (G, s, nk) at xhat_k — two-stage via fresh scenario
+        blocks, multistage via an independent sample tree."""
+        refmodel = self.refmodel
+        if self.multistage:
+            num_stages = len(self.cfg["branching_factors"]) + 1
+            bfs = ciutils.branching_factors_from_numscens(
+                max(int(lower_bound_k), 2), num_stages)
+            nk = int(np.prod(bfs))
+            names = refmodel.scenario_names_creator(nk)
+            sample_options = {"branching_factors": bfs,
+                              "seed": self.SeedCount}
+            lcfg = self._local_cfg(nk)
+            estim = ciutils.gap_estimators(
+                xhat_k, self.refmodelname, solving_type=self.solving_type,
+                scenario_names=names, sample_options=sample_options,
+                ArRP=1, cfg=lcfg, solver_name=self.solver_name)
+            self.SeedCount = estim["seed"]
+        else:
+            nk = max(self.ArRP * int(np.ceil(lower_bound_k / self.ArRP)),
+                     nk_min)
+            names = refmodel.scenario_names_creator(nk, start=self.ScenCount)
+            self.ScenCount += nk
+            lcfg = self._local_cfg(nk)
+            estim = ciutils.gap_estimators(
+                xhat_k, self.refmodelname, solving_type=self.solving_type,
+                scenario_names=names, ArRP=self.ArRP, cfg=lcfg,
+                solver_name=self.solver_name)
+        return estim["G"], estim["s"], nk
+
+    def _local_cfg(self, nk):
+        lcfg = Config()
+        for kname, v in self.cfg.items():
+            lcfg.add_and_assign(kname, f"copied {kname}", object, None, v)
+        lcfg.quick_assign("num_scens", int, nk)
+        return lcfg
+
+
+class IndepScens_SeqSampling(SeqSampling):
+    """Multistage variant with independent sample trees
+    (multi_seqsampling.py:29-339).  Uses fresh branching-factor samples per
+    iteration; otherwise the BM/BPL loop is shared."""
+
+    def __init__(self, refmodel, xhat_generator, cfg,
+                 stochastic_sampling=False, stopping_criterion="BM"):
+        super().__init__(refmodel, xhat_generator, cfg,
+                         stochastic_sampling=stochastic_sampling,
+                         stopping_criterion=stopping_criterion,
+                         solving_type="EF_mstage")

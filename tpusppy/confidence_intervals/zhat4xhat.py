@@ -1,0 +1,55 @@
+"""zhat4xhat: confidence interval on z(xhat) for a stored candidate.
+
+TPU-native analogue of ``mpisppy/confidence_intervals/zhat4xhat.py`` (200
+LoC): evaluate a fixed first-stage candidate over ``num_samples`` independent
+batches and report a t-based CI on its expected objective.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+import numpy as np
+import scipy.stats
+
+from .. import global_toc
+from ..xhat_eval import Xhat_Eval
+from . import ciutils
+
+
+def evaluate_sample_trees(xhat_one, num_samples, cfg, InitSeed=0,
+                          model_module=None):
+    """Mean/std of z(xhat) over independent sample batches
+    (zhat4xhat.py core)."""
+    mname = cfg["model_module_name"] if model_module is None else None
+    m = model_module or importlib.import_module(mname)
+    num_scens = cfg["num_scens"]
+    zhats = []
+    seed = InitSeed
+    kwargs = m.kw_creator(cfg)
+    for _ in range(num_samples):
+        names = m.scenario_names_creator(num_scens, start=seed)
+        seed += num_scens
+        ev = Xhat_Eval({"solver_options": {}}, names, m.scenario_creator,
+                       scenario_creator_kwargs=kwargs)
+        cache = ciutils._root_cache_to_full(ev, xhat_one)
+        zhats.append(ev.evaluate(cache))
+    return np.array(zhats), seed
+
+
+def run_samples(cfg, args_module=None, model_module=None):
+    """CI on z(xhat): zhatbar +/- t * s / sqrt(n)."""
+    m = model_module or importlib.import_module(cfg["model_module_name"])
+    xhat_one = ciutils.read_xhat(cfg["xhatpath"])
+    num_samples = cfg.get("num_samples", 10)
+    confidence_level = cfg.get("confidence_level", 0.95)
+
+    zhats, seed = evaluate_sample_trees(xhat_one, num_samples, cfg,
+                                        model_module=m)
+    zhatbar = float(np.mean(zhats))
+    s_zhat = float(np.std(zhats, ddof=1)) if len(zhats) > 1 else 0.0
+    t_zhat = scipy.stats.t.ppf(confidence_level, max(num_samples - 1, 1))
+    eps_z = t_zhat * s_zhat / np.sqrt(num_samples)
+    global_toc(f"zhatbar = {zhatbar:.6f} +/- {eps_z:.6f} "
+               f"({confidence_level:.0%} CI)", True)
+    return zhatbar, eps_z
