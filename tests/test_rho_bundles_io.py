@@ -1,0 +1,116 @@
+"""Rho tooling, bundling, W/xbar checkpoint IO, pickle bundles.
+
+Mirrors the reference posture of test_gradient_rho.py, test_w_writer.py and
+test_pickle_bundle.py.
+"""
+
+import numpy as np
+import pytest
+
+from tpusppy.bundles import form_bundles
+from tpusppy.ef import solve_ef
+from tpusppy.extensions.gradient_extension import Gradient_extension
+from tpusppy.extensions.wxbarreader import WXBarReader
+from tpusppy.extensions.wxbarwriter import WXBarWriter
+from tpusppy.ir import ScenarioBatch
+from tpusppy.models import farmer
+from tpusppy.opt.ph import PH
+from tpusppy.utils import wxbarutils
+from tpusppy.utils.find_rho import Find_Rho, Set_Rho
+from tpusppy.utils.gradient import Find_Grad
+from tpusppy.utils.pickle_bundle import dill_pickle, dill_unpickle
+from tpusppy.utils.rho_utils import rho_list_from_csv, rhos_to_csv
+
+
+def _ph(n=3, iters=3, **opts):
+    return PH({"defaultPHrho": 1.0, "PHIterLimit": iters,
+               "convthresh": -1.0, **opts},
+              farmer.scenario_names_creator(n), farmer.scenario_creator,
+              scenario_creator_kwargs={"num_scens": n})
+
+
+def test_find_grad_matches_linear_cost():
+    ph = _ph()
+    ph.ph_main(finalize=False)
+    fg = Find_Grad(ph, {})
+    grads = fg.compute_grad()
+    # farmer is an LP: the objective gradient IS the cost vector
+    expected = ph.batch.c[:, ph.tree.nonant_indices]
+    np.testing.assert_allclose(grads, expected, rtol=1e-12)
+
+
+def test_find_rho_order_stats_and_csv(tmp_path):
+    ph = _ph()
+    ph.ph_main(finalize=False)
+    fr = Find_Rho(ph, {"order_stat": 0.5})
+    rho = fr.compute_rho()
+    assert len(rho) == 3
+    assert all(v > 0 for v in rho.values())
+    path = str(tmp_path / "rho.csv")
+    rhos_to_csv(rho, path)
+    pairs = rho_list_from_csv(path)
+    assert len(pairs) == 3
+    setter = Set_Rho({"rho_path": path}).rho_setter
+    vals = setter(ph.batch)
+    assert vals.shape == (3,)
+
+
+def test_gradient_extension_sets_rho():
+    ph = _ph(iters=4)
+    ph.extobject = Gradient_extension(ph, cfg={"order_stat": 0.5,
+                                               "rho_relative_bound": 1e3})
+    ph.ph_main(finalize=False)
+    # rho was replaced by the heuristic (no longer the default 1.0 everywhere)
+    assert not np.allclose(ph.rho, 1.0)
+
+
+def test_bundles_preserve_ef_objective():
+    n = 6
+    names = farmer.scenario_names_creator(n)
+    problems = [farmer.scenario_creator(nm, num_scens=n) for nm in names]
+    plain = ScenarioBatch.from_problems(problems)
+    obj_plain, _ = solve_ef(plain, solver="highs")
+    bundles = form_bundles(problems, 2)
+    bbatch = ScenarioBatch.from_problems(bundles)
+    obj_b, _ = solve_ef(bbatch, solver="highs")
+    assert obj_b == pytest.approx(obj_plain, rel=1e-9)
+    assert bbatch.num_scenarios == 2
+
+
+def test_bundled_ph_matches_ef():
+    n = 6
+    names = farmer.scenario_names_creator(n)
+    problems = [farmer.scenario_creator(nm, num_scens=n) for nm in names]
+    obj_plain, _ = solve_ef(ScenarioBatch.from_problems(problems),
+                            solver="highs")
+    ph = _ph(n=n, iters=100, convthresh=1e-6, bundles_per_rank=3)
+    assert ph.batch.num_scenarios == 3  # bundled
+    conv, eobj, triv = ph.ph_main()
+    assert eobj == pytest.approx(obj_plain, rel=2e-3)
+
+
+def test_pickle_bundle_roundtrip(tmp_path):
+    p = farmer.scenario_creator("scen0", num_scens=3)
+    path = str(tmp_path / "bundle.npz")
+    dill_pickle(p, path)
+    back = dill_unpickle(path)
+    np.testing.assert_allclose(back.c, p.c)
+    np.testing.assert_allclose(back.A, p.A)
+    assert back.prob == p.prob
+
+
+def test_wxbar_checkpoint_roundtrip(tmp_path):
+    wf = str(tmp_path / "w.csv")
+    xf = str(tmp_path / "xbar.csv")
+    ph = _ph(iters=5, W_fname=wf, Xbar_fname=xf)
+    ph.extobject = WXBarWriter(ph)
+    ph.ph_main(finalize=False)
+    W_final = ph.W.copy()
+    xb_final = ph.xbars.copy()
+
+    ph2 = _ph(iters=1, init_W_fname=wf, init_Xbar_fname=xf)
+    ph2.extobject = WXBarReader(ph2)
+    ph2.Iter0()
+    # reader loads the LAST written iteration's W (file appends per iter and
+    # the reader keeps overwriting -> final values win)
+    np.testing.assert_allclose(ph2.W, W_final, atol=1e-12)

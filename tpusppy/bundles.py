@@ -1,0 +1,58 @@
+"""Scenario bundling: merge scenario groups into per-bundle EF subproblems.
+
+TPU-native analogue of the reference's bundling machinery (P6 in SURVEY
+§2.12): ``_assign_bundles`` (spbase.py:219-253) groups contiguous scenarios,
+``FormEF`` (spopt.py:743-836) builds one EF model per bundle.  Here a bundle
+is a block-merged :class:`~tpusppy.ir.ScenarioProblem` produced by the EF
+assembler on the member sub-batch with conditional probabilities, so the
+batched solver sees fewer, larger subproblems — same trade as the reference
+(shrinks PH subproblem count, tightens iter0 bounds).
+
+Two-stage only (the reference's "proper bundles" for multistage require
+whole-subtree alignment, utils/pickle_bundle.py docs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .ef import build_ef
+from .ir import ScenarioBatch, ScenarioProblem
+from .scenario_tree import ScenarioNode
+
+
+def form_bundles(problems, num_bundles: int) -> list:
+    """Contiguous-slice bundling (spbase.py:219-253): ``num_bundles`` merged
+    ScenarioProblems from ``len(problems)`` scenarios."""
+    S = len(problems)
+    if num_bundles <= 0 or num_bundles > S:
+        raise ValueError(f"num_bundles={num_bundles} out of range for {S}")
+    for p in problems:
+        if len(p.nodes) != 1:
+            raise ValueError("bundling supports two-stage models only")
+    if any(p.prob is None for p in problems):
+        problems = [dataclasses.replace(p, prob=1.0 / S) for p in problems]
+
+    slices = np.array_split(np.arange(S), num_bundles)
+    bundles = []
+    for bnum, sl in enumerate(slices):
+        members = [problems[i] for i in sl]
+        bprob = sum(p.prob for p in members)
+        cond = [dataclasses.replace(p, prob=p.prob / bprob) for p in members]
+        sub = ScenarioBatch.from_problems(cond)
+        ef = build_ef(sub)
+        K = sub.tree.num_nonants
+        # build_ef allocates the shared ROOT nonant columns first: 0..K-1
+        bundles.append(ScenarioProblem(
+            name=f"bundle_{bnum}",
+            c=ef.c, q2=ef.q2, A=ef.A, cl=ef.cl, cu=ef.cu,
+            lb=ef.lb, ub=ef.ub, is_int=ef.is_int,
+            prob=bprob,
+            nodes=[ScenarioNode("ROOT", 1.0, 1,
+                                np.arange(K, dtype=np.int32))],
+            var_names=None,
+            const=ef.const,
+        ))
+    return bundles

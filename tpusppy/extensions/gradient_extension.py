@@ -1,0 +1,49 @@
+"""Gradient_extension: per-iteration gradient-based dynamic rho.
+
+TPU-native analogue of ``mpisppy/extensions/gradient_extension.py:18-111``:
+each iteration, recompute gradient costs at the current iterate and reset rho
+via the WW heuristic order statistic.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .extension import Extension
+from ..utils.find_rho import Find_Rho, _nonant_var_names
+from ..utils.gradient import Find_Grad
+
+
+class Gradient_extension(Extension):
+    def __init__(self, opt, cfg=None):
+        super().__init__(opt)
+        self.cfg = cfg or opt.options.get("gradient_extension_options", {})
+        self.grad_object = Find_Grad(opt, self.cfg)
+        self.rho_finder = Find_Rho(opt, self.cfg)
+        self._vnames = None
+
+    def _update_rho(self):
+        opt = self.opt
+        grads = self.grad_object.compute_grad()
+        if self._vnames is None:
+            self._vnames = _nonant_var_names(opt)
+        self.rho_finder.c = {
+            (sname, self._vnames[k]): float(grads[s, k])
+            for s, sname in enumerate(opt.all_scenario_names)
+            for k in range(grads.shape[1])
+        }
+        rho_by_name = self.rho_finder.compute_rho()
+        rho_k = np.array([rho_by_name[v] for v in self._vnames])
+        opt.rho = np.broadcast_to(
+            rho_k[None, :], (opt.batch.num_scenarios, rho_k.shape[0])
+        ).copy()
+
+    def post_iter0(self):
+        self._update_rho()
+
+    def miditer(self):
+        it = self.opt._iter
+        start = self.cfg.get("grad_rho_start_iter", 1)
+        step = self.cfg.get("grad_rho_setter_step", 1)
+        if it >= start and (it - start) % step == 0:
+            self._update_rho()

@@ -1,0 +1,25 @@
+"""WXBarReader: warm-start W / xbar from files before iteration 0.
+
+TPU-native analogue of ``mpisppy/utils/wxbarreader.py``: options
+``init_W_fname`` / ``init_Xbar_fname`` / ``init_separate_W_files``.
+"""
+
+from __future__ import annotations
+
+from .extension import Extension
+from ..utils import wxbarutils
+
+
+class WXBarReader(Extension):
+    def __init__(self, opt):
+        super().__init__(opt)
+        self.W_fname = opt.options.get("init_W_fname")
+        self.Xbar_fname = opt.options.get("init_Xbar_fname")
+        self.sep_files = opt.options.get("init_separate_W_files", False)
+
+    def post_iter0(self):
+        if self.W_fname:
+            wxbarutils.set_W_from_file(self.W_fname, self.opt,
+                                       sep_files=self.sep_files)
+        if self.Xbar_fname:
+            wxbarutils.set_xbar_from_file(self.Xbar_fname, self.opt)

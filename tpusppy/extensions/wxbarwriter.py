@@ -1,0 +1,32 @@
+"""WXBarWriter: checkpoint W / xbar each iteration (or at the end).
+
+TPU-native analogue of ``mpisppy/utils/wxbarwriter.py`` (an Extension in the
+reference's utils): options ``W_fname`` / ``Xbar_fname`` /
+``separate_W_files``.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .extension import Extension
+from ..utils import wxbarutils
+
+
+class WXBarWriter(Extension):
+    def __init__(self, opt):
+        super().__init__(opt)
+        self.W_fname = opt.options.get("W_fname")
+        self.Xbar_fname = opt.options.get("Xbar_fname")
+        self.sep_files = opt.options.get("separate_W_files", False)
+        # start fresh (the writers append per iteration)
+        for fname in (self.W_fname, self.Xbar_fname):
+            if fname and not self.sep_files and os.path.exists(fname):
+                os.remove(fname)
+
+    def enditer(self):
+        if self.W_fname:
+            wxbarutils.write_W_to_file(self.opt, self.W_fname,
+                                       sep_files=self.sep_files)
+        if self.Xbar_fname:
+            wxbarutils.write_xbar_to_file(self.opt, self.Xbar_fname)

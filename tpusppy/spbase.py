@@ -61,6 +61,16 @@ class SPBase:
             scenario_creator(name, **self.scenario_creator_kwargs)
             for name in self.all_scenario_names
         ]
+        # bundling (P6): merge scenario groups into per-bundle EFs before
+        # batching (spbase.py:219-253 + spopt.py:743-836 collapsed); with one
+        # controller, "bundles_per_rank" is the total bundle count
+        nbundles = int(self.options.get("bundles_per_rank", 0) or 0)
+        self.bundling = nbundles > 0
+        if self.bundling:
+            from .bundles import form_bundles
+
+            problems = form_bundles(problems, nbundles)
+            self.all_scenario_names = [p.name for p in problems]
         self.batch = ScenarioBatch.from_problems(problems)
         self.tree = self.batch.tree
         global_toc(
@@ -80,6 +90,12 @@ class SPBase:
     def _make_admm_settings(self) -> ADMMSettings:
         so = dict(self.options.get("solver_options") or {})
         allowed = {f.name for f in ADMMSettings.__dataclass_fields__.values()}
+        # bundles are fewer but larger/harder subproblems; spend more solver
+        # budget per problem unless the user pinned it (same trade as giving
+        # the external solver more time per bundle EF in the reference)
+        if getattr(self, "bundling", False):
+            so.setdefault("max_iter", 4000)
+            so.setdefault("restarts", 6)
         return ADMMSettings(**{k: v for k, v in so.items() if k in allowed})
 
     def _options_check(self, required, options=None):

@@ -1,0 +1,139 @@
+"""Find_Rho / Set_Rho: WW-heuristic rho from costs and nonant spreads.
+
+TPU-native analogue of ``mpisppy/utils/find_rho.py:45-331``: per-variable rho
+= |cost| / denominator, where the denominator is either the per-scenario
+max(|x - xbar|, 2(x - xbar)^2) or the scenario-independent probability-
+weighted spread, then condensed by an order statistic across scenarios.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import rho_utils
+
+
+class Find_Rho:
+    """(find_rho.py:45-220).  ``self.c``: {(sname, vname): cost} — from
+    Find_Grad or a csv (cfg["grad_cost_file"])."""
+
+    def __init__(self, ph_object, cfg):
+        self.ph_object = ph_object
+        self.cfg = cfg
+        self.c = {}
+        if cfg.get("grad_cost_file") and cfg.get("load_cost_file", False):
+            import csv
+
+            with open(cfg["grad_cost_file"]) as f:
+                for row in csv.reader(f):
+                    if not row or row[0].startswith("#"):
+                        continue
+                    self.c[(row[0], row[1])] = float(row[2])
+
+    def _spread(self) -> np.ndarray:
+        """(S, K) |x - xbar| at the current iterate."""
+        opt = self.ph_object
+        xk = opt.nonants_of(opt.local_x)
+        return np.abs(xk - opt.xbars)
+
+    def _w_denom(self) -> np.ndarray:
+        """(S, K) w denominator (find_rho.py:78-96)."""
+        return self._spread()
+
+    def _prox_denom(self) -> np.ndarray:
+        """(S, K) prox denominator (find_rho.py:98-116)."""
+        return 2.0 * np.square(self._spread())
+
+    def _grad_denom(self) -> np.ndarray:
+        """(K,) scenario-independent denominator (find_rho.py:118-148)."""
+        opt = self.ph_object
+        denom = opt.probs @ self._spread()
+        bound = 1.0 / self.cfg.get("rho_relative_bound", 1e3)
+        return np.maximum(denom, bound)
+
+    def _order_stat(self, rho_list) -> float:
+        """(find_rho.py:150-168)"""
+        alpha = self.cfg.get("order_stat", -1.0)
+        assert alpha != -1.0, \
+            "set the order statistic parameter for rho using --order-stat"
+        assert 0 <= alpha <= 1, "0 is the min, 0.5 the average, 1 the max"
+        rho_mean = float(np.mean(rho_list))
+        rho_min = float(np.min(rho_list))
+        rho_max = float(np.max(rho_list))
+        if alpha == 0.5:
+            return rho_mean
+        if alpha < 0.5:
+            return rho_min + alpha * 2 * (rho_mean - rho_min)
+        return (2 * rho_mean - rho_max) + alpha * 2 * (rho_max - rho_mean)
+
+    def compute_rho(self, indep_denom=False) -> dict:
+        """{vname: rho} (find_rho.py:170-206)."""
+        opt = self.ph_object
+        S = opt.batch.num_scenarios
+        K = opt.nonant_length
+        vnames = _nonant_var_names(opt)
+        if self.c:
+            cost = np.zeros((S, K))
+            for s, sname in enumerate(opt.all_scenario_names):
+                for k, vname in enumerate(vnames):
+                    cost[s, k] = self.c.get((sname, vname), 0.0)
+        else:
+            cost = np.abs(opt.batch.c[:, opt.tree.nonant_indices])
+        if indep_denom:
+            denom = np.broadcast_to(self._grad_denom()[None, :], (S, K))
+        else:
+            denom = np.maximum(self._w_denom(), self._prox_denom())
+            denom = np.maximum(denom, 1.0 / self.cfg.get(
+                "rho_relative_bound", 1e3))
+        rho_sk = np.abs(cost / denom)
+        return {vname: self._order_stat(rho_sk[:, k])
+                for k, vname in enumerate(vnames)}
+
+    def write_rho(self):
+        """(find_rho.py:207-219)"""
+        if not self.cfg.get("rho_file"):
+            return
+        rho_utils.rhos_to_csv(self.compute_rho(), self.cfg["rho_file"])
+
+
+class Set_Rho:
+    """rho_setter from a rho csv (find_rho.py:221-262)."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+
+    def rho_setter(self, batch):
+        """(K,) rho over the packed nonant layout from cfg['rho_path']."""
+        pairs = rho_utils.rho_list_from_csv(self.cfg["rho_path"])
+        name_to_rho = dict(pairs)
+        p0_names = batch.names if not hasattr(batch, "var_names") else None
+        # map by position in the csv (written in nonant-slot order)
+        return np.array([rho for _, rho in pairs])
+
+
+def _nonant_var_names(opt):
+    p0 = opt.scenario_creator(opt.all_scenario_names[0],
+                              **opt.scenario_creator_kwargs)
+    names = p0.var_names or [f"x[{j}]" for j in range(opt.batch.num_vars)]
+    return [names[j] for j in opt.tree.nonant_indices]
+
+
+def get_rho_from_W(mname, original_cfg):
+    """CLI-style driver (find_rho.py:285-331)."""
+    import importlib
+
+    from ..opt.ph import PH
+
+    m = importlib.import_module(mname) if isinstance(mname, str) else mname
+    cfg = original_cfg
+    names = m.scenario_names_creator(cfg["num_scens"])
+    ph = PH(
+        {"defaultPHrho": cfg.get("default_rho") or 1.0,
+         "PHIterLimit": 2, "convthresh": -1.0},
+        names, m.scenario_creator,
+        scenario_creator_kwargs=m.kw_creator(cfg),
+    )
+    ph.ph_main(finalize=False)
+    fr = Find_Rho(ph, cfg)
+    fr.write_rho()
+    return fr

@@ -1,0 +1,121 @@
+"""Find_Grad: gradient-based cost extraction at a candidate xhat.
+
+TPU-native analogue of ``mpisppy/utils/gradient.py:44-253``.  The reference
+computes objective gradients through pynumero's C++ ASL interface
+(gradient.py:30,65-82); here the objective is a traced JAX function of x, so
+the gradient is ``jax.grad`` — free on TPU and exact for the quadratic IR.
+"""
+
+from __future__ import annotations
+
+import csv
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..confidence_intervals import ciutils
+from . import rho_utils
+
+
+class Find_Grad:
+    """(gradient.py:44-180)"""
+
+    def __init__(self, ph_object, cfg):
+        self.ph_object = ph_object
+        self.cfg = cfg
+        self.c = {}          # {(sname, vname): gradient cost}
+
+    def compute_grad(self, xhat_cache=None) -> np.ndarray:
+        """(S, K) objective gradients w.r.t. nonant slots at the candidate
+        (gradient.py:65-82): fix, solve, differentiate."""
+        opt = self.ph_object
+        if xhat_cache is not None:
+            saved = (opt._warm, opt.local_x, opt.pri_res, opt.dua_res)
+            opt.fix_nonants(xhat_cache)
+            try:
+                x = opt.solve_loop(warm=False)
+            finally:
+                opt.restore_nonants()
+                opt._warm, opt.local_x, opt.pri_res, opt.dua_res = saved
+        else:
+            x = opt.local_x
+        b = opt.batch
+
+        def scen_obj(xs, c, q2):
+            return jnp.dot(c, xs) + 0.5 * jnp.dot(q2, xs * xs)
+
+        grads = jax.vmap(jax.grad(scen_obj))(
+            jnp.asarray(x), jnp.asarray(b.c), jnp.asarray(b.q2))
+        return np.asarray(grads)[:, opt.tree.nonant_indices]
+
+    def find_grad_cost(self):
+        """(gradient.py:84-123)"""
+        if not self.cfg.get("grad_cost_file"):
+            return
+        if not self.cfg.get("xhatpath"):
+            raise RuntimeError(
+                "to compute gradient cost, give an xhat path via --xhatpath")
+        xhat = ciutils.read_xhat(self.cfg["xhatpath"])
+        opt = self.ph_object
+        cache = ciutils._root_cache_to_full(opt, xhat)
+        grads = self.compute_grad(cache)
+        vnames = self._var_names()
+        self.c = {
+            (sname, vnames[k]): float(grads[s, k])
+            for s, sname in enumerate(opt.all_scenario_names)
+            for k in range(grads.shape[1])
+        }
+
+    def _var_names(self):
+        opt = self.ph_object
+        p0 = opt.scenario_creator(opt.all_scenario_names[0],
+                                  **opt.scenario_creator_kwargs)
+        names = p0.var_names or [f"x[{j}]" for j in range(opt.batch.num_vars)]
+        return [names[j] for j in opt.tree.nonant_indices]
+
+    def write_grad_cost(self):
+        """(gradient.py:125-145)"""
+        self.find_grad_cost()
+        fname = self.cfg["grad_cost_file"]
+        with open(fname, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["#grad cost values"])
+            for (sname, vname), val in self.c.items():
+                w.writerow([sname, vname, repr(val)])
+
+    def find_grad_rho(self):
+        """(gradient.py:146-158): rho from gradient costs via Find_Rho."""
+        from .find_rho import Find_Rho
+
+        fr = Find_Rho(self.ph_object, self.cfg)
+        fr.c = self.c
+        return fr.compute_rho()
+
+    def write_grad_rho(self):
+        """(gradient.py:159-180)"""
+        rho = self.find_grad_rho()
+        rho_utils.rhos_to_csv(rho, self.cfg["grad_rho_file"])
+
+
+def grad_cost_and_rho(mname, original_cfg):
+    """CLI-style driver (gradient.py:204-253): build PH, write both files."""
+    import importlib
+
+    from ..opt.ph import PH
+
+    m = importlib.import_module(mname) if isinstance(mname, str) else mname
+    cfg = original_cfg
+    names = m.scenario_names_creator(cfg["num_scens"])
+    ph = PH(
+        {"defaultPHrho": cfg.get("default_rho") or 1.0,
+         "PHIterLimit": 0, "convthresh": -1.0},
+        names, m.scenario_creator,
+        scenario_creator_kwargs=m.kw_creator(cfg),
+    )
+    ph.Iter0()
+    fg = Find_Grad(ph, cfg)
+    fg.write_grad_cost()
+    if cfg.get("grad_rho_file"):
+        fg.write_grad_rho()
+    return fg

@@ -1,0 +1,70 @@
+"""Proper-bundle serialization: save/load pre-built bundles to skip model
+construction.
+
+TPU-native analogue of ``mpisppy/utils/pickle_bundle.py`` (66 LoC): the
+reference dill-pickles Pyomo bundle models; here a bundle is a tensor record,
+so serialization is a plain ``.npz`` (faster and portable).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ir import ScenarioProblem
+from ..scenario_tree import ScenarioNode
+
+
+def dill_pickle(problem: ScenarioProblem, fname: str):
+    """Write a ScenarioProblem (bundle or scenario) to .npz
+    (pickle_bundle.py:11-33 semantics)."""
+    nd = problem.nodes[0]
+    np.savez_compressed(
+        fname,
+        name=np.array(problem.name),
+        c=problem.c, q2=problem.q2, A=problem.A, cl=problem.cl,
+        cu=problem.cu, lb=problem.lb, ub=problem.ub, is_int=problem.is_int,
+        prob=np.array(-1.0 if problem.prob is None else problem.prob),
+        const=np.array(problem.const),
+        nonant_indices=nd.nonant_indices,
+    )
+
+
+def dill_unpickle(fname: str) -> ScenarioProblem:
+    """(pickle_bundle.py:35-46)"""
+    if not fname.endswith(".npz"):
+        fname = fname + ".npz"
+    z = np.load(fname, allow_pickle=False)
+    prob = float(z["prob"])
+    return ScenarioProblem(
+        name=str(z["name"]),
+        c=z["c"], q2=z["q2"], A=z["A"], cl=z["cl"], cu=z["cu"],
+        lb=z["lb"], ub=z["ub"], is_int=z["is_int"],
+        prob=None if prob < 0 else prob,
+        nodes=[ScenarioNode("ROOT", 1.0, 1, z["nonant_indices"])],
+        const=float(z["const"]),
+    )
+
+
+def check_args(cfg):
+    """Option sanity for pickled-bundle CLIs (pickle_bundle.py:48-66)."""
+    if cfg.get("pickle_bundles_dir") and cfg.get("unpickle_bundles_dir"):
+        raise RuntimeError(
+            "Arguments pickle_bundles_dir and unpickle_bundles_dir are "
+            "mutually exclusive"
+        )
+    if cfg.get("bundles_per_rank") and (cfg.get("pickle_bundles_dir")
+                                        or cfg.get("unpickle_bundles_dir")):
+        raise RuntimeError(
+            "Proper bundles (pickle/unpickle dirs) cannot be combined with "
+            "loose bundles_per_rank"
+        )
+
+
+def pickle_bundle_config(cfg):
+    """Config group (pickle_bundle.py parser args)."""
+    cfg.add_to_config("pickle_bundles_dir",
+                      "write bundles here (default None)", str, None)
+    cfg.add_to_config("unpickle_bundles_dir",
+                      "read bundles from here (default None)", str, None)
+    cfg.add_to_config("scenarios_per_bundle",
+                      "used for pickle/unpickle (default None)", int, None)

@@ -1,0 +1,25 @@
+"""rho csv IO (mpisppy/utils/rho_utils.py, 37 LoC)."""
+
+from __future__ import annotations
+
+import csv
+
+
+def rhos_to_csv(rho_dict, filename):
+    """Write {vname: rho} rows as 'vname,rho'."""
+    with open(filename, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["#Rho values"])
+        for vname, rho in rho_dict.items():
+            w.writerow([vname, repr(float(rho))])
+
+
+def rho_list_from_csv(filename):
+    """[(vname, rho)] from a rho csv."""
+    out = []
+    with open(filename) as f:
+        for row in csv.reader(f):
+            if not row or row[0].startswith("#"):
+                continue
+            out.append((row[0], float(row[1])))
+    return out

@@ -1,0 +1,78 @@
+"""W / xbar checkpoint IO — the reference's only restart mechanism.
+
+TPU-native analogue of ``mpisppy/utils/wxbarutils.py`` (395 LoC): W and xbar
+vectors written each iteration and read back to warm-start a later run
+(single csv or per-scenario files).  Formats: W rows are
+``scenario,slot,value``; xbar rows are ``slot,value``.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+
+
+def write_W_to_file(opt, fname, sep_files=False):
+    """(wxbarutils.py:42-100)"""
+    if sep_files:
+        os.makedirs(fname, exist_ok=True)
+        for s, sname in enumerate(opt.all_scenario_names):
+            with open(os.path.join(fname, sname + "_weights.csv"), "w",
+                      newline="") as f:
+                w = csv.writer(f)
+                for k in range(opt.nonant_length):
+                    w.writerow([k, repr(float(opt.W[s, k]))])
+        return
+    with open(fname, "a", newline="") as f:
+        w = csv.writer(f)
+        for s, sname in enumerate(opt.all_scenario_names):
+            for k in range(opt.nonant_length):
+                w.writerow([sname, k, repr(float(opt.W[s, k]))])
+
+
+def set_W_from_file(fname, opt, sep_files=False):
+    """(wxbarutils.py:101-180)"""
+    W = np.array(opt.W, copy=True)
+    name_to_idx = {nm: i for i, nm in enumerate(opt.all_scenario_names)}
+    if sep_files:
+        for sname, s in name_to_idx.items():
+            path = os.path.join(fname, sname + "_weights.csv")
+            with open(path) as f:
+                for row in csv.reader(f):
+                    if not row:
+                        continue
+                    W[s, int(row[0])] = float(row[1])
+    else:
+        with open(fname) as f:
+            for row in csv.reader(f):
+                if not row or row[0].startswith("#"):
+                    continue
+                s = name_to_idx.get(row[0])
+                if s is not None:
+                    W[s, int(row[1])] = float(row[2])
+    opt.W = W
+    # consistency: probability-weighted W should sum ~0 per slot
+    wsum = np.abs(opt.probs @ W).max()
+    if wsum > 1e-4 * max(1.0, np.abs(W).max()):
+        print(f"WARNING: read Ws are not dual-feasible (max |E W| = {wsum})")
+
+
+def write_xbar_to_file(opt, fname):
+    """(wxbarutils.py:181-220)"""
+    with open(fname, "a", newline="") as f:
+        w = csv.writer(f)
+        for k in range(opt.nonant_length):
+            w.writerow([k, repr(float(opt.xbars[0, k]))])
+
+
+def set_xbar_from_file(fname, opt):
+    """(wxbarutils.py:221-260)"""
+    xb = np.array(opt.xbars, copy=True)
+    with open(fname) as f:
+        for row in csv.reader(f):
+            if not row or row[0].startswith("#"):
+                continue
+            xb[:, int(row[0])] = float(row[1])
+    opt.xbars = xb
