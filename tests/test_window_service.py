@@ -1,0 +1,90 @@
+"""C++ shared-memory window service: protocol parity + cross-process exchange.
+
+The analogue of the reference's standalone RMA smoke test
+(mpi_one_sided_test.py: 2 ranks, Lock/Put/Get/Unlock assertions).
+"""
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from tpusppy.runtime import ShmMailbox, ShmWindowFabric, load_library
+from tpusppy.runtime.window_service import ShmSegment
+
+
+def test_library_builds():
+    lib = load_library()
+    assert lib is not None
+
+
+def test_shm_mailbox_protocol():
+    seg = ShmSegment(f"/tpusppy_test_{os.getpid()}", lengths=[3, 2])
+    try:
+        mb = ShmMailbox(seg, 0)
+        data, wid = mb.get()
+        assert wid == 0
+        assert mb.put(np.array([1.0, 2.0, 3.0])) == 1
+        data, wid = mb.get()
+        assert wid == 1 and np.array_equal(data, [1.0, 2.0, 3.0])
+        assert mb.put(np.array([4.0, 5.0, 6.0])) == 2
+        mb.kill()
+        data, wid = mb.get()
+        assert wid == -1
+        # payload preserved after kill; put is terminal
+        assert np.array_equal(data, [4.0, 5.0, 6.0])
+        assert mb.put(np.array([7.0, 8.0, 9.0])) == -1
+        with pytest.raises(RuntimeError):
+            mb.put(np.zeros(4))
+    finally:
+        seg.close()
+
+
+def _spoke_process(name):
+    """Child: attach, echo hub payloads + 1 until the kill sentinel."""
+    import time
+
+    from tpusppy.runtime import ShmWindowFabric as F
+
+    fabric = F(name, attach=True)
+    last = 0
+    while True:
+        data, wid = fabric.to_spoke[1].get()
+        if wid == -1:
+            break
+        if wid > last:
+            last = wid
+            fabric.to_hub[1].put(data + 1.0)
+        else:
+            time.sleep(0.001)
+
+
+def test_cross_process_exchange():
+    import time
+
+    name = f"/tpusppy_xproc_{os.getpid()}"
+    fabric = ShmWindowFabric(name, spoke_lengths=[(4, 4)])
+    try:
+        # spawn, not fork: jax/XLA threads make fork unsafe in-test
+        ctx = mp.get_context("spawn")
+        child = ctx.Process(target=_spoke_process, args=(name,))
+        child.start()
+        seen = 0
+        for r in range(5):
+            fabric.to_spoke[1].put(np.full(4, float(r)))
+            deadline = time.time() + 30.0
+            while time.time() < deadline:
+                data, wid = fabric.to_hub[1].get()
+                if wid > seen:
+                    seen = wid
+                    np.testing.assert_allclose(data, np.full(4, r + 1.0))
+                    break
+                time.sleep(0.001)
+            else:
+                raise AssertionError("spoke never echoed")
+        fabric.send_terminate()
+        child.join(timeout=30)
+        assert child.exitcode == 0
+    finally:
+        fabric.close()

@@ -1,0 +1,5 @@
+"""Native runtime components (C++): shared-memory window service."""
+
+from .window_service import ShmMailbox, ShmWindowFabric, load_library
+
+__all__ = ["ShmMailbox", "ShmWindowFabric", "load_library"]

@@ -1,0 +1,212 @@
+// Shared-memory window service: versioned mailboxes for cross-process
+// cylinder exchange.
+//
+// TPU-native replacement for the reference's one-sided MPI RMA windows
+// (mpisppy/cylinders/spcommunicator.py:93-120 and the Lock/Put/Get/Unlock +
+// write-id protocol in hub.py:370-450 / spoke.py:60-118).  Each mailbox is a
+// fixed-length double payload plus an atomic write-id; writers use a seqlock
+// (sequence odd while writing) so readers never block a writer and always
+// obtain a consistent (payload, write_id) snapshot -- the moral equivalent of
+// MPI.Win.Lock/Unlock without requiring progress threads
+// (cf. the reference's MPICH_ASYNC_PROGRESS caveat, README.rst).
+//
+// Layout of the POSIX shm segment:
+//   Header  { magic, n_boxes }
+//   BoxDesc { offset, length } * n_boxes
+//   per box: { atomic<int64> write_id; atomic<uint64> seq; double[length] }
+//
+// The kill sentinel is write_id == -1, terminal as in the Python Mailbox.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <new>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x7451u;
+constexpr int64_t kKillId = -1;
+
+struct Header {
+  uint64_t magic;
+  uint64_t n_boxes;
+};
+
+struct BoxDesc {
+  uint64_t offset;  // bytes from segment start
+  uint64_t length;  // payload doubles
+};
+
+struct BoxHead {
+  std::atomic<int64_t> write_id;
+  std::atomic<uint64_t> seq;
+};
+
+struct Handle {
+  void* base;
+  size_t size;
+  int fd;
+  bool owner;
+  char name[256];
+};
+
+inline BoxDesc* descs(void* base) {
+  return reinterpret_cast<BoxDesc*>(static_cast<char*>(base) +
+                                    sizeof(Header));
+}
+
+inline BoxHead* box_head(void* base, uint64_t off) {
+  return reinterpret_cast<BoxHead*>(static_cast<char*>(base) + off);
+}
+
+inline double* box_payload(void* base, uint64_t off) {
+  return reinterpret_cast<double*>(static_cast<char*>(base) + off +
+                                   sizeof(BoxHead));
+}
+
+size_t segment_size(int n_boxes, const int64_t* lengths) {
+  size_t sz = sizeof(Header) + n_boxes * sizeof(BoxDesc);
+  for (int i = 0; i < n_boxes; ++i) {
+    sz = (sz + 63) & ~size_t(63);  // cacheline-align each box
+    sz += sizeof(BoxHead) + lengths[i] * sizeof(double);
+  }
+  return sz;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a named segment with n_boxes mailboxes of the given payload lengths.
+// Returns an opaque handle or nullptr.
+void* ws_create(const char* name, int n_boxes, const int64_t* lengths) {
+  shm_unlink(name);  // stale segment from a crashed run
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  size_t size = segment_size(n_boxes, lengths);
+  if (ftruncate(fd, static_cast<off_t>(size)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* base =
+      mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  std::memset(base, 0, size);
+  auto* hdr = static_cast<Header*>(base);
+  hdr->n_boxes = static_cast<uint64_t>(n_boxes);
+  size_t off = sizeof(Header) + n_boxes * sizeof(BoxDesc);
+  for (int i = 0; i < n_boxes; ++i) {
+    off = (off + 63) & ~size_t(63);
+    descs(base)[i].offset = off;
+    descs(base)[i].length = static_cast<uint64_t>(lengths[i]);
+    new (box_head(base, off)) BoxHead{};
+    off += sizeof(BoxHead) + lengths[i] * sizeof(double);
+  }
+  hdr->magic = kMagic;  // publish last
+  auto* h = new Handle{base, size, fd, true, {0}};
+  std::strncpy(h->name, name, sizeof(h->name) - 1);
+  return h;
+}
+
+// Attach to an existing segment (spoke processes).
+void* ws_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, static_cast<size_t>(st.st_size),
+                    PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  if (static_cast<Header*>(base)->magic != kMagic) {
+    munmap(base, static_cast<size_t>(st.st_size));
+    close(fd);
+    return nullptr;
+  }
+  auto* h = new Handle{base, static_cast<size_t>(st.st_size), fd, false, {0}};
+  std::strncpy(h->name, name, sizeof(h->name) - 1);
+  return h;
+}
+
+int64_t ws_num_boxes(void* handle) {
+  auto* h = static_cast<Handle*>(handle);
+  return static_cast<int64_t>(static_cast<Header*>(h->base)->n_boxes);
+}
+
+int64_t ws_length(void* handle, int box) {
+  auto* h = static_cast<Handle*>(handle);
+  return static_cast<int64_t>(descs(h->base)[box].length);
+}
+
+// Owner-side Put: seqlock write, bump write_id.  Returns the new id, the
+// kill sentinel if the box was killed, or -2 on a length mismatch.
+int64_t ws_put(void* handle, int box, const double* values, int64_t n) {
+  auto* h = static_cast<Handle*>(handle);
+  BoxDesc d = descs(h->base)[box];
+  if (n != static_cast<int64_t>(d.length)) return -2;
+  BoxHead* bh = box_head(h->base, d.offset);
+  int64_t id = bh->write_id.load(std::memory_order_acquire);
+  if (id == kKillId) return kKillId;  // terminal (Mailbox.put parity)
+  uint64_t s = bh->seq.load(std::memory_order_relaxed);
+  bh->seq.store(s + 1, std::memory_order_release);  // odd: write in progress
+  std::memcpy(box_payload(h->base, d.offset), values, n * sizeof(double));
+  bh->write_id.store(id + 1, std::memory_order_release);
+  bh->seq.store(s + 2, std::memory_order_release);  // even: stable
+  return id + 1;
+}
+
+// Reader-side Get: consistent snapshot; returns the write_id.
+int64_t ws_get(void* handle, int box, double* out, int64_t n) {
+  auto* h = static_cast<Handle*>(handle);
+  BoxDesc d = descs(h->base)[box];
+  if (n != static_cast<int64_t>(d.length)) return -2;
+  BoxHead* bh = box_head(h->base, d.offset);
+  while (true) {
+    uint64_t s0 = bh->seq.load(std::memory_order_acquire);
+    if (s0 & 1u) continue;  // writer mid-flight
+    int64_t id = bh->write_id.load(std::memory_order_acquire);
+    std::memcpy(out, box_payload(h->base, d.offset), n * sizeof(double));
+    uint64_t s1 = bh->seq.load(std::memory_order_acquire);
+    if (s0 == s1) return id;
+  }
+}
+
+int64_t ws_write_id(void* handle, int box) {
+  auto* h = static_cast<Handle*>(handle);
+  BoxDesc d = descs(h->base)[box];
+  return box_head(h->base, d.offset)
+      ->write_id.load(std::memory_order_acquire);
+}
+
+// Kill sentinel: payload preserved (see the Python Mailbox.kill docstring).
+void ws_kill(void* handle, int box) {
+  auto* h = static_cast<Handle*>(handle);
+  BoxDesc d = descs(h->base)[box];
+  box_head(h->base, d.offset)
+      ->write_id.store(kKillId, std::memory_order_release);
+}
+
+void ws_close(void* handle) {
+  auto* h = static_cast<Handle*>(handle);
+  munmap(h->base, h->size);
+  close(h->fd);
+  if (h->owner) shm_unlink(h->name);
+  delete h;
+}
+
+}  // extern "C"

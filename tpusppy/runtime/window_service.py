@@ -1,0 +1,185 @@
+"""ctypes bindings for the C++ shared-memory window service.
+
+Exposes :class:`ShmMailbox` with the exact interface of the in-process
+:class:`tpusppy.cylinders.spcommunicator.Mailbox` (put/get/kill/write_id and
+the terminal −1 sentinel), so a :class:`ShmWindowFabric` drops into
+``WheelSpinner`` unchanged when cylinders are separate OS processes — the
+cross-process analogue of the reference's MPI RMA windows
+(spcommunicator.py:93-120).
+
+The library is compiled on first use with g++ (cached beside the source);
+pybind11 is unavailable in this image, hence ctypes over a C ABI.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "csrc", "window_service.cpp")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "csrc",
+                         "libwindow_service.so")
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def load_library() -> ctypes.CDLL:
+    """Compile (once) and load the shared library."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_LIB_PATH)
+                or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)):
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                 _SRC, "-o", _LIB_PATH],
+                check=True, capture_output=True,
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.ws_create.restype = ctypes.c_void_p
+        lib.ws_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                  ctypes.POINTER(ctypes.c_int64)]
+        lib.ws_attach.restype = ctypes.c_void_p
+        lib.ws_attach.argtypes = [ctypes.c_char_p]
+        lib.ws_num_boxes.restype = ctypes.c_int64
+        lib.ws_num_boxes.argtypes = [ctypes.c_void_p]
+        lib.ws_length.restype = ctypes.c_int64
+        lib.ws_length.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ws_put.restype = ctypes.c_int64
+        lib.ws_put.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                               ctypes.POINTER(ctypes.c_double),
+                               ctypes.c_int64]
+        lib.ws_get.restype = ctypes.c_int64
+        lib.ws_get.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                               ctypes.POINTER(ctypes.c_double),
+                               ctypes.c_int64]
+        lib.ws_write_id.restype = ctypes.c_int64
+        lib.ws_write_id.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ws_kill.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ws_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class ShmSegment:
+    """One named segment holding several mailboxes."""
+
+    def __init__(self, name: str, lengths=None, attach=False):
+        self._lib = load_library()
+        self.name = name
+        if attach:
+            handle = self._lib.ws_attach(name.encode())
+            if not handle:
+                raise RuntimeError(f"cannot attach shm segment {name!r}")
+        else:
+            arr = (ctypes.c_int64 * len(lengths))(*[int(x) for x in lengths])
+            handle = self._lib.ws_create(name.encode(), len(lengths), arr)
+            if not handle:
+                raise RuntimeError(f"cannot create shm segment {name!r}")
+        self._handle = ctypes.c_void_p(handle)
+
+    @property
+    def num_boxes(self) -> int:
+        return int(self._lib.ws_num_boxes(self._handle))
+
+    def length(self, box: int) -> int:
+        return int(self._lib.ws_length(self._handle, box))
+
+    def close(self):
+        if self._handle:
+            self._lib.ws_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class ShmMailbox:
+    """Mailbox-view over one box of a segment (Mailbox API parity)."""
+
+    KILL_ID = -1
+
+    def __init__(self, segment: ShmSegment, box: int, name: str = ""):
+        self.segment = segment
+        self.box = int(box)
+        self.name = name
+        self.length = segment.length(box)
+
+    def put(self, values) -> int:
+        values = np.ascontiguousarray(values, dtype=np.float64)
+        if values.shape != (self.length,):
+            raise RuntimeError(
+                f"ShmMailbox {self.name}: putting length {values.shape} into "
+                f"buffer of length {self.length}"
+            )
+        rc = self.segment._lib.ws_put(
+            self.segment._handle, self.box,
+            values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            self.length,
+        )
+        if rc == -2:
+            raise RuntimeError("length mismatch in ws_put")
+        return int(rc)
+
+    def get(self):
+        out = np.empty(self.length, dtype=np.float64)
+        wid = self.segment._lib.ws_get(
+            self.segment._handle, self.box,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), self.length,
+        )
+        if wid == -2:
+            raise RuntimeError("length mismatch in ws_get")
+        return out, int(wid)
+
+    def kill(self):
+        self.segment._lib.ws_kill(self.segment._handle, self.box)
+
+    @property
+    def write_id(self) -> int:
+        return int(self.segment._lib.ws_write_id(self.segment._handle,
+                                                 self.box))
+
+
+class ShmWindowFabric:
+    """WindowFabric API over a shm segment: 2 boxes per spoke
+    (hub->spoke then spoke->hub), creatable by the hub process and attachable
+    by spoke processes."""
+
+    def __init__(self, name: str, spoke_lengths=None, attach=False):
+        """``spoke_lengths``: list of (hub_to_spoke_len, spoke_to_hub_len)."""
+        self.name = name
+        if attach:
+            self.segment = ShmSegment(name, attach=True)
+            n = self.segment.num_boxes // 2
+        else:
+            lengths = []
+            for (h2s, s2h) in spoke_lengths:
+                lengths.extend([h2s, s2h])
+            self.segment = ShmSegment(name, lengths=lengths)
+            n = len(spoke_lengths)
+        self.to_spoke = {}
+        self.to_hub = {}
+        for i in range(1, n + 1):
+            self.to_spoke[i] = ShmMailbox(self.segment, 2 * (i - 1),
+                                          f"hub->spoke{i}")
+            self.to_hub[i] = ShmMailbox(self.segment, 2 * (i - 1) + 1,
+                                        f"spoke{i}->hub")
+
+    @property
+    def n_spokes(self) -> int:
+        return len(self.to_spoke)
+
+    def send_terminate(self):
+        for mb in self.to_spoke.values():
+            mb.kill()
+
+    def close(self):
+        self.segment.close()
