@@ -47,6 +47,7 @@ class ADMMSettings:
     rho_max: float = 1e6
     max_iter: int = 1000          # inner iterations per rho setting
     restarts: int = 4             # rho-adaptation refactorizations
+    check_every: int = 4          # sweeps per termination check (unrolled)
     eps_abs: float = 1e-8
     eps_rel: float = 1e-8
     scaling_iters: int = 10
@@ -225,7 +226,15 @@ def _admm_core(q, q2, A, cl, cu, lb, ub, state, LK, rho_a, rho_x,
         done = (s.pri < eps_pri) & (s.dua < eps_dua)
         return (s.k < st.max_iter) & ~jnp.all(done)
 
-    return jax.lax.while_loop(cont, step, state)
+    def multi_step(s: _IterState) -> _IterState:
+        # unrolled sweeps between termination checks: each sweep is a handful
+        # of tiny batched matvecs, so per-iteration loop overhead dominates
+        # unless several are fused into one loop body
+        for _ in range(max(1, st.check_every)):
+            s = step(s)
+        return s
+
+    return jax.lax.while_loop(cont, multi_step, state)
 
 
 def _solve_scaled(q, q2, A, cl, cu, lb, ub, warm, masks, st: ADMMSettings,
@@ -316,54 +325,51 @@ def _polish(state: _IterState, q, q2, A, cl, cu, lb, ub, masks,
 
     eq = masks.eq
 
-    N = n + m + n
     eye_n = jnp.eye(n, dtype=dt)[None]
-    eye_m = jnp.eye(m, dtype=dt)[None]
     ftol = 1e-7
+    # Penalized reduced system instead of the full (n+m+n) KKT: active rows
+    # and bounds become quadratic penalties with weight 1/delta, so the solve
+    # is an n x n batched Cholesky (MXU-friendly) rather than an LU of the
+    # 3x-larger saddle system; duals recover as nu = (Ax-b)/delta on active
+    # rows.  Iterative refinement absorbs the 1/delta conditioning; float32
+    # cannot survive weights beyond ~1e6, so the floor is dtype-dependent
+    # (the residual shift from the delta*I regularizer is delta*|x|).
+    floor = 1e-6 if dt == jnp.float32 else 0.0
+    delta = jnp.asarray(max(st.polish_delta, floor), dt)
 
     def kkt_solve(act_lo, act_up, v_lo, v_up):
         row_act = act_lo | act_up
         row_b = jnp.where(act_up, cu, cl)
         var_act = v_lo | v_up
         var_b = jnp.where(v_up, ub, lb)
-        M = jnp.zeros((S, N, N), dt)
-        rhs = jnp.zeros((S, N), dt)
-        # stationarity: Q x + A' nu + mu = -q
-        Qblock = jax.vmap(jnp.diag)(q2) + st.polish_delta * eye_n
+        w_row = row_act.astype(dt) / delta          # (S, m)
+        w_var = var_act.astype(dt) / delta          # (S, n)
+        K = jnp.einsum("smn,sm,smk->snk", A, w_row, A)
+        K = K + delta * eye_n
+        K = K + jax.vmap(jnp.diag)(q2 + w_var)
         if P is not None:
-            Qblock = Qblock + P
-        M = M.at[:, :n, :n].set(Qblock)
-        M = M.at[:, :n, n:n + m].set(jnp.swapaxes(A, 1, 2))
-        M = M.at[:, :n, n + m:].set(eye_n)
-        rhs = rhs.at[:, :n].set(-q)
-        # rows: active -> A_i x = b_i (regularized), inactive -> nu_i = 0
-        ra = row_act[:, :, None]
-        M = M.at[:, n:n + m, :n].set(jnp.where(ra, A, 0.0))
-        M = M.at[:, n:n + m, n:n + m].set(
-            jnp.where(ra, -st.polish_delta * eye_m, eye_m)
-        )
-        rhs = rhs.at[:, n:n + m].set(jnp.where(row_act, row_b, 0.0))
-        # bounds: active -> x_j = bound, inactive -> mu_j = 0
-        va = var_act[:, :, None]
-        M = M.at[:, n + m:, :n].set(jnp.where(va, eye_n, 0.0))
-        M = M.at[:, n + m:, n + m:].set(
-            jnp.where(va, -st.polish_delta * eye_n, eye_n)
-        )
-        rhs = rhs.at[:, n + m:].set(jnp.where(var_act, var_b, 0.0))
-        sol = jnp.linalg.solve(M, rhs[..., None])[..., 0]
-        return sol[:, :n], sol[:, n:n + m], sol[:, n + m:]
+            K = K + P
+        rhs = (-q + jnp.einsum("smn,sm->sn", A, w_row * row_b)
+               + w_var * var_b)
+        L = jnp.linalg.cholesky(K)
+        xp = _chol_solve((L, K), rhs, refine=3)
+        Ax = jnp.einsum("smn,sn->sm", A, xp)
+        yp = w_row * (Ax - row_b)
+        yxp = w_var * (xp - var_b)
+        return xp, yp, yxp
 
     def refine_sets(xp, yp, yxp, sets):
-        """Add violated rows at the violated side; drop wrong-sign duals."""
+        """ADD violated rows at the violated side.  Add-only on purpose:
+        dropping actives by dual sign (the textbook rule) oscillates here —
+        a dropped land/balance row lets the penalized solve blow x to -q/delta
+        and the next pass re-adds it, forever.  Over-active rows only cost
+        dual residual, and the accept-if-better test guards that."""
         act_lo, act_up, v_lo, v_up = sets
         Ax = jnp.einsum("smn,sn->sm", A, xp)
-        act_lo = (act_lo & ~(yp > ftol)) | (Ax < cl - ftol)
-        act_up = (act_up & ~(yp < -ftol)) | (Ax > cu + ftol)
-        # equality rows are always active on both sides
-        act_lo = act_lo | eq
-        act_up = act_up | eq
-        v_lo = ((v_lo & ~(yxp > ftol)) | (xp < lb - ftol)) & fin_lb
-        v_up = ((v_up & ~(yxp < -ftol)) | (xp > ub + ftol)) & fin_ub
+        act_lo = act_lo | (Ax < cl - ftol) | eq
+        act_up = act_up | (Ax > cu + ftol) | eq
+        v_lo = (v_lo | (xp < lb - ftol)) & fin_lb
+        v_up = (v_up | (xp > ub + ftol)) & fin_ub
         return act_lo, act_up, v_lo, v_up
 
     sets = (act_lo | eq, act_up | eq, v_lo, v_up)
