@@ -119,7 +119,8 @@ def make_ph_step(nonant_idx: np.ndarray, settings: ADMMSettings,
         sp = jax.sharding.PartitionSpec(axis)
         sharded_solve = jax.shard_map(
             local_solve, mesh=mesh, in_specs=(sp,) * 11,
-            out_specs=admm.BatchSolution(*([sp] * 7)),
+            out_specs=admm.BatchSolution(
+                *([sp] * 7), raw=(sp, sp, sp, sp)),
             # the solver seeds loop carries with literals (ones/zeros); skip
             # the varying-manual-axes typecheck rather than pcast each one
             check_vma=False,

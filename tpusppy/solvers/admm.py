@@ -48,12 +48,18 @@ class ADMMSettings:
     max_iter: int = 1000          # inner iterations per rho setting
     restarts: int = 4             # rho-adaptation refactorizations
     check_every: int = 4          # sweeps per termination check (unrolled)
+    solve_refine: int = 2         # refinement passes per x-update solve
     eps_abs: float = 1e-8
     eps_rel: float = 1e-8
     scaling_iters: int = 10
     polish: bool = True           # active-set KKT polish (OSQP-style)
     polish_passes: int = 4        # active-set correction passes
     polish_delta: float = 1e-8
+    # Opt-in fused Pallas sweep kernel (scenario-on-lanes layout).  Off by
+    # default: at the benchmark shapes XLA's batched MXU einsums beat the
+    # VPU multiply-accumulate kernel; flip on for bandwidth-bound regimes
+    # (very large S with small n) where VMEM residency wins.
+    use_pallas: bool = False
     dtype: str = "float64"
 
     def jdtype(self):
@@ -68,6 +74,9 @@ class BatchSolution(NamedTuple):
     pri_res: jax.Array  # (S,)
     dua_res: jax.Array  # (S,)
     iters: jax.Array   # (S,) total inner iterations used (same for all)
+    raw: tuple         # pre-polish (x, z, y, yx) — the ONLY valid warm start
+    # (polished states are exact-KKT candidates, not consistent ADMM
+    # iterates; feeding them back as warm starts destabilizes later solves)
 
 
 class _Scaling(NamedTuple):
@@ -84,6 +93,7 @@ class _BoundMasks(NamedTuple):
     fin_lb: jax.Array  # (S, n) lower var bound finite
     fin_ub: jax.Array  # (S, n) upper var bound finite
     eq: jax.Array      # (S, m) equality row
+    eqx: jax.Array     # (S, n) zero-width variable box (clamped column)
 
 
 def _clean_bounds(lo, hi):
@@ -137,22 +147,29 @@ def _factor(q2, A, rho_a, rho_x, sigma, P=None):
     K = K + jax.vmap(jnp.diag)(q2 + rho_x)
     if P is not None:
         K = K + P
-    return jnp.linalg.cholesky(K), K
+    # Explicit inverse via Cholesky: triangular substitution is SEQUENTIAL on
+    # TPU (length-n dependency chain per solve), so the hot loop applies K^-1
+    # as one MXU matmul per solve instead.  Iterative refinement against the
+    # exact K (kept alongside) recovers the digits the explicit inverse
+    # loses — cheaper than two triangular sweeps per inner iteration.
+    return _explicit_inverse(K), K
 
 
-def _tri_solve(L, b):
-    t = jax.scipy.linalg.solve_triangular(L, b[..., None], lower=True)
-    return jax.scipy.linalg.solve_triangular(
-        L, t, lower=True, trans=1
-    )[..., 0]
+def _explicit_inverse(K):
+    """K^-1 via batched Cholesky + two triangular solves against I."""
+    n = K.shape[-1]
+    L = jnp.linalg.cholesky(K)
+    eye = jnp.broadcast_to(jnp.eye(n, dtype=K.dtype), K.shape)
+    t = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+    return jax.scipy.linalg.solve_triangular(L, t, lower=True, trans=1)
 
 
 def _chol_solve(LK, b, refine=2):
-    L, K = LK
-    x = _tri_solve(L, b)
+    Kinv, K = LK
+    x = jnp.einsum("snk,sk->sn", Kinv, b)
     for _ in range(refine):
         r = b - jnp.einsum("snk,sk->sn", K, x)
-        x = x + _tri_solve(L, r)
+        x = x + jnp.einsum("snk,sk->sn", Kinv, r)
     return x
 
 
@@ -180,61 +197,102 @@ def _admm_core(q, q2, A, cl, cu, lb, ub, state, LK, rho_a, rho_x,
             base = base + jnp.einsum("snk,sk->sn", P, x)
         return base
 
-    def step(s: _IterState) -> _IterState:
+    def sweep(x, z, zx, y, yx, Ax):
+        """One ADMM sweep WITHOUT residual bookkeeping.  Ax is carried
+        incrementally (Ax_new = alpha*Axt + (1-alpha)*Ax), saving one matvec
+        per sweep."""
         rhs = (
-            sigma * s.x - q
-            + jnp.einsum("smn,sm->sn", A, rho_a * s.z - s.y)
-            + (rho_x * s.zx - s.yx)
+            sigma * x - q
+            + jnp.einsum("smn,sm->sn", A, rho_a * z - y)
+            + (rho_x * zx - yx)
         )
-        xt = _chol_solve(LK, rhs)
+        xt = _chol_solve(LK, rhs, refine=st.solve_refine)
         Axt = jnp.einsum("smn,sn->sm", A, xt)
-        x_new = alpha * xt + (1 - alpha) * s.x
+        x_new = alpha * xt + (1 - alpha) * x
+        Ax_new = alpha * Axt + (1 - alpha) * Ax
 
-        za_arg = alpha * Axt + (1 - alpha) * s.z + s.y / rho_a
+        za_arg = alpha * Axt + (1 - alpha) * z + y / rho_a
         z_new = jnp.clip(za_arg, cl, cu)
-        y_new = s.y + rho_a * (alpha * Axt + (1 - alpha) * s.z - z_new)
+        y_new = y + rho_a * (alpha * Axt + (1 - alpha) * z - z_new)
 
-        zx_arg = alpha * xt + (1 - alpha) * s.zx + s.yx / rho_x
+        zx_arg = alpha * xt + (1 - alpha) * zx + yx / rho_x
         zx_new = jnp.clip(zx_arg, lb, ub)
-        yx_new = s.yx + rho_x * (alpha * xt + (1 - alpha) * s.zx - zx_new)
+        yx_new = yx + rho_x * (alpha * xt + (1 - alpha) * zx - zx_new)
+        return x_new, z_new, zx_new, y_new, yx_new, Ax_new
 
-        Ax = jnp.einsum("smn,sn->sm", A, x_new)
+    def residuals(x, z, zx, y, yx, Ax):
         pri = jnp.maximum(
-            jnp.max(jnp.abs(Ax - z_new), axis=1),
-            jnp.max(jnp.abs(x_new - zx_new), axis=1),
+            jnp.max(jnp.abs(Ax - z), axis=1),
+            jnp.max(jnp.abs(x - zx), axis=1),
         )
-        Aty = jnp.einsum("smn,sm->sn", A, y_new)
-        dua = jnp.max(jnp.abs(Px(x_new) + q + Aty + yx_new), axis=1)
+        Aty = jnp.einsum("smn,sm->sn", A, y)
+        Pxv = Px(x)
+        dua = jnp.max(jnp.abs(Pxv + q + Aty + yx), axis=1)
         # OSQP-normalized residual scales, for tolerances and rho adaptation
         prinorm = jnp.maximum(
-            jnp.max(jnp.abs(Ax), axis=1), jnp.max(jnp.abs(z_new), axis=1)
+            jnp.max(jnp.abs(Ax), axis=1), jnp.max(jnp.abs(z), axis=1)
         )
         duanorm = jnp.maximum(
             jnp.maximum(
-                jnp.max(jnp.abs(Px(x_new)), axis=1),
+                jnp.max(jnp.abs(Pxv), axis=1),
                 jnp.max(jnp.abs(Aty), axis=1),
             ),
             jnp.max(jnp.abs(q), axis=1),
         )
-        return _IterState(x_new, z_new, zx_new, y_new, yx_new, pri, dua,
-                          prinorm, duanorm, s.k + 1)
+        return pri, dua, prinorm, duanorm
 
-    def cont(s: _IterState):
+    def cont(carry):
+        s, Ax = carry
         # OSQP termination: eps_abs + eps_rel * residual-scale norms
         eps_pri = st.eps_abs + st.eps_rel * jnp.maximum(s.prinorm, 1.0)
         eps_dua = st.eps_abs + st.eps_rel * jnp.maximum(s.duanorm, 1.0)
         done = (s.pri < eps_pri) & (s.dua < eps_dua)
         return (s.k < st.max_iter) & ~jnp.all(done)
 
-    def multi_step(s: _IterState) -> _IterState:
-        # unrolled sweeps between termination checks: each sweep is a handful
-        # of tiny batched matvecs, so per-iteration loop overhead dominates
-        # unless several are fused into one loop body
-        for _ in range(max(1, st.check_every)):
-            s = step(s)
-        return s
+    # fused Pallas sweep block on TPU: all matrices stay in VMEM across the
+    # check_every sweeps instead of re-streaming from HBM every sweep, in
+    # scenario-on-lanes layout (matrices transposed ONCE per rho setting)
+    from . import pallas_kernels
 
-    return jax.lax.while_loop(cont, multi_step, state)
+    S, m, n = A.shape
+    bs = (pallas_kernels.usable(S, m, n, P=P) if st.use_pallas else None)
+    if bs is not None:
+        Kinv, K = LK
+        tT = lambda a: jnp.transpose(a, (1, 2, 0))
+        AT, AtT = tT(A), jnp.transpose(A, (2, 1, 0))
+        KinvT, KT = tT(Kinv), tT(K)
+        qT, clT, cuT, lbT, ubT = q.T, cl.T, cu.T, lb.T, ub.T
+        rho_aT, rho_xT = rho_a.T, jnp.broadcast_to(rho_x, (S, n)).T
+
+    def multi_step(carry):
+        # unrolled sweeps between termination checks: each sweep is a handful
+        # of tiny batched matvecs, so per-iteration overhead and residual
+        # bookkeeping are amortized over check_every sweeps
+        s, Ax = carry
+        x, z, zx, y, yx = s.x, s.z, s.zx, s.y, s.yx
+        if bs is not None:
+            outs = pallas_kernels.fused_sweeps(
+                qT, AT, AtT, KinvT, KT, clT, cuT, lbT, ubT, rho_aT, rho_xT,
+                x.T, z.T, zx.T, y.T, yx.T, Ax.T,
+                n_sweeps=max(1, st.check_every),
+                n_refine=st.solve_refine, sigma=float(sigma),
+                alpha=float(alpha), bs=bs,
+            )
+            x, z, zx, y, yx, Ax = (o.T for o in outs)
+        else:
+            for _ in range(max(1, st.check_every)):
+                x, z, zx, y, yx, Ax = sweep(x, z, zx, y, yx, Ax)
+        # re-anchor the incrementally carried Ax: the relaxation combination
+        # (alpha=1.6) amplifies carried floating error exponentially across
+        # sweeps, so one true matvec per checkpoint resets the drift
+        Ax = jnp.einsum("smn,sn->sm", A, x)
+        pri, dua, prinorm, duanorm = residuals(x, z, zx, y, yx, Ax)
+        return (_IterState(x, z, zx, y, yx, pri, dua, prinorm, duanorm,
+                           s.k + max(1, st.check_every)), Ax)
+
+    Ax0 = jnp.einsum("smn,sn->sm", A, state.x)
+    state, _ = jax.lax.while_loop(cont, multi_step, (state, Ax0))
+    return state
 
 
 def _solve_scaled(q, q2, A, cl, cu, lb, ub, warm, masks, st: ADMMSettings,
@@ -251,6 +309,13 @@ def _solve_scaled(q, q2, A, cl, cu, lb, ub, warm, masks, st: ADMMSettings,
     def rho_vec(base):
         r = jnp.where(eq, base * st.rho_eq_scale, base)
         return jnp.where(loose, st.rho_min, r)
+
+    def rho_x_vec(base):
+        # clamped columns (lb == ub, the fix-nonants / Benders trick) get the
+        # same equality boosting as equality rows: without it ADMM can stall
+        # at ~1e-2 primal residuals on fix-and-evaluate solves
+        return jnp.where(masks.eqx, base * st.rho_eq_scale,
+                         jnp.broadcast_to(base, (S, n)))
 
     if warm is None:
         x0 = jnp.zeros((S, n), dt)
@@ -271,7 +336,7 @@ def _solve_scaled(q, q2, A, cl, cu, lb, ub, warm, masks, st: ADMMSettings,
     def outer(carry, _):
         state, base, total = carry
         rho_a = rho_vec(base[:, None])
-        rho_x = jnp.broadcast_to(base[:, None], (S, n))
+        rho_x = rho_x_vec(base[:, None])
         LK = _factor(q2, A, rho_a, rho_x, st.sigma, P)
         state = _admm_core(
             q, q2, A, cl, cu, lb, ub,
@@ -327,17 +392,57 @@ def _polish(state: _IterState, q, q2, A, cl, cu, lb, ub, masks,
 
     eye_n = jnp.eye(n, dtype=dt)[None]
     ftol = 1e-7
-    # Penalized reduced system instead of the full (n+m+n) KKT: active rows
-    # and bounds become quadratic penalties with weight 1/delta, so the solve
-    # is an n x n batched Cholesky (MXU-friendly) rather than an LU of the
-    # 3x-larger saddle system; duals recover as nu = (Ax-b)/delta on active
-    # rows.  Iterative refinement absorbs the 1/delta conditioning; float32
-    # cannot survive weights beyond ~1e6, so the floor is dtype-dependent
-    # (the residual shift from the delta*I regularizer is delta*|x|).
-    floor = 1e-6 if dt == jnp.float32 else 0.0
-    delta = jnp.asarray(max(st.polish_delta, floor), dt)
+    # Reduced augmented-Lagrangian system instead of the full (n+m+n) KKT:
+    # active rows and bounds become quadratic penalties with weight 1/delta,
+    # so each solve is an n x n batched Cholesky (MXU-friendly) rather than
+    # an LU of the 3x-larger saddle system.  A pure penalty would need
+    # delta ~ 1e-8 for vertex accuracy — hopeless in float32 — so instead a
+    # few multiplier (AL) iterations at a MODERATE delta reuse one
+    # factorization and converge the constraint error geometrically:
+    # nu_{k+1} = nu_k + (A x_k - b)/delta.
+    # AL penalty parameter deliberately DECOUPLED from polish_delta: the
+    # multiplier iterations exist so a moderate delta (f64-safe conditioning,
+    # cond(K) ~ 1e7) still reaches vertex-exact primal feasibility; the
+    # residual dual shift is delta*|x| and is absorbed at bound-active
+    # coordinates by the recovery step below.
+    delta = jnp.asarray(max(st.polish_delta, 1e-7), dt)
+    AL_ITERS = 4
 
-    def kkt_solve(act_lo, act_up, v_lo, v_up):
+    def kkt_solve_full(act_lo, act_up, v_lo, v_up):
+        """Full (n+m+n) saddle-system LU — float32's only accurate option:
+        the reduced system's 1/delta conditioning exceeds what f32 Cholesky
+        plus refinement can recover, while the indefinite KKT LU stays
+        backward-stable at the cost of a 3x-larger batched solve."""
+        row_act = act_lo | act_up
+        row_b = jnp.where(act_up, cu, cl)
+        var_act = v_lo | v_up
+        var_b = jnp.where(v_up, ub, lb)
+        N = n + m + n
+        eye_m = jnp.eye(m, dtype=dt)[None]
+        pd = jnp.asarray(st.polish_delta, dt)
+        M = jnp.zeros((S, N, N), dt)
+        rhs = jnp.zeros((S, N), dt)
+        Qblock = jax.vmap(jnp.diag)(q2) + pd * eye_n
+        if P is not None:
+            Qblock = Qblock + P
+        M = M.at[:, :n, :n].set(Qblock)
+        M = M.at[:, :n, n:n + m].set(jnp.swapaxes(A, 1, 2))
+        M = M.at[:, :n, n + m:].set(eye_n)
+        rhs = rhs.at[:, :n].set(-q)
+        ra = row_act[:, :, None]
+        M = M.at[:, n:n + m, :n].set(jnp.where(ra, A, 0.0))
+        M = M.at[:, n:n + m, n:n + m].set(
+            jnp.where(ra, -pd * eye_m, eye_m))
+        rhs = rhs.at[:, n:n + m].set(jnp.where(row_act, row_b, 0.0))
+        va = var_act[:, :, None]
+        M = M.at[:, n + m:, :n].set(jnp.where(va, eye_n, 0.0))
+        M = M.at[:, n + m:, n + m:].set(
+            jnp.where(va, -pd * eye_n, eye_n))
+        rhs = rhs.at[:, n + m:].set(jnp.where(var_act, var_b, 0.0))
+        sol = jnp.linalg.solve(M, rhs[..., None])[..., 0]
+        return sol[:, :n], sol[:, n:n + m], sol[:, n + m:]
+
+    def kkt_solve_reduced(act_lo, act_up, v_lo, v_up):
         row_act = act_lo | act_up
         row_b = jnp.where(act_up, cu, cl)
         var_act = v_lo | v_up
@@ -349,21 +454,36 @@ def _polish(state: _IterState, q, q2, A, cl, cu, lb, ub, masks,
         K = K + jax.vmap(jnp.diag)(q2 + w_var)
         if P is not None:
             K = K + P
-        rhs = (-q + jnp.einsum("smn,sm->sn", A, w_row * row_b)
-               + w_var * var_b)
-        L = jnp.linalg.cholesky(K)
-        xp = _chol_solve((L, K), rhs, refine=3)
-        Ax = jnp.einsum("smn,sn->sm", A, xp)
-        yp = w_row * (Ax - row_b)
-        yxp = w_var * (xp - var_b)
+        Kinv = _explicit_inverse(K)
+        ra = row_act.astype(dt)
+        va = var_act.astype(dt)
+        nu = jnp.zeros_like(row_b)
+        mu = jnp.zeros_like(var_b)
+        xp = jnp.zeros_like(q)
+        for _ in range(AL_ITERS):
+            rhs = (-q + jnp.einsum("smn,sm->sn", A, w_row * row_b - ra * nu)
+                   + (w_var * var_b - va * mu))
+            xp = _chol_solve((Kinv, K), rhs, refine=1)
+            Ax = jnp.einsum("smn,sn->sm", A, xp)
+            nu = nu + w_row * (Ax - row_b)
+            mu = mu + w_var * (xp - var_b)
+        yp, yxp = ra * nu, va * mu
+        # exact bound-dual recovery: at bound-active coordinates mu absorbs
+        # the stationarity residual exactly — critical for consumers of
+        # clamp duals (Benders cut gradients are -yx on clamped columns)
+        Pxp = q2 * xp if P is None else q2 * xp + jnp.einsum(
+            "snk,sk->sn", P, xp)
+        r_d = Pxp + q + jnp.einsum("smn,sm->sn", A, yp) + yxp
+        yxp = jnp.where(var_act, yxp - r_d, yxp)
         return xp, yp, yxp
 
-    def refine_sets(xp, yp, yxp, sets):
-        """ADD violated rows at the violated side.  Add-only on purpose:
-        dropping actives by dual sign (the textbook rule) oscillates here —
-        a dropped land/balance row lets the penalized solve blow x to -q/delta
-        and the next pass re-adds it, forever.  Over-active rows only cost
-        dual residual, and the accept-if-better test guards that."""
+    kkt_solve = (kkt_solve_full if dt == jnp.float32 else kkt_solve_reduced)
+
+    def refine_add_only(xp, yp, yxp, sets):
+        """ADD violated rows at the violated side, never drop.  Robust when
+        the initial guess is near-correct: dropping actives by dual sign can
+        oscillate (a dropped land/balance row lets the penalized solve blow
+        x to -q/delta and the next pass re-adds it, forever)."""
         act_lo, act_up, v_lo, v_up = sets
         Ax = jnp.einsum("smn,sn->sm", A, xp)
         act_lo = act_lo | (Ax < cl - ftol) | eq
@@ -372,21 +492,51 @@ def _polish(state: _IterState, q, q2, A, cl, cu, lb, ub, masks,
         v_up = (v_up | (xp > ub + ftol)) & fin_ub
         return act_lo, act_up, v_lo, v_up
 
-    sets = (act_lo | eq, act_up | eq, v_lo, v_up)
-    xp, yp, yxp = kkt_solve(*sets)
-    for _ in range(st.polish_passes):
-        sets = refine_sets(xp, yp, yxp, sets)
-        xp, yp, yxp = kkt_solve(*sets)
+    def refine_textbook(xp, yp, yxp, sets):
+        """Textbook add-and-drop: also prune actives whose dual sign is
+        wrong.  Recovers from BAD initial guesses (e.g. stalled clamped
+        solves) where add-only is stuck with over-constrained sets."""
+        act_lo, act_up, v_lo, v_up = sets
+        Ax = jnp.einsum("smn,sn->sm", A, xp)
+        act_lo = ((act_lo & ~(yp > ftol)) | (Ax < cl - ftol) | eq)
+        act_up = ((act_up & ~(yp < -ftol)) | (Ax > cu + ftol) | eq)
+        v_lo = ((v_lo & ~(yxp > ftol)) | (xp < lb - ftol)) & fin_lb
+        v_up = ((v_up & ~(yxp < -ftol)) | (xp > ub + ftol)) & fin_ub
+        return act_lo, act_up, v_lo, v_up
 
-    Ax = jnp.einsum("smn,sn->sm", A, xp)
-    zp = jnp.clip(Ax, cl, cu)
-    zxp = jnp.clip(xp, lb, ub)
-    pri = jnp.maximum(
-        jnp.max(jnp.abs(Ax - zp), axis=1), jnp.max(jnp.abs(xp - zxp), axis=1)
+    # the initial solve on the guessed sets is shared by both disciplines
+    sets0 = (act_lo | eq, act_up | eq, v_lo, v_up)
+    first = kkt_solve(*sets0)
+
+    def run_passes(refine):
+        sets = sets0
+        xp, yp, yxp = first
+        for _ in range(st.polish_passes):
+            sets = refine(xp, yp, yxp, sets)
+            xp, yp, yxp = kkt_solve(*sets)
+        Ax = jnp.einsum("smn,sn->sm", A, xp)
+        zp = jnp.clip(Ax, cl, cu)
+        zxp = jnp.clip(xp, lb, ub)
+        pri = jnp.maximum(
+            jnp.max(jnp.abs(Ax - zp), axis=1),
+            jnp.max(jnp.abs(xp - zxp), axis=1),
+        )
+        Aty = jnp.einsum("smn,sm->sn", A, yp)
+        Pxp = (q2 * xp if P is None
+               else q2 * xp + jnp.einsum("snk,sk->sn", P, xp))
+        dua = jnp.max(jnp.abs(Pxp + q + Aty + yxp), axis=1)
+        return xp, zp, zxp, yp, yxp, pri, dua
+
+    # run BOTH refinement disciplines; per scenario, keep whichever candidate
+    # (or the original state) has the best worst-case residual
+    cand = run_passes(refine_add_only)
+    cand2 = run_passes(refine_textbook)
+    worse2 = jnp.maximum(cand2[5], cand2[6]) >= jnp.maximum(cand[5], cand[6])
+    cand = tuple(
+        jnp.where(worse2[:, None] if a.ndim == 2 else worse2, a, b)
+        for a, b in zip(cand, cand2)
     )
-    Aty = jnp.einsum("smn,sm->sn", A, yp)
-    Pxp = q2 * xp if P is None else q2 * xp + jnp.einsum("snk,sk->sn", P, xp)
-    dua = jnp.max(jnp.abs(Pxp + q + Aty + yxp), axis=1)
+    xp, zp, zxp, yp, yxp, pri, dua = cand
 
     better = jnp.maximum(pri, dua) < jnp.maximum(state.pri, state.dua)
     pick = lambda a, b: jnp.where(better[:, None], a, b)
@@ -429,6 +579,7 @@ def _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm, P=None) -> BatchSoluti
         fin_cl=cl > -BIG / 2, fin_cu=cu < BIG / 2,
         fin_lb=lb > -BIG / 2, fin_ub=ub < BIG / 2,
         eq=jnp.abs(cu - cl) < 1e-10,
+        eqx=jnp.abs(ub - lb) < 1e-10,
     )
 
     D, E = _ruiz(A, q2, settings.scaling_iters)
@@ -455,19 +606,22 @@ def _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm, P=None) -> BatchSoluti
 
     state, total = _solve_scaled(qs, q2s, As, cls, cus, lbs, ubs, warm, masks,
                                  settings, Ps)
+
+    def unscale(s):
+        return (s.x * D, s.z / E, s.y * E / cost[:, None],
+                s.yx / D / cost[:, None])
+
+    raw = unscale(state)
     if settings.polish:
         state = _polish(state, qs, q2s, As, cls, cus, lbs, ubs, masks,
                         settings, Ps)
-
-    x = state.x * D
-    z = state.z / E
-    y = state.y * E / cost[:, None]
-    yx = state.yx / D / cost[:, None]
+    x, z, y, yx = unscale(state)
     S = A.shape[0]
     return BatchSolution(
         x=x, z=z, y=y, yx=yx,
         pri_res=state.pri, dua_res=state.dua,
         iters=jnp.broadcast_to(total, (S,)),
+        raw=raw,
     )
 
 

@@ -1,0 +1,171 @@
+"""Pallas TPU kernel: fused ADMM sweep block.
+
+The ADMM inner loop is bandwidth-bound: every sweep re-reads the (S, n, n)
+K-inverse/K pair and the (S, m, n) constraint matrix from HBM (three to five
+matrix passes per sweep).  This kernel runs ``n_sweeps`` sweeps over a block
+of scenarios with all matrices resident in VMEM, so HBM sees each matrix once
+per kernel call instead of once per sweep — the hot-op fusion the build brief
+calls for (SURVEY §7 step 2; the XLA einsum path remains the fallback for
+CPU, dense-P, and shapes that exceed the VMEM budget).
+
+All contractions are per-scenario matvecs with tiny n/m (tens), so the VPU
+multiply-reduce form ``(M * v[:, None, :]).sum(-1)`` is used rather than MXU
+dots (the 128-lane MXU tiles would be mostly padding at these sizes).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    HAVE_PALLAS = False
+
+# VMEM budget for one scenario block's matrices (bytes).  v5e has ~16 MB of
+# scoped VMEM per core; Mosaic double-buffers in/out blocks for the grid
+# pipeline, so keep the single-block working set near a quarter of that.
+_VMEM_BUDGET = 4 * 1024 * 1024
+
+
+def sweep_block_size(S, m, n, itemsize=4) -> int:
+    """Scenarios per grid step so A/Kinv/K (+vectors) fit in VMEM."""
+    per_scen = (m * n + 2 * n * n + 6 * n + 6 * m) * itemsize
+    bs = max(1, _VMEM_BUDGET // max(per_scen, 1))
+    return int(min(S, bs))
+
+
+def _sweeps_kernel(q_ref, A_ref, At_ref, Kinv_ref, K_ref, cl_ref, cu_ref,
+                   lb_ref, ub_ref, rho_a_ref, rho_x_ref, x_ref, z_ref,
+                   zx_ref, y_ref, yx_ref, Ax_ref, x_out, z_out, zx_out,
+                   y_out, yx_out, Ax_out, *, n_sweeps, n_refine, sigma,
+                   alpha, m, n):
+    """Scenario-on-lanes layout: every tensor is (..., Sb) with the scenario
+    block on the 128-lane axis, so each matvec step is a full-width VPU
+    multiply-accumulate.  Contractions loop over the LEADING (untiled) dim
+    with static Python indices (m, n are small trace-time constants):
+
+      A'(v):  out[j] += A[i, j, :] * v[i, :]   via A (m, n, Sb), loop i<m
+      A x:    out[i] += At[j, i, :] * x[j, :]  via At (n, m, Sb), loop j<n
+      K^-1 r: sym matrix, loop over rows.
+    """
+    A = A_ref[:]          # (m, n, Sb)
+    At = At_ref[:]        # (n, m, Sb)
+    Kinv = Kinv_ref[:]    # (n, n, Sb)
+    K = K_ref[:]
+    q = q_ref[:]          # (n, Sb)
+    cl, cu, lb, ub = cl_ref[:], cu_ref[:], lb_ref[:], ub_ref[:]
+    rho_a, rho_x = rho_a_ref[:], rho_x_ref[:]
+    x, z, zx, y, yx, Ax = (x_ref[:], z_ref[:], zx_ref[:], y_ref[:],
+                           yx_ref[:], Ax_ref[:])
+
+    def contract(M, v, rows):
+        """out[k, :] = sum_i M[i, k, :] * v[i, :] (loop over leading dim)."""
+        acc = M[0] * v[0][None, :]
+        for i in range(1, rows):
+            acc = acc + M[i] * v[i][None, :]
+        return acc
+
+    def body(_, carry):
+        x, z, zx, y, yx, Ax = carry
+        rhs = (sigma * x - q + contract(A, rho_a * z - y, m)
+               + (rho_x * zx - yx))
+        xt = contract(Kinv, rhs, n)           # Kinv symmetric
+        for _ in range(n_refine):
+            r = rhs - contract(K, xt, n)
+            xt = xt + contract(Kinv, r, n)
+        Axt = contract(At, xt, n)
+        x_new = alpha * xt + (1 - alpha) * x
+        Ax_new = alpha * Axt + (1 - alpha) * Ax
+
+        za_arg = alpha * Axt + (1 - alpha) * z + y / rho_a
+        z_new = jnp.clip(za_arg, cl, cu)
+        y_new = y + rho_a * (alpha * Axt + (1 - alpha) * z - z_new)
+
+        zx_arg = alpha * xt + (1 - alpha) * zx + yx / rho_x
+        zx_new = jnp.clip(zx_arg, lb, ub)
+        yx_new = yx + rho_x * (alpha * xt + (1 - alpha) * zx - zx_new)
+        return x_new, z_new, zx_new, y_new, yx_new, Ax_new
+
+    x, z, zx, y, yx, Ax = jax.lax.fori_loop(
+        0, n_sweeps, body, (x, z, zx, y, yx, Ax))
+    x_out[:] = x
+    z_out[:] = z
+    zx_out[:] = zx
+    y_out[:] = y
+    yx_out[:] = yx
+    Ax_out[:] = Ax
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_sweeps", "n_refine", "sigma", "alpha",
+                                    "bs"))
+def fused_sweeps(q, A, At, Kinv, K, cl, cu, lb, ub, rho_a, rho_x,
+                 x, z, zx, y, yx, Ax, n_sweeps, n_refine, sigma, alpha, bs):
+    """Run ``n_sweeps`` sweeps; ALL arrays in scenario-last layout
+    (m,n,S)/(n,S) etc.  Returns transposed-state (x, z, zx, y, yx, Ax)."""
+    m, n, S = A.shape
+    grid = ((S + bs - 1) // bs,)
+
+    def spec3(d0, d1):
+        return pl.BlockSpec((d0, d1, bs), lambda i: (0, 0, i),
+                            memory_space=pltpu.VMEM)
+
+    def spec2(d0):
+        return pl.BlockSpec((d0, bs), lambda i: (0, i),
+                            memory_space=pltpu.VMEM)
+
+    kern = functools.partial(_sweeps_kernel, n_sweeps=n_sweeps,
+                             n_refine=n_refine, sigma=sigma, alpha=alpha,
+                             m=m, n=n)
+    dt = A.dtype
+    out_shape = [
+        jax.ShapeDtypeStruct((n, S), dt),   # x
+        jax.ShapeDtypeStruct((m, S), dt),   # z
+        jax.ShapeDtypeStruct((n, S), dt),   # zx
+        jax.ShapeDtypeStruct((m, S), dt),   # y
+        jax.ShapeDtypeStruct((n, S), dt),   # yx
+        jax.ShapeDtypeStruct((m, S), dt),   # Ax
+    ]
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            spec2(n),            # q
+            spec3(m, n),         # A
+            spec3(n, m),         # At
+            spec3(n, n),         # Kinv
+            spec3(n, n),         # K
+            spec2(m), spec2(m),  # cl cu
+            spec2(n), spec2(n),  # lb ub
+            spec2(m), spec2(n),  # rho_a rho_x
+            spec2(n), spec2(m), spec2(n), spec2(m), spec2(n),  # x z zx y yx
+            spec2(m),            # Ax
+        ],
+        out_specs=[spec2(n), spec2(m), spec2(n), spec2(m), spec2(n),
+                   spec2(m)],
+        out_shape=out_shape,
+    )(q, A, At, Kinv, K, cl, cu, lb, ub, rho_a, rho_x, x, z, zx, y, yx, Ax)
+
+
+def usable(S, m, n, platform=None, P=None) -> int | None:
+    """Block size if the fused kernel applies, else None."""
+    if not HAVE_PALLAS or P is not None:
+        return None
+    platform = platform or jax.default_backend()
+    if platform != "tpu":
+        return None
+    budget = sweep_block_size(S, m, n)
+    if budget >= S:
+        return S          # one block covering the whole (lane) dimension
+    # the lane-dim block must be a multiple of 128 (Mosaic tiling); the grid
+    # uses ceiling division, so S need not divide evenly
+    bs = (budget // 128) * 128
+    return bs if bs >= 128 else None

@@ -51,6 +51,9 @@ class SPOpt(SPBase):
             settings=self.admm_settings,
             warm=self._warm if warm else None,
         )
+        # polished states warm-start the NEXT objective's solve well (the
+        # PH persistent-solver pattern); raw iterates matter only when
+        # re-solving the SAME problem repeatedly (e.g. the Benders root)
         self._warm = (sol.x, sol.z, sol.y, sol.yx)
         self.local_x = np.asarray(sol.x)
         self.pri_res = np.asarray(sol.pri_res)
