@@ -1,0 +1,111 @@
+"""General example driver for farmer with cylinders.
+
+Behavioral port of ``examples/farmer/farmer_cylinders.py`` from the
+reference: a Config-driven CLI assembling a PH (or APH) hub plus any of the
+fwph / lagrangian / lagranger / xhatlooper / xhatshuffle spokes, spun by the
+WheelSpinner.  Example::
+
+    python farmer_cylinders.py --num-scens 3 --max-iterations 50 \
+        --default-rho 1.0 --rel-gap 0.001 --lagrangian --xhatshuffle
+"""
+
+from tpusppy.convergers.norm_rho_converger import NormRhoConverger
+from tpusppy.convergers.primal_dual_converger import PrimalDualConverger
+from tpusppy.extensions.norm_rho_updater import NormRhoUpdater
+from tpusppy.models import farmer
+from tpusppy.spin_the_wheel import WheelSpinner
+from tpusppy.utils import cfg_vanilla as vanilla
+from tpusppy.utils import config
+
+write_solution = True
+
+
+def _parse_args():
+    cfg = config.Config()
+    cfg.num_scens_required()
+    cfg.popular_args()
+    cfg.two_sided_args()
+    cfg.ph_args()
+    cfg.aph_args()
+    cfg.xhatlooper_args()
+    cfg.fwph_args()
+    cfg.lagrangian_args()
+    cfg.lagranger_args()
+    cfg.xhatshuffle_args()
+    cfg.converger_args()
+    cfg.wxbar_read_write_args()
+    cfg.tracking_args()
+    cfg.add_to_config("crops_mult",
+                      "There will be 3x this many crops (default 1)",
+                      int, 1)
+    cfg.add_to_config("use_norm_rho_updater",
+                      "Use the norm rho updater extension", bool, False)
+    cfg.add_to_config("run_async",
+                      "Run with async projective hedging instead of PH",
+                      bool, False)
+    cfg.parse_command_line("farmer_cylinders")
+    return cfg
+
+
+def main():
+    cfg = _parse_args()
+    num_scen = cfg.num_scens
+    if cfg.default_rho is None:
+        raise RuntimeError("specify --default-rho")
+
+    if cfg.use_norm_rho_converger:
+        if not cfg.use_norm_rho_updater:
+            raise RuntimeError(
+                "--use-norm-rho-converger requires --use-norm-rho-updater")
+        ph_converger = NormRhoConverger
+    elif cfg.primal_dual_converger:
+        ph_converger = PrimalDualConverger
+    else:
+        ph_converger = None
+
+    scenario_creator = farmer.scenario_creator
+    scenario_denouement = farmer.scenario_denouement
+    all_scenario_names = farmer.scenario_names_creator(num_scen)
+    scenario_creator_kwargs = {
+        "use_integer": False,
+        "crops_multiplier": cfg.crops_mult,
+        "num_scens": num_scen,
+    }
+
+    beans = dict(
+        cfg=cfg, scenario_creator=scenario_creator,
+        scenario_denouement=scenario_denouement,
+        all_scenario_names=all_scenario_names,
+        scenario_creator_kwargs=scenario_creator_kwargs,
+    )
+    if cfg.run_async:
+        hub_dict = vanilla.aph_hub(ph_converger=ph_converger, **beans)
+    else:
+        hub_dict = vanilla.ph_hub(ph_converger=ph_converger, **beans)
+    if cfg.use_norm_rho_updater:
+        vanilla.extension_adder(hub_dict, NormRhoUpdater)
+
+    list_of_spoke_dict = []
+    if cfg.fwph:
+        list_of_spoke_dict.append(vanilla.fwph_spoke(**beans))
+    if cfg.lagrangian:
+        list_of_spoke_dict.append(vanilla.lagrangian_spoke(**beans))
+    if cfg.lagranger:
+        list_of_spoke_dict.append(vanilla.lagranger_spoke(**beans))
+    if cfg.xhatlooper:
+        list_of_spoke_dict.append(vanilla.xhatlooper_spoke(**beans))
+    if cfg.xhatshuffle:
+        list_of_spoke_dict.append(vanilla.xhatshuffle_spoke(**beans))
+
+    ws = WheelSpinner(hub_dict, list_of_spoke_dict)
+    ws.spin()
+
+    if write_solution:
+        ws.write_first_stage_solution("farmer_first_stage.csv")
+        ws.write_first_stage_solution("farmer_first_stage.npy")
+        ws.write_tree_solution("farmer_full_solution")
+    return ws
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Solve the farmer extensive form directly.
+
+Port of ``examples/farmer/farmer_ef.py`` usage: golden 3-scenario objective
+is -108390.  Example::
+
+    python farmer_ef.py --num-scens 3 --EF-solver-name admm
+"""
+
+from tpusppy.ef import solve_ef
+from tpusppy.ir import ScenarioBatch
+from tpusppy.models import farmer
+from tpusppy.utils import config
+
+
+def main():
+    cfg = config.Config()
+    cfg.EF2()
+    cfg.add_to_config("crops_mult", "crops multiplier", int, 1)
+    cfg.parse_command_line("farmer_ef")
+    n = cfg.num_scens or 3
+    batch = ScenarioBatch.from_problems([
+        farmer.scenario_creator(nm, num_scens=n,
+                                crops_multiplier=cfg.crops_mult)
+        for nm in farmer.scenario_names_creator(n)
+    ])
+    solver = cfg.EF_solver_name or "admm"
+    obj, x = solve_ef(batch, solver=solver)
+    print(f"EF objective: {obj}")
+    root = x[0][batch.tree.nonant_indices[batch.tree.nonant_stage == 1]]
+    print(f"first-stage solution: {root}")
+    return obj
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""SIZES cylinders driver with rho setter + fixer.
+
+Analogue of ``examples/sizes/sizes_cylinders.py``.  Example::
+
+    python sizes_cylinders.py --num-scens 3 --max-iterations 100 \
+        --default-rho 0.01 --rel-gap 0.01 --lagrangian --xhatshuffle --fixer
+"""
+
+from tpusppy.extensions.fixer import Fixer
+from tpusppy.models import sizes
+from tpusppy.spin_the_wheel import WheelSpinner
+from tpusppy.utils import cfg_vanilla as vanilla
+from tpusppy.utils import config
+
+
+def _parse_args():
+    cfg = config.Config()
+    cfg.num_scens_required()
+    cfg.popular_args()
+    cfg.two_sided_args()
+    cfg.ph_args()
+    cfg.fixer_args()
+    cfg.fwph_args()
+    cfg.lagrangian_args()
+    cfg.xhatshuffle_args()
+    cfg.parse_command_line("sizes_cylinders")
+    return cfg
+
+
+def main():
+    cfg = _parse_args()
+    names = sizes.scenario_names_creator(cfg.num_scens)
+    kwargs = {"scenario_count": cfg.num_scens}
+    beans = dict(
+        cfg=cfg, scenario_creator=sizes.scenario_creator,
+        scenario_denouement=sizes.scenario_denouement,
+        all_scenario_names=names, scenario_creator_kwargs=kwargs,
+    )
+    hub_dict = vanilla.ph_hub(
+        rho_setter=lambda batch: sizes._rho_setter(batch), **beans)
+    if cfg.fixer:
+        hub_dict["opt_kwargs"]["options"]["fixeroptions"] = {
+            "verbose": cfg.verbose,
+            "boundtol": cfg.fixer_tol,
+            "id_fix_list_fct": sizes.id_fix_list_fct,
+        }
+        vanilla.extension_adder(hub_dict, Fixer)
+
+    spokes = []
+    if cfg.fwph:
+        spokes.append(vanilla.fwph_spoke(**beans))
+    if cfg.lagrangian:
+        spokes.append(vanilla.lagrangian_spoke(**beans))
+    if cfg.xhatshuffle:
+        spokes.append(vanilla.xhatshuffle_spoke(**beans))
+    ws = WheelSpinner(hub_dict, spokes)
+    ws.spin()
+    ws.write_first_stage_solution("sizes_first_stage.csv")
+    return ws
+
+
+if __name__ == "__main__":
+    main()

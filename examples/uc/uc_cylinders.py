@@ -1,0 +1,57 @@
+"""Stochastic unit-commitment cylinders driver (UC-lite family).
+
+The analogue of ``examples/uc/uc_cylinders.py``: PH hub + bound spokes on the
+self-contained UC-lite model (the reference reads Egret/Prescient data files;
+see tpusppy/models/uc_lite.py).  Example::
+
+    python uc_cylinders.py --num-scens 10 --uc-num-gens 10 --uc-horizon 24 \
+        --max-iterations 50 --default-rho 100 --rel-gap 0.005 \
+        --lagrangian --xhatshuffle
+"""
+
+from tpusppy.models import uc_lite
+from tpusppy.spin_the_wheel import WheelSpinner
+from tpusppy.utils import cfg_vanilla as vanilla
+from tpusppy.utils import config
+
+
+def _parse_args():
+    cfg = config.Config()
+    cfg.num_scens_required()
+    cfg.popular_args()
+    cfg.two_sided_args()
+    cfg.ph_args()
+    cfg.fixer_args()
+    cfg.fwph_args()
+    cfg.lagrangian_args()
+    cfg.xhatshuffle_args()
+    uc_lite.inparser_adder(cfg)
+    cfg.parse_command_line("uc_cylinders")
+    return cfg
+
+
+def main():
+    cfg = _parse_args()
+    kwargs = uc_lite.kw_creator(cfg)
+    names = uc_lite.scenario_names_creator(cfg.num_scens)
+    beans = dict(
+        cfg=cfg, scenario_creator=uc_lite.scenario_creator,
+        scenario_denouement=uc_lite.scenario_denouement,
+        all_scenario_names=names, scenario_creator_kwargs=kwargs,
+    )
+    hub_dict = vanilla.ph_hub(**beans)
+    spokes = []
+    if cfg.fwph:
+        spokes.append(vanilla.fwph_spoke(**beans))
+    if cfg.lagrangian:
+        spokes.append(vanilla.lagrangian_spoke(**beans))
+    if cfg.xhatshuffle:
+        spokes.append(vanilla.xhatshuffle_spoke(**beans))
+    ws = WheelSpinner(hub_dict, spokes)
+    ws.spin()
+    ws.write_first_stage_solution("uc_first_stage.csv")
+    return ws
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,10 @@ def kw_creator(cfg=None, **kwargs):
     }
 
 
+def scenario_denouement(rank, scenario_name, scenario):
+    pass
+
+
 def inparser_adder(cfg):
     if "num_scens" not in cfg:
         cfg.num_scens_required()
