@@ -1,0 +1,72 @@
+"""Cross-scenario cuts: spoke cut generation + hub-side cutting-plane bound."""
+
+import numpy as np
+import pytest
+
+from tpusppy.models import farmer
+from tpusppy.spin_the_wheel import WheelSpinner
+from tpusppy.utils import cfg_vanilla as vanilla
+from tpusppy.utils.config import Config
+
+EF_OBJ = -108390.0
+
+
+def _cfg(n=3):
+    cfg = Config()
+    cfg.popular_args()
+    cfg.two_sided_args()
+    cfg.cross_scenario_cuts_args()
+    cfg.xhatshuffle_args()
+    cfg.num_scens_optional()
+    cfg.num_scens = n
+    cfg.max_iterations = 30
+    cfg.default_rho = 1.0
+    cfg.rel_gap = 0.005
+    cfg.cross_scenario_cuts = True
+    return cfg
+
+
+def test_cross_scenario_cut_wheel():
+    n = 3
+    cfg = _cfg(n)
+    names = farmer.scenario_names_creator(n)
+    kw = {"num_scens": n}
+    beans = dict(cfg=cfg, scenario_creator=farmer.scenario_creator,
+                 all_scenario_names=names, scenario_creator_kwargs=kw)
+    hub_dict = vanilla.ph_hub(**beans)
+    from tpusppy.cylinders import CrossScenarioHub
+
+    assert hub_dict["hub_class"] is CrossScenarioHub
+    vanilla.add_cross_scenario_cuts(hub_dict, cfg)
+    spokes = [
+        vanilla.cross_scenario_cuts_spoke(**beans),
+        vanilla.xhatshuffle_spoke(**beans),
+    ]
+    ws = WheelSpinner(hub_dict, spokes).spin()
+    # the cutting-plane outer bound must be valid and the incumbent near EF
+    assert ws.BestInnerBound == pytest.approx(EF_OBJ, rel=5e-3)
+    assert ws.BestOuterBound <= ws.BestInnerBound + 1e-6
+    assert np.isfinite(ws.BestOuterBound)
+
+
+def test_cut_spoke_cuts_valid():
+    """Cuts must underestimate the true scenario value functions."""
+    from tpusppy.cylinders import CrossScenarioCutSpoke
+    from tpusppy.cylinders.spcommunicator import WindowFabric
+    from tpusppy.xhat_eval import Xhat_Eval
+
+    n = 3
+    names = farmer.scenario_names_creator(n)
+    ev = Xhat_Eval({}, names, farmer.scenario_creator,
+                   scenario_creator_kwargs={"num_scens": n})
+    fabric = WindowFabric()
+    spoke = CrossScenarioCutSpoke(ev, 1, fabric)
+    xhat = np.broadcast_to(np.array([170.0, 80.0, 250.0]), (n, 3)).copy()
+    cuts = spoke.make_cuts(xhat)
+    assert cuts.shape == (n, 4)
+    assert not np.isnan(cuts).any()
+    # evaluate cut at another point and compare against the true clamp value
+    other = np.broadcast_to(np.array([100.0, 150.0, 250.0]), (n, 3)).copy()
+    vals = ev.objective_values(other)
+    cut_vals = cuts[:, :3] @ other[0] + cuts[:, 3]
+    assert (cut_vals <= vals + 1.0).all()

@@ -13,8 +13,9 @@ from .spoke import (
     OuterBoundWSpoke,
     Spoke,
 )
+from .cross_scen_spoke import CrossScenarioCutSpoke
 from .fwph_spoke import FrankWolfeOuterBound
-from .hub import APHHub, Hub, LShapedHub, PHHub
+from .hub import APHHub, CrossScenarioHub, Hub, LShapedHub, PHHub
 from .lagrangian_bounder import LagrangianOuterBound
 from .lshaped_bounder import XhatLShapedInnerBound
 from .lagranger_bounder import LagrangerOuterBound
@@ -28,7 +29,8 @@ __all__ = [
     "KILL_ID", "Mailbox", "SPCommunicator", "WindowFabric",
     "ConvergerSpokeType", "Spoke", "InnerBoundSpoke", "OuterBoundSpoke",
     "OuterBoundWSpoke", "InnerBoundNonantSpoke", "OuterBoundNonantSpoke",
-    "APHHub", "FrankWolfeOuterBound",
+    "APHHub", "CrossScenarioCutSpoke", "CrossScenarioHub",
+    "FrankWolfeOuterBound",
     "Hub", "LShapedHub", "PHHub", "LagrangianOuterBound",
     "LagrangerOuterBound",
     "SlamMaxHeuristic", "SlamMinHeuristic", "ScenarioCycler",

@@ -270,6 +270,41 @@ class PHHub(Hub):
             self.hub_to_spoke(payload, idx)
 
 
+class CrossScenarioHub(PHHub):
+    """PH hub that additionally feeds nonants to cross-scenario cut spokes
+    and routes their cut payloads to the CrossScenarioExtension
+    (cross_scen_hub.py:11-156)."""
+
+    def setup_hub(self):
+        super().setup_hub()
+        from .cross_scen_spoke import CrossScenarioCutSpoke
+
+        self.cs_spoke_indices = {
+            i + 1 for i, sd in enumerate(self.spokes)
+            if sd["spoke_class"] is CrossScenarioCutSpoke
+        }
+
+    def sync(self):
+        super().sync()
+        if not self.cs_spoke_indices:
+            return
+        xk = self.opt.nonants_of(self.opt.local_x)
+        payload = np.concatenate(
+            [np.asarray(xk, dtype=np.float64).ravel(),
+             [self.BestOuterBound, self.BestInnerBound]]
+        )
+        S = self.opt.batch.num_scenarios
+        K = self.opt.nonant_length
+        ext = getattr(self.opt, "extobject", None)
+        for idx in self.cs_spoke_indices:
+            self.hub_to_spoke(payload, idx)
+            data, is_new = self.hub_from_spoke(idx)
+            if is_new and ext is not None and hasattr(ext, "add_cuts"):
+                ext.add_cuts(data.reshape(S, K + 1))
+
+    sync_with_spokes = sync
+
+
 class APHHub(PHHub):
     """APH-flavored hub (hub.py:691-771).  The reference's variant skips
     cylinder barriers in Put/Get; our mailboxes are barrier-free already, so

@@ -1,0 +1,102 @@
+"""CrossScenarioExtension: hub-side management of cross-scenario cuts.
+
+TPU-native analogue of ``mpisppy/extensions/cross_scen_extension.py:16`` (283
+LoC).  The reference distributes Benders rows into every scenario model
+(an eta variable per scenario inside each subproblem).  In the batched
+runtime the same information is exploited WITHOUT reshaping the device batch:
+the accumulated cuts define a host-side cutting-plane relaxation
+
+    min_x  sum_s p_s eta_s
+    s.t.   eta_s >= g_s . x + c_s          (every accumulated cut)
+           x in the first-stage feasible set
+
+whose optimum is a certified OUTER bound the hub reports each iteration —
+the cuts tighten it monotonically, which is the role the reference's
+`boundsout` path plays (cross_scen_hub.py:11).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .extension import Extension
+
+
+class CrossScenarioExtension(Extension):
+    def __init__(self, opt):
+        super().__init__(opt)
+        so = opt.options.get("cross_scen_options", {})
+        self.check_bound_iterations = so.get("check_bound_improve_iterations",
+                                             4)
+        self._cuts = []            # list of (S, K+1) arrays
+        self._last_lb = -np.inf
+
+    def add_cuts(self, rows: np.ndarray):
+        """Accept a (S, K+1) payload from the cut spoke (NaN rows dropped)."""
+        rows = rows[~np.isnan(rows).any(axis=1)]
+        if rows.size:
+            self._cuts.append(rows)
+
+    def compute_outer_bound(self):
+        """Solve the host cutting-plane LP; returns the bound or None."""
+        if not self._cuts:
+            return None
+        from ..solvers import scipy_backend
+
+        opt = self.opt
+        b = opt.batch
+        idx = opt.tree.nonant_indices
+        K = idx.shape[0]
+        S = b.num_scenarios
+        cuts = np.concatenate(self._cuts, axis=0)   # (C, K+1) but per-scen?
+        # rebuild per-scenario cut lists: rows arrive S at a time in order
+        ncut_rounds = len(self._cuts)
+        nv = K + S
+        rows = []
+        cl, cu = [], []
+        # first-stage rows from scenario 0 (support within nonant columns)
+        mask = np.zeros(b.num_vars, dtype=bool)
+        mask[idx] = True
+        A0 = b.A[0]
+        fs = ~(np.abs(A0[:, ~mask]) > 0).any(axis=1) & (np.abs(A0) > 0).any(
+            axis=1)
+        for r in np.where(fs)[0]:
+            row = np.zeros(nv)
+            row[:K] = A0[r, idx]
+            rows.append(row)
+            cl.append(b.cl[0, r])
+            cu.append(b.cu[0, r])
+        for rnd in self._cuts:
+            for s in range(rnd.shape[0]):
+                if np.isnan(rnd[s]).any():
+                    continue
+                row = np.zeros(nv)
+                row[:K] = -rnd[s, :K]
+                row[K + s] = 1.0
+                rows.append(row)
+                cl.append(rnd[s, K])
+                cu.append(np.inf)
+        if len(rows) <= fs.sum():
+            return None
+        A = np.stack(rows)
+        c = np.zeros(nv)
+        c[K:] = opt.probs
+        lbv = np.concatenate([b.lb[0, idx], np.full(S, -1e9)])
+        ubv = np.concatenate([b.ub[0, idx], np.full(S, np.inf)])
+        res = scipy_backend.solve_lp(c, A, np.asarray(cl), np.asarray(cu),
+                                     lbv, ubv)
+        if not res.feasible:
+            return None
+        return float(res.obj)
+
+    def miditer(self):
+        it = self.opt._iter
+        if it % max(1, self.check_bound_iterations) != 0:
+            return
+        lb = self.compute_outer_bound()
+        if lb is None or lb <= self._last_lb:
+            return
+        self._last_lb = lb
+        spcomm = getattr(self.opt, "spcomm", None)
+        if spcomm is not None and hasattr(spcomm, "OuterBoundUpdate"):
+            spcomm.OuterBoundUpdate(lb, char='C')

@@ -106,8 +106,14 @@ def ph_hub(
     options = shared_options(cfg)
     options["convthresh"] = cfg.get("intra_hub_conv_thresh", 1e-10)
     options["bundles_per_rank"] = cfg.get("bundles_per_rank", 0)
+    if _hasit(cfg, "cross_scenario_cuts") and cfg.cross_scenario_cuts:
+        from ..cylinders import CrossScenarioHub
+
+        hub_class = CrossScenarioHub
+    else:
+        hub_class = PHHub
     hub_dict = {
-        "hub_class": PHHub,
+        "hub_class": hub_class,
         "hub_kwargs": {"options": {
             "rel_gap": cfg.get("rel_gap"),
             "abs_gap": cfg.get("abs_gap"),
@@ -426,6 +432,40 @@ def xhatxbar_spoke(
         scenario_creator_kwargs, all_nodenames,
         {"xhat_xbar_options": {"xhat_solver_options": {}, "csvname": "xbar.csv"}},
     )
+
+
+def cross_scenario_cuts_spoke(
+    cfg,
+    scenario_creator,
+    scenario_denouement=None,
+    all_scenario_names=None,
+    scenario_creator_kwargs=None,
+    all_nodenames=None,
+):
+    """(cfg_vanilla.py:602-637)"""
+    from ..cylinders import CrossScenarioCutSpoke
+
+    options = shared_options(cfg)
+    return {
+        "spoke_class": CrossScenarioCutSpoke,
+        "spoke_kwargs": {},
+        "opt_class": Xhat_Eval,
+        "opt_kwargs": _spoke_opt_kwargs(
+            cfg, scenario_creator, all_scenario_names,
+            scenario_creator_kwargs, all_nodenames, options),
+    }
+
+
+def add_cross_scenario_cuts(hub_dict, cfg):
+    """Attach the hub-side cut extension (cfg_vanilla.py:191-214)."""
+    from ..extensions.cross_scen_extension import CrossScenarioExtension
+
+    extension_adder(hub_dict, CrossScenarioExtension)
+    hub_dict["opt_kwargs"]["options"]["cross_scen_options"] = {
+        "check_bound_improve_iterations": cfg.get(
+            "cross_scenario_iter_cnt", 4),
+    }
+    return hub_dict
 
 
 def slammax_spoke(
