@@ -121,3 +121,44 @@ def test_diagnoser_writes(tmp_path):
     ph.ph_main(finalize=False)
     files = os.listdir(d)
     assert "diagnose_iter0.csv" in files and "diagnose_iter2.csv" in files
+
+
+def test_phtracker_writes_csvs(tmp_path):
+    from tpusppy.extensions.phtracker import PHTracker
+
+    d = str(tmp_path / "results")
+    ph = _ph(iters=4, extensions=PHTracker, extra_options={
+        "phtracker_options": {"results_folder": d},
+        "track_convergence": 1, "track_xbars": 1, "track_duals": 2,
+        "track_nonants": 1, "track_scen_gaps": 1,
+    })
+    ph.ph_main(finalize=False)
+    hub = os.path.join(d, "hub")
+    files = set(os.listdir(hub))
+    assert {"convergence.csv", "xbars.csv", "duals.csv", "nonants.csv",
+            "scen_gaps.csv"} <= files
+    rows = open(os.path.join(hub, "convergence.csv")).read().strip().splitlines()
+    assert len(rows) >= 4  # header + iterations
+
+
+def test_schur_complement_solves_continuous():
+    from tpusppy.opt.sc import SchurComplement
+
+    n = 3
+    sc = SchurComplement({}, farmer.scenario_names_creator(n),
+                         farmer.scenario_creator,
+                         scenario_creator_kwargs={"num_scens": n})
+    obj = sc.solve()
+    import pytest as _pytest
+
+    assert obj == _pytest.approx(-108390.0, rel=1e-4)
+
+
+def test_schur_complement_rejects_integers():
+    from tpusppy.opt.sc import SchurComplement
+
+    with pytest.raises(ValueError, match="mixed-integer"):
+        SchurComplement({}, farmer.scenario_names_creator(3),
+                        farmer.scenario_creator,
+                        scenario_creator_kwargs={"num_scens": 3,
+                                                 "use_integer": True})

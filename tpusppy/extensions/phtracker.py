@@ -1,0 +1,160 @@
+"""PHTracker: per-iteration CSV tracking of gaps, bounds, nonants, Ws, rhos.
+
+TPU-native analogue of ``mpisppy/extensions/phtracker.py`` (510 LoC;
+``TrackedData:14``): each enabled track writes one CSV row per PH iteration
+under ``options["phtracker_options"]["results_folder"]/<cylinder_name>/``,
+and ``plot_results`` renders the convergence curves when matplotlib is
+available.
+
+Options (mirroring ``tracking_args``, config.py:673-706): track_convergence,
+track_xbars, track_duals, track_nonants, track_scen_gaps — integers giving
+the tracking period (0 disables).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+
+from .extension import Extension
+
+
+class TrackedData:
+    """One CSV-backed track (phtracker.py:14-110)."""
+
+    def __init__(self, name, folder, plot=False, verbose=False):
+        self.name = name
+        self.folder = folder
+        self.plot = plot
+        self.verbose = verbose
+        self.fname = None
+        self.plot_fname = None
+        self.columns = None
+        self.rows = []
+
+    def initialize_fnames(self, name=None):
+        base = name or self.name
+        self.fname = os.path.join(self.folder, base + ".csv")
+        self.plot_fname = os.path.join(self.folder, base + ".png")
+
+    def initialize_df(self, columns):
+        self.columns = list(columns)
+
+    def add_row(self, row):
+        self.rows.append(list(row))
+
+    def write_out_data(self):
+        new_file = not os.path.exists(self.fname)
+        with open(self.fname, "a", newline="") as f:
+            w = csv.writer(f)
+            if new_file and self.columns:
+                w.writerow(self.columns)
+            w.writerows(self.rows)
+        self.rows = []
+
+
+class PHTracker(Extension):
+    def __init__(self, opt):
+        super().__init__(opt)
+        topt = opt.options.get("phtracker_options", {})
+        cylinder_name = topt.get("cylinder_name", "hub")
+        folder = os.path.join(topt.get("results_folder", "results"),
+                              cylinder_name)
+        os.makedirs(folder, exist_ok=True)
+        self.folder = folder
+        g = lambda k: int(opt.options.get(k, topt.get(k, 0)) or 0)
+        self.periods = {
+            "convergence": g("track_convergence"),
+            "xbars": g("track_xbars"),
+            "duals": g("track_duals"),
+            "nonants": g("track_nonants"),
+            "scen_gaps": g("track_scen_gaps"),
+        }
+        self.tracks = {}
+        for name, period in self.periods.items():
+            if period > 0:
+                t = TrackedData(name, folder)
+                t.initialize_fnames()
+                self.tracks[name] = t
+        if "convergence" in self.tracks:
+            self.tracks["convergence"].initialize_df(
+                ["iteration", "conv", "best_outer", "best_inner",
+                 "abs_gap", "rel_gap"])
+        K = opt.nonant_length
+        for name in ("xbars", "duals", "nonants"):
+            if name in self.tracks:
+                self.tracks[name].initialize_df(
+                    ["iteration"] + [f"k{k}" for k in range(K)])
+        if "scen_gaps" in self.tracks:
+            self.tracks["scen_gaps"].initialize_df(
+                ["iteration"] + list(opt.all_scenario_names))
+
+    def _due(self, name):
+        p = self.periods.get(name, 0)
+        return name in self.tracks and p > 0 and self.opt._iter % p == 0
+
+    def _snapshot(self):
+        opt = self.opt
+        it = opt._iter
+        if self._due("convergence"):
+            spcomm = getattr(opt, "spcomm", None)
+            if spcomm is not None and hasattr(spcomm, "compute_gaps"):
+                abs_gap, rel_gap = spcomm.compute_gaps()
+                ob, ib = spcomm.BestOuterBound, spcomm.BestInnerBound
+            else:
+                abs_gap = rel_gap = np.nan
+                ob = ib = np.nan
+            self.tracks["convergence"].add_row(
+                [it, opt.conv, ob, ib, abs_gap, rel_gap])
+        if self._due("xbars"):
+            self.tracks["xbars"].add_row([it] + list(opt.xbars[0]))
+        if self._due("duals"):
+            self.tracks["duals"].add_row([it] + list(opt.W.mean(axis=0)))
+        if self._due("nonants") and opt.local_x is not None:
+            xk = opt.nonants_of(opt.local_x)
+            self.tracks["nonants"].add_row([it] + list(xk.mean(axis=0)))
+        if self._due("scen_gaps") and opt.local_x is not None:
+            objs = opt.batch.objective(opt.local_x)
+            self.tracks["scen_gaps"].add_row([it] + list(objs))
+        for t in self.tracks.values():
+            if t.rows:
+                t.write_out_data()
+
+    def post_iter0(self):
+        self._snapshot()
+
+    def enditer_after_sync(self):
+        self._snapshot()
+
+    def enditer(self):
+        if getattr(self.opt, "spcomm", None) is None:
+            self._snapshot()
+
+    def post_everything(self):
+        self.plot_results()
+
+    def plot_results(self):
+        """Render convergence curves if matplotlib is present
+        (phtracker.py plot path)."""
+        t = self.tracks.get("convergence")
+        if t is None or not os.path.exists(t.fname):
+            return
+        try:
+            import matplotlib
+
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+        except Exception:
+            return
+        data = np.genfromtxt(t.fname, delimiter=",", names=True)
+        if data.size < 2:
+            return
+        plt.figure()
+        plt.semilogy(data["iteration"], np.abs(data["conv"]), label="conv")
+        plt.xlabel("Iteration")
+        plt.ylabel("Convergence metric")
+        plt.legend()
+        plt.savefig(t.plot_fname)
+        plt.close()

@@ -1,0 +1,41 @@
+"""SchurComplement: distributed interior-point entry point (continuous SPs).
+
+API analogue of ``mpisppy/opt/sc.py:59-106``.  The reference is a thin
+wrapper over parapint's MPI Schur-complement interior point with MA27 linear
+algebra (sc.py:4,95-97) — all the numerics live in external native code.  On
+TPU the same block-arrowhead KKT structure is what the batched ADMM already
+exploits: scenario blocks factor independently (the batched Cholesky) and the
+coupling (Schur) system is the nonant consensus, handled by the node-grouped
+reductions.  So this class keeps the reference's constructor/solve surface
+and solves the continuous extensive form through the merged-column EF +
+batched first-order path, refusing integer problems exactly as the reference
+does (sc.py:18-21).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ef import build_ef, solve_ef
+from ..spbase import SPBase
+
+
+class SchurComplement(SPBase):
+    def __init__(self, options, all_scenario_names, scenario_creator,
+                 scenario_creator_kwargs=None, all_nodenames=None, **kwargs):
+        super().__init__(options, all_scenario_names, scenario_creator,
+                         scenario_creator_kwargs=scenario_creator_kwargs,
+                         all_nodenames=all_nodenames, **kwargs)
+        if bool(np.any(self.batch.is_int)):
+            raise ValueError(
+                "SchurComplement does not support mixed-integer problems "
+                "(continuous only, cf. sc.py:18-21)"
+            )
+
+    def solve(self):
+        """Solve the continuous SP; returns the objective (sc.py:89-106)."""
+        obj, x = solve_ef(self.batch, solver="admm")
+        self.local_x = x
+        self.first_stage_solution_available = True
+        self.objective_value = obj
+        return obj
