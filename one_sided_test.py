@@ -1,0 +1,61 @@
+"""Standalone smoke test of the cross-process window service.
+
+The analogue of the reference's repo-root ``mpi_one_sided_test.py`` (a
+2-rank Lock/Put/Get/Unlock check): spawn a child process, exchange a payload
+through the C++ shared-memory mailbox pair, verify the write-id protocol and
+the kill sentinel.  Run: ``python one_sided_test.py``.
+"""
+
+import multiprocessing as mp
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _child(name):
+    from tpusppy.runtime import ShmWindowFabric
+
+    fabric = ShmWindowFabric(name, attach=True)
+    last = 0
+    while True:
+        data, wid = fabric.to_spoke[1].get()
+        if wid == -1:
+            break
+        if wid > last:
+            last = wid
+            fabric.to_hub[1].put(data * 2.0)
+        else:
+            time.sleep(0.001)
+
+
+def main():
+    from tpusppy.runtime import ShmWindowFabric
+
+    name = f"/tpusppy_onesided_{os.getpid()}"
+    fabric = ShmWindowFabric(name, spoke_lengths=[(3, 3)])
+    ctx = mp.get_context("spawn")
+    child = ctx.Process(target=_child, args=(name,))
+    child.start()
+    try:
+        fabric.to_spoke[1].put(np.array([1.0, 2.0, 3.0]))
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            data, wid = fabric.to_hub[1].get()
+            if wid == 1:
+                assert np.array_equal(data, [2.0, 4.0, 6.0]), data
+                break
+            time.sleep(0.001)
+        else:
+            raise RuntimeError("no echo from the spoke process")
+        fabric.send_terminate()
+        child.join(timeout=30)
+        assert child.exitcode == 0
+        print("one-sided window service test: OK")
+    finally:
+        fabric.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

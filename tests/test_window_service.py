@@ -88,3 +88,37 @@ def test_cross_process_exchange():
         assert child.exitcode == 0
     finally:
         fabric.close()
+
+
+def test_synchronizer_async_reduction():
+    """Listener-thread reduction engine (the APH Synchronizer analogue)."""
+    import numpy as np
+
+    from tpusppy.utils.listener_util import Synchronizer
+
+    sync = Synchronizer({"FirstReduce": 4}, asynch=True, sleep_secs=0.001)
+    seen = []
+
+    def side_gig(s):
+        out = {}
+        s._unsafe_get_global_data("FirstReduce", out)
+        seen.append(out["FirstReduce"].copy())
+
+    def worker():
+        import time
+
+        for w in range(3):
+            sync.compute_global_data(
+                {"FirstReduce": np.full(4, float(w + 1))},
+                enable_side_gig=True, worker_id=w)
+        deadline = time.time() + 10
+        out = {"FirstReduce": np.zeros(4)}
+        while time.time() < deadline:
+            sync.compute_global_data({}, global_out=out)
+            if out["FirstReduce"][0] == 6.0:  # 1 + 2 + 3
+                return
+            time.sleep(0.001)
+        raise AssertionError(f"reduction never completed: {out}")
+
+    sync.run(worker, side_gig=side_gig)
+    assert sync.global_quitting == 1

@@ -1,0 +1,110 @@
+"""Synchronizer: background-thread reduction engine (the APH listener).
+
+TPU-native analogue of ``mpisppy/utils/listener_util/listener_util.py``
+(333 LoC).  The reference runs a listener thread doing MPI Allreduces
+concurrently with worker solves, guarding a data cache with a lock
+(listener_util.py:80-320).  In the batched runtime global reductions are
+cheap host einsums, so :class:`tpusppy.opt.aph.APH` runs them inline; this
+class keeps the *architecture* available — a listener thread periodically
+reducing worker-published contributions into a lock-guarded global cache —
+for workloads where reductions genuinely overlap device solves (e.g.
+cross-host DCN reductions).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+
+class Synchronizer:
+    """(listener_util.py:53-330 semantics, single-host form).
+
+    Workers publish named local contributions via
+    :meth:`compute_global_data`; the listener thread sums the latest
+    contribution of every registered worker into the global cache and runs
+    the optional ``side_gig`` afterwards.
+    """
+
+    def __init__(self, lens: dict, asynch=True, sleep_secs=0.01):
+        self.Lens = dict(lens)          # name -> vector length
+        self.asynch = asynch
+        self.sleep_secs = sleep_secs
+        self._lock = threading.Lock()
+        self._locals = {}               # worker id -> {name: vector}
+        self._global = {name: np.zeros(ln) for name, ln in self.Lens.items()}
+        self.global_quitting = 0
+        self.quitting = 0
+        self.enable_side_gig = False
+        self._listener = None
+        self._side_gig = None
+
+    # ---- worker side --------------------------------------------------------
+    def compute_global_data(self, local_data: dict, global_out: dict = None,
+                            enable_side_gig=False, worker_id=0,
+                            rednames=None, keep_up=False):
+        """Publish local contributions; read back the global cache."""
+        with self._lock:
+            slot = self._locals.setdefault(worker_id, {})
+            for name, vec in local_data.items():
+                if rednames is not None and name not in rednames:
+                    continue
+                slot[name] = np.array(vec, copy=True)
+            if enable_side_gig:
+                self.enable_side_gig = True
+            if global_out is not None:
+                for name in global_out:
+                    if name in self._global:
+                        global_out[name][...] = self._global[name]
+        if not self.asynch:
+            self._reduce_once()
+            if global_out is not None:
+                with self._lock:
+                    for name in global_out:
+                        if name in self._global:
+                            global_out[name][...] = self._global[name]
+        return global_out
+
+    def _unsafe_get_global_data(self, name, out: dict):
+        out[name] = np.array(self._global[name], copy=True)
+
+    def _unsafe_put_local_data(self, name, data: dict, worker_id=0):
+        self._locals.setdefault(worker_id, {})[name] = np.array(
+            data[name], copy=True)
+
+    # ---- listener side ------------------------------------------------------
+    def _reduce_once(self):
+        with self._lock:
+            for name in self.Lens:
+                acc = np.zeros(self.Lens[name])
+                for slot in self._locals.values():
+                    if name in slot:
+                        acc += slot[name]
+                self._global[name] = acc
+            if self.enable_side_gig and self._side_gig is not None:
+                self._side_gig(self)
+                self.enable_side_gig = False
+
+    def _listener_daemon(self):
+        while self.global_quitting == 0:
+            self._reduce_once()
+            time.sleep(self.sleep_secs)
+        self._reduce_once()
+
+    def run(self, worker_fct, side_gig=None, **worker_kwargs):
+        """Start the listener thread, run the worker, join
+        (listener_util.py:82-103)."""
+        self._side_gig = side_gig
+        if self.asynch:
+            self._listener = threading.Thread(
+                target=self._listener_daemon, name="SynchronizerListener",
+                daemon=True)
+            self._listener.start()
+        try:
+            worker_fct(**worker_kwargs)
+        finally:
+            self.global_quitting = 1
+            if self._listener is not None:
+                self._listener.join(timeout=30)
