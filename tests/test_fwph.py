@@ -53,6 +53,8 @@ def test_fwph_spoke_in_wheel():
          "opt_kwargs": _kwargs(n)},
     ]
     ws = WheelSpinner(hub_dict, spokes).spin()
-    assert ws.BestInnerBound == pytest.approx(EF_OBJ, rel=5e-3)
+    # the hub terminates at rel_gap=0.02, so the incumbent is only
+    # guaranteed to that tolerance (spoke timing races decide the rest)
+    assert ws.BestInnerBound == pytest.approx(EF_OBJ, rel=2e-2)
     assert ws.BestOuterBound <= ws.BestInnerBound + 1e-6
     assert ws.BestOuterBound > TRIVIAL + 1e3  # FWPH moved the outer bound

@@ -27,11 +27,92 @@ class Xhat_Eval(SPOpt):
 
         ev = Xhat_Eval(options, names, scenario_creator, ...)
         z_hat = ev.evaluate(nonant_cache)   # expected objective, or +inf
+
+    Integer recourse: the reference's external MIP solver returns integral
+    second-stage solutions natively; here a ROUND-AND-DIVE loop over the
+    batched LP solves does (fix near-integral integer columns, re-solve,
+    repeat) — options["xhat_dive_rounds"] bounds the dives (default 12).
     """
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self.tee_rank0_solves = False
+
+    def _integer_dive(self, lb, ub):
+        """Drive remaining fractional integer columns integral.
+
+        Per round: solve the batch; clamp integer columns within 0.1 of an
+        integer to that integer, plus (to guarantee progress) each
+        scenario's single most fractional integer column to its rounding.
+        """
+        import numpy as np
+
+        from .solvers import admm
+
+        b = self.batch
+        ints = b.is_int
+        rounds = int(self.options.get("xhat_dive_rounds", 12))
+        lb = np.array(lb, copy=True)
+        ub = np.array(ub, copy=True)
+        x = None
+        for _ in range(rounds):
+            sol = admm.solve_batch(b.c, b.q2, b.A, b.cl, b.cu, lb, ub,
+                                   settings=self.admm_settings)
+            x = np.asarray(sol.x)
+            self.local_x = x
+            self.pri_res = np.asarray(sol.pri_res)
+            self.dua_res = np.asarray(sol.dua_res)
+            free = ints[None, :] & (ub > lb)          # (S, n) undecided ints
+            if not free.any():
+                break
+            frac = np.where(free, np.abs(x - np.round(x)), -1.0)
+            if frac.max() < 1e-6:
+                break
+            near = free & (frac < 0.1)
+            # force progress: most fractional free int column per scenario,
+            # rounded UP (covering-style constraints stay satisfiable; the
+            # re-solve lets other free columns compensate)
+            worst = frac.argmax(axis=1)
+            has_free = free.any(axis=1)
+            force = np.zeros_like(near)
+            force[np.arange(x.shape[0]), worst] = has_free
+            vals = np.round(np.where(near, x, 0.0))
+            vals = np.where(force, np.ceil(np.where(force, x, 0.0) - 1e-9),
+                            vals)
+            clamp = near | force
+            lb = np.where(clamp, np.maximum(vals, lb), lb)
+            ub = np.where(clamp, np.minimum(vals, ub), ub)
+            lb = np.minimum(lb, ub)  # keep boxes sane after rounding
+        return x
+
+    def _host_milp(self, lb, ub):
+        """Per-scenario HiGHS MILP with nonants clamped — the fallback when
+        diving wedges (e.g. capacity-binding all-integer recourse).  This is
+        exactly the role the reference's external MIP solver plays for
+        incumbent evaluation; each scenario MILP is small and independent.
+        """
+        import numpy as np
+
+        from .solvers import scipy_backend
+
+        b = self.batch
+        S = b.num_scenarios
+        xs = np.zeros((S, b.num_vars))
+        pri = np.zeros(S)
+        limit = float(self.options.get("xhat_mip_time_limit", 2.0))
+        gap = float(self.options.get("xhat_mip_rel_gap", 1e-4))
+        for s in range(S):
+            res = scipy_backend.solve_lp(
+                b.c[s], b.A[s], b.cl[s], b.cu[s], lb[s], ub[s],
+                is_int=b.is_int, mip_rel_gap=gap, time_limit=limit)
+            if res.feasible:
+                xs[s] = res.x
+            else:
+                pri[s] = np.inf
+        self.local_x = xs
+        self.pri_res = pri
+        self.dua_res = np.zeros(S)
+        return xs
 
     def _fix_and_solve(self, nonant_cache):
         """Clamp nonants to the candidate and solve the whole batch.
@@ -40,11 +121,24 @@ class Xhat_Eval(SPOpt):
         (S, K) per-scenario (multistage xhats fix per-node values; scenarios of
         one node must carry identical values there).
         """
+        import numpy as np
+
         self.fix_nonants(nonant_cache)
         try:
-            # cold start: the clamped problem's geometry differs enough that
-            # stale warm duals slow ADMM down rather than help
-            x = self.solve_loop(warm=False)
+            b = self.batch
+            leftover_ints = b.is_int.any() and bool(
+                (b.is_int[None, :] & (self._fixed_ub > self._fixed_lb)).any()
+            )
+            if leftover_ints:
+                x = self._integer_dive(self._fixed_lb, self._fixed_ub)
+                tol = max(self.options.get("feas_tol", 1e-3),
+                          10.0 * self.admm_settings.eps_rel)
+                if (np.asarray(self.pri_res) > tol).any():
+                    x = self._host_milp(self._fixed_lb, self._fixed_ub)
+            else:
+                # cold start: the clamped problem's geometry differs enough
+                # that stale warm duals slow ADMM down rather than help
+                x = self.solve_loop(warm=False)
         finally:
             self.restore_nonants()
         return x
