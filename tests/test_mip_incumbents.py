@@ -57,3 +57,47 @@ def test_sizes_integer_incumbent_near_golden():
     x = ev.local_x
     ints = batch.is_int
     assert np.abs(x[:, ints] - np.round(x[:, ints])).max() < 1e-6
+
+
+def test_integer_sizes_wheel_certified_gap():
+    """The reference's headline workflow on a MIP: PH hub (LP relaxation
+    drives Ws), Lagrangian outer bound, XhatShuffle incumbents with integer
+    diving -> certified MIP gap at termination."""
+    from tpusppy.cylinders import LagrangianOuterBound, PHHub, XhatShuffleInnerBound
+    from tpusppy.opt.ph import PH
+    from tpusppy.phbase import PHBase
+    from tpusppy.spin_the_wheel import WheelSpinner
+
+    n = 3
+    names = sizes.scenario_names_creator(n)
+    kw = {"scenario_count": n, "relax_integers": False}
+
+    def okw(iters=60):
+        return {
+            "options": {"defaultPHrho": 0.01, "PHIterLimit": iters,
+                        "convthresh": -1.0, "xhat_dive_rounds": 20,
+                        "xhat_looper_options": {"scen_limit": 2}},
+            "all_scenario_names": names,
+            "scenario_creator": sizes.scenario_creator,
+            "scenario_creator_kwargs": kw,
+        }
+
+    hub_dict = {
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": {"rel_gap": 0.02}},
+        "opt_class": PH,
+        "opt_kwargs": okw(40),
+    }
+    spokes = [
+        {"spoke_class": LagrangianOuterBound, "opt_class": PHBase,
+         "opt_kwargs": okw()},
+        {"spoke_class": XhatShuffleInnerBound, "opt_class": Xhat_Eval,
+         "opt_kwargs": okw()},
+    ]
+    ws = WheelSpinner(hub_dict, spokes).spin()
+    # integer incumbent above the LP bound, gap certified
+    assert np.isfinite(ws.BestInnerBound)
+    assert ws.BestOuterBound <= ws.BestInnerBound + 1e-6
+    # reference golden: integer optimum ~224k-226k; LP bound ~220k+
+    assert 218000.0 <= ws.BestOuterBound <= 230000.0
+    assert 220000.0 <= ws.BestInnerBound <= 240000.0
