@@ -127,3 +127,19 @@ def test_uc_lite_ef_and_ph():
             names, uc_lite.scenario_creator, scenario_creator_kwargs=kw)
     conv, eobj, triv = ph.ph_main()
     assert eobj == pytest.approx(obj_h, rel=1e-2)
+
+
+def test_gbd_ef_and_ph():
+    from tpusppy.models import gbd
+
+    names = gbd.scenario_names_creator(5)
+    kw = {"num_scens": 5}
+    batch = _batch(gbd, names, **kw)
+    obj_h, _ = solve_ef(batch, solver="highs")
+    obj_a, _ = solve_ef(batch, solver="admm")
+    assert obj_a == pytest.approx(obj_h, rel=1e-3)
+    assert obj_h > 0
+    ph = PH({"defaultPHrho": 20.0, "PHIterLimit": 200, "convthresh": 1e-6},
+            names, gbd.scenario_creator, scenario_creator_kwargs=kw)
+    conv, eobj, triv = ph.ph_main()
+    assert eobj == pytest.approx(obj_h, rel=1e-2)

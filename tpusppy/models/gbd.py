@@ -1,0 +1,101 @@
+"""GBD: aircraft allocation under uncertain route demand (Dantzig 1956).
+
+Behavioral port of ``mpisppy/tests/examples/gbd/gbd.py``: allocate four
+aircraft types to five routes before demands realize; slack passengers are
+lost revenue.  First-stage nonants are the 4x5 allocation matrix (minus the
+three forbidden pairs, which are fixed at 0).  Demand outcomes/probabilities
+are the 1956 paper's tables (the reference's json carries an extended fan;
+the original tables are used here), drawn with the same seeded flipped-cumsum
+scheme.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ir import LinearModelBuilder
+from ..scenario_tree import ScenarioNode, extract_num
+
+NUM_AIRCRAFT = [10.0, 19.0, 25.0, 15.0]
+FORBIDDEN = {(1, 0), (2, 0), (2, 2)}
+# p[i][j]: hundreds of passengers/month for aircraft i route j; row 4 = slack
+P = np.array([
+    [16, 15, 28, 23, 81],
+    [0, 10, 14, 15, 57],
+    [0, 5, 0, 7, 29],
+    [9, 11, 22, 17, 55],
+    [1, 1, 1, 1, 1],
+], dtype=float)
+# c[i][j]: cost (thousands)/month; row 4 = lost revenue per slack unit
+C = np.array([
+    [18, 21, 18, 16, 10],
+    [0, 15, 16, 14, 9],
+    [0, 10, 0, 9, 6],
+    [17, 16, 17, 15, 10],
+    [13, 13, 7, 7, 1],
+], dtype=float)
+POSSIBLE_DEMANDS = ([20, 22, 25, 27, 30], [5, 15], [14, 16, 18, 20, 22],
+                    [1, 5, 8, 10, 34], [58, 60, 62])
+DEMAND_PROBS = ([.2, .05, .35, .2, .2], [.3, .7], [.1, .2, .4, .2, .1],
+                [.2, .2, .3, .2, .1], [.1, .8, .1])
+
+
+def scenario_names_creator(num_scens, start=None):
+    start = start or 0
+    return [f"scen{i}" for i in range(start, start + num_scens)]
+
+
+def kw_creator(cfg=None, **kwargs):
+    cfg = cfg or {}
+    get = cfg.get if hasattr(cfg, "get") else lambda k, d=None: getattr(cfg, k, d)
+    return {"num_scens": kwargs.get("num_scens", get("num_scens"))}
+
+
+def inparser_adder(cfg):
+    if "num_scens" not in cfg:
+        cfg.num_scens_required()
+
+
+def scenario_creator(sname, num_scens=None):
+    seed = extract_num(sname)
+    stream = np.random.RandomState(seed)
+    rand = stream.rand(5)
+    demand = np.empty(5)
+    for r in range(5):
+        cum = np.flip(np.cumsum(np.flip(DEMAND_PROBS[r])))
+        j = int(np.searchsorted(np.flip(cum), rand[r]))
+        demand[r] = POSSIBLE_DEMANDS[r][len(cum) - 1 - j]
+
+    b = LinearModelBuilder(sname)
+    x = {}
+    for i in range(4):
+        for j in range(5):
+            ubij = 0.0 if (i, j) in FORBIDDEN else np.inf
+            x[i, j] = b.add_var(f"x[{i},{j}]", lb=0.0, ub=ubij,
+                                cost=C[i, j])
+    slack_a = b.add_vars("aircraftSlack", 4, lb=0.0)
+    pos = b.add_vars("passengerSlack_pos", 5, lb=0.0)
+    neg = b.add_vars("passengerSlack_neg", 5, lb=0.0)
+    for j in range(5):
+        b.set_cost(pos[j], C[4, j])      # lost revenue
+
+    for i in range(4):
+        coeffs = {x[i, j]: 1.0 for j in range(5)}
+        coeffs[slack_a[i]] = 1.0
+        b.add_eq(coeffs, NUM_AIRCRAFT[i])
+    for j in range(5):
+        coeffs = {x[i, j]: P[i, j] for i in range(4)}
+        coeffs[pos[j]] = P[4, j]
+        coeffs[neg[j]] = -P[4, j]
+        b.add_eq(coeffs, float(demand[j]))
+
+    p = b.build()
+    p.prob = None if num_scens is None else 1.0 / num_scens
+    nonants = np.asarray([x[i, j] for i in range(4) for j in range(5)],
+                         dtype=np.int32)
+    p.nodes = [ScenarioNode("ROOT", 1.0, 1, nonants)]
+    return p
+
+
+def scenario_denouement(rank, scenario_name, scenario):
+    pass
