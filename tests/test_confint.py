@@ -101,3 +101,38 @@ def test_seqsampling_bpl_farmer():
     assert res["CI"][1] == 2000.0
     assert "ROOT" in res["Candidate_solution"]
     assert res["T"] <= 8
+
+
+def test_multistage_gap_estimator_aircond():
+    """EF_mstage path: sample subtree + walking-tree xhats on aircond."""
+    bfs = [2, 2]
+    cfg = Config()
+    cfg.add_and_assign("EF_mstage", "mstage", bool, None, True)
+    cfg.quick_assign("EF_solver_name", str, "admm")
+    cfg.quick_assign("branching_factors", list, bfs)
+    cfg.quick_assign("num_scens", int, 4)
+    cfg.quick_assign("mu_dev", float, 0.0)
+    cfg.quick_assign("sigma_dev", float, 40.0)
+    cfg.quick_assign("start_ups", bool, False)
+    cfg.quick_assign("start_seed", int, 0)
+
+    from tpusppy.models import aircond
+
+    # candidate: root policy from a quick EF on one sample tree
+    from tpusppy.confidence_intervals.sample_tree import SampleSubtree
+
+    st = SampleSubtree("tpusppy.models.aircond", xhats=[], root_scen=None,
+                      starting_stage=1, branching_factors=bfs, seed=0,
+                      cfg=cfg)
+    st.run()
+    xhat_one = st.root_xstar
+    assert xhat_one.shape == (2,)   # (RegularProd, OvertimeProd) at ROOT
+
+    estim = ciutils.gap_estimators(
+        {"ROOT": xhat_one}, "tpusppy.models.aircond",
+        solving_type="EF_mstage",
+        sample_options={"seed": 100, "branching_factors": bfs},
+        cfg=cfg, solver_name="admm")
+    assert estim["G"] >= 0
+    assert np.isfinite(estim["s"])
+    assert estim["seed"] > 100   # seed advanced by the tree size

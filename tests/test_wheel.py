@@ -149,3 +149,50 @@ def test_wheel_many_spokes():
     assert ws.BestInnerBound == pytest.approx(ef_obj, rel=5e-3)
     assert ws.BestOuterBound <= ws.BestInnerBound + 1e-6
     assert ws.BestOuterBound >= -115405.6
+
+
+def test_wheel_multistage_hydro():
+    """Multistage wheel: hydro 3-stage PH hub + Lagrangian + XhatShuffle
+    (per-node donor completion makes shuffled candidates nonanticipative)."""
+    from tpusppy.ef import solve_ef
+    from tpusppy.ir import ScenarioBatch
+    from tpusppy.models import hydro
+
+    names = hydro.scenario_names_creator(9)
+    kw = {"branching_factors": [3, 3]}
+    batch = ScenarioBatch.from_problems(
+        [hydro.scenario_creator(nm, **kw) for nm in names])
+    ef_obj, _ = solve_ef(batch, solver="highs")
+
+    def okw(iters):
+        return {
+            "options": {"defaultPHrho": 1.0, "PHIterLimit": iters,
+                        "convthresh": -1.0,
+                        "xhat_looper_options": {"scen_limit": 2}},
+            "all_scenario_names": names,
+            "scenario_creator": hydro.scenario_creator,
+            "scenario_creator_kwargs": kw,
+        }
+
+    hub_dict = {
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": {"rel_gap": 0.01}},
+        "opt_class": PH,
+        "opt_kwargs": okw(60),
+    }
+    spokes = [
+        {"spoke_class": LagrangianOuterBound, "opt_class": PHBase,
+         "opt_kwargs": okw(60)},
+        {"spoke_class": XhatShuffleInnerBound, "opt_class": Xhat_Eval,
+         "opt_kwargs": okw(60)},
+    ]
+    ws = WheelSpinner(hub_dict, spokes).spin()
+    assert ws.BestOuterBound <= ws.BestInnerBound + 1e-6
+    assert ws.BestInnerBound == pytest.approx(ef_obj, rel=0.02)
+    # incumbent cache is nonanticipative per stage-2 node
+    cache = ws.local_nonant_cache
+    stage2 = ws.opt.tree.nonant_stage == 2
+    for g in range(3):
+        grp = cache[3 * g:3 * g + 3][:, stage2]
+        np.testing.assert_allclose(grp, np.broadcast_to(grp[:1], grp.shape),
+                                   atol=1e-6)
