@@ -1,0 +1,47 @@
+"""Confidence-interval Config groups.
+
+TPU-native analogue of ``mpisppy/confidence_intervals/confidence_config.py``
+(85 LoC): the option groups consumed by MMW / sequential sampling CLIs.
+"""
+
+from __future__ import annotations
+
+
+def confidence_config(cfg):
+    cfg.add_to_config("confidence_level",
+                      "1 minus alpha (default 0.95)", float, 0.95)
+
+
+def sequential_config(cfg):
+    cfg.add_to_config("sample_size_ratio",
+                      "xhat sample size / gap estimator sample size "
+                      "(default 1)", float, 1.0)
+    cfg.add_to_config("ArRP", "how many estimators to pool (default 1)",
+                      int, 1)
+    cfg.add_to_config("kf_Gs",
+                      "resampling frequency for gap estimators (default 1)",
+                      int, 1)
+    cfg.add_to_config("kf_xhat",
+                      "resampling frequency for xhat (default 1)", int, 1)
+
+
+def BM_config(cfg):
+    """Bayraksan-Morton relative-width options (seqsampling defaults)."""
+    cfg.add_to_config("BM_h", "BM h parameter (default 0.2)", float, 0.2)
+    cfg.add_to_config("BM_hprime", "BM h' parameter (default 0.015)", float,
+                      0.015)
+    cfg.add_to_config("BM_eps", "BM epsilon (default 0.5)", float, 0.5)
+    cfg.add_to_config("BM_eps_prime", "BM epsilon' (default 0.4)", float,
+                      0.4)
+    cfg.add_to_config("BM_p", "BM p parameter (default 0.2)", float, 0.2)
+    cfg.add_to_config("BM_q", "BM q parameter (default 1.2)", float, 1.2)
+
+
+def BPL_config(cfg):
+    """Bayraksan-Pierre-Louis fixed-width options."""
+    cfg.add_to_config("BPL_eps", "BPL epsilon (CI width)", float, 50.0)
+    cfg.add_to_config("BPL_c0", "BPL starting sample size (default 50)",
+                      int, 50)
+    cfg.add_to_config("BPL_c1", "BPL growth coefficient (default 2)", int, 2)
+    cfg.add_to_config("BPL_n0min",
+                      "stochastic-sampling minimum n0 (default 50)", int, 50)

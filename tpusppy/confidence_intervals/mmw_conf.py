@@ -1,0 +1,54 @@
+"""MMW CLI: confidence interval on the gap of a stored xhat.
+
+TPU-native analogue of ``mpisppy/confidence_intervals/mmw_conf.py`` (113
+LoC)::
+
+    python -m tpusppy.confidence_intervals.mmw_conf tpusppy.models.farmer \
+        --xhatpath xhat.npy --num-scens 3 --MMW-num-batches 5 \
+        --MMW-batch-size 10 --confidence-level 0.95
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+
+from ..utils.config import Config
+from . import ciutils
+from .confidence_config import confidence_config
+from .mmw_ci import MMWConfidenceIntervals
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0].startswith("-"):
+        raise SystemExit(
+            "usage: mmw_conf <model module> [--xhatpath ...] ...")
+    mname = argv.pop(0)
+    m = importlib.import_module(mname)
+
+    cfg = Config()
+    cfg.add_and_assign("EF_2stage", "2stage EF", bool, None, True)
+    cfg.EF2()
+    confidence_config(cfg)
+    cfg.add_to_config("xhatpath", "path to .npy xhat", str, "xhat.npy")
+    cfg.add_to_config("MMW_num_batches", "number of MMW batches", int, 2)
+    cfg.add_to_config("MMW_batch_size", "MMW batch size", int, None)
+    cfg.add_to_config("start_scen",
+                      "first scenario index for sampling (default "
+                      "num_scens)", int, None)
+    m.inparser_adder(cfg)
+    cfg.parse_command_line("mmw_conf", args=argv)
+
+    xhat = ciutils.read_xhat(cfg.xhatpath)
+    batch_size = cfg.MMW_batch_size or cfg.num_scens
+    start = cfg.start_scen if cfg.start_scen is not None else cfg.num_scens
+    mmw = MMWConfidenceIntervals(mname, cfg, xhat, cfg.MMW_num_batches,
+                                 batch_size=batch_size, start=start)
+    result = mmw.run(confidence_level=cfg.confidence_level)
+    print(result)
+    return result
+
+
+if __name__ == "__main__":
+    main()
