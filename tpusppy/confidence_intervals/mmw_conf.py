@@ -40,11 +40,16 @@ def main(argv=None):
     m.inparser_adder(cfg)
     cfg.parse_command_line("mmw_conf", args=argv)
 
+    if cfg.num_scens is None and (cfg.MMW_batch_size is None
+                                  or cfg.start_scen is None):
+        raise SystemExit(
+            "mmw_conf: give --num-scens, or both --MMW-batch-size and "
+            "--start-scen")
     xhat = ciutils.read_xhat(cfg.xhatpath)
-    batch_size = cfg.MMW_batch_size or cfg.num_scens
     start = cfg.start_scen if cfg.start_scen is not None else cfg.num_scens
+    # batch_size=None lets MMWConfidenceIntervals resolve it (single source)
     mmw = MMWConfidenceIntervals(mname, cfg, xhat, cfg.MMW_num_batches,
-                                 batch_size=batch_size, start=start)
+                                 batch_size=cfg.MMW_batch_size, start=start)
     result = mmw.run(confidence_level=cfg.confidence_level)
     print(result)
     return result
