@@ -184,6 +184,7 @@ class ScenarioBatch:
     is_int: np.ndarray     # (n,) bool (shared across scenarios)
     const: np.ndarray      # (S,)
     tree: TreeInfo
+    var_names: list | None = None  # (n,) shared column names, if known
 
     @classmethod
     def from_problems(cls, problems: list[ScenarioProblem]) -> "ScenarioBatch":
@@ -205,6 +206,11 @@ class ScenarioBatch:
         for p in problems:
             if not np.array_equal(p.is_int, is_int):
                 raise ValueError("integer pattern must match across scenarios")
+        # Column names are only meaningful if every scenario agrees; degrade to
+        # index labels otherwise (never mislabel a checkpoint column).
+        var_names = problems[0].var_names
+        if any(p.var_names != var_names for p in problems):
+            var_names = None
 
         return cls(
             names=[p.name for p in problems],
@@ -218,6 +224,7 @@ class ScenarioBatch:
             is_int=is_int,
             const=np.array([p.const for p in problems]),
             tree=tree,
+            var_names=var_names,
         )
 
     @property

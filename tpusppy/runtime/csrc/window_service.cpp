@@ -25,6 +25,7 @@
 #include <fcntl.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <time.h>
 #include <unistd.h>
 
 namespace {
@@ -163,25 +164,45 @@ int64_t ws_put(void* handle, int box, const double* values, int64_t n) {
   int64_t id = bh->write_id.load(std::memory_order_acquire);
   if (id == kKillId) return kKillId;  // terminal (Mailbox.put parity)
   uint64_t s = bh->seq.load(std::memory_order_relaxed);
-  bh->seq.store(s + 1, std::memory_order_release);  // odd: write in progress
+  bh->seq.store(s + 1, std::memory_order_relaxed);  // odd: write in progress
+  // Standard seqlock write idiom: the fence orders the odd-seq store before
+  // the payload writes on every architecture (a release store alone does not
+  // keep *subsequent* writes after it).
+  std::atomic_thread_fence(std::memory_order_seq_cst);
   std::memcpy(box_payload(h->base, d.offset), values, n * sizeof(double));
-  bh->write_id.store(id + 1, std::memory_order_release);
-  bh->seq.store(s + 2, std::memory_order_release);  // even: stable
+  bh->write_id.store(id + 1, std::memory_order_relaxed);
+  std::atomic_thread_fence(std::memory_order_release);
+  bh->seq.store(s + 2, std::memory_order_relaxed);  // even: stable
   return id + 1;
 }
 
-// Reader-side Get: consistent snapshot; returns the write_id.
-int64_t ws_get(void* handle, int box, double* out, int64_t n) {
+// Reader-side Get: consistent snapshot; returns the write_id, or -3 if the
+// sequence never stabilized within timeout_us microseconds (writer died or
+// stalled mid-put; timeout_us <= 0 means wait forever, with backoff).
+int64_t ws_get(void* handle, int box, double* out, int64_t n,
+               int64_t timeout_us) {
   auto* h = static_cast<Handle*>(handle);
   BoxDesc d = descs(h->base)[box];
   if (n != static_cast<int64_t>(d.length)) return -2;
   BoxHead* bh = box_head(h->base, d.offset);
-  while (true) {
+  // A put is a memcpy of at most a few MB: microseconds.  Spin briefly, then
+  // back off with nanosleep so a writer that crashed mid-put (seq left odd
+  // forever) cannot wedge readers in a 100%-CPU loop.
+  constexpr int64_t kSpins = 1 << 14;
+  for (int64_t attempt = 0;; ++attempt) {
+    if (attempt >= kSpins) {
+      if (timeout_us > 0 && (attempt - kSpins) * 100 >= timeout_us) return -3;
+      struct timespec ts = {0, 100000};  // 100us
+      nanosleep(&ts, nullptr);
+    }
     uint64_t s0 = bh->seq.load(std::memory_order_acquire);
     if (s0 & 1u) continue;  // writer mid-flight
-    int64_t id = bh->write_id.load(std::memory_order_acquire);
+    int64_t id = bh->write_id.load(std::memory_order_relaxed);
     std::memcpy(out, box_payload(h->base, d.offset), n * sizeof(double));
-    uint64_t s1 = bh->seq.load(std::memory_order_acquire);
+    // Fence before re-reading seq: orders the payload reads before the
+    // validation load (the mirror of the writer-side fences).
+    std::atomic_thread_fence(std::memory_order_acquire);
+    uint64_t s1 = bh->seq.load(std::memory_order_relaxed);
     if (s0 == s1) return id;
   }
 }

@@ -57,7 +57,7 @@ def load_library() -> ctypes.CDLL:
         lib.ws_get.restype = ctypes.c_int64
         lib.ws_get.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                ctypes.POINTER(ctypes.c_double),
-                               ctypes.c_int64]
+                               ctypes.c_int64, ctypes.c_int64]
         lib.ws_write_id.restype = ctypes.c_int64
         lib.ws_write_id.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.ws_kill.argtypes = [ctypes.c_void_p, ctypes.c_int]
@@ -129,14 +129,23 @@ class ShmMailbox:
             raise RuntimeError("length mismatch in ws_put")
         return int(rc)
 
-    def get(self):
+    def get(self, timeout=60.0):
+        """Snapshot (values, write_id).  ``timeout`` (seconds) bounds the wait
+        for a stable snapshot; <= 0 waits forever (with sleep backoff, so a
+        dead writer never spins a reader at 100% CPU)."""
         out = np.empty(self.length, dtype=np.float64)
         wid = self.segment._lib.ws_get(
             self.segment._handle, self.box,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), self.length,
+            int(timeout * 1e6),
         )
         if wid == -2:
             raise RuntimeError("length mismatch in ws_get")
+        if wid == -3:
+            raise RuntimeError(
+                f"ShmMailbox {self.name}: no stable snapshot within "
+                f"{timeout}s (writer died or stalled mid-put)"
+            )
         return out, int(wid)
 
     def kill(self):

@@ -122,6 +122,15 @@ class SPBase:
         """Gather packed nonant vector(s) (…, K) from full x (…, n)."""
         return np.asarray(x)[..., self.tree.nonant_indices]
 
+    @property
+    def nonant_var_names(self) -> list:
+        """Names of the packed nonant slots (for checkpoint files interchange-
+        able with reference wxbarutils CSVs); slot indices when unnamed."""
+        vn = self.batch.var_names
+        if vn is None:
+            return [str(k) for k in range(self.nonant_length)]
+        return [vn[i] for i in self.tree.nonant_indices]
+
     # ---- reporting ----------------------------------------------------------
     def report_var_values_at_rank0(self, x, max_rows=40):
         """Pretty table of nonant values (spbase.py:584-616)."""

@@ -2,8 +2,12 @@
 
 TPU-native analogue of ``mpisppy/utils/wxbarutils.py`` (395 LoC): W and xbar
 vectors written each iteration and read back to warm-start a later run
-(single csv or per-scenario files).  Formats: W rows are
-``scenario,slot,value``; xbar rows are ``slot,value``.
+(single csv or per-scenario files).  Row formats match the reference so
+checkpoints interchange with mpi-sppy runs: W rows are
+``scenario,varname,value`` (wxbarutils.py:42-100); xbar rows are
+``varname,value``.  Variable names come from the IR's column names
+(``SPBase.nonant_var_names``); when a model was built without names the slot
+index is written in the name field, and the reader resolves either form.
 """
 
 from __future__ import annotations
@@ -14,8 +18,30 @@ import os
 import numpy as np
 
 
+def _name_resolver(opt):
+    """name -> packed nonant slot; accepts var names or literal slot indices."""
+    names = opt.nonant_var_names
+    table = {nm: k for k, nm in enumerate(names)}
+
+    def resolve(key):
+        k = table.get(key)
+        if k is None:
+            try:
+                k = int(key)
+            except ValueError:
+                k = -1
+            if not 0 <= k < len(names):
+                raise KeyError(
+                    f"unknown nonant variable {key!r} in W/xbar file"
+                )
+        return k
+
+    return resolve
+
+
 def write_W_to_file(opt, fname, sep_files=False):
     """(wxbarutils.py:42-100)"""
+    names = opt.nonant_var_names
     if sep_files:
         os.makedirs(fname, exist_ok=True)
         for s, sname in enumerate(opt.all_scenario_names):
@@ -23,27 +49,28 @@ def write_W_to_file(opt, fname, sep_files=False):
                       newline="") as f:
                 w = csv.writer(f)
                 for k in range(opt.nonant_length):
-                    w.writerow([k, repr(float(opt.W[s, k]))])
+                    w.writerow([names[k], repr(float(opt.W[s, k]))])
         return
     with open(fname, "a", newline="") as f:
         w = csv.writer(f)
         for s, sname in enumerate(opt.all_scenario_names):
             for k in range(opt.nonant_length):
-                w.writerow([sname, k, repr(float(opt.W[s, k]))])
+                w.writerow([sname, names[k], repr(float(opt.W[s, k]))])
 
 
 def set_W_from_file(fname, opt, sep_files=False):
     """(wxbarutils.py:101-180)"""
     W = np.array(opt.W, copy=True)
+    resolve = _name_resolver(opt)
     name_to_idx = {nm: i for i, nm in enumerate(opt.all_scenario_names)}
     if sep_files:
         for sname, s in name_to_idx.items():
             path = os.path.join(fname, sname + "_weights.csv")
             with open(path) as f:
                 for row in csv.reader(f):
-                    if not row:
+                    if not row or row[0].startswith("#"):
                         continue
-                    W[s, int(row[0])] = float(row[1])
+                    W[s, resolve(row[0])] = float(row[1])
     else:
         with open(fname) as f:
             for row in csv.reader(f):
@@ -51,7 +78,7 @@ def set_W_from_file(fname, opt, sep_files=False):
                     continue
                 s = name_to_idx.get(row[0])
                 if s is not None:
-                    W[s, int(row[1])] = float(row[2])
+                    W[s, resolve(row[1])] = float(row[2])
     opt.W = W
     # consistency: probability-weighted W should sum ~0 per slot
     wsum = np.abs(opt.probs @ W).max()
@@ -61,18 +88,20 @@ def set_W_from_file(fname, opt, sep_files=False):
 
 def write_xbar_to_file(opt, fname):
     """(wxbarutils.py:181-220)"""
+    names = opt.nonant_var_names
     with open(fname, "a", newline="") as f:
         w = csv.writer(f)
         for k in range(opt.nonant_length):
-            w.writerow([k, repr(float(opt.xbars[0, k]))])
+            w.writerow([names[k], repr(float(opt.xbars[0, k]))])
 
 
 def set_xbar_from_file(fname, opt):
     """(wxbarutils.py:221-260)"""
     xb = np.array(opt.xbars, copy=True)
+    resolve = _name_resolver(opt)
     with open(fname) as f:
         for row in csv.reader(f):
             if not row or row[0].startswith("#"):
                 continue
-            xb[:, int(row[0])] = float(row[1])
+            xb[:, resolve(row[0])] = float(row[1])
     opt.xbars = xb
