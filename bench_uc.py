@@ -1,0 +1,178 @@
+"""UC benchmark: integer stochastic unit commitment at scale.
+
+The reference's headline family is 1000-scenario stochastic UC with integer
+commitment (paperruns/larger_uc/quartz/1000scen_fw:1-16, examples/uc/
+uc_cylinders.py:74-80).  Two numbers:
+
+- ``ph_iters_per_sec``: hub PH iteration rate over the S-scenario integer UC
+  (LP-relaxed subproblems — exactly what the PH hub iterates on here), on the
+  factorization-amortized sharded path.
+- ``wall_s_to_gap``: wall-clock for a full in-process wheel (PH hub +
+  Lagrangian outer bound + XhatShuffle integer-diving incumbents) to reach a
+  certified MIP gap of ``BENCH_UC_GAP`` (default 1%).
+
+``vs_baseline`` compares the PH iteration rate against the reference
+architecture on this host: serial per-scenario HiGHS MIP solves.
+
+Standalone: prints ONE JSON line.  Or imported by bench.py for the combined
+line (`uc_metrics()`).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def uc_metrics():
+    import jax
+
+    import tpusppy
+
+    tpusppy.disable_tictoc_output()
+    from tpusppy.ir import ScenarioBatch
+    from tpusppy.models import uc_lite
+    from tpusppy.parallel import sharded
+    from tpusppy.solvers import scipy_backend
+    from tpusppy.solvers.admm import ADMMSettings
+
+    S = int(os.environ.get("BENCH_UC_SCENS", "1000"))
+    gens = int(os.environ.get("BENCH_UC_GENS", "5"))
+    horizon = int(os.environ.get("BENCH_UC_HORIZON", "12"))
+    iters = int(os.environ.get("BENCH_UC_ITERS", "30"))
+    refresh_every = max(1, int(os.environ.get("BENCH_REFRESH", "16")))
+    gap_target = float(os.environ.get("BENCH_UC_GAP", "0.01"))
+
+    platform = jax.devices()[0].platform
+    dtype = "float32" if platform != "cpu" else "float64"
+    if dtype == "float64":
+        jax.config.update("jax_enable_x64", True)
+    eps = 1e-5 if dtype == "float32" else 1e-8
+    settings = ADMMSettings(
+        dtype=dtype, eps_abs=eps, eps_rel=eps, max_iter=200, restarts=2,
+        scaling_iters=6, polish_passes=1,
+    )
+
+    kw = {"num_gens": gens, "horizon": horizon, "num_scens": S,
+          "relax_integers": False}
+    names = uc_lite.scenario_names_creator(S)
+    batch = ScenarioBatch.from_problems(
+        [uc_lite.scenario_creator(nm, **kw) for nm in names])
+    log(f"uc batch: {batch.num_scenarios} x ({batch.num_rows} rows, "
+        f"{batch.num_vars} vars, {int(batch.is_int.sum())} ints)")
+
+    # ---- metric 1: hub PH iteration rate ---------------------------------
+    mesh = sharded.make_mesh()
+    arr = sharded.shard_batch(batch, mesh)
+    refresh, frozen = sharded.make_ph_step_pair(
+        batch.tree.nonant_indices, settings, mesh)
+    state = sharded.init_state(arr, 1.0, settings)
+    t0 = time.time()
+    state, out, _ = refresh(state, arr, 0.0)
+    np.asarray(out.conv)
+    log(f"uc compile+iter0: {time.time() - t0:.1f}s "
+        f"eobj={float(np.asarray(out.eobj)):.2f}")
+    state, out, factors = refresh(state, arr, 1.0)
+    state, out = frozen(state, arr, 1.0, factors)
+    np.asarray(out.conv)
+
+    t0 = time.time()
+    for i in range(iters):
+        if i % refresh_every == 0:
+            state, out, factors = refresh(state, arr, 1.0)
+        else:
+            state, out = frozen(state, arr, 1.0, factors)
+    conv = float(np.asarray(out.conv))
+    iters_per_sec = iters / (time.time() - t0)
+    log(f"uc PH: {iters_per_sec:.3f} iters/sec (conv={conv:.3e})")
+
+    # baseline: serial per-scenario HiGHS MIP loop (reference architecture)
+    sample = min(8, S)
+    t0 = time.time()
+    for s in range(sample):
+        scipy_backend.solve_lp(
+            batch.c[s], batch.A[s], batch.cl[s], batch.cu[s],
+            batch.lb[s], batch.ub[s], is_int=batch.is_int,
+            mip_rel_gap=1e-4, time_limit=60,
+        )
+    t_mip = (time.time() - t0) / sample
+    base_ips = 1.0 / (t_mip * S)
+    log(f"uc baseline (serial HiGHS MIP): {t_mip*1e3:.1f} ms/scenario "
+        f"=> {base_ips:.4f} iters/sec")
+
+    # ---- metric 2: wall-clock to certified MIP gap (full wheel) ----------
+    from tpusppy.cylinders import (
+        LagrangianOuterBound, PHHub, XhatShuffleInnerBound)
+    from tpusppy.opt.ph import PH
+    from tpusppy.phbase import PHBase
+    from tpusppy.spin_the_wheel import WheelSpinner
+    from tpusppy.xhat_eval import Xhat_Eval
+
+    # cold UC batches need the full adaptive budget (per-row rho boosts act
+    # between restarts); warm/frozen iterations terminate early on residuals,
+    # and the straggler-rescue path host-solves whatever still resists
+    so = {"dtype": dtype, "eps_abs": eps, "eps_rel": eps, "max_iter": 1000,
+          "restarts": 6, "scaling_iters": 10, "polish_passes": 1}
+
+    def okw(iters=60):
+        return {
+            "options": {"defaultPHrho": 20.0, "PHIterLimit": iters,
+                        "convthresh": -1.0, "xhat_dive_rounds": 16,
+                        "solver_options": so,
+                        "xhat_looper_options": {"scen_limit": 3}},
+            "all_scenario_names": names,
+            "scenario_creator": uc_lite.scenario_creator,
+            "scenario_creator_kwargs": kw,
+        }
+
+    hub_dict = {
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": {"rel_gap": gap_target}},
+        "opt_class": PH,
+        "opt_kwargs": okw(int(os.environ.get("BENCH_UC_PH_ITERS", "40"))),
+    }
+    spokes = [
+        {"spoke_class": LagrangianOuterBound, "opt_class": PHBase,
+         "opt_kwargs": okw()},
+        {"spoke_class": XhatShuffleInnerBound, "opt_class": Xhat_Eval,
+         "opt_kwargs": okw()},
+    ]
+    t0 = time.time()
+    ws = WheelSpinner(hub_dict, spokes).spin()
+    wall = time.time() - t0
+    ib, ob = ws.BestInnerBound, ws.BestOuterBound
+    gap = (ib - ob) / max(abs(ib), 1e-9) if np.isfinite(ib) else float("inf")
+    log(f"uc wheel: {wall:.1f}s inner={ib:.2f} outer={ob:.2f} "
+        f"gap={gap*100:.2f}%")
+
+    return {
+        "ph_iters_per_sec": round(iters_per_sec, 4),
+        "vs_baseline": round(iters_per_sec / base_ips, 2),
+        "S": S,
+        "wall_s_to_gap": round(wall, 1),
+        "gap_pct": round(gap * 100, 3),
+        "gap_target_pct": gap_target * 100,
+        "certified": bool(np.isfinite(ib) and np.isfinite(ob)
+                          and gap <= gap_target + 1e-9),
+    }
+
+
+def main():
+    m = uc_metrics()
+    print(json.dumps({
+        "metric": f"ph_iters_per_sec_uc{m['S']}",
+        "value": m["ph_iters_per_sec"],
+        "unit": "iter/s",
+        "vs_baseline": m["vs_baseline"],
+        "uc": m,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -35,7 +35,11 @@ def main():
         path = os.path.join(EXDIR, script)
         cmd = [sys.executable, path] + args
         print("==>", " ".join(cmd), flush=True)
-        res = subprocess.run(cmd, cwd=os.path.dirname(path))
+        # drivers import tpusppy from the repo root regardless of caller cwd
+        env = dict(os.environ)
+        root = os.path.dirname(EXDIR)
+        env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        res = subprocess.run(cmd, cwd=os.path.dirname(path), env=env)
         if res.returncode != 0:
             badguys.append(script)
     if badguys:

@@ -1,0 +1,75 @@
+"""Aircond (multistage production/inventory) cylinders driver.
+
+Behavioral analogue of the reference's ``examples/aircond/aircond_cylinders.py``:
+multistage PH hub + lagrangian / lagranger / fwph / xhatshuffle spokes over a
+branching-factor tree (the reference's MPI smoke test drives exactly this
+combination, straight_tests.py).  Example::
+
+    python aircond_cylinders.py --branching-factors "3 2" \
+        --max-iterations 30 --default-rho 1.0 --rel-gap 0.02 \
+        --lagrangian --xhatshuffle
+"""
+
+from tpusppy.models import aircond
+from tpusppy.spin_the_wheel import WheelSpinner
+from tpusppy.utils import cfg_vanilla as vanilla
+from tpusppy.utils import config
+
+write_solution = True
+
+
+def _parse_args():
+    cfg = config.Config()
+    cfg.multistage()
+    cfg.popular_args()
+    cfg.two_sided_args()
+    cfg.ph_args()
+    cfg.fwph_args()
+    cfg.lagrangian_args()
+    cfg.lagranger_args()
+    cfg.xhatshuffle_args()
+    aircond.inparser_adder(cfg)
+    cfg.parse_command_line("aircond_cylinders")
+    return cfg
+
+
+def main():
+    cfg = _parse_args()
+    if cfg.default_rho is None:
+        raise RuntimeError("specify --default-rho")
+    if cfg.branching_factors is None:
+        raise RuntimeError("specify --branching-factors (e.g. \"3 2\")")
+    bf = [int(f) for f in cfg.branching_factors]
+    num_scens = 1
+    for f in bf:
+        num_scens *= f
+    all_scenario_names = aircond.scenario_names_creator(num_scens)
+    kw = aircond.kw_creator(cfg)
+    kw["branching_factors"] = bf
+    beans = dict(
+        cfg=cfg, scenario_creator=aircond.scenario_creator,
+        scenario_denouement=aircond.scenario_denouement,
+        all_scenario_names=all_scenario_names,
+        scenario_creator_kwargs=kw,
+    )
+    hub_dict = vanilla.ph_hub(**beans)
+
+    spokes = []
+    if cfg.fwph:
+        spokes.append(vanilla.fwph_spoke(**beans))
+    if cfg.lagrangian:
+        spokes.append(vanilla.lagrangian_spoke(**beans))
+    if cfg.lagranger:
+        spokes.append(vanilla.lagranger_spoke(**beans))
+    if cfg.xhatshuffle:
+        spokes.append(vanilla.xhatshuffle_spoke(**beans))
+
+    ws = WheelSpinner(hub_dict, spokes)
+    ws.spin()
+    if write_solution:
+        ws.write_first_stage_solution("aircond_first_stage.csv")
+    return ws
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Hydro (3-stage) cylinders driver.
+
+Behavioral analogue of the reference's ``examples/hydro/hydro_cylinders.py``:
+multistage PH hub + lagrangian / xhatshuffle / xhatspecific spokes over the
+branching-factor tree.  Example::
+
+    python hydro_cylinders.py --branching-factors "3 3" --max-iterations 50 \
+        --default-rho 1.0 --rel-gap 0.01 --lagrangian --xhatshuffle
+"""
+
+from tpusppy.models import hydro
+from tpusppy.spin_the_wheel import WheelSpinner
+from tpusppy.utils import cfg_vanilla as vanilla
+from tpusppy.utils import config
+
+write_solution = True
+
+
+def _parse_args():
+    cfg = config.Config()
+    cfg.multistage()
+    cfg.popular_args()
+    cfg.two_sided_args()
+    cfg.ph_args()
+    cfg.fwph_args()
+    cfg.lagrangian_args()
+    cfg.xhatshuffle_args()
+    cfg.xhatspecific_args()
+    cfg.parse_command_line("hydro_cylinders")
+    return cfg
+
+
+def main():
+    cfg = _parse_args()
+    if cfg.default_rho is None:
+        raise RuntimeError("specify --default-rho")
+    if cfg.branching_factors is None:
+        raise RuntimeError("specify --branching-factors (e.g. \"3 3\")")
+    bf = cfg.branching_factors
+    num_scens = 1
+    for f in bf:
+        num_scens *= int(f)
+    all_scenario_names = hydro.scenario_names_creator(num_scens)
+    kw = hydro.kw_creator(cfg)
+    beans = dict(
+        cfg=cfg, scenario_creator=hydro.scenario_creator,
+        scenario_denouement=hydro.scenario_denouement,
+        all_scenario_names=all_scenario_names,
+        scenario_creator_kwargs=kw,
+    )
+    hub_dict = vanilla.ph_hub(**beans)
+
+    spokes = []
+    if cfg.lagrangian:
+        spokes.append(vanilla.lagrangian_spoke(**beans))
+    if cfg.xhatshuffle:
+        spokes.append(vanilla.xhatshuffle_spoke(**beans))
+    if getattr(cfg, "xhatspecific", False):
+        # fixed candidate: the first scenario under each nonleaf node
+        xhat_dict = {"ROOT": all_scenario_names[0]}
+        for i in range(int(bf[0])):
+            xhat_dict[f"ROOT_{i}"] = all_scenario_names[i * int(bf[1])]
+        spokes.append(vanilla.xhatspecific_spoke(
+            xhat_scenario_dict=xhat_dict, **beans))
+
+    ws = WheelSpinner(hub_dict, spokes)
+    ws.spin()
+    if write_solution:
+        ws.write_first_stage_solution("hydro_first_stage.csv")
+    return ws
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""Network design cylinders driver.
+
+Behavioral analogue of the reference's ``examples/netdes/netdes_cylinders.py``:
+PH hub + fwph / lagrangian / xhat spokes + cross-scenario cuts (the family the
+reference uses to showcase them).  Example::
+
+    python netdes_cylinders.py --num-scens 4 --max-iterations 30 \
+        --default-rho 1.0 --rel-gap 0.02 --lagrangian --xhatshuffle \
+        --cross-scenario-cuts
+"""
+
+from tpusppy.models import netdes
+from tpusppy.spin_the_wheel import WheelSpinner
+from tpusppy.utils import cfg_vanilla as vanilla
+from tpusppy.utils import config
+
+write_solution = True
+
+
+def _parse_args():
+    cfg = config.Config()
+    cfg.num_scens_required()
+    cfg.popular_args()
+    cfg.two_sided_args()
+    cfg.ph_args()
+    cfg.fwph_args()
+    cfg.lagrangian_args()
+    cfg.xhatlooper_args()
+    cfg.xhatshuffle_args()
+    cfg.slammax_args()
+    cfg.cross_scenario_cuts_args()
+    netdes.inparser_adder(cfg)
+    cfg.parse_command_line("netdes_cylinders")
+    return cfg
+
+
+def main():
+    cfg = _parse_args()
+    if cfg.default_rho is None:
+        raise RuntimeError("specify --default-rho")
+    all_scenario_names = netdes.scenario_names_creator(cfg.num_scens)
+    kw = netdes.kw_creator(cfg)
+    beans = dict(
+        cfg=cfg, scenario_creator=netdes.scenario_creator,
+        scenario_denouement=netdes.scenario_denouement,
+        all_scenario_names=all_scenario_names,
+        scenario_creator_kwargs=kw,
+    )
+    hub_dict = vanilla.ph_hub(**beans)
+    if cfg.cross_scenario_cuts:
+        vanilla.add_cross_scenario_cuts(hub_dict, cfg)
+
+    spokes = []
+    if cfg.fwph:
+        spokes.append(vanilla.fwph_spoke(**beans))
+    if cfg.lagrangian:
+        spokes.append(vanilla.lagrangian_spoke(**beans))
+    if cfg.xhatlooper:
+        spokes.append(vanilla.xhatlooper_spoke(**beans))
+    if cfg.xhatshuffle:
+        spokes.append(vanilla.xhatshuffle_spoke(**beans))
+    if cfg.slammax:
+        spokes.append(vanilla.slammax_spoke(**beans))
+    if cfg.cross_scenario_cuts:
+        spokes.append(vanilla.cross_scenario_cuts_spoke(**beans))
+
+    ws = WheelSpinner(hub_dict, spokes)
+    ws.spin()
+    if write_solution:
+        ws.write_first_stage_solution("netdes_first_stage.csv")
+    return ws
+
+
+if __name__ == "__main__":
+    main()

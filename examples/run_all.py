@@ -1,0 +1,83 @@
+"""Run ALL example drivers end-to-end; collect failures in `badguys`.
+
+The analogue of the reference's ``examples/run_all.py`` (the de-facto
+regression harness per examples/AAAReadme.txt / SURVEY §4): every family's
+cylinder driver runs at small scale, exit status asserted.  ``afew.py`` is
+the quick subset.  Usage::
+
+    python run_all.py            # everything
+    python run_all.py nouc       # skip the UC family (reference flag parity)
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+EXDIR = os.path.dirname(os.path.abspath(__file__))
+
+RUNS = [
+    ("farmer/farmer_ef.py",
+     ["--num-scens", "3", "--EF-solver-name", "admm"]),
+    ("farmer/farmer_ef.py",
+     ["--num-scens", "3", "--EF-solver-name", "highs"]),
+    ("farmer/farmer_cylinders.py",
+     ["--num-scens", "3", "--max-iterations", "20", "--default-rho", "1.0",
+      "--rel-gap", "0.01", "--lagrangian", "--xhatshuffle"]),
+    ("farmer/farmer_cylinders.py",
+     ["--num-scens", "3", "--max-iterations", "10", "--default-rho", "1.0",
+      "--rel-gap", "0.02", "--fwph", "--lagranger", "--xhatlooper"]),
+    ("sizes/sizes_cylinders.py",
+     ["--num-scens", "3", "--max-iterations", "30", "--default-rho", "0.01",
+      "--rel-gap", "0.05", "--lagrangian", "--xhatshuffle"]),
+    ("sslp/sslp_cylinders.py",
+     ["--num-scens", "4", "--max-iterations", "20", "--default-rho", "5.0",
+      "--rel-gap", "0.05", "--lagrangian", "--xhatshuffle"]),
+    ("netdes/netdes_cylinders.py",
+     ["--num-scens", "3", "--max-iterations", "20", "--default-rho", "1.0",
+      "--rel-gap", "0.05", "--lagrangian", "--xhatshuffle"]),
+    ("netdes/netdes_cylinders.py",
+     ["--num-scens", "3", "--max-iterations", "12", "--default-rho", "1.0",
+      "--rel-gap", "0.05", "--cross-scenario-cuts", "--xhatshuffle"]),
+    ("hydro/hydro_cylinders.py",
+     ["--branching-factors", "3 3", "--max-iterations", "20",
+      "--default-rho", "1.0", "--rel-gap", "0.02", "--lagrangian",
+      "--xhatshuffle"]),
+    ("aircond/aircond_cylinders.py",
+     ["--branching-factors", "3 2", "--max-iterations", "10",
+      "--default-rho", "1.0", "--rel-gap", "0.05", "--lagrangian",
+      "--xhatshuffle"]),
+    ("uc/uc_cylinders.py",
+     ["--num-scens", "4", "--uc-num-gens", "3", "--uc-horizon", "6",
+      "--max-iterations", "20", "--default-rho", "50.0",
+      "--rel-gap", "0.02", "--lagrangian", "--xhatshuffle"]),
+]
+
+
+def main():
+    skip_uc = "nouc" in sys.argv[1:]
+    badguys = []
+    for script, args in RUNS:
+        if skip_uc and script.startswith("uc/"):
+            continue
+        path = os.path.join(EXDIR, script)
+        cmd = [sys.executable, path] + args
+        print("==>", " ".join(cmd), flush=True)
+        # drivers import tpusppy from the repo root regardless of caller cwd
+        env = dict(os.environ)
+        root = os.path.dirname(EXDIR)
+        env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        res = subprocess.run(cmd, cwd=os.path.dirname(path), env=env)
+        if res.returncode != 0:
+            badguys.append(script + " " + " ".join(args))
+    if badguys:
+        print("BAD GUYS:")
+        for b in badguys:
+            print("  ", b)
+        sys.exit(1)
+    print(f"All {len(RUNS)} example runs succeeded.")
+
+
+if __name__ == "__main__":
+    main()

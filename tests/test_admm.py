@@ -88,6 +88,81 @@ class TestRandomLPs:
         assert obj2 == pytest.approx(obj1, abs=1e-5)
 
 
+class TestFrozenFactors:
+    """Factorization-amortized path: factors from an adaptive refresh are
+    reused by sweep-only solves on PH-style perturbed objectives."""
+
+    def _stack(self, rng, S=12, n=8, m=6):
+        probs = [random_feasible_lp(rng, n, m) for _ in range(S)]
+        return [np.stack([p[i] for p in probs]) for i in range(6)]
+
+    def test_frozen_matches_adaptive_on_perturbed_q(self):
+        from tpusppy.solvers.admm import solve_batch_factored, solve_batch_frozen
+
+        import dataclasses
+
+        rng = np.random.RandomState(3)
+        c, A, cl, cu, lb, ub = self._stack(rng)
+        S, n = c.shape
+        q2 = np.full((S, n), 0.5)          # strongly convex: unique optimum
+        # eps tighter than the asserts but reachable within one sweep budget
+        # (the frozen path has no restarts: OSQP-relative convergence at
+        # eps=1e-9 can need a final rho re-adaptation it doesn't have)
+        st = dataclasses.replace(SETTINGS, eps_abs=1e-7, eps_rel=1e-7)
+        sol0, factors = solve_batch_factored(c, q2, A, cl, cu, lb, ub, st)
+        assert float(np.max(sol0.pri_res)) < 1e-6
+
+        # PH-style: only the linear term moves (a little) between iterations
+        qp = c + 0.05 * rng.normal(size=c.shape)
+        frz = solve_batch_frozen(qp, q2, A, cl, cu, lb, ub, factors, st,
+                                 warm=sol0.raw)
+        ada = solve_batch(qp, q2, A, cl, cu, lb, ub, st, warm=sol0.raw)
+        assert int(frz.iters[0]) < st.max_iter    # converged within budget
+        assert float(np.max(frz.pri_res)) < 5e-6  # OSQP-relative at eps=1e-7
+        assert float(np.max(frz.dua_res)) < 5e-6
+        np.testing.assert_allclose(np.asarray(frz.x), np.asarray(ada.x),
+                                   atol=1e-4)
+
+        # a LARGE objective change can outgrow the frozen rho: the contract
+        # is detectability — budget exhaustion shows in ``iters`` (this is
+        # what SPOpt.solve_loop uses to fall back to an adaptive refresh)
+        qbig = c + 0.5 * rng.normal(size=c.shape)
+        frz2 = solve_batch_frozen(qbig, q2, A, cl, cu, lb, ub, factors, st,
+                                  warm=sol0.raw)
+        bad = (np.asarray(frz2.pri_res) > 1e-6) | (np.asarray(frz2.dua_res)
+                                                   > 1e-6)
+        assert (not bad.any()) or int(frz2.iters[0]) >= st.max_iter
+
+    def test_solve_loop_frozen_refresh_cycle(self):
+        """SPOpt.solve_loop alternates refresh/frozen transparently and keeps
+        returning correct solutions as the PH objective moves."""
+        from tpusppy.spopt import SPOpt
+
+        n = 3
+        names = farmer.scenario_names_creator(n)
+        opt = SPOpt({"solver_refresh_every": 8,
+                     "solver_options": {"max_iter": 2000, "restarts": 8,
+                                        "eps_abs": 1e-9, "eps_rel": 1e-9}},
+                    names, farmer.scenario_creator,
+                    scenario_creator_kwargs={"num_scens": n})
+        b = opt.batch
+        ref = scipy_backend.solve_batch(b, mip=False)
+        rng = np.random.RandomState(4)
+        opt.solve_loop()          # refresh (cold)
+        for it in range(4):       # frozen iterations on perturbed objectives
+            q = b.c + rng.normal(scale=1e-3 * np.abs(b.c).max(),
+                                 size=b.c.shape)
+            x = opt.solve_loop(q=q)
+            # residuals are OSQP-relative: scale tolerance by problem norms
+            assert opt.pri_res.max() < 1e-5
+        # back to the ORIGINAL objective: must recover the HiGHS optimum
+        x = opt.solve_loop()
+        objs = b.objective(x)
+        for s in range(n):
+            assert objs[s] == pytest.approx(ref[s].obj, rel=1e-5)
+        assert opt._factors_age > 1   # the frozen path was actually exercised
+
+
 class TestFarmerADMM:
     def make_batch(self, num_scens=3):
         names = farmer.scenario_names_creator(num_scens)

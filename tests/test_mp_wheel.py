@@ -1,0 +1,56 @@
+"""Cross-process wheel: hub + spoke OS processes over the C++ shm fabric.
+
+The reference's cylinders are MPI process groups exchanging one-sided RMA
+windows (spin_the_wheel.py:219-237); this exercises our equivalent — spokes
+as spawned processes, seqlock shm mailboxes with write-id + kill-sentinel
+semantics (runtime/csrc/window_service.cpp) — end to end on farmer.
+"""
+
+import numpy as np
+import pytest
+
+from tpusppy.models import farmer
+from tpusppy.opt.ph import PH
+from tpusppy.phbase import PHBase
+from tpusppy.spin_the_wheel import MultiprocessWheelSpinner
+from tpusppy.xhat_eval import Xhat_Eval
+
+
+@pytest.mark.slow
+def test_mp_wheel_farmer_two_spokes():
+    from tpusppy.cylinders import LagrangianOuterBound, PHHub, XhatShuffleInnerBound
+
+    n = 3
+    names = farmer.scenario_names_creator(n)
+    kw = {"num_scens": n}
+
+    def okw(iters):
+        return {
+            "options": {"defaultPHrho": 1.0, "PHIterLimit": iters,
+                        "convthresh": -1.0,
+                        "xhat_looper_options": {"scen_limit": 2}},
+            "all_scenario_names": names,
+            "scenario_creator": farmer.scenario_creator,
+            "scenario_creator_kwargs": kw,
+        }
+
+    hub_dict = {
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": {"rel_gap": 0.01}},
+        "opt_class": PH,
+        "opt_kwargs": okw(20),
+    }
+    spokes = [
+        {"spoke_class": LagrangianOuterBound, "opt_class": PHBase,
+         "opt_kwargs": okw(60)},
+        {"spoke_class": XhatShuffleInnerBound, "opt_class": Xhat_Eval,
+         "opt_kwargs": okw(60)},
+    ]
+    ws = MultiprocessWheelSpinner(hub_dict, spokes).spin()
+    # bounds crossed the process boundary and bracket the optimum (farmer
+    # EF golden -108390); kill signal terminated the children cleanly
+    assert np.isfinite(ws.BestInnerBound)
+    assert np.isfinite(ws.BestOuterBound)
+    assert ws.BestOuterBound <= ws.BestInnerBound + 1e-6
+    assert ws.BestOuterBound <= -108390.0 + 60.0
+    assert ws.BestInnerBound >= -108390.0 - 60.0

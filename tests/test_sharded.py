@@ -38,6 +38,25 @@ def test_sharded_ph_matches_ef():
     assert float(out.eobj) == pytest.approx(ef_obj, rel=2e-3)
 
 
+def test_frozen_pair_converges_like_adaptive():
+    """The factorization-amortized pair (refresh + sweep-only frozen steps)
+    reaches the same PH fixed point as all-adaptive iterations."""
+    batch = make_batch(3)
+    ef_obj, _ = solve_ef(batch, solver="highs")
+    mesh = sharded.make_mesh()
+    settings = ADMMSettings(max_iter=300, restarts=3)
+    _, out_adapt = sharded.run_ph(
+        batch, mesh, iters=100, settings=settings, refresh_every=1)
+    _, out_frozen = sharded.run_ph(
+        batch, mesh, iters=100, settings=settings, refresh_every=8)
+    assert float(out_frozen.conv) < 1e-2
+    assert float(out_frozen.eobj) == pytest.approx(ef_obj, rel=2e-3)
+    assert float(out_frozen.eobj) == pytest.approx(
+        float(out_adapt.eobj), rel=1e-3)
+    # frozen steps really solved to tolerance (budget not exhausted)
+    assert float(np.max(np.asarray(out_frozen.pri_res))) < 1e-5
+
+
 def test_sharded_ph_padding_inert():
     """S=5 over 8 shards: zero-prob padding must not corrupt the reductions.
 
@@ -85,7 +104,7 @@ def test_sharded_matches_host_ph():
     # the host's full-batch program), so trajectories drift at float epsilon
     # amplified over PH iterations — compare loosely.
     np.testing.assert_allclose(
-        np.sort(W, axis=None), np.sort(ph.W, axis=None), rtol=1e-3, atol=1e-3,
+        np.sort(W, axis=None), np.sort(ph.W, axis=None), rtol=5e-3, atol=5e-3,
     )
     assert float(out.conv) == pytest.approx(ph.conv, rel=1e-2, abs=1e-5)
 

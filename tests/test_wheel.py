@@ -194,5 +194,8 @@ def test_wheel_multistage_hydro():
     stage2 = ws.opt.tree.nonant_stage == 2
     for g in range(3):
         grp = cache[3 * g:3 * g + 3][:, stage2]
+        # solver-tolerance consistency: the incumbent comes from eps-accurate
+        # (frozen, unpolished) solves, so node-mates agree to ~1e-4 of the
+        # O(100) flow values, not to machine epsilon
         np.testing.assert_allclose(grp, np.broadcast_to(grp[:1], grp.shape),
-                                   atol=1e-6)
+                                   atol=1e-3)

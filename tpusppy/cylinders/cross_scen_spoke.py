@@ -24,6 +24,58 @@ from .spoke import Spoke
 from ..solvers import admm
 
 
+def make_clamp_cuts(opt, xhat_sk: np.ndarray) -> np.ndarray:
+    """(S, K+1) optimality-cut rows from one batched clamp solve at xhat.
+
+    Cut semantics: row s bounds the SECOND-STAGE value function only,
+    ``Q2_s(x) >= g_s.x + const_s``.  Uses the weak-duality construction
+    (admm.dual_cut) with an exact-simplex host fallback where the batch
+    duals leave a cut gap — shared by the cut spoke and the hub-side
+    Benders refinement in CrossScenarioExtension.
+    """
+    b = opt.batch
+    idx = opt.tree.nonant_indices
+    q = np.array(b.c, copy=True)
+    q[:, idx] = 0.0
+    lb = np.array(b.lb, copy=True)
+    ub = np.array(b.ub, copy=True)
+    lb[:, idx] = xhat_sk
+    ub[:, idx] = xhat_sk
+    sol = admm.solve_batch(q, b.q2, b.A, b.cl, b.cu, lb, ub,
+                           settings=opt.admm_settings)
+    x = np.asarray(sol.x)
+    Q = (np.einsum("sn,sn->s", q, x)
+         + 0.5 * np.einsum("sn,sn->s", b.q2, x * x) + b.const)
+    import jax.numpy as jnp
+
+    from ..spopt import host_exact_clamp_cut
+
+    dt = opt.admm_settings.jdtype()
+    base, g_full = admm.dual_cut(
+        jnp.asarray(q, dt), jnp.asarray(b.q2, dt), jnp.asarray(b.A, dt),
+        jnp.asarray(b.cl, dt), jnp.asarray(b.cu, dt),
+        jnp.asarray(lb, dt), jnp.asarray(ub, dt),
+        sol.y, sol.x, jnp.asarray(b.nonant_mask()))
+    consts = np.asarray(base, dtype=float) + b.const
+    grads = np.asarray(g_full, dtype=float)[:, idx]
+    tol = max(opt.options.get("feas_tol", 1e-3),
+              10.0 * opt.admm_settings.eps_rel)
+    pri = np.asarray(sol.pri_res)
+    gap_w = Q - (consts + np.einsum("sk,sk->s", grads, xhat_sk))
+    cut_tol = 1e-5 * (1.0 + np.abs(Q))
+    ok = pri <= tol
+    for s in np.flatnonzero((pri > tol) | (gap_w > cut_tol)):
+        if np.any(b.q2[s] != 0.0):
+            continue
+        okay, _, cb, gs = host_exact_clamp_cut(b, q, s, lb, ub, idx)
+        if okay:
+            consts[s], grads[s] = cb, gs
+            ok[s] = True
+    rows = np.concatenate([grads, consts[:, None]], axis=1)
+    rows[~ok] = np.nan                           # consumers drop NaN rows
+    return rows
+
+
 class CrossScenarioCutSpoke(Spoke):
     converger_spoke_char = 'C'
 
@@ -51,27 +103,9 @@ class CrossScenarioCutSpoke(Spoke):
         return self._new_locals
 
     def make_cuts(self, xhat_sk: np.ndarray) -> np.ndarray:
-        """(S, K+1) cut rows from one batched clamp solve at the hub's x."""
-        opt = self.opt
-        b = opt.batch
-        idx = opt.tree.nonant_indices
-        lb = np.array(b.lb, copy=True)
-        ub = np.array(b.ub, copy=True)
-        lb[:, idx] = xhat_sk
-        ub[:, idx] = xhat_sk
-        sol = admm.solve_batch(b.c, b.q2, b.A, b.cl, b.cu, lb, ub,
-                               settings=opt.admm_settings)
-        x = np.asarray(sol.x)
-        Q = b.objective(x)
-        grads = -np.asarray(sol.yx)[:, idx]      # dQ/dxhat (Benders trick)
-        consts = Q - np.einsum("sk,sk->s", grads, xhat_sk)
-        # suppress cuts from solves that did not certify feasibility
-        tol = max(opt.options.get("feas_tol", 1e-3),
-                  10.0 * opt.admm_settings.eps_rel)
-        ok = np.asarray(sol.pri_res) <= tol
-        rows = np.concatenate([grads, consts[:, None]], axis=1)
-        rows[~ok] = np.nan                       # hub side drops NaN rows
-        return rows
+        """(S, K+1) cut rows from one batched clamp solve at the hub's x
+        (see :func:`make_clamp_cuts`)."""
+        return make_clamp_cuts(self.opt, xhat_sk)
 
     def main(self):
         while not self.got_kill_signal():

@@ -39,10 +39,9 @@ class LagrangerOuterBound(OuterBoundNonantSpoke):
                 and iternum in self.rho_rescale_factors:
             self.opt.rho = self.opt.rho * self.rho_rescale_factors[iternum]
         q, q2 = self.opt._augmented_q()
-        x = self.opt.solve_loop(q=q, q2=q2)
-        xk = self.opt.nonants_of(x)
-        extra = np.einsum("sk,sk->s", self.opt.W, xk)
-        return self.opt.Ebound(extra_obj=extra)
+        self.opt.solve_loop(q=q, q2=q2)
+        # certified dual-objective bound (see LagrangianOuterBound.lagrangian)
+        return self.opt.Edualbound(q=q, q2=q2)
 
     def _update_weights_and_solve(self, iternum) -> float:
         """Adopt hub x, recompute own xbar/W, solve

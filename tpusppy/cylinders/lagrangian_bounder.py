@@ -36,10 +36,11 @@ class LagrangianOuterBound(OuterBoundWSpoke):
         sourced with PH."""
         opt = self.opt
         q, q2 = opt._augmented_q()
-        x = opt.solve_loop(q=q, q2=q2)
-        xk = opt.nonants_of(x)
-        extra = np.einsum("sk,sk->s", opt.W, xk)
-        return opt.Ebound(extra_obj=extra)
+        opt.solve_loop(q=q, q2=q2)
+        # CERTIFIED bound: dual objective of the W-augmented subproblems
+        # (weak duality absorbs solver tolerance; an inexact primal objective
+        # can overshoot the true bound and falsely certify rel_gap)
+        return opt.Edualbound(q=q, q2=q2)
 
     def _set_weights_and_solve(self) -> float:
         self.opt.W = np.asarray(self.localWs, dtype=float).copy()

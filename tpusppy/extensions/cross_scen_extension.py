@@ -28,14 +28,155 @@ class CrossScenarioExtension(Extension):
         so = opt.options.get("cross_scen_options", {})
         self.check_bound_iterations = so.get("check_bound_improve_iterations",
                                              4)
+        self.max_cut_rounds = int(so.get("max_cut_rounds", 32))
         self._cuts = []            # list of (S, K+1) arrays
         self._last_lb = -np.inf
+        self._phi_col = None       # set by pre_iter0's batch reform
+        self._cut_row0 = None
+        self._next_row = None
+        self._q2lb = None          # certified per-scenario Q2 lower bounds
+
+    # ---- in-batch reform (cross_scen_extension.py:120-283 analogue) --------
+    def pre_iter0(self):
+        """Reshape the scenario batch: one aggregate ``phi`` column (the
+        epigraph of the OTHER scenarios' probability-weighted costs — the
+        reference's per-scenario eta vector, aggregated so the column count
+        stays O(1)) plus preallocated cut-row slots.  Regular PH solves are
+        unaffected (phi has zero cost and only cut rows touch it); the
+        periodic ``_check_bound`` alt-objective solve uses it to turn every
+        subproblem into a certified EF relaxation."""
+        opt = self.opt
+        if opt.tree.num_stages != 2:
+            raise RuntimeError(
+                "CrossScenarioExtension supports two-stage problems only "
+                "(as the reference, cross_scen_extension.py:120-122)")
+        b = opt.batch
+        self._phi_col = b.num_vars
+        self._cut_row0 = b.num_rows
+        self._next_row = 0
+        # a CERTIFIED finite phi lower bound (the reference's valid_eta_bound,
+        # cross_scen_extension.py:130-141): phi_s >= sum_{s'!=s} p' d_s' with
+        # d_s the dual-certified scenario minima from one plain batched solve
+        # — a huge-magnitude artificial lb would poison the dual-objective
+        # certificate of the _check_bound solve (eps * |lb| error terms)
+        so = opt.options.get("cross_scen_options", {})
+        # certified per-scenario minima of the SECOND-STAGE-only problems
+        # (first-stage cost zeroed — the lshaped.py eta-bound trick): used
+        # for phi's lower bound AND as the safe substitute constant when a
+        # scenario's cut row is invalid (see add_cuts)
+        q0 = np.array(b.c, copy=True)
+        q0[:, opt.tree.nonant_indices] = 0.0
+        opt.solve_loop(q=q0, warm=False)
+        x, _, y, _ = opt._warm
+        import jax.numpy as jnp
+
+        from ..solvers import admm
+
+        dt = opt.admm_settings.jdtype()
+        args = (jnp.asarray(q0, dt), jnp.asarray(b.q2, dt),
+                jnp.asarray(b.A, dt), jnp.asarray(b.cl, dt),
+                jnp.asarray(b.cu, dt), jnp.asarray(b.lb, dt),
+                jnp.asarray(b.ub, dt), jnp.asarray(y, dt),
+                jnp.asarray(x, dt))
+        dvals = (np.asarray(admm.dual_objective(*args), dtype=float)
+                 - np.asarray(admm.dual_objective_margin(*args), dtype=float))
+        self._q2lb = dvals + b.const - 1.0       # Q2_s(x) >= _q2lb[s], all x
+        if "phi_lb" in so:
+            phi_lb = np.full(b.num_scenarios, float(so["phi_lb"]))
+        else:
+            d = opt.probs * self._q2lb
+            phi_lb = d.sum() - d
+        opt.batch = b.augment(
+            1, self.max_cut_rounds, col_lb=0.0, col_ub=np.inf,
+            col_names=["_cross_scen_phi"])
+        opt.batch.lb[:, self._phi_col] = phi_lb
+        # shapes changed: the PH warm chain and cached factors are void
+        opt._warm = None
+        opt._factors = None
+        opt._factors_sig = None
 
     def add_cuts(self, rows: np.ndarray):
-        """Accept a (S, K+1) payload from the cut spoke (NaN rows dropped)."""
-        rows = rows[~np.isnan(rows).any(axis=1)]
-        if rows.size:
-            self._cuts.append(rows)
+        """Accept a (S, K+1) payload from the cut spoke (NaN rows dropped)
+        and inject the aggregate cut into every scenario's preallocated slot:
+
+            phi_s >= sum_{s' != s} p_s' [g_s' . x + const_s']
+
+        written as the row  phi - G_s.x >= C_s  (cl finite, cu = +inf).
+        """
+        valid = ~np.isnan(rows).any(axis=1)
+        if not valid.any():
+            return
+        if self._next_row is not None and self._next_row >= self.max_cut_rounds:
+            # slots exhausted: further cuts can no longer steer the batch,
+            # and unbounded _cuts growth would make every bound check pay a
+            # growing host LP — stop accumulating (hub keeps existing cuts)
+            return
+        # scenarios whose cut row is invalid (NaN) CANNOT simply be omitted
+        # from the aggregate: Q2 can be negative, so dropping a term would
+        # raise the aggregate "lower bound" above the true sum — an invalid
+        # cut that can push the EF-relaxation bound above the optimum.
+        # Substitute the certified constant cut Q2_t(x) >= _q2lb[t] instead.
+        clean = np.where(valid[:, None], rows, 0.0)
+        if self._q2lb is not None:
+            clean[~valid, -1] = self._q2lb[~valid]
+        elif not valid.all():
+            return      # no safe substitute available: skip this round
+        self._cuts.append(rows[valid])
+        if self._phi_col is None:
+            return
+        opt = self.opt
+        b = opt.batch
+        idx = opt.tree.nonant_indices
+        p = opt.probs                             # every scenario contributes
+        G_tot = p @ clean[:, :-1]                 # (K,)
+        C_tot = float(p @ clean[:, -1])
+        G_s = G_tot[None, :] - p[:, None] * clean[:, :-1]     # (S, K)
+        C_s = C_tot - p * clean[:, -1]                        # (S,)
+        row = self._cut_row0 + self._next_row
+        b.A[:, row, :] = 0.0
+        b.A[:, row, idx] = -G_s
+        b.A[:, row, self._phi_col] = 1.0
+        b.cl[:, row] = C_s
+        b.cu[:, row] = np.inf
+        b.version += 1
+        self._next_row += 1
+
+    def _check_bound(self):
+        """Alt-objective batched solve: each subproblem becomes
+        ``min  c1.x + p_s c2.y + phi``  s.t. own rows + cut rows — an EF
+        relaxation, so max_s of the CERTIFIED per-scenario dual values is a
+        valid EF outer bound (the reference's EF_Obj flip + max reduce,
+        cross_scen_extension.py:72-117)."""
+        opt = self.opt
+        if self._phi_col is None or self._next_row == 0:
+            return None
+        b = opt.batch
+        nm = b.nonant_mask()
+        p = opt.probs
+        q = np.where(nm[None, :], b.c, b.c * p[:, None])
+        q[:, self._phi_col] = 1.0
+        q2 = np.where(nm[None, :], b.q2, b.q2 * p[:, None])
+        # hold the PH warm chain harmless across the side solve
+        saved = (opt._warm, opt._factors, opt._factors_sig, opt._factors_age)
+        try:
+            opt.solve_loop(q=q, q2=q2, warm=False)
+            x, _, y, _ = opt._warm
+            import jax.numpy as jnp
+
+            from ..solvers import admm
+
+            dt = opt.admm_settings.jdtype()
+            dvals = admm.dual_objective(
+                jnp.asarray(q, dt), jnp.asarray(q2, dt),
+                jnp.asarray(b.A, dt), jnp.asarray(b.cl, dt),
+                jnp.asarray(b.cu, dt), jnp.asarray(b.lb, dt),
+                jnp.asarray(b.ub, dt), jnp.asarray(y, dt),
+                jnp.asarray(x, dt))
+            vals = np.asarray(dvals, dtype=float) + p * b.const
+            return float(np.max(vals))
+        finally:
+            (opt._warm, opt._factors, opt._factors_sig,
+             opt._factors_age) = saved
 
     def compute_outer_bound(self):
         """Solve the host cutting-plane LP; returns the bound or None."""
@@ -80,6 +221,7 @@ class CrossScenarioExtension(Extension):
             return None
         A = np.stack(rows)
         c = np.zeros(nv)
+        c[:K] = b.c[0, idx]        # first-stage cost (cuts are 2nd-stage-only)
         c[K:] = opt.probs
         lbv = np.concatenate([b.lb[0, idx], np.full(S, -1e9)])
         ubv = np.concatenate([b.ub[0, idx], np.full(S, np.inf)])
@@ -87,14 +229,40 @@ class CrossScenarioExtension(Extension):
                                      lbv, ubv)
         if not res.feasible:
             return None
-        return float(res.obj)
+        return float(res.obj), np.asarray(res.x[:K])
 
     def miditer(self):
         it = self.opt._iter
         if it % max(1, self.check_bound_iterations) != 0:
             return
-        lb = self.compute_outer_bound()
-        if lb is None or lb <= self._last_lb:
+        # two certified outer bounds from the same cuts: the host
+        # cutting-plane LP (exact, first-stage space) and the in-batch
+        # EF-relaxation check (steered subproblems, device batch)
+        cands = []
+        host = self.compute_outer_bound()
+        if host is not None:
+            lb_host, x_cp = host
+            cands.append(lb_host)
+            # hub-side Benders refinement: new cuts at the cutting-plane
+            # ARGMIN (hub iterates cluster near one point, so spoke cuts
+            # alone leave the relaxation loose away from it; cutting at the
+            # relaxation's own minimizer is the classical convergent choice).
+            # Skipped once slots are exhausted — the refinement solve would
+            # be pure cost with nowhere to put the result.
+            if self._next_row is not None and self._next_row < self.max_cut_rounds:
+                from ..cylinders.cross_scen_spoke import make_clamp_cuts
+
+                S = self.opt.batch.num_scenarios
+                self.add_cuts(make_clamp_cuts(
+                    self.opt, np.broadcast_to(
+                        x_cp, (S, x_cp.shape[0])).copy()))
+        chk = self._check_bound()
+        if chk is not None:
+            cands.append(chk)
+        if not cands:
+            return
+        lb = max(cands)
+        if lb <= self._last_lb:
             return
         self._last_lb = lb
         spcomm = getattr(self.opt, "spcomm", None)

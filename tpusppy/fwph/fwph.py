@@ -107,9 +107,11 @@ class FWPH(PHBase):
             q[:, idx] += W_mip
             xstar = self.solve_loop(q=q)
             if fw == 0:
-                vals = self.batch.objective(xstar) + np.einsum(
-                    "sk,sk->s", W_mip, xstar[:, idx])
-                dual_bound = float(self.probs @ vals)
+                # CERTIFIED Lagrangian bound: dual objective of the
+                # W_mip-augmented solve (weak duality absorbs solver
+                # tolerance; the primal objective of an inexact solve can
+                # overshoot — cf. lagrangian_bounder)
+                dual_bound = self.Edualbound(q=q)
             # Gamma^t stop check (fwph.py:264-283): linearized objective at
             # the QP point minus at the new vertex, normalized
             val0 = np.einsum("sn,sn->s", q, xstar) \

@@ -185,6 +185,10 @@ class ScenarioBatch:
     const: np.ndarray      # (S,)
     tree: TreeInfo
     var_names: list | None = None  # (n,) shared column names, if known
+    # mutation counter: bump after ANY in-place edit of the arrays above
+    # (e.g. cross-scenario cut injection) so cached solver factorizations
+    # keyed on it (SPOpt._solve_sig) invalidate
+    version: int = 0
 
     @classmethod
     def from_problems(cls, problems: list[ScenarioProblem]) -> "ScenarioBatch":
@@ -254,3 +258,41 @@ class ScenarioBatch:
         lin = np.einsum("sn,sn->s", self.c, x)
         quad = 0.5 * np.einsum("sn,sn->s", self.q2, x * x)
         return lin + quad + self.const
+
+    def augment(self, extra_cols: int, extra_rows: int,
+                col_lb=0.0, col_ub=0.0,
+                col_names=None) -> "ScenarioBatch":
+        """A NEW batch with ``extra_cols`` zero-cost columns and
+        ``extra_rows`` inactive (-inf, +inf) row slots appended.
+
+        The device-batch analogue of the reference's model reshaping
+        (cross_scen_extension.py:120-283 attaches eta variables and cut
+        Constraints to every scenario model): fixed shapes mean one compiled
+        program, so structural additions must be PREALLOCATED slots that
+        later in-place writes activate (then bump ``version``).  Appending
+        keeps every existing column index — tree/nonant arrays stay valid.
+        """
+        S, m, n = self.A.shape
+        dc, dr = int(extra_cols), int(extra_rows)
+        pad_c = np.zeros((S, dc))
+        A = np.zeros((S, m + dr, n + dc))
+        A[:, :m, :n] = self.A
+        names = None
+        if self.var_names is not None:
+            names = self.var_names + list(
+                col_names or [f"_aug{i}" for i in range(dc)])
+        return dataclasses.replace(
+            self,
+            c=np.concatenate([self.c, pad_c], axis=1),
+            q2=np.concatenate([self.q2, pad_c], axis=1),
+            A=A,
+            cl=np.concatenate([self.cl, np.full((S, dr), -INF)], axis=1),
+            cu=np.concatenate([self.cu, np.full((S, dr), INF)], axis=1),
+            lb=np.concatenate(
+                [self.lb, np.full((S, dc), float(col_lb))], axis=1),
+            ub=np.concatenate(
+                [self.ub, np.full((S, dc), float(col_ub))], axis=1),
+            is_int=np.concatenate([self.is_int, np.zeros(dc, dtype=bool)]),
+            var_names=names,
+            version=self.version + 1,
+        )

@@ -15,9 +15,12 @@ Here:
   -dQ_s/dx_hat (verified sign convention; replaces the per-scenario dual
   extraction of lshaped.py:508-679).
 
-Multi-cut by default (one eta per scenario).  Assumes relatively complete
-recourse (the reference's feasibility-cut machinery guards the same failure
-mode; here an infeasible subproblem raises).
+Multi-cut by default (one eta per scenario).  Feasibility cuts: scenarios the
+batched clamp solve leaves infeasible get a host-exact phase-1 LP (elastic row
+slacks, HiGHS duals); its value/subgradient become a feasibility cut
+``g.x <= g.xhat - v`` (no eta term), matching the capability of
+``mpisppy/opt/lshaped.py:380-506`` + ``utils/lshaped_cuts.py:1-85`` —
+incomplete-recourse models are in scope.
 """
 
 from __future__ import annotations
@@ -157,8 +160,55 @@ class LShapedMethod(SPOpt):
         return x[:K], x[K:], float(r["c"] @ x)
 
     # ---- subproblems (lshaped.py:380-506 collapsed to one batched solve) ----
+    def _phase1(self, s, xhat):
+        """Host-exact phase-1 LP for one clamped scenario: minimize the
+        1-norm of elastic row slacks.  Returns (violation v >= 0, subgradient
+        g = dv/dxhat (K,)) — the feasibility-cut data (the reference gets the
+        same from its solver's Farkas/infeasibility certificate through
+        pyomo.contrib.benders; an elastic phase-1 is the solver-agnostic
+        equivalent)."""
+        from ..solvers import scipy_backend
+
+        b = self.batch
+        idx = self.tree.nonant_indices
+        m, n = b.A[s].shape
+        A_aug = np.hstack([b.A[s], np.eye(m), -np.eye(m)])
+        c_aug = np.concatenate([np.zeros(n), np.ones(2 * m)])
+        lb = np.array(b.lb[s], copy=True)
+        ub = np.array(b.ub[s], copy=True)
+        lb[idx] = xhat
+        ub[idx] = xhat
+        lb_aug = np.concatenate([lb, np.zeros(2 * m)])
+        ub_aug = np.concatenate([ub, np.full(2 * m, np.inf)])
+        res = scipy_backend.solve_lp_with_duals(
+            c_aug, A_aug, b.cl[s], b.cu[s], lb_aug, ub_aug)
+        if not res.feasible or res.duals is None:
+            raise RuntimeError(
+                f"phase-1 LP unsolvable for {self.all_scenario_names[s]}")
+        v = float(c_aug @ res.x)
+        # weak-duality cut construction (see _solve_subproblems): for any
+        # duals y, v(x̂') >= base + g[idx].x̂'; feasibility then requires
+        # base + g.x <= 0
+        from ..spopt import _np_dual_cut, _pick_dual_sign
+
+        ys = _pick_dual_sign(c_aug, A_aug, b.cl[s], b.cu[s],
+                             lb_aug, ub_aug, res.duals, res.x, v)
+        mask = np.zeros(A_aug.shape[1], dtype=bool)
+        mask[idx] = True
+        base, g = _np_dual_cut(c_aug, A_aug, b.cl[s], b.cu[s],
+                               lb_aug, ub_aug, ys, res.x, mask)
+        return base, g[idx]
+
+    def _host_exact_sub(self, s, q, lb, ub):
+        """Host-exact clamped-subproblem solve (straggler path): returns
+        (feasible, Q_s, cut_base, grad (K,)) with exact simplex duals."""
+        from ..spopt import host_exact_clamp_cut
+
+        return host_exact_clamp_cut(self.batch, q, s, lb, ub,
+                                    self.tree.nonant_indices)
+
     def _solve_subproblems(self, xhat):
-        """Returns (Q values (S,), gradients (S, K))."""
+        """Returns (Q (S,), gradients (S, K), feasible, feas_cuts list)."""
         b = self.batch
         idx = self.tree.nonant_indices
         q = np.array(b.c, copy=True)
@@ -172,37 +222,90 @@ class LShapedMethod(SPOpt):
         pri = np.asarray(sol.pri_res)
         tol = max(self.options.get("feas_tol", 1e-3),
                   10.0 * self.admm_settings.eps_rel)
-        # the root x carries the root solve's own primal error into the
-        # clamp, making the clamped problem infeasible by exactly that
-        # much — near-feasible solves still yield valid cuts, so only a
-        # gross violation (not explained by solver tolerances) aborts
-        feasible = not (pri > tol).any()
-        gross = max(1e3 * tol, 1.0)
-        if (pri > gross).any():
-            bad = [self.all_scenario_names[s]
-                   for s in np.where(pri > gross)[0]]
-            raise RuntimeError(
-                f"L-shaped subproblems infeasible at root x: {bad} "
-                "(no feasibility-cut support; ensure complete recourse)"
-            )
         x = np.asarray(sol.x)
         Q = np.einsum("sn,sn->s", q, x) + 0.5 * np.einsum(
             "sn,sn->s", b.q2, x * x) + b.const
-        grads = -np.asarray(sol.yx)[:, idx]        # dQ/dxhat = -yx
-        return Q, grads, feasible
+        # cut data via the weak-duality construction (admm.dual_cut): valid
+        # for ANY duals — raw clamp duals -yx can be sign-infeasible at
+        # DEGENERATE clamped optima (stationarity holds, residuals can't see
+        # it) and then cut off the true optimum
+        import jax.numpy as jnp
 
-    def _add_cuts(self, xhat, Q, grads):
-        """eta_s >= Q_s + g_s.(x - xhat) as rows of the root cut block."""
+        dt = self.admm_settings.jdtype()
+        cut_base, g_full = admm.dual_cut(
+            jnp.asarray(q, dt), jnp.asarray(b.q2, dt), jnp.asarray(b.A, dt),
+            jnp.asarray(b.cl, dt), jnp.asarray(b.cu, dt),
+            jnp.asarray(lb, dt), jnp.asarray(ub, dt),
+            sol.y, sol.x, jnp.asarray(b.nonant_mask()))
+        cut_base = np.asarray(cut_base, dtype=float) + b.const
+        grads = np.asarray(g_full, dtype=float)[:, idx]
+        # weak-duality cut TIGHTNESS check: gap_s = Q_s - cut-value-at-x̂ is
+        # >= 0 by construction and ~0 when the batch duals are exact and
+        # sign-feasible; a large gap flags degenerate/stalled duals, where
+        # the exact simplex fallback restores a tight (still valid) cut
+        gap_w = Q - (cut_base + grads @ xhat)
+        cut_tol = 1e-5 * (1.0 + np.abs(Q))
+        # scenarios the batch left unconverged (or with loose cuts):
+        # host-exact re-solve decides feasibility + tightens the cut; truly
+        # infeasible ones yield phase-1 feasibility cuts
+        feas_cuts = []
+        skip_opt = set()                           # no optimality cut from
+        feasible = True                            # infeasible scenarios
+        gross = max(1e3 * tol, 1.0)
+        for s in np.flatnonzero((pri > tol) | (gap_w > cut_tol)):
+            if np.any(b.q2[s] != 0.0):
+                if pri[s] > gross:
+                    # QP scenario with a grossly infeasible clamp: there is
+                    # no host-exact LP path and no feasibility-cut support
+                    # for QPs — fail loudly rather than looping to max_iter
+                    raise RuntimeError(
+                        "L-shaped QP subproblem infeasible at root x: "
+                        f"{self.all_scenario_names[s]} (pri {pri[s]:.2e}; "
+                        "ensure complete recourse for QP scenarios)")
+                if pri[s] > tol:                   # QP scenario: no host path
+                    feasible = False
+                continue
+            ok, Qs, cb, gs = self._host_exact_sub(s, q, lb, ub)
+            if ok:
+                Q[s], cut_base[s], grads[s] = Qs, cb, gs
+            else:
+                feasible = False
+                skip_opt.add(int(s))
+                base_f, gf = self._phase1(s, xhat)
+                feas_cuts.append((base_f, gf))
+                global_toc(
+                    f"L-shaped: feasibility cut from "
+                    f"{self.all_scenario_names[s]} "
+                    f"(violation {base_f + gf @ xhat:.3e})",
+                    self.verbose)
+        for s in skip_opt:
+            Q[s] = np.inf          # candidate is infeasible: honest ub = inf
+        return Q, cut_base, grads, feasible, feas_cuts, skip_opt
+
+    def _add_cuts(self, xhat, cut_base, grads, feas_cuts=(), skip_opt=()):
+        """eta_s >= cut_base_s + g_s.x as rows of the root cut block;
+        feasibility cuts ``g.x <= g.xhat - v`` use no eta column."""
         r = self._root
         K, S = r["K"], r["S"]
         for s in range(S):
+            if s in skip_opt:                      # infeasible: junk Q/grad
+                continue
             row = r["next_cut"]
             if row >= r["A"].shape[0]:
                 return  # cut capacity exhausted; root keeps old cuts
             r["A"][row, :K] = -grads[s]
             r["A"][row, K + s] = 1.0
-            r["cl"][row] = Q[s] - grads[s] @ xhat
+            r["cl"][row] = cut_base[s]
             r["cu"][row] = np.inf
+            r["next_cut"] += 1
+        for base, g in feas_cuts:
+            row = r["next_cut"]
+            if row >= r["A"].shape[0]:
+                return
+            # 0 >= base + g.x  (weak-duality phase-1 cut; see _phase1)
+            r["A"][row, :K] = g
+            r["cl"][row] = -np.inf
+            r["cu"][row] = float(-base)
             r["next_cut"] += 1
 
     # ---- driver (lshaped.py:508-679) ---------------------------------------
@@ -214,7 +317,8 @@ class LShapedMethod(SPOpt):
             xhat, eta, root_obj = self._solve_root()
             if not self._root_loose:
                 self.outer_bound = root_obj        # certified lower bound
-            Q, grads, feasible = self._solve_subproblems(xhat)
+            Q, cut_base, grads, feasible, feas_cuts, skip_opt = \
+                self._solve_subproblems(xhat)
             ub_val = float(b.c[0, idx] @ xhat + self.probs @ Q)
             if feasible:
                 # only certified-feasible evaluations move the incumbent
@@ -223,14 +327,14 @@ class LShapedMethod(SPOpt):
             gap = ub_val - root_obj
             global_toc(
                 f"L-shaped iter {it} lb {root_obj:.6f} ub {ub_val:.6f} "
-                f"gap {gap:.3e}", self.verbose)
+                f"gap {gap:.3e} fcuts {len(feas_cuts)}", self.verbose)
             if self.spcomm is not None:
                 self.spcomm.sync()
                 if self.spcomm.is_converged():
                     break
             if feasible and gap <= self.tol * max(1.0, abs(ub_val)):
                 break
-            self._add_cuts(xhat, Q, grads)
+            self._add_cuts(xhat, cut_base, grads, feas_cuts, skip_opt)
         # final full solve at root x for solution reporting
         self.fix_nonants(xhat)
         try:

@@ -90,58 +90,72 @@ def _gather_per_scenario(xbar_nk, nid_sk):
 
 def make_ph_step(nonant_idx: np.ndarray, settings: ADMMSettings,
                  mesh: Mesh | None = None, axis: str = "scen"):
-    """Build the jitted PH iteration: augmented-objective batch solve,
-    node-grouped xbar reduction, dual update, convergence metric.
+    """Back-compat single-step API: the adaptive (refresh) step of
+    :func:`make_ph_step_pair`, with the factors dropped.  One compiled
+    program per (shapes, settings); PH iterations re-enter it with new state
+    only — the persistent-solver analogue (spopt.py:129-144)."""
+    refresh, _ = make_ph_step_pair(nonant_idx, settings, mesh, axis)
 
-    ``nonant_idx`` is closed over (trace-time constant).  One compiled program
-    per (shapes, settings); PH iterations re-enter it with new state only —
-    the persistent-solver analogue (spopt.py:129-144).
+    def step(state: PHState, arr: PHArrays, prox_on):
+        new_state, out, _ = refresh(state, arr, prox_on)
+        return new_state, out
 
-    When ``mesh`` is given, the ADMM solve runs under ``jax.shard_map`` so its
-    data-dependent ``while_loop`` terminates on *device-local* residuals only —
-    the solve is embarrassingly scenario-parallel, and keeping collectives out
-    of the loop predicate means no cross-device rendezvous per inner iteration
-    (which both deadlocks XLA's CPU in-process collectives when trip counts
-    diverge and would serialize ICI traffic on real meshes).  The only
-    collective left is the psum XLA inserts for the node-grouped xbar
-    contraction below — exactly the reference's one-Allreduce-per-node
-    structure (phbase.py:75-87).
+    return step
+
+
+def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
+                      mesh: Mesh | None = None, axis: str = "scen"):
+    """(refresh_step, frozen_step) — the factorization-amortized PH iteration.
+
+    ``refresh_step(state, arr, prox_on) -> (state, out, factors)`` runs the
+    full adaptive solve (Ruiz + rho adaptation + factorizations + optional
+    polish) and returns the final :class:`~tpusppy.solvers.admm.Factors`.
+    ``frozen_step(state, arr, prox_on, factors) -> (state, out)`` reuses them:
+    no factorization in the program at all, so the steady-state PH iteration
+    is pure batched matvec sweeps (the MXU path).  PH leaves (A, q2, bounds)
+    unchanged between iterations — only q moves — so factors stay valid; the
+    residual-driven while_loop still guards accuracy, and a periodic refresh
+    re-adapts rho (see :func:`run_ph`'s ``refresh_every``).
     """
     idx = jnp.asarray(nonant_idx)
 
-    def local_solve(q, q2, A, cl, cu, lb, ub, x, z, y, yx):
+    def local_refresh(q, q2, A, cl, cu, lb, ub, x, z, y, yx):
         with jax.default_matmul_precision("highest"):
             return admm._solve_impl(
-                q, q2, A, cl, cu, lb, ub, settings, (x, z, y, yx)
-            )
+                q, q2, A, cl, cu, lb, ub, settings, (x, z, y, yx),
+                want_factors=True)
+
+    def local_frozen(q, q2, A, cl, cu, lb, ub, x, z, y, yx, factors):
+        with jax.default_matmul_precision("highest"):
+            return admm._solve_frozen_impl(
+                q, q2, A, cl, cu, lb, ub, factors, (x, z, y, yx), settings)
 
     if mesh is not None:
         sp = jax.sharding.PartitionSpec(axis)
-        sharded_solve = jax.shard_map(
-            local_solve, mesh=mesh, in_specs=(sp,) * 11,
-            out_specs=admm.BatchSolution(
-                *([sp] * 7), raw=(sp, sp, sp, sp)),
-            # the solver seeds loop carries with literals (ones/zeros); skip
-            # the varying-manual-axes typecheck rather than pcast each one
-            check_vma=False,
+        sol_spec = admm.BatchSolution(*([sp] * 7), raw=(sp, sp, sp, sp))
+        fac_spec = admm.Factors(*([sp] * 7))
+        refresh_solve = jax.shard_map(
+            local_refresh, mesh=mesh, in_specs=(sp,) * 11,
+            out_specs=(sol_spec, fac_spec), check_vma=False,
+        )
+        frozen_solve = jax.shard_map(
+            local_frozen, mesh=mesh,
+            in_specs=(sp,) * 11 + (fac_spec,),
+            out_specs=sol_spec, check_vma=False,
         )
     else:
-        sharded_solve = local_solve
+        refresh_solve, frozen_solve = local_refresh, local_frozen
 
-    @jax.jit
-    def step(state: PHState, arr: PHArrays, prox_on):
+    def _objective(arr, state, prox_on):
         dt = settings.jdtype()
-        W, xbars, rho = state.W.astype(dt), state.xbars.astype(dt), state.rho.astype(dt)
+        W, xbars, rho = (state.W.astype(dt), state.xbars.astype(dt),
+                         state.rho.astype(dt))
         prox_on = jnp.asarray(prox_on, dt)
-        # attach_PH_to_objective (phbase.py:617-699) as a (q, q2) override;
-        # Iter0 solves with the plain objective (prox_on=0, W=0) but still
-        # performs the full xbar/W update afterwards (phbase.py:758-872).
         q = arr.c.astype(dt).at[:, idx].add(W - prox_on * rho * xbars)
         q2 = arr.q2.astype(dt).at[:, idx].add(prox_on * rho)
-        sol = sharded_solve(
-            q, q2, arr.A, arr.cl, arr.cu, arr.lb, arr.ub,
-            state.x, state.z, state.y, state.yx,
-        )
+        return q, q2, W, rho
+
+    def _finish(arr, state, sol, W, rho):
         xk = sol.x[:, idx]
         xbar_nk, _ = _node_xbar(arr.onehot, arr.probs, xk)
         new_xbars = _gather_per_scenario(xbar_nk, arr.nid_sk)
@@ -157,7 +171,27 @@ def make_ph_step(nonant_idx: np.ndarray, settings: ADMMSettings,
         )
         return new_state, PHStepOut(conv, eobj, sol.pri_res, sol.dua_res)
 
-    return step
+    @jax.jit
+    def refresh_step(state: PHState, arr: PHArrays, prox_on):
+        q, q2, W, rho = _objective(arr, state, prox_on)
+        sol, factors = refresh_solve(
+            q, q2, arr.A, arr.cl, arr.cu, arr.lb, arr.ub,
+            state.x, state.z, state.y, state.yx,
+        )
+        new_state, out = _finish(arr, state, sol, W, rho)
+        return new_state, out, factors
+
+    @jax.jit
+    def frozen_step(state: PHState, arr: PHArrays, prox_on, factors):
+        q, q2, W, rho = _objective(arr, state, prox_on)
+        sol = frozen_solve(
+            q, q2, arr.A, arr.cl, arr.cu, arr.lb, arr.ub,
+            state.x, state.z, state.y, state.yx, factors,
+        )
+        new_state, out = _finish(arr, state, sol, W, rho)
+        return new_state, out
+
+    return refresh_step, frozen_step
 
 
 def dispatch_window(mesh: Mesh) -> int:
@@ -251,22 +285,32 @@ def init_state(arr: PHArrays, default_rho: float, settings: ADMMSettings) -> PHS
 
 
 def run_ph(batch, mesh: Mesh, iters: int, default_rho: float = 1.0,
-           settings: ADMMSettings | None = None, axis: str = "scen"):
+           settings: ADMMSettings | None = None, axis: str = "scen",
+           refresh_every: int = 32):
     """Sharded PH driver: Iter0 (plain objective via rho=W=0 warmup step
     semantics) + ``iters`` PH iterations.  Returns (state, last PHStepOut).
 
-    Used by ``__graft_entry__.dryrun_multichip`` and ``bench.py``; the class
-    API (:class:`tpusppy.opt.ph.PH`) remains the feature-complete host path.
+    Iterations run on the factorization-amortized path: a full adaptive
+    refresh at the first PH iteration and every ``refresh_every`` after it,
+    sweep-only frozen steps in between (``refresh_every=1`` disables the
+    frozen path).  Used by ``__graft_entry__.dryrun_multichip`` and
+    ``bench.py``; the class API (:class:`tpusppy.opt.ph.PH`) remains the
+    feature-complete host path.
     """
     settings = settings or ADMMSettings()
     arr = shard_batch(batch, mesh, axis)
-    step = make_ph_step(batch.tree.nonant_indices, settings, mesh, axis)
+    refresh, frozen = make_ph_step_pair(
+        batch.tree.nonant_indices, settings, mesh, axis)
     state = init_state(arr, default_rho, settings)
     window = dispatch_window(mesh)
     # Iter0: W=0, prox off, cf. phbase.py:758-872
-    state, out = step(state, arr, 0.0)
+    state, out, _ = refresh(state, arr, 0.0)
+    factors = None
     for i in range(iters):
-        state, out = step(state, arr, 1.0)
+        if factors is None or i % max(refresh_every, 1) == 0:
+            state, out, factors = refresh(state, arr, 1.0)
+        else:
+            state, out = frozen(state, arr, 1.0, factors)
         if (i + 1) % window == 0:
             jax.block_until_ready(out.conv)
     return state, out

@@ -60,6 +60,14 @@ class ADMMSettings:
     # VPU multiply-accumulate kernel; flip on for bandwidth-bound regimes
     # (very large S with small n) where VMEM residency wins.
     use_pallas: bool = False
+    # Per-ROW rho adaptation between restarts: rows (and variable boxes) with
+    # persistent primal violation get their penalty boosted.  Cures ADMM
+    # stalls on strongly-coupled LPs (UC's ramp/genlim rows) that global rho
+    # adaptation cannot fix — the global ratio is balanced while a handful of
+    # rows are far from feasible.
+    rho_row_adapt: bool = True
+    rho_row_boost: float = 10.0
+    rho_row_max: float = 1e6
     dtype: str = "float64"
 
     def jdtype(self):
@@ -83,6 +91,26 @@ class _Scaling(NamedTuple):
     D: jax.Array       # (S, n) column scaling
     E: jax.Array       # (S, m) row scaling
     cost: jax.Array    # (S,) objective scaling
+
+
+class Factors(NamedTuple):
+    """Reusable solve state for the frozen-factor path.
+
+    PH changes only the linear term between iterations (spopt.py:129-144 is
+    the reference's persistent-solver analogue); the Ruiz scaling, the adapted
+    rho vectors, and the KKT factorization all depend only on (A, q2, bounds)
+    — so they can be computed once at a "refresh" solve and reused for many
+    cheap sweep-only solves.  On TPU this removes the batched factorization
+    (the dominant per-iteration cost) from the steady-state PH iteration.
+    """
+
+    D: jax.Array       # (S, n) Ruiz column scaling
+    E: jax.Array       # (S, m) Ruiz row scaling
+    cost: jax.Array    # (S,) objective scaling
+    rho_a: jax.Array   # (S, m) row penalties actually used last
+    rho_x: jax.Array   # (S, n) variable-box penalties actually used last
+    Kinv: jax.Array    # (S, n, n) explicit inverse of the x-update system
+    K: jax.Array       # (S, n, n) exact K for iterative refinement
 
 
 class _BoundMasks(NamedTuple):
@@ -333,30 +361,71 @@ def _solve_scaled(q, q2, A, cl, cu, lb, ub, warm, masks, st: ADMMSettings,
     state0 = _IterState(x0, z0, zx0, y0, yx0, inf, inf, one, one,
                         jnp.zeros((), jnp.int32))
 
-    def outer(carry, _):
-        state, base, total = carry
+    # Restart loop as a lax.scan with the factorization in the CARRY, so
+    # the LAST rho vectors + factorization survive to become the reusable
+    # :class:`Factors` of the frozen-factor path.  (A python-unrolled loop
+    # multiplies the traced program by `restarts`; at restarts=8 the XLA:CPU
+    # compiler has been observed to segfault on the resulting program.)
+    def restart(carry, _):
+        state, base, total, mult, multx = carry[:5]
         rho_a = rho_vec(base[:, None])
         rho_x = rho_x_vec(base[:, None])
+        if st.rho_row_adapt:
+            rho_a = jnp.minimum(rho_a * mult, st.rho_row_max)
+            rho_x = jnp.minimum(rho_x * multx, st.rho_row_max)
         LK = _factor(q2, A, rho_a, rho_x, st.sigma, P)
         state = _admm_core(
             q, q2, A, cl, cu, lb, ub,
             state._replace(k=jnp.zeros((), jnp.int32)),
             LK, rho_a, rho_x, st, P,
         )
+        total = total + state.k
         # OSQP rho adaptation on NORMALIZED residuals (raw residual ratios
-        # push rho the wrong way when primal/dual scales differ)
+        # push rho the wrong way when primal/dual scales differ).  CONVERGED
+        # scenarios keep their rho: their restarts do zero sweeps, so
+        # adapting on the stale residual ratio would compound x10 per
+        # remaining restart into a runaway rho that only ever reaches the
+        # Factors (and wrecks the frozen path's dual convergence).
+        eps_pri = st.eps_abs + st.eps_rel * jnp.maximum(state.prinorm, 1.0)
+        eps_dua = st.eps_abs + st.eps_rel * jnp.maximum(state.duanorm, 1.0)
+        done = (state.pri < eps_pri) & (state.dua < eps_dua)
         pri_rel = state.pri / jnp.maximum(state.prinorm, 1e-10)
         dua_rel = state.dua / jnp.maximum(state.duanorm, 1e-10)
         ratio = jnp.sqrt(
             jnp.maximum(pri_rel, 1e-12) / jnp.maximum(dua_rel, 1e-12)
         )
-        base = jnp.clip(base * jnp.clip(ratio, 0.1, 10.0), st.rho_min, st.rho_max)
-        return (state, base, total + state.k), None
+        new_base = jnp.clip(base * jnp.clip(ratio, 0.1, 10.0),
+                            st.rho_min, st.rho_max)
+        base = jnp.where(done, base, new_base)
+        if st.rho_row_adapt:
+            # Per-row boost for the DOMINANT violated rows of scenarios that
+            # are genuinely stuck: global adaptation balances aggregate
+            # residual ratios while a few strongly-coupled rows (UC
+            # ramp/genlim) stay infeasible for thousands of sweeps.  The
+            # double gate (scenario far from converged AND row near the max
+            # violation) keeps ordinary mid-convergence rows un-boosted --
+            # indiscriminate boosting wrecks dual convergence and poisons
+            # the frozen-path factors.  Boost-only + bounded.
+            stuck = (state.pri > 100.0 * eps_pri)[:, None]
+            gate = jnp.maximum(0.3 * state.pri,
+                               10.0 * eps_pri)[:, None]
+            Ax = jnp.einsum("smn,sn->sm", A, state.x)
+            viol = jnp.maximum(cl - Ax, Ax - cu)
+            mult = jnp.where(stuck & (viol > gate),
+                             mult * st.rho_row_boost, mult)
+            violx = jnp.maximum(lb - state.x, state.x - ub)
+            multx = jnp.where(stuck & (violx > gate),
+                              multx * st.rho_row_boost, multx)
+        return (state, base, total, mult, multx,
+                rho_a, rho_x, LK[0], LK[1]), None
 
-    (state, _, total), _ = jax.lax.scan(
-        outer, (state0, base0, jnp.zeros((), jnp.int32)), None, length=st.restarts
-    )
-    return state, total
+    zK = jnp.zeros((S, n, n), dt)
+    carry0 = (state0, base0, jnp.zeros((), jnp.int32),
+              jnp.ones((S, m), dt), jnp.ones((S, n), dt),
+              jnp.zeros((S, m), dt), jnp.zeros((S, n), dt), zK, zK)
+    (state, _, total, _, _, rho_a, rho_x, Kinv, K), _ = jax.lax.scan(
+        restart, carry0, None, length=st.restarts)
+    return state, total, rho_a, rho_x, (Kinv, K)
 
 
 def _polish(state: _IterState, q, q2, A, cl, cu, lb, ub, masks,
@@ -409,38 +478,48 @@ def _polish(state: _IterState, q, q2, A, cl, cu, lb, ub, masks,
     AL_ITERS = 4
 
     def kkt_solve_full(act_lo, act_up, v_lo, v_up):
-        """Full (n+m+n) saddle-system LU — float32's only accurate option:
-        the reduced system's 1/delta conditioning exceeds what f32 Cholesky
-        plus refinement can recover, while the indefinite KKT LU stays
-        backward-stable at the cost of a 3x-larger batched solve."""
+        """Row-replacement saddle LU at (n+m) — float32's accurate option.
+
+        The reduced system's 1/delta conditioning exceeds what f32 Cholesky
+        plus refinement can recover, so f32 needs a backward-stable LU of an
+        O(1)-entry system.  Instead of the full (n+m+n) KKT, the variable
+        -bound dual block is eliminated EXACTLY: for bound-active columns the
+        stationarity row is replaced by ``x_j = vb_j`` and the bound dual is
+        recovered afterwards from the stationarity residual (same recovery
+        step the reduced path uses) — a 3x smaller batched LU, which is the
+        dominant polish cost on TPU (batched LU is sequential per step).
+        """
         row_act = act_lo | act_up
         row_b = jnp.where(act_up, cu, cl)
         var_act = v_lo | v_up
         var_b = jnp.where(v_up, ub, lb)
-        N = n + m + n
+        N = n + m
         eye_m = jnp.eye(m, dtype=dt)[None]
-        pd = jnp.asarray(st.polish_delta, dt)
-        M = jnp.zeros((S, N, N), dt)
-        rhs = jnp.zeros((S, N), dt)
+        # f32 floor on the row regularizer: 1e-8 is below f32 eps, so a
+        # degenerate (redundant) active row set would make the LU singular
+        pd = jnp.asarray(max(st.polish_delta,
+                             1e-6 if dt == jnp.float32 else 0.0), dt)
         Qblock = jax.vmap(jnp.diag)(q2) + pd * eye_n
         if P is not None:
             Qblock = Qblock + P
-        M = M.at[:, :n, :n].set(Qblock)
-        M = M.at[:, :n, n:n + m].set(jnp.swapaxes(A, 1, 2))
-        M = M.at[:, :n, n + m:].set(eye_n)
-        rhs = rhs.at[:, :n].set(-q)
-        ra = row_act[:, :, None]
-        M = M.at[:, n:n + m, :n].set(jnp.where(ra, A, 0.0))
-        M = M.at[:, n:n + m, n:n + m].set(
-            jnp.where(ra, -pd * eye_m, eye_m))
-        rhs = rhs.at[:, n:n + m].set(jnp.where(row_act, row_b, 0.0))
         va = var_act[:, :, None]
-        M = M.at[:, n + m:, :n].set(jnp.where(va, eye_n, 0.0))
-        M = M.at[:, n + m:, n + m:].set(
-            jnp.where(va, -pd * eye_n, eye_n))
-        rhs = rhs.at[:, n + m:].set(jnp.where(var_act, var_b, 0.0))
+        ra = row_act[:, :, None]
+        M = jnp.zeros((S, N, N), dt)
+        rhs = jnp.zeros((S, N), dt)
+        M = M.at[:, :n, :n].set(jnp.where(va, eye_n, Qblock))
+        M = M.at[:, :n, n:].set(jnp.where(va, 0.0, jnp.swapaxes(A, 1, 2)))
+        rhs = rhs.at[:, :n].set(jnp.where(var_act, var_b, -q))
+        M = M.at[:, n:, :n].set(jnp.where(ra, A, 0.0))
+        M = M.at[:, n:, n:].set(jnp.where(ra, -pd * eye_m, eye_m))
+        rhs = rhs.at[:, n:].set(jnp.where(row_act, row_b, 0.0))
         sol = jnp.linalg.solve(M, rhs[..., None])[..., 0]
-        return sol[:, :n], sol[:, n:n + m], sol[:, n + m:]
+        xp, yp = sol[:, :n], sol[:, n:]
+        # bound duals absorb the stationarity residual at active columns
+        Pxp = (q2 * xp if P is None
+               else q2 * xp + jnp.einsum("snk,sk->sn", P, xp))
+        r_d = Pxp + q + jnp.einsum("smn,sm->sn", A, yp)
+        yxp = jnp.where(var_act, -r_d, 0.0)
+        return xp, yp, yxp
 
     def kkt_solve_reduced(act_lo, act_up, v_lo, v_up):
         row_act = act_lo | act_up
@@ -568,7 +647,9 @@ def solve_batch(c, q2, A, cl, cu, lb, ub, settings: ADMMSettings = ADMMSettings(
         return _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm, P)
 
 
-def _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm, P=None) -> BatchSolution:
+def _prep(c, q2, A, cl, cu, lb, ub, settings, P):
+    """Dtype casting, bound cleaning, finiteness masks — shared by the
+    adaptive and frozen entry points."""
     dt = settings.jdtype()
     c, q2, A = (jnp.asarray(v, dt) for v in (c, q2, A))
     if P is not None:
@@ -581,20 +662,18 @@ def _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm, P=None) -> BatchSoluti
         eq=jnp.abs(cu - cl) < 1e-10,
         eqx=jnp.abs(ub - lb) < 1e-10,
     )
+    return c, q2, A, cl, cu, lb, ub, masks, P
 
-    D, E = _ruiz(A, q2, settings.scaling_iters)
+
+def _scale(c, q2, A, cl, cu, lb, ub, D, E, cost, P, warm, dt):
     As = A * E[:, :, None] * D[:, None, :]
-    q2s = q2 * D * D
-    qs = c * D
-    cost = 1.0 / jnp.maximum(jnp.max(jnp.abs(qs), axis=1), 1e-8)
-    qs = qs * cost[:, None]
-    q2s = q2s * cost[:, None]
+    q2s = q2 * D * D * cost[:, None]
+    qs = c * D * cost[:, None]
     Ps = None
     if P is not None:
         Ps = P * D[:, :, None] * D[:, None, :] * cost[:, None, None]
     cls, cus = cl * E, cu * E
     lbs, ubs = lb / D, ub / D
-
     if warm is not None:
         x0, z0, y0, yx0 = warm
         warm = (
@@ -603,9 +682,22 @@ def _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm, P=None) -> BatchSoluti
             jnp.asarray(y0, dt) / E * cost[:, None],
             jnp.asarray(yx0, dt) * D * cost[:, None],
         )
+    return qs, q2s, As, cls, cus, lbs, ubs, Ps, warm
 
-    state, total = _solve_scaled(qs, q2s, As, cls, cus, lbs, ubs, warm, masks,
-                                 settings, Ps)
+
+def _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm, P=None,
+                want_factors=False):
+    dt = settings.jdtype()
+    c, q2, A, cl, cu, lb, ub, masks, P = _prep(
+        c, q2, A, cl, cu, lb, ub, settings, P)
+
+    D, E = _ruiz(A, q2, settings.scaling_iters)
+    cost = 1.0 / jnp.maximum(jnp.max(jnp.abs(c * D), axis=1), 1e-8)
+    qs, q2s, As, cls, cus, lbs, ubs, Ps, warm = _scale(
+        c, q2, A, cl, cu, lb, ub, D, E, cost, P, warm, dt)
+
+    state, total, rho_a, rho_x, LK = _solve_scaled(
+        qs, q2s, As, cls, cus, lbs, ubs, warm, masks, settings, Ps)
 
     def unscale(s):
         return (s.x * D, s.z / E, s.y * E / cost[:, None],
@@ -617,12 +709,189 @@ def _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm, P=None) -> BatchSoluti
                         settings, Ps)
     x, z, y, yx = unscale(state)
     S = A.shape[0]
-    return BatchSolution(
+    sol = BatchSolution(
         x=x, z=z, y=y, yx=yx,
         pri_res=state.pri, dua_res=state.dua,
         iters=jnp.broadcast_to(total, (S,)),
         raw=raw,
     )
+    if want_factors:
+        return sol, Factors(D=D, E=E, cost=cost, rho_a=rho_a, rho_x=rho_x,
+                            Kinv=LK[0], K=LK[1])
+    return sol
+
+
+def _solve_frozen_impl(c, q2, A, cl, cu, lb, ub, factors: Factors, warm,
+                       settings, P=None) -> BatchSolution:
+    """Sweep-only solve reusing a previous refresh's :class:`Factors`.
+
+    No Ruiz recomputation, no factorization, no rho adaptation, no polish —
+    the steady-state PH iteration on TPU.  Valid while (A, q2, bounds) are
+    unchanged since the refresh (only the linear term q may move); accuracy
+    is still enforced by the residual-based while_loop, so a drifted active
+    set costs extra sweeps, not correctness.
+    """
+    dt = settings.jdtype()
+    c, q2, A, cl, cu, lb, ub, masks, P = _prep(
+        c, q2, A, cl, cu, lb, ub, settings, P)
+    D, E, cost = factors.D, factors.E, factors.cost
+    qs, q2s, As, cls, cus, lbs, ubs, Ps, warm = _scale(
+        c, q2, A, cl, cu, lb, ub, D, E, cost, P, warm, dt)
+
+    S, m, n = A.shape
+    if warm is None:
+        x0 = jnp.zeros((S, n), dt)
+        z0 = jnp.clip(jnp.zeros((S, m), dt), cls, cus)
+        y0 = jnp.zeros((S, m), dt)
+        yx0 = jnp.zeros((S, n), dt)
+    else:
+        x0, z0, y0, yx0 = warm
+    zx0 = jnp.clip(x0, lbs, ubs)
+    inf = jnp.full((S,), jnp.inf, dt)
+    one = jnp.ones((S,), dt)
+    state0 = _IterState(x0, z0, zx0, y0, yx0, inf, inf, one, one,
+                        jnp.zeros((), jnp.int32))
+
+    state = _admm_core(qs, q2s, As, cls, cus, lbs, ubs, state0,
+                       (factors.Kinv, factors.K), factors.rho_a,
+                       factors.rho_x, settings, Ps)
+    x, z, y, yx = (state.x * D, state.z / E, state.y * E / cost[:, None],
+                   state.yx / D / cost[:, None])
+    return BatchSolution(
+        x=x, z=z, y=y, yx=yx,
+        pri_res=state.pri, dua_res=state.dua,
+        iters=jnp.broadcast_to(state.k, (S,)),
+        raw=(x, z, y, yx),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("settings",))
+def solve_batch_frozen(c, q2, A, cl, cu, lb, ub, factors: Factors,
+                       settings: ADMMSettings = ADMMSettings(),
+                       warm=None, P=None) -> BatchSolution:
+    """Jitted frozen-factor solve; see :func:`_solve_frozen_impl`."""
+    with jax.default_matmul_precision("highest"):
+        return _solve_frozen_impl(c, q2, A, cl, cu, lb, ub, factors, warm,
+                                  settings, P)
+
+
+@jax.jit
+def dual_objective(c, q2, A, cl, cu, lb, ub, y, x_hint, margin_scale=100.0):
+    """(S,) LOWER bounds on each scenario optimum from row duals ``y``.
+
+    Weak duality: for ANY y, ``g(y) = min_x L(x, y)`` bounds the optimum below
+    — unlike the primal objective of an inexact solve, which the reference's
+    Lagrangian spoke (lagrangian_bounder.py:19-56) gets exact from its MIP
+    solver but an iterative solver only gets to tolerance.  Construction:
+
+    - rows: contribute ``-y+·cu + y-·cl``; y is first CLIPPED to the dual
+      cone of finite sides (clipping just picks a different valid y),
+    - variables are NOT dualized: ``min_x [0.5 x'diag(q2)x + (c + A'y)'x]``
+      is solved in closed form per coordinate over the variable box.
+
+    For coordinates whose needed side is infinite (free variables with
+    residual reduced cost), the box is capped at ``X = margin_scale *
+    (1 + max|x_hint|)`` per scenario: the result is a certificate under the
+    assumption that the true optimizer lies within X (use
+    :func:`dual_objective_capped` to know which scenarios relied on it).
+    Models with finite variable bounds get an unconditional certificate.
+
+    Implemented as :func:`dual_cut` with nothing clamped.
+    """
+    base, _ = dual_cut(c, q2, A, cl, cu, lb, ub, y, x_hint,
+                       jnp.zeros(c.shape[1], dtype=bool), margin_scale)
+    return base
+
+
+@jax.jit
+def dual_objective_margin(c, q2, A, cl, cu, lb, ub, y, x_hint,
+                          margin_scale=100.0, widen=10.0):
+    """(S,) defensive margins for :func:`dual_objective`'s X-cap.
+
+    ``dual_objective`` evaluates free coordinates over a synthetic box of
+    half-width ``X = margin_scale*(1+max|x_hint|)``; its value is certified
+    only under ``|x*| <= X``.  Subtracting this margin extends the validity
+    box to ``widen*X``: for each coordinate whose needed side is infinite,
+    the margin is the decrease of the coordinate minimum when the box grows
+    from X to widen*X (exact for linear coordinates, an upper bound for
+    quadratic ones).  Tight duals make every margin ~0, so the cost of the
+    widened certificate vanishes exactly when the bound is good.
+    """
+    cl, cu = _clean_bounds(cl, cu)
+    lb, ub = _clean_bounds(lb, ub)
+    fin_lb, fin_ub = lb > -BIG / 2, ub < BIG / 2
+    y = jnp.where(~(cu < BIG / 2) & (y > 0), 0.0, y)
+    y = jnp.where(~(cl > -BIG / 2) & (y < 0), 0.0, y)
+    g = c + jnp.einsum("smn,sm->sn", A, y)
+    X = margin_scale * (1.0 + jnp.max(jnp.abs(x_hint), axis=1, keepdims=True))
+    # linear coords: value at the capped side is g*(+-X); widening multiplies
+    # the capped side by `widen`, decreasing the minimum by |g|*(widen-1)*X.
+    # quadratic coords: the minimum over a LARGER box can only decrease, and
+    # by at most the same linear envelope (q2 >= 0), so the bound applies too.
+    need_hi = ~fin_ub & (g < 0)
+    need_lo = ~fin_lb & (g > 0)
+    # a quadratic coordinate only hits the cap when its unconstrained
+    # minimizer |g|/q2 lies beyond X; interior minima are exact as-is
+    engaged = (q2 <= 1e-14) | (jnp.abs(g) > q2 * X)
+    per = jnp.where((need_hi | need_lo) & engaged,
+                    jnp.abs(g) * (widen - 1.0) * X, 0.0)
+    return jnp.sum(per, axis=1)
+
+
+@jax.jit
+def dual_cut(c, q2, A, cl, cu, lb, ub, y, x_hint, clamp_mask,
+             margin_scale=100.0):
+    """Benders-cut data valid for ANY duals ``y`` (weak duality).
+
+    For the value function of a problem whose ``clamp_mask`` columns are
+    fixed at x̂ (lb = ub = x̂), the dual objective decomposes into terms
+    independent of x̂ plus a term LINEAR in x̂:
+
+        Q(x̂') >= base + g[clamp] . x̂'      for every x̂'
+
+    with ``g = c + A'y`` and ``base`` the row term plus the non-clamped
+    coordinate minima.  Unlike the raw clamp duals ``-yx`` (exact only for
+    sign-FEASIBLE optimal duals — a polished dual at a degenerate optimum
+    can satisfy stationarity with wrong-signed multipliers and yield an
+    INVALID cut), this construction can only weaken, never invalidate.
+    Returns ``(base (S,), g (S, n))``; callers slice g at the clamp columns.
+    """
+    dt = c.dtype
+    cl, cu = _clean_bounds(cl, cu)
+    lb, ub = _clean_bounds(lb, ub)
+    fin_cl, fin_cu = cl > -BIG / 2, cu < BIG / 2
+    fin_lb, fin_ub = lb > -BIG / 2, ub < BIG / 2
+
+    y = jnp.where(~fin_cu & (y > 0), 0.0, y)
+    y = jnp.where(~fin_cl & (y < 0), 0.0, y)
+    yp = jnp.maximum(y, 0.0)
+    ym = jnp.minimum(y, 0.0)
+    row_term = jnp.sum(-yp * jnp.where(fin_cu, cu, 0.0)
+                       - ym * jnp.where(fin_cl, cl, 0.0), axis=1)
+
+    X = margin_scale * (1.0 + jnp.max(jnp.abs(x_hint), axis=1, keepdims=True))
+    L = jnp.where(fin_lb, lb, -X)
+    U = jnp.where(fin_ub, ub, X)
+    g = c + jnp.einsum("smn,sm->sn", A, y)
+    quad = q2 > 1e-14
+    xq = jnp.clip(jnp.where(quad, -g / jnp.where(quad, q2, 1.0), 0.0), L, U)
+    val_quad = 0.5 * q2 * xq * xq + g * xq
+    val_lin = g * jnp.where(g >= 0, L, U)
+    term = jnp.where(quad, val_quad, val_lin)
+    base = row_term + jnp.sum(jnp.where(clamp_mask[None, :], 0.0, term),
+                              axis=1)
+    return base, g
+
+
+@functools.partial(jax.jit, static_argnames=("settings",))
+def solve_batch_factored(c, q2, A, cl, cu, lb, ub,
+                         settings: ADMMSettings = ADMMSettings(),
+                         warm=None, P=None):
+    """Adaptive solve that ALSO returns the reusable :class:`Factors` for
+    subsequent :func:`solve_batch_frozen` calls."""
+    with jax.default_matmul_precision("highest"):
+        return _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm, P,
+                           want_factors=True)
 
 
 class SingleSolution(NamedTuple):

@@ -172,3 +172,154 @@ def spin_the_wheel(hub_dict, list_of_spoke_dict, comm_world=None):
     ws.spin(comm_world)
     global_toc("Spinning complete", True)
     return ws
+
+
+# ---- cross-process wheel over the C++ shm window service --------------------
+
+def _scrubbed_child_env():
+    """Child-process env for CPU cylinders.
+
+    The axon sitecustomize (TPU tunnel shim, injected via PYTHONPATH) dials
+    its relay at interpreter start; spawned CPU children must not inherit it
+    or they hang before reaching our code when the relay is down.  A shared
+    persistent compilation cache is enabled so sibling cylinder processes
+    (which compile identical solver programs) pay the XLA compile once.
+    """
+    import os
+
+    env = dict(os.environ)
+    pp = env.get("PYTHONPATH", "")
+    parts = [p for p in pp.split(os.pathsep) if p and ".axon_site" not in p]
+    if parts:
+        env["PYTHONPATH"] = os.pathsep.join(parts)
+    else:
+        env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "tpusppy_xla"))
+    return env
+
+
+def _spoke_worker(fabric_name, spoke_dict, strata_rank):
+    """Child-process entry: attach the shm fabric, build this cylinder's opt,
+    run its main loop (the per-rank role dispatch of spin_the_wheel.py:92-127,
+    as an OS process instead of an MPI rank)."""
+    from .runtime.window_service import ShmWindowFabric
+
+    fabric = ShmWindowFabric(fabric_name, attach=True)
+    opt = spoke_dict["opt_class"](**spoke_dict["opt_kwargs"])
+    comm = spoke_dict["spoke_class"](
+        opt, strata_rank, fabric, **spoke_dict.get("spoke_kwargs", {}))
+    try:
+        comm.main()
+    finally:
+        comm.finalize()
+
+
+class MultiprocessWheelSpinner(WheelSpinner):
+    """WheelSpinner whose spokes are separate OS processes over the C++
+    shared-memory window service — true algorithm parallelism (SURVEY P3).
+
+    The reference gives each cylinder its own process group and exchanges
+    one-sided RMA windows (spin_the_wheel.py:219-237, spcommunicator.py:
+    93-120); here each cylinder is an OS process and the windows are seqlock
+    shm mailboxes (runtime/csrc/window_service.cpp) with identical write-id /
+    kill-sentinel semantics.  Intended for CPU cylinders or multi-host
+    deployments where each process owns its own device slice; on the shared
+    single-TPU dev box, the in-process (threaded) WheelSpinner remains the
+    default.
+    """
+
+    def run(self):
+        import multiprocessing as mp
+        import os
+        import uuid
+
+        from .runtime.window_service import ShmWindowFabric
+
+        hub = self.hub_dict
+        hub_opt = hub["opt_class"](**hub["opt_kwargs"])
+
+        # Length negotiation (the Send/Recv of spoke.py:34-58): buffer sizes
+        # are functions of the shared model shape, so temporary spoke comms
+        # around the HUB's opt compute them without building spoke opts.
+        lengths = []
+        for i, sd in enumerate(self.list_of_spoke_dict):
+            tmp = sd["spoke_class"](hub_opt, i + 1, WindowFabric(),
+                                    **sd.get("spoke_kwargs", {}))
+            s2h, h2s = tmp.buffer_lengths()
+            lengths.append((h2s, s2h))
+        hub_opt.spcomm = None
+
+        name = f"/tpusppy_wheel_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+        fabric = ShmWindowFabric(name, spoke_lengths=lengths)
+
+        ctx = mp.get_context("spawn")
+        procs = []
+        old_env = dict(os.environ)
+        os.environ.clear()
+        os.environ.update(_scrubbed_child_env())
+        try:
+            for i, sd in enumerate(self.list_of_spoke_dict):
+                p = ctx.Process(
+                    target=_spoke_worker, args=(name, sd, i + 1),
+                    name=sd["spoke_class"].__name__, daemon=True,
+                )
+                p.start()
+                procs.append(p)
+        finally:
+            os.environ.clear()
+            os.environ.update(old_env)
+
+        hub_comm = hub["hub_class"](
+            hub_opt, 0, fabric, spokes=self.list_of_spoke_dict,
+            **hub.get("hub_kwargs", {}),
+        )
+        hub_comm.setup_hub()
+        # First-contact barrier: spawned cylinders cold-start a full python +
+        # jax + XLA-compile pipeline; a fast hub would otherwise finish and
+        # kill them before they ever report a bound.  (MPI ranks start
+        # together; process spawn does not.)  Each spoke's first Put marks it
+        # live; a dead child is detected via its exit code.
+        import time as _time
+
+        wait = float(self.hub_dict.get("first_contact_wait", 900.0))
+        t0 = _time.time()
+        while _time.time() - t0 < wait:
+            if all(mb.write_id != 0 for mb in fabric.to_hub.values()):
+                break
+            if any(p.exitcode not in (None, 0) for p in procs):
+                break
+            _time.sleep(0.25)
+        try:
+            try:
+                hub_comm.main()
+            finally:
+                hub_comm.send_terminate()
+            for p in procs:
+                p.join(timeout=300)
+            hung = [p.name for p in procs if p.is_alive()]
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            if hung:
+                raise RuntimeError(
+                    f"Spoke processes did not terminate: {hung}")
+            bad = [(p.name, p.exitcode) for p in procs if p.exitcode != 0]
+            if bad:
+                raise RuntimeError(f"Spoke process failures: {bad}")
+        finally:
+            # failure paths must not abandon the hub's results or leak the
+            # POSIX shm segment
+            hub_comm.finalize()
+            hub_comm.hub_finalize()
+            self.spcomm = hub_comm
+            self.opt = hub_opt
+            self.spoke_comms = []
+            self.spun = True
+            self.BestInnerBound = hub_comm.BestInnerBound
+            self.BestOuterBound = hub_comm.BestOuterBound
+            self.local_nonant_cache = self._best_nonant_cache()
+            fabric.close()
+        return self
