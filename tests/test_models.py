@@ -118,7 +118,10 @@ def test_uc_lite_ef_and_ph():
     from tpusppy.models import uc_lite
 
     names = uc_lite.scenario_names_creator(3)
-    kw = {"num_gens": 3, "horizon": 6, "num_scens": 3}
+    # LP-relaxation parity leg: uc_lite is integer-by-default now; the
+    # integer-mode coverage lives in test_mip_incumbents
+    kw = {"num_gens": 3, "horizon": 6, "num_scens": 3,
+          "relax_integers": True}
     batch = _batch(uc_lite, names, **kw)
     obj_h, _ = solve_ef(batch, solver="highs")
     obj_a, _ = solve_ef(batch, solver="admm")

@@ -35,8 +35,11 @@ def kw_creator(cfg=None, **kwargs):
         "horizon": kwargs.get("horizon", get("uc_horizon", 12)),
         "num_scens": kwargs.get("num_scens", get("num_scens")),
         "seedoffset": kwargs.get("seedoffset", get("seedoffset", 0)),
+        # integer commitment by DEFAULT: this is the headline family's whole
+        # point (1000-scenario stochastic UC with integer u); pass
+        # relax_integers=True explicitly for the easy LP mode
         "relax_integers": kwargs.get("relax_integers",
-                                     get("relax_integers", True)),
+                                     get("relax_integers", False)),
     }
 
 
@@ -58,7 +61,7 @@ def _fleet(num_gens, seedoffset):
 
 
 def scenario_creator(scenario_name, num_gens=5, horizon=12, num_scens=None,
-                     seedoffset=0, relax_integers=True):
+                     seedoffset=0, relax_integers=False):
     scennum = extract_num(scenario_name)
     pmax, pmin, mc, noload, ramp = _fleet(num_gens, seedoffset)
     stream = np.random.RandomState(31400 + scennum + seedoffset)

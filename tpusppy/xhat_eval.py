@@ -38,12 +38,49 @@ class Xhat_Eval(SPOpt):
         super().__init__(*args, **kwargs)
         self.tee_rank0_solves = False
 
+    @staticmethod
+    def _dive_round(x, ints, lb, ub, choose_up):
+        """One dive clamp: snap near-integral free integer columns, then
+        force the single most fractional free column per row toward the
+        direction ``choose_up`` picks (True=ceil).  Forced values are CLIPPED
+        into the current box first — an out-of-box force (e.g. flooring an
+        x-iterate that sits just below lb) must tighten inside the domain,
+        never collapse the box past its true bounds.
+        Returns the updated (lb, ub) or None when nothing is left to do."""
+        import numpy as np
+
+        free = ints[None, :] & (ub > lb)
+        frac = np.where(free, np.abs(x - np.round(x)), -1.0)
+        if not free.any() or frac.max() < 1e-6:
+            return None
+        near = free & (frac < 0.1)
+        vals = np.round(np.where(near, x, 0.0))
+        pick = frac.argmax(axis=1)
+        # force only when the worst column is OUTSIDE the snap band: if all
+        # free columns are near-integral, snapping already progresses, and a
+        # force would override the snap and round a ~0.08 binary the wrong way
+        has = free.any(axis=1) & (frac.max(axis=1) >= 0.1)
+        B = x.shape[0]
+        up = choose_up(B)
+        force = np.zeros_like(near)
+        force[np.arange(B), pick] = has
+        fx = np.where(force, x, 0.0)
+        fv = np.where(up[:, None], np.ceil(fx - 1e-9), np.floor(fx + 1e-9))
+        vals = np.where(force, fv, vals)
+        vals = np.clip(vals, lb, ub)
+        clamp = near | force
+        lb = np.where(clamp, np.maximum(vals, lb), lb)
+        ub = np.where(clamp, np.minimum(vals, ub), ub)
+        return lb, np.maximum(ub, lb)
+
     def _integer_dive(self, lb, ub):
         """Drive remaining fractional integer columns integral.
 
         Per round: solve the batch; clamp integer columns within 0.1 of an
         integer to that integer, plus (to guarantee progress) each
-        scenario's single most fractional integer column to its rounding.
+        scenario's single most fractional integer column rounded UP
+        (covering-style constraints stay satisfiable; the re-solve lets
+        other free columns compensate).
         """
         import numpy as np
 
@@ -51,7 +88,7 @@ class Xhat_Eval(SPOpt):
 
         b = self.batch
         ints = b.is_int
-        rounds = int(self.options.get("xhat_dive_rounds", 12))
+        rounds = max(1, int(self.options.get("xhat_dive_rounds", 12)))
         lb = np.array(lb, copy=True)
         ub = np.array(ub, copy=True)
         x = None
@@ -62,34 +99,79 @@ class Xhat_Eval(SPOpt):
             self.local_x = x
             self.pri_res = np.asarray(sol.pri_res)
             self.dua_res = np.asarray(sol.dua_res)
-            free = ints[None, :] & (ub > lb)          # (S, n) undecided ints
-            if not free.any():
+            nxt = self._dive_round(x, ints, lb, ub,
+                                   lambda B: np.ones(B, dtype=bool))
+            if nxt is None:
                 break
-            frac = np.where(free, np.abs(x - np.round(x)), -1.0)
-            if frac.max() < 1e-6:
-                break
-            near = free & (frac < 0.1)
-            # force progress: most fractional free int column per scenario,
-            # rounded UP (covering-style constraints stay satisfiable; the
-            # re-solve lets other free columns compensate)
-            worst = frac.argmax(axis=1)
-            has_free = free.any(axis=1)
-            force = np.zeros_like(near)
-            force[np.arange(x.shape[0]), worst] = has_free
-            vals = np.round(np.where(near, x, 0.0))
-            vals = np.where(force, np.ceil(np.where(force, x, 0.0) - 1e-9),
-                            vals)
-            clamp = near | force
-            lb = np.where(clamp, np.maximum(vals, lb), lb)
-            ub = np.where(clamp, np.minimum(vals, ub), ub)
-            lb = np.minimum(lb, ub)  # keep boxes sane after rounding
+            lb, ub = nxt
         return x
 
-    def _host_milp(self, lb, ub):
-        """Per-scenario HiGHS MILP with nonants clamped — the fallback when
-        diving wedges (e.g. capacity-binding all-integer recourse).  This is
-        exactly the role the reference's external MIP solver plays for
-        incumbent evaluation; each scenario MILP is small and independent.
+    def _retry_dive(self, lb0, ub0, bad):
+        """Batched randomized-rounding retries for the scenarios a plain dive
+        wedged (device path; replaces most uses of the serial host MILP).
+
+        Each wedged scenario is tiled R times; every replica gets a random
+        rounding direction for its forced column each round, and all
+        replicas re-dive TOGETHER in one batch.  The deterministic round-up
+        dive wedges exactly when some column needed the other direction
+        (e.g. cardinality rows); randomization explores the corners at batch
+        cost instead of per-scenario host MILPs.  Work is chunked so the
+        replica batch never exceeds ``xhat_dive_retry_batch`` rows.
+        Returns (solutions (len(bad), n), feasible flags).
+        """
+        import numpy as np
+
+        from .solvers import admm
+
+        b = self.batch
+        cap = max(1, int(self.options.get("xhat_dive_retry_batch", 512)))
+        # R in [1, cap] so the replica batch honors the memory cap
+        R = max(1, min(int(self.options.get("xhat_dive_retries", 8)), cap))
+        rng = np.random.RandomState(
+            int(self.options.get("xhat_dive_seed", 0)))
+        ints = b.is_int
+        tol = max(self.options.get("feas_tol", 1e-3),
+                  10.0 * self.admm_settings.eps_rel)
+        rounds = max(1, int(self.options.get("xhat_dive_rounds", 12)))
+        chunk = max(1, cap // R)
+
+        xs = np.zeros((bad.size, b.num_vars))
+        feas = np.zeros(bad.size, dtype=bool)
+        for c0 in range(0, bad.size, chunk):
+            sel = bad[c0:c0 + chunk]
+            tile = lambda a: np.repeat(a[sel], R, axis=0)
+            c_t, q2_t, A_t = tile(b.c), tile(b.q2), tile(b.A)
+            cl_t, cu_t = tile(b.cl), tile(b.cu)
+            lb_t, ub_t = tile(lb0), tile(ub0)
+            x = None
+            for _ in range(rounds):
+                sol = admm.solve_batch(c_t, q2_t, A_t, cl_t, cu_t, lb_t,
+                                       ub_t, settings=self.admm_settings)
+                x = np.asarray(sol.x)
+                nxt = self._dive_round(x, ints, lb_t, ub_t,
+                                       lambda B: rng.rand(B) < 0.5)
+                if nxt is None:
+                    break
+                lb_t, ub_t = nxt
+            # best feasible replica per wedged scenario
+            objs = (np.einsum("bn,bn->b", c_t, x)
+                    + 0.5 * np.einsum("bn,bn->b", q2_t, x * x))
+            pri = np.asarray(sol.pri_res)
+            frac = np.where(ints[None, :], np.abs(x - np.round(x)), 0.0)
+            ok = (pri <= tol) & (frac.max(axis=1) < 1e-5)
+            objs = np.where(ok, objs, np.inf)
+            for i in range(sel.size):
+                grp = objs[i * R:(i + 1) * R]
+                j = int(np.argmin(grp))
+                feas[c0 + i] = np.isfinite(grp[j])
+                xs[c0 + i] = x[i * R + j]
+        return xs, feas
+
+    def _host_milp(self, lb, ub, only=None):
+        """Per-scenario HiGHS MILP with nonants clamped — the LAST-DITCH
+        fallback when both diving and batched retries wedge.  This is the
+        role the reference's external MIP solver plays for incumbent
+        evaluation; ``only`` restricts the loop to the still-wedged slice.
         """
         import numpy as np
 
@@ -97,11 +179,13 @@ class Xhat_Eval(SPOpt):
 
         b = self.batch
         S = b.num_scenarios
-        xs = np.zeros((S, b.num_vars))
+        scens = range(S) if only is None else only
+        xs = np.array(self.local_x, copy=True) if self.local_x is not None \
+            else np.zeros((S, b.num_vars))
         pri = np.zeros(S)
         limit = float(self.options.get("xhat_mip_time_limit", 2.0))
         gap = float(self.options.get("xhat_mip_rel_gap", 1e-4))
-        for s in range(S):
+        for s in scens:
             res = scipy_backend.solve_lp(
                 b.c[s], b.A[s], b.cl[s], b.cu[s], lb[s], ub[s],
                 is_int=b.is_int, mip_rel_gap=gap, time_limit=limit)
@@ -133,8 +217,27 @@ class Xhat_Eval(SPOpt):
                 x = self._integer_dive(self._fixed_lb, self._fixed_ub)
                 tol = max(self.options.get("feas_tol", 1e-3),
                           10.0 * self.admm_settings.eps_rel)
-                if (np.asarray(self.pri_res) > tol).any():
-                    x = self._host_milp(self._fixed_lb, self._fixed_ub)
+                ints = b.is_int[None, :]
+                frac = np.where(ints, np.abs(x - np.round(x)), 0.0)
+                bad = np.flatnonzero(
+                    (np.asarray(self.pri_res) > tol)
+                    | (frac.max(axis=1) > 1e-5))
+                if bad.size:
+                    # batched randomized-rounding retries for wedged
+                    # scenarios (device path)
+                    xs, feas = self._retry_dive(self._fixed_lb,
+                                                self._fixed_ub, bad)
+                    x = np.array(x, copy=True)   # jax arrays are read-only
+                    x[bad[feas]] = xs[feas]
+                    self.local_x = x
+                    pri = np.array(self.pri_res, copy=True)
+                    pri[bad[feas]] = 0.0
+                    self.pri_res = pri
+                    still = bad[~feas]
+                    if still.size:
+                        # last ditch: exact host MILPs on the residue only
+                        x = self._host_milp(self._fixed_lb, self._fixed_ub,
+                                            only=still)
             else:
                 # cold start: the clamped problem's geometry differs enough
                 # that stale warm duals slow ADMM down rather than help
