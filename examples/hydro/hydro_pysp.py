@@ -1,0 +1,85 @@
+"""Hydro via PySP-format inputs: the Pyomo-less ReferenceModel.
+
+Demonstrates :mod:`tpusppy.utils.pysp_model`: the scenario tree and all data
+come from ``PySP/scenariodata/*.dat`` (ScenarioStructure grammar + AMPL
+data files); only the model algebra below is python.  Usage::
+
+    python hydro_pysp.py            # solves the EF, prints the objective
+"""
+
+import os
+
+import numpy as np
+
+from tpusppy.ir import LinearModelBuilder
+
+DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "PySP", "scenariodata")
+
+
+def pysp_instance_creator(data, scenario_name):
+    """Build one hydro scenario from parsed .dat data (the Pyomo-less
+    ReferenceModel; compare tpusppy/models/hydro.py which hard-codes the
+    same constants)."""
+    T = int(data["nb_etap"])
+    D = [float(data["D"][t + 1]) for t in range(T)]
+    u = [float(data["u"][t + 1]) for t in range(T)]
+    dur = [float(data["duration"][t + 1]) for t in range(T)]
+    A = [float(data["A"][t + 1]) for t in range(T)]
+    disc = [(1.0 / float(data["rate"])) ** (dur[t] / float(data["horizon"]))
+            for t in range(T)]
+    bgt, bgh, bdns = (float(data["betaGt"]), float(data["betaGh"]),
+                      float(data["betaDns"]))
+    V0 = float(data["V0"])
+    wv = float(data["WaterValue"])
+
+    b = LinearModelBuilder(scenario_name)
+    pgt, pgh, pdns, vol = [], [], [], []
+    for t in range(T):
+        pgt.append(b.add_var(f"Pgt[{t + 1}]", lb=0.0,
+                             ub=float(data["PgtMax"]), cost=disc[t] * bgt))
+        pgh.append(b.add_var(f"Pgh[{t + 1}]", lb=0.0,
+                             ub=float(data["PghMax"]), cost=disc[t] * bgh))
+        pdns.append(b.add_var(f"PDns[{t + 1}]", lb=0.0, ub=D[t],
+                              cost=disc[t] * bdns))
+        vol.append(b.add_var(f"Vol[{t + 1}]", lb=0.0,
+                             ub=float(data["VMax"])))
+    sl = b.add_var("sl", lb=0.0, cost=1.0)
+
+    for t in range(T):
+        b.add_eq({pgt[t]: 1.0, pgh[t]: 1.0, pdns[t]: 1.0}, D[t])
+        coeffs = {vol[t]: 1.0, pgh[t]: u[t]}
+        rhs = u[t] * A[t]
+        if t == 0:
+            rhs += V0
+        else:
+            coeffs[vol[t - 1]] = -1.0
+        b.add_le(coeffs, rhs)
+    b.add_ge({sl: 1.0, vol[-1]: wv}, wv * V0)
+    return b.build()
+
+
+def make_model():
+    from tpusppy.utils.pysp_model import PySPModel
+
+    return PySPModel(
+        pysp_instance_creator,
+        os.path.join(DATA_DIR, "ScenarioStructure.dat"),
+    )
+
+
+def main():
+    from tpusppy.ef import solve_ef
+    from tpusppy.ir import ScenarioBatch
+
+    model = make_model()
+    batch = ScenarioBatch.from_problems([
+        model.scenario_creator(nm) for nm in model.all_scenario_names
+    ])
+    obj, _ = solve_ef(batch, solver="highs")
+    print(f"hydro (PySP inputs) EF objective: {obj:.2f}")
+    return obj
+
+
+if __name__ == "__main__":
+    main()

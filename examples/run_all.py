@@ -40,6 +40,7 @@ RUNS = [
     ("netdes/netdes_cylinders.py",
      ["--num-scens", "3", "--max-iterations", "12", "--default-rho", "1.0",
       "--rel-gap", "0.05", "--cross-scenario-cuts", "--xhatshuffle"]),
+    ("hydro/hydro_pysp.py", []),
     ("hydro/hydro_cylinders.py",
      ["--branching-factors", "3 3", "--max-iterations", "20",
       "--default-rho", "1.0", "--rel-gap", "0.02", "--lagrangian",

@@ -1,0 +1,238 @@
+"""PySP-format ingestion: .dat parser, ScenarioStructure, PySPModel.
+
+Mirrors the semantics of the reference's pysp_model tests
+(``mpisppy/utils/pysp_model/tests``): structure parsing and validation,
+scenario-tree construction, and end-to-end model building from bundled
+PySP inputs (examples/hydro/PySP).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from tpusppy.utils.pysp_model import (
+    PySPModel, ScenarioStructure, parse_dat_text)
+
+EXDIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+# ---- datparser ----------------------------------------------------------
+
+def test_parse_sets_params_tables():
+    data = parse_dat_text("""
+    # a comment
+    set S := a b c ;
+    set Children[root] := n1 n2 ;
+    param scalar := 3 ;
+    param keyed :=
+      1 40  # trailing comment
+      2 60
+    ;
+    param tab:
+      1 2 :=
+      r1 10 20
+      r2 30 40
+    ;
+    """)
+    assert data["S"] == ["a", "b", "c"]
+    assert data["Children[root]"] == ["n1", "n2"]
+    assert data["scalar"] == 3
+    assert data["keyed"] == {1: 40, 2: 60}
+    assert data["tab"][("r1", 2)] == 20
+    assert data["tab"][("r2", 1)] == 30
+
+
+def test_parse_default_params():
+    """AMPL 'default' clause: missing keys return the default (sparse
+    params), surviving node-data layering."""
+    a = parse_dat_text("param A default 0 := 2 10 ;")
+    assert a["A"][2] == 10
+    assert a["A"][1] == 0            # default applied
+    assert a["A"].get(7) == 0
+    b = parse_dat_text("param A := 3 30 ;")
+    a.merge(b)
+    assert a["A"][3] == 30 and a["A"][99] == 0
+
+
+def test_structure_rejects_nonunit_root_probability():
+    bad = STRUCT.replace("root 1.0", "root 0.5")
+    with pytest.raises(ValueError, match="root node conditional"):
+        ScenarioStructure(parse_dat_text(bad))
+
+
+def test_overlapping_stage_variables_deduped(tmp_path):
+    """'x[*] x[1]' (explicit entry overlapping a wildcard) must not inflate
+    the nonant count."""
+    struct = STRUCT.replace("set StageVariables[t1] := x[*] ;",
+                            "set StageVariables[t1] := x[*] x[1] ;")
+    (tmp_path / "ScenarioStructure.dat").write_text(struct)
+    (tmp_path / "s1.dat").write_text("param d := 1.0 ;")
+    (tmp_path / "s2.dat").write_text("param d := 2.0 ;")
+
+    from tpusppy.ir import LinearModelBuilder
+
+    def creator(data, name):
+        b = LinearModelBuilder(name)
+        x1 = b.add_var("x[1]", lb=0.0, ub=4.0, cost=1.0)
+        x2 = b.add_var("x[2]", lb=0.0, ub=4.0, cost=1.0)
+        b.add_ge({x1: 1.0, x2: 1.0}, float(data["d"]))
+        return b.build()
+
+    model = PySPModel(creator, str(tmp_path / "ScenarioStructure.dat"))
+    s1 = model.scenario_creator("s1")
+    assert s1.nodes[0].nonant_indices.tolist() == [0, 1]
+
+
+def test_missing_scenario_data_raises(tmp_path):
+    """Shared data alone must not silently degenerate the program to its
+    deterministic mean problem."""
+    (tmp_path / "ScenarioStructure.dat").write_text(STRUCT)
+    (tmp_path / "ReferenceModel.dat").write_text("param d := 1.0 ;")
+    model = PySPModel(lambda data, name: None,
+                      str(tmp_path / "ScenarioStructure.dat"))
+    with pytest.raises(FileNotFoundError, match="scenario-specific"):
+        model.scenario_data("s1")
+
+
+def test_parse_merge_layering():
+    a = parse_dat_text("param p := 1 10 2 20 ; set S := x ;")
+    b = parse_dat_text("param p := 2 99 3 30 ; set S := y ;")
+    a.merge(b)
+    assert a["p"] == {1: 10, 2: 99, 3: 30}     # later file overrides
+    assert a["S"] == ["x", "y"]
+
+
+# ---- ScenarioStructure --------------------------------------------------
+
+STRUCT = """
+set Stages := t1 t2 ;
+set Nodes := root n1 n2 ;
+param NodeStage := root t1 n1 t2 n2 t2 ;
+set Children[root] := n1 n2 ;
+param ConditionalProbability := root 1.0 n1 0.5 n2 0.5 ;
+set Scenarios := s1 s2 ;
+param ScenarioLeafNode := s1 n1 s2 n2 ;
+set StageVariables[t1] := x[*] ;
+param StageCost := t1 cost[1] t2 cost[2] ;
+"""
+
+
+def test_structure_parse_and_canonical_names():
+    st = ScenarioStructure(parse_dat_text(STRUCT))
+    assert st.root == "root"
+    assert st.canon == {"root": "ROOT", "n1": "ROOT_0", "n2": "ROOT_1"}
+    assert st.node_path("s2") == ["root", "n2"]
+    assert st.scenario_probability("s1") == pytest.approx(0.5)
+    assert st.stage_index == {"t1": 1, "t2": 2}
+
+
+def test_structure_validation_errors():
+    bad = STRUCT.replace("n1 0.5 n2 0.5", "n1 0.6 n2 0.6")
+    with pytest.raises(ValueError, match="sum"):
+        ScenarioStructure(parse_dat_text(bad))
+    bad2 = STRUCT.replace("param ScenarioLeafNode := s1 n1 s2 n2 ;",
+                          "param ScenarioLeafNode := s1 root s2 n2 ;")
+    with pytest.raises(ValueError, match="last stage"):
+        ScenarioStructure(parse_dat_text(bad2))
+
+
+def test_wildcard_stage_variables():
+    st = ScenarioStructure(parse_dat_text(STRUCT))
+    names = ["x[1]", "x[2]", "y", "xx"]
+    assert st.match_stage_vars("t1", names) == [0, 1]
+    with pytest.raises(ValueError, match="matches nothing"):
+        st.match_stage_vars("t1", ["y", "z"])
+
+
+# ---- PySPModel end-to-end on the bundled hydro PySP inputs --------------
+
+def _hydro_pysp():
+    sys.path.insert(0, os.path.join(EXDIR, "hydro"))
+    try:
+        import hydro_pysp
+    finally:
+        sys.path.pop(0)
+    return hydro_pysp
+
+
+def test_pysp_hydro_matches_native_model():
+    """EF objective of the PySP-ingested hydro equals the hand-annotated
+    tpusppy hydro model (and the golden ~190 at 2 significant digits)."""
+    from tpusppy.ef import solve_ef
+    from tpusppy.ir import ScenarioBatch
+    from tpusppy.models import hydro
+
+    hp = _hydro_pysp()
+    model = hp.make_model()
+    assert model.all_scenario_names == [f"Scen{i+1}" for i in range(9)]
+
+    probs = [model.structure.scenario_probability(s)
+             for s in model.all_scenario_names]
+    assert sum(probs) == pytest.approx(1.0, abs=1e-6)
+
+    scens = [model.scenario_creator(nm) for nm in model.all_scenario_names]
+    batch = ScenarioBatch.from_problems(scens)
+    obj_pysp, _ = solve_ef(batch, solver="highs")
+
+    native = ScenarioBatch.from_problems([
+        hydro.scenario_creator(nm)
+        for nm in hydro.scenario_names_creator(9)])
+    obj_native, _ = solve_ef(native, solver="highs")
+    assert obj_pysp == pytest.approx(obj_native, rel=1e-6)
+    assert round(obj_pysp, -1) == 190.0        # golden, 2 sig figs
+
+    # nonant structure: stage-1 and stage-2 nodes with 4 nonants each,
+    # canonical names, and consistent node membership
+    s0 = scens[0]
+    assert [nd.name for nd in s0.nodes] == ["ROOT", "ROOT_0"]
+    assert all(len(nd.nonant_indices) == 4 for nd in s0.nodes)
+    s8 = scens[8]
+    assert [nd.name for nd in s8.nodes] == ["ROOT", "ROOT_2"]
+
+
+def test_pysp_hydro_ph_runs():
+    """The PySP-sourced creator drives PH unchanged (protocol parity)."""
+    from tpusppy.opt.ph import PH
+
+    hp = _hydro_pysp()
+    model = hp.make_model()
+    ph = PH({"defaultPHrho": 1.0, "PHIterLimit": 25, "convthresh": 1e-4},
+            model.all_scenario_names,
+            lambda nm, **kw: model.scenario_creator(nm))
+    conv, eobj, triv = ph.ph_main()
+    assert triv <= eobj + 1.0
+    assert eobj == pytest.approx(190.0, rel=0.05)
+
+
+# ---- node-based data layout --------------------------------------------
+
+def test_node_based_data_layout(tmp_path):
+    """PySP node-data mode: per-node .dat files merged along the scenario's
+    root->leaf path (later stages override)."""
+    (tmp_path / "ScenarioStructure.dat").write_text(STRUCT)
+    (tmp_path / "root.dat").write_text("param c := 1 5.0 2 7.0 ;")
+    (tmp_path / "n1.dat").write_text("param d := 1.0 ;")
+    (tmp_path / "n2.dat").write_text("param d := 3.0 ; param c := 2 9.0 ;")
+
+    from tpusppy.ir import LinearModelBuilder
+
+    def creator(data, name):
+        b = LinearModelBuilder(name)
+        x1 = b.add_var("x[1]", lb=0.0, ub=4.0, cost=float(data["c"][1]))
+        x2 = b.add_var("x[2]", lb=0.0, ub=4.0, cost=float(data["c"][2]))
+        b.add_ge({x1: 1.0, x2: 1.0}, float(data["d"]))
+        return b.build()
+
+    model = PySPModel(creator, str(tmp_path / "ScenarioStructure.dat"))
+    s1 = model.scenario_creator("s1")
+    s2 = model.scenario_creator("s2")
+    assert s1.prob == pytest.approx(0.5)
+    # node layering: s2 overrides c[2] and d
+    assert s1.c.tolist() == [5.0, 7.0]
+    assert s2.c.tolist() == [5.0, 9.0]
+    assert float(s1.cl[0]) == 1.0 and float(s2.cl[0]) == 3.0
+    # wildcard nonants resolved: both x columns at the root node
+    assert s1.nodes[0].nonant_indices.tolist() == [0, 1]

@@ -1,0 +1,29 @@
+"""PySP-format model ingestion (Pyomo-less).
+
+TPU-native analogue of ``mpisppy/utils/pysp_model/`` (~3.7k LoC in the
+reference: ``pysp_model.py``, ``instance_factory.py:1``,
+``tree_structure.py:1``).  The reference turns old-PySP inputs — a Pyomo
+``ReferenceModel``, a ``ScenarioStructure.dat`` tree file, and per-scenario
+or per-node AMPL ``.dat`` data files — into mpi-sppy scenario creators.
+
+This package keeps the PySP DATA side byte-compatible (full parser for the
+AMPL .dat subset PySP uses; the ScenarioStructure tree grammar with stages,
+nodes, children, conditional probabilities, scenario->leaf maps, wildcard
+StageVariables) while replacing the Pyomo side with the builder protocol:
+the user's ReferenceModel becomes a callable
+
+    instance_creator(data: dict, scenario_name: str) -> ScenarioProblem
+
+taking the parsed .dat data (sets/params as dicts).  :class:`PySPModel`
+then provides ``scenario_creator``/``all_scenario_names``/... exactly like
+the reference's wrapper, with nonant annotations derived from
+StageVariables instead of hand-written ``attach_root_node`` calls.
+"""
+
+from .datparser import parse_dat_file, parse_dat_text
+from .tree_structure import ScenarioStructure
+from .pysp_model import PySPModel
+
+__all__ = [
+    "parse_dat_file", "parse_dat_text", "ScenarioStructure", "PySPModel",
+]

@@ -1,0 +1,163 @@
+"""AMPL ``.dat`` parser for the subset PySP inputs use.
+
+Covers what appears across the reference's PySP examples and test fixtures
+(sslp/hydro data dirs, pysp_model/tests/testdata): comments, simple and
+indexed sets, scalar params, keyed params (one or more key columns), and
+tabular ``param NAME : c1 c2 ... :=`` matrices.  Everything lands in plain
+python dicts — the data surface the Pyomo-less instance creators consume.
+
+Grammar subset::
+
+    # comment to end of line
+    set NAME := tok tok ... ;
+    set NAME[idx] := tok ... ;
+    param NAME := value ;                      # scalar
+    param NAME := key value key value ... ;    # 1-key
+    param NAME := k1 k2 value ... ;            # n-key (arity passed by caller
+                                               #        or inferred per name)
+    param NAME default V := ... ;
+    param NAME : col col ... := row v v ... ;  # tabular -> {(row, col): v}
+"""
+
+from __future__ import annotations
+
+import re
+
+
+def _tokens(text: str):
+    text = re.sub(r"#[^\n]*", " ", text)
+    # ':=' and ';' and ':' are their own tokens; brackets stay attached to
+    # names (PySP set names like Children[root] and values like x[*])
+    text = text.replace(":=", " := ").replace(";", " ; ")
+    text = re.sub(r"(?<![:\[]):(?!=)", " : ", text)
+    return text.split()
+
+
+def _coerce(tok: str):
+    try:
+        return int(tok)
+    except ValueError:
+        try:
+            return float(tok)
+        except ValueError:
+            return tok
+
+
+class DefaultedDict(dict):
+    """Keyed param with an AMPL ``default`` clause: missing keys return the
+    default (PySP/AMPL sparse-param semantics)."""
+
+    def __init__(self, default, items=()):
+        super().__init__(items)
+        self.default = default
+
+    def __missing__(self, key):
+        return self.default
+
+    def get(self, key, fallback=None):  # dict.get bypasses __missing__
+        return super().get(key, self.default if fallback is None else fallback)
+
+
+class DatData(dict):
+    """Parsed .dat contents: name -> value.
+
+    Sets are lists; scalar params are numbers/strings; keyed params are
+    dicts (tuple keys for arity > 1); tabular params are dicts keyed by
+    (row, col).  ``merge`` implements PySP's node-data layering (later files
+    override/extend earlier ones, as Pyomo's per-node instance construction
+    does).
+    """
+
+    def merge(self, other: "DatData"):
+        for k, v in other.items():
+            if k in self and isinstance(self[k], dict) and isinstance(v, dict):
+                merged = {**self[k], **v}
+                # a default clause survives layering (later file's wins)
+                if isinstance(v, DefaultedDict):
+                    merged = DefaultedDict(v.default, merged)
+                elif isinstance(self[k], DefaultedDict):
+                    merged = DefaultedDict(self[k].default, merged)
+                self[k] = merged
+            elif k in self and isinstance(self[k], list) and isinstance(v, list):
+                self[k] = self[k] + [e for e in v if e not in self[k]]
+            else:
+                self[k] = v
+        return self
+
+
+def parse_dat_text(text: str, param_arity=None) -> DatData:
+    """Parse .dat text; ``param_arity`` maps param name -> number of key
+    columns for n-key params (default inferred: scalar if one token, else
+    1-key pairs)."""
+    param_arity = dict(param_arity or {})
+    toks = _tokens(text)
+    out = DatData()
+    i = 0
+    n = len(toks)
+
+    def until_semicolon(j):
+        k = j
+        while k < n and toks[k] != ";":
+            k += 1
+        return toks[j:k], k + 1
+
+    while i < n:
+        t = toks[i]
+        if t == "set":
+            name = toks[i + 1]
+            assert toks[i + 2] == ":=", f"set {name}: expected ':='"
+            body, i = until_semicolon(i + 3)
+            out[name] = [_coerce(b) for b in body]
+        elif t == "param":
+            name = toks[i + 1]
+            j = i + 2
+            default = None
+            if toks[j] == "default":
+                default = _coerce(toks[j + 1])
+                j += 2
+            if toks[j] == ":":
+                # tabular: columns up to ':=', then rows of 1 key + values
+                j += 1
+                cols = []
+                while toks[j] != ":=":
+                    cols.append(_coerce(toks[j]))
+                    j += 1
+                body, i = until_semicolon(j + 1)
+                d = {}
+                w = len(cols) + 1
+                assert len(body) % w == 0, f"param {name}: ragged table"
+                for r in range(0, len(body), w):
+                    row = _coerce(body[r])
+                    for c, col in enumerate(cols):
+                        d[(row, col)] = _coerce(body[r + 1 + c])
+                out[name] = d if default is None else DefaultedDict(default, d)
+            else:
+                assert toks[j] == ":=", f"param {name}: expected ':='"
+                body, i = until_semicolon(j + 1)
+                if len(body) == 1 and name not in param_arity \
+                        and default is None:
+                    out[name] = _coerce(body[0])
+                else:
+                    arity = int(param_arity.get(name, 1))
+                    w = arity + 1
+                    assert len(body) % w == 0, (
+                        f"param {name}: {len(body)} tokens not divisible by "
+                        f"key arity {arity} + 1")
+                    d = {}
+                    for r in range(0, len(body), w):
+                        key = tuple(_coerce(b) for b in body[r:r + arity])
+                        if arity == 1:
+                            key = key[0]
+                        d[key] = _coerce(body[r + arity])
+                    out[name] = (d if default is None
+                                 else DefaultedDict(default, d))
+        elif t == ";":
+            i += 1
+        else:
+            raise ValueError(f"unexpected token {t!r} in .dat input")
+    return out
+
+
+def parse_dat_file(path: str, param_arity=None) -> DatData:
+    with open(path) as f:
+        return parse_dat_text(f.read(), param_arity)

@@ -1,0 +1,141 @@
+"""PySPModel: PySP inputs -> tpusppy scenario-creator protocol.
+
+Analogue of ``mpisppy/utils/pysp_model/pysp_model.py`` (which wraps the
+reference's instance_factory + tree_structure to expose
+``scenario_creator``/``all_scenario_names``/...).  Data layout support, as
+in PySP:
+
+- scenario-based: one ``<ScenarioName>.dat`` per scenario, optionally
+  layered over a shared ``ReferenceModel.dat``/``RootNode.dat``;
+- node-based: one ``<NodeName>.dat`` per tree node; a scenario's data is
+  the root->leaf merge of its node files (later stages override).
+
+The Pyomo ReferenceModel becomes ``instance_creator(data, name) ->
+ScenarioProblem``: a python callable building the model from the parsed
+.dat data dicts.  Nonanticipativity comes from ScenarioStructure's
+StageVariables (wildcards supported), turned into per-scenario
+:class:`~tpusppy.scenario_tree.ScenarioNode` lists with canonical
+ROOT/ROOT_i names — so Amalgamator, WheelSpinner, EF, and the confidence
+machinery all work unchanged on PySP-sourced models.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ...scenario_tree import ScenarioNode
+from .datparser import DatData, parse_dat_file
+from .tree_structure import ScenarioStructure
+
+
+class PySPModel:
+    """``PySPModel(instance_creator, scenario_structure, data_dir)``.
+
+    - ``instance_creator``: callable ``(data: DatData, scenario_name) ->
+      ScenarioProblem`` (a module exposing ``pysp_instance_creator`` also
+      works) — the Pyomo-less ReferenceModel;
+    - ``scenario_structure``: path to ScenarioStructure.dat (or a parsed
+      :class:`ScenarioStructure`);
+    - ``data_dir``: directory of the .dat files (defaults to the structure
+      file's directory).
+    """
+
+    def __init__(self, instance_creator, scenario_structure, data_dir=None,
+                 param_arity=None):
+        if hasattr(instance_creator, "pysp_instance_creator"):
+            instance_creator = instance_creator.pysp_instance_creator
+        self._creator = instance_creator
+        if isinstance(scenario_structure, ScenarioStructure):
+            self.structure = scenario_structure
+            self._dir = data_dir
+        else:
+            self.structure = ScenarioStructure.from_file(scenario_structure)
+            self._dir = data_dir or os.path.dirname(
+                os.path.abspath(scenario_structure))
+        if self._dir is None:
+            raise ValueError("data_dir required with a parsed structure")
+        self._arity = param_arity
+
+    # ---- data loading ---------------------------------------------------
+    def _read(self, fname) -> DatData | None:
+        """Parse (and memoize) one data file; shared files would otherwise
+        be re-parsed once per scenario at batch construction."""
+        cache = getattr(self, "_file_cache", None)
+        if cache is None:
+            cache = self._file_cache = {}
+        if fname not in cache:
+            path = os.path.join(self._dir, fname)
+            cache[fname] = (parse_dat_file(path, self._arity)
+                            if os.path.exists(path) else None)
+        # parsed data is read-only by contract (merge copies on collision,
+        # so cached entries are never mutated by layering)
+        return cache[fname]
+
+    def scenario_data(self, scenario_name: str) -> DatData:
+        """Parsed data for one scenario (scenario-based preferred, else
+        node-based merge along the root->leaf path)."""
+        data = DatData()
+        for shared in ("ReferenceModel.dat", "RootNode.dat"):
+            d = self._read(shared)
+            if d:
+                data.merge(d)
+        own = self._read(f"{scenario_name}.dat")
+        if own is not None:
+            return data.merge(own)
+        merged_any = False
+        for nd in self.structure.node_path(scenario_name):
+            d = self._read(f"{nd}.dat")
+            if d is not None:
+                data.merge(d)
+                merged_any = True
+        if not merged_any:
+            # shared data alone would make every scenario identical — the
+            # stochastic program silently degenerating to its mean problem
+            # is exactly the failure this must catch (e.g. node filenames
+            # not matching the structure's node names)
+            raise FileNotFoundError(
+                f"no scenario-specific data for {scenario_name}: neither "
+                f"{scenario_name}.dat nor node files found in {self._dir}")
+        return data
+
+    # ---- the tpusppy protocol (pysp_model.py surface) -------------------
+    @property
+    def all_scenario_names(self):
+        return list(self.structure.scenarios)
+
+    def scenario_names_creator(self, num_scens=None, start=0):
+        names = self.all_scenario_names
+        if num_scens is None:
+            return names[start:]
+        return names[start:start + num_scens]
+
+    def kw_creator(self, cfg=None, **kwargs):
+        return {}
+
+    @staticmethod
+    def scenario_denouement(rank, scenario_name, scenario):
+        pass
+
+    def scenario_creator(self, scenario_name, **kwargs):
+        st = self.structure
+        prob = st.scenario_probability(scenario_name)
+        mdl = self._creator(self.scenario_data(scenario_name), scenario_name)
+        if mdl.var_names is None:
+            raise ValueError(
+                "pysp instance creators must build via LinearModelBuilder "
+                "(variable names are needed to resolve StageVariables)")
+        nodes = []
+        path = st.node_path(scenario_name)
+        for nd in path[:-1]:               # nonleaf nodes carry nonants
+            stage_name = st.node_stage[nd]
+            idx = st.match_stage_vars(stage_name, mdl.var_names)
+            # dedup: an explicit entry may overlap a wildcard (legal PySP);
+            # duplicates would inflate K and double-count xbar averages
+            nodes.append(ScenarioNode(
+                st.canon[nd], st.cond_prob[nd], st.stage_index[stage_name],
+                np.asarray(sorted(set(idx)), dtype=np.int32)))
+        mdl.nodes = nodes
+        mdl.prob = prob
+        return mdl

@@ -1,0 +1,146 @@
+"""ScenarioStructure.dat semantics (reference: pysp_model/tree_structure.py).
+
+Builds the scenario tree the PySP way: explicit Stages (time-ordered), Nodes
+with NodeStage + Children + ConditionalProbability, Scenarios mapped to leaf
+nodes, per-stage StageVariables (with ``name[*]``-style wildcards) and
+StageCost expressions.  Validations mirror the reference's tree checks:
+every non-root node has exactly one parent, children probabilities sum to 1,
+each scenario's leaf sits in the last stage.
+
+The output is deliberately in tpusppy vocabulary: per-scenario
+:class:`~tpusppy.scenario_tree.ScenarioNode` lists use the ROOT/ROOT_i...
+naming convention, so a PySP tree drops into the same machinery as
+hand-annotated models.
+"""
+
+from __future__ import annotations
+
+from .datparser import DatData, parse_dat_file
+
+
+class ScenarioStructure:
+    """Parsed + validated ScenarioStructure.dat."""
+
+    def __init__(self, data: DatData):
+        self.stages = [str(s) for s in data["Stages"]]
+        self.nodes = [str(n) for n in data["Nodes"]]
+        self.node_stage = {str(k): str(v)
+                           for k, v in data["NodeStage"].items()}
+        self.cond_prob = {str(k): float(v)
+                          for k, v in data["ConditionalProbability"].items()}
+        self.scenarios = [str(s) for s in data["Scenarios"]]
+        self.scenario_leaf = {str(k): str(v)
+                              for k, v in data["ScenarioLeafNode"].items()}
+        self.children = {}
+        for key, val in data.items():
+            if key.startswith("Children[") and key.endswith("]"):
+                self.children[key[len("Children["):-1]] = [str(c) for c in val]
+        self.stage_vars = {}
+        for key, val in data.items():
+            if key.startswith("StageVariables[") and key.endswith("]"):
+                self.stage_vars[key[len("StageVariables["):-1]] = [
+                    str(v) for v in val]
+        self.stage_cost = {str(k): str(v)
+                           for k, v in data.get("StageCost", {}).items()}
+        self._validate()
+        self._index()
+
+    @classmethod
+    def from_file(cls, path: str) -> "ScenarioStructure":
+        return cls(parse_dat_file(path))
+
+    # ---- validation (tree_structure.py checks) --------------------------
+    def _validate(self):
+        parents = {}
+        for p, kids in self.children.items():
+            for c in kids:
+                if c in parents:
+                    raise ValueError(f"node {c} has two parents")
+                parents[c] = p
+        roots = [nd for nd in self.nodes if nd not in parents]
+        if len(roots) != 1:
+            raise ValueError(f"expected exactly one root node, got {roots}")
+        self.root = roots[0]
+        self.parent = parents
+        for nd in self.nodes:
+            if nd not in self.node_stage:
+                raise ValueError(f"node {nd} has no NodeStage entry")
+            if nd not in self.cond_prob:
+                raise ValueError(f"node {nd} has no ConditionalProbability")
+        if abs(self.cond_prob[self.root] - 1.0) > 1e-6:
+            raise ValueError(
+                f"root node conditional probability must be 1.0, got "
+                f"{self.cond_prob[self.root]} (scenario probabilities would "
+                "silently fail to sum to 1)")
+        for p, kids in self.children.items():
+            tot = sum(self.cond_prob[c] for c in kids)
+            if abs(tot - 1.0) > 1e-4:
+                raise ValueError(
+                    f"children probabilities of {p} sum to {tot}, not 1")
+        last = self.stages[-1]
+        for s in self.scenarios:
+            leaf = self.scenario_leaf.get(s)
+            if leaf is None:
+                raise ValueError(f"scenario {s} has no ScenarioLeafNode")
+            if self.node_stage[leaf] != last:
+                raise ValueError(
+                    f"scenario {s} leaf {leaf} is not in the last stage")
+
+    # ---- indexing -------------------------------------------------------
+    def _index(self):
+        # canonical ROOT/ROOT_i names: children keep .dat order
+        self.canon = {self.root: "ROOT"}
+
+        def walk(nd):
+            for i, c in enumerate(self.children.get(nd, [])):
+                base = self.canon[nd]
+                self.canon[c] = ("ROOT_" + str(i)) if base == "ROOT" \
+                    else f"{base}_{i}"
+                walk(c)
+
+        walk(self.root)
+        self.stage_index = {s: i + 1 for i, s in enumerate(self.stages)}
+
+    def node_path(self, scenario: str):
+        """Root->leaf node-name path of a scenario."""
+        nd = self.scenario_leaf[scenario]
+        path = [nd]
+        while nd in self.parent:
+            nd = self.parent[nd]
+            path.append(nd)
+        return list(reversed(path))
+
+    def scenario_probability(self, scenario: str) -> float:
+        p = 1.0
+        for nd in self.node_path(scenario):
+            p *= self.cond_prob[nd]
+        return p
+
+    def match_stage_vars(self, stage: str, var_names: list) -> list:
+        """Resolve a stage's StageVariables (exact names or ``name[*]``
+        wildcards, PySP semantics) against a model's variable names;
+        returns indices in var_names order."""
+        import re
+
+        pats = self.stage_vars.get(stage, [])
+        out = []
+        for pat in pats:
+            if "*" in pat:
+                # literal brackets, '*' as a glob (PySP wildcard semantics;
+                # fnmatch would misread '[...]' as a character class)
+                rx = re.escape(pat).replace(r"\*", ".*")
+                hits = [i for i, nm in enumerate(var_names)
+                        if nm is not None and re.fullmatch(rx, nm)]
+                if not hits:
+                    raise ValueError(
+                        f"StageVariables pattern {pat!r} matches nothing")
+                out.extend(hits)
+            else:
+                if pat not in var_names:
+                    raise ValueError(
+                        f"StageVariables entry {pat!r} not a model variable")
+                out.append(var_names.index(pat))
+        return out
+
+    def nodes_of_stage(self, stage: str):
+        return [nd for nd in self.nodes if self.node_stage[nd] == stage]
