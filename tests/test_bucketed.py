@@ -1,0 +1,88 @@
+"""Ragged-family shape bucketing (VERDICT r1 weak #9).
+
+Heterogeneous scenario shapes (uneven bundles are the in-repo source) used
+to pad the whole (S, m, n) constraint tensor to the family max; buckets
+solve compact sub-batches instead, with the bookkeeping layout unchanged.
+"""
+
+import numpy as np
+import pytest
+
+from tpusppy.bundles import form_bundles
+from tpusppy.ef import solve_ef
+from tpusppy.ir import BucketedBatch, ScenarioBatch
+from tpusppy.models import farmer
+from tpusppy.opt.ph import PH
+
+EF_OBJ = -108390.0
+
+
+def _problems(n=7):
+    names = farmer.scenario_names_creator(n)
+    return [farmer.scenario_creator(nm, num_scens=n) for nm in names]
+
+
+def test_bucketed_batch_structure_and_memory():
+    """7 scenarios in 3 bundles (3/2/2) are ragged; bucketing (quantum 1 to
+    force the split) must not pay the padded-to-max quadratic cost."""
+    bundles = form_bundles(_problems(7), 3)
+    shapes = {(p.num_vars, p.num_rows) for p in bundles}
+    assert len(shapes) > 1                      # genuinely ragged
+
+    bb = BucketedBatch.from_problems(bundles, quantum=1)
+    assert len(bb.buckets) == 2                 # sizes 3 and 2,2
+    assert bb.num_scenarios == 3
+    naive = ScenarioBatch.from_problems(bundles)
+    naive_elems = (naive.num_scenarios * naive.num_rows * naive.num_vars)
+    assert bb.padded_elements() < naive_elems   # quadratic waste avoided
+    # probabilities survive bucket-local normalization
+    assert bb.probs.sum() == pytest.approx(1.0)
+    np.testing.assert_allclose(
+        sorted(bb.probs), sorted(naive.probs), rtol=1e-12)
+    # the quadratic global view is refused with guidance
+    with pytest.raises(AttributeError, match="bucketing exists to avoid"):
+        bb.A
+
+
+def test_bucketed_ph_matches_unbucketed_and_ef():
+    """PH over ragged bundles: the bucketed path converges to the same
+    expected objective as padding (and the farmer EF golden)."""
+    n = 7
+    names = farmer.scenario_names_creator(n)
+    kw = {"num_scens": n}
+
+    def run(shape_buckets):
+        ph = PH({"defaultPHrho": 1.0, "PHIterLimit": 80,
+                 "convthresh": 1e-4, "bundles_per_rank": 3,
+                 "shape_buckets": shape_buckets,
+                 "shape_bucket_quantum": 1},
+                names, farmer.scenario_creator, scenario_creator_kwargs=kw)
+        conv, eobj, triv = ph.ph_main()
+        return ph, eobj
+
+    ph_b, eobj_b = run(True)
+    assert isinstance(ph_b.batch, BucketedBatch)
+    ph_p, eobj_p = run(False)
+    assert isinstance(ph_p.batch, ScenarioBatch)
+
+    batch = ScenarioBatch.from_problems(_problems(n))
+    ef_obj, _ = solve_ef(batch, solver="highs")
+    assert eobj_b == pytest.approx(ef_obj, rel=2e-3)
+    assert eobj_b == pytest.approx(eobj_p, rel=2e-3)
+
+
+def test_bucketed_xhat_eval_continuous():
+    """Fix-and-evaluate works bucketed (clamp columns are 2-D bookkeeping)."""
+    from tpusppy.xhat_eval import Xhat_Eval
+
+    n = 7
+    names = farmer.scenario_names_creator(n)
+    ev = Xhat_Eval({"bundles_per_rank": 3, "shape_buckets": True,
+                    "shape_bucket_quantum": 1},
+                   names, farmer.scenario_creator,
+                   scenario_creator_kwargs={"num_scens": n})
+    assert isinstance(ev.batch, BucketedBatch)
+    K = ev.nonant_length
+    z = ev.evaluate(np.array([170.0, 80.0, 250.0] * (K // 3))[:K])
+    assert np.isfinite(z)
+    assert z >= EF_OBJ - 1.0                    # a valid incumbent value

@@ -253,12 +253,6 @@ class ScenarioBatch:
         mask[self.tree.nonant_indices] = True
         return mask
 
-    def objective(self, x: np.ndarray) -> np.ndarray:
-        """(S,) per-scenario objective values at x of shape (S, n)."""
-        lin = np.einsum("sn,sn->s", self.c, x)
-        quad = 0.5 * np.einsum("sn,sn->s", self.q2, x * x)
-        return lin + quad + self.const
-
     def augment(self, extra_cols: int, extra_rows: int,
                 col_lb=0.0, col_ub=0.0,
                 col_names=None) -> "ScenarioBatch":
@@ -296,3 +290,147 @@ class ScenarioBatch:
             var_names=names,
             version=self.version + 1,
         )
+
+    def objective(self, x: np.ndarray) -> np.ndarray:
+        """(S,) per-scenario objective values at x of shape (S, n)."""
+        lin = np.einsum("sn,sn->s", self.c, x)
+        quad = 0.5 * np.einsum("sn,sn->s", self.q2, x * x)
+        return lin + quad + self.const
+
+
+def _quantize(v: int, quantum: int) -> int:
+    return int(-(-v // quantum) * quantum)
+
+
+@dataclasses.dataclass
+class BucketedBatch:
+    """Shape-bucketed scenario batch for RAGGED families (SURVEY §7 hard
+    part 2; VERDICT r1 weak #9).
+
+    ``ScenarioBatch`` pads every scenario to the family maximum — one
+    oversized scenario makes the whole (S, m, n) constraint tensor pay
+    quadratically.  Here scenarios are grouped by their (n, m) rounded up to
+    a quantum; each bucket is its own compact :class:`ScenarioBatch` (its
+    own compiled solver program), while the LINEAR-memory bookkeeping
+    arrays (c, q2, lb, ub, cl, cu — all 2-D) are still exposed padded to
+    the global maxima so PH/xhat bookkeeping code is unchanged.  The
+    quadratic ``A`` tensor deliberately has NO padded global view.
+
+    Uneven bundling (np.array_split remainders) is the in-repo source of
+    ragged shapes; per-bucket ``is_int`` also lifts ScenarioBatch's
+    same-integer-pattern-across-scenarios restriction for bundles.
+    """
+
+    names: list
+    buckets: list          # [(np.ndarray scenario indices, ScenarioBatch)]
+    tree: "TreeInfo"
+    c: np.ndarray          # (S, n_max) — bookkeeping views, zero-padded
+    q2: np.ndarray
+    lb: np.ndarray
+    ub: np.ndarray
+    cl: np.ndarray         # (S, m_max)
+    cu: np.ndarray
+    const: np.ndarray      # (S,)
+    var_names: list | None = None   # column names are bucket-local; the
+    # global bookkeeping layout degrades to slot indices (None)
+    version: int = 0
+
+    @classmethod
+    def from_problems(cls, problems, quantum: int = 16) -> "BucketedBatch":
+        groups: dict = {}
+        for i, p in enumerate(problems):
+            key = (_quantize(p.num_vars, quantum),
+                   _quantize(p.num_rows, quantum))
+            groups.setdefault(key, []).append(i)
+        order = sorted(groups)          # deterministic bucket order
+        probs = [p.prob for p in problems]
+        if all(pr is None for pr in probs):
+            problems = [dataclasses.replace(p, prob=1.0 / len(problems))
+                        for p in problems]
+        elif any(pr is None for pr in probs):
+            raise ValueError(
+                "either all or no scenarios may carry a probability")
+        buckets = []
+        for key in order:
+            idx = np.asarray(groups[key], dtype=np.int64)
+            members = [problems[i] for i in idx]
+            # normalize probs within the bucket: the sub-batch's internal
+            # tree is solver plumbing only (reductions use the OUTER tree),
+            # but its construction validates a unit probability mass
+            tot = sum(p.prob for p in members)
+            members = [dataclasses.replace(p, prob=p.prob / tot)
+                       for p in members]
+            sub = ScenarioBatch.from_problems(members)
+            buckets.append((idx, sub))
+        tree = build_tree(problems)
+        S = len(problems)
+        n_max = max(p.num_vars for p in problems)
+        m_max = max(p.num_rows for p in problems)
+
+        def pad2(get, width):
+            out = np.zeros((S, width))
+            for i, p in enumerate(problems):
+                v = get(p)
+                out[i, :v.shape[0]] = v
+            return out
+
+        lb = pad2(lambda p: p.lb, n_max)
+        ub = pad2(lambda p: p.ub, n_max)   # padded slots clamp at 0
+        return cls(
+            names=[p.name for p in problems],
+            buckets=buckets, tree=tree,
+            c=pad2(lambda p: p.c, n_max), q2=pad2(lambda p: p.q2, n_max),
+            lb=lb, ub=ub,
+            cl=pad2(lambda p: p.cl, m_max), cu=pad2(lambda p: p.cu, m_max),
+            const=np.array([p.const for p in problems]),
+        )
+
+    # ---- ScenarioBatch-compatible surface -------------------------------
+    @property
+    def num_scenarios(self) -> int:
+        return len(self.names)
+
+    @property
+    def num_vars(self) -> int:
+        return int(self.c.shape[1])
+
+    @property
+    def num_rows(self) -> int:
+        return int(self.cl.shape[1])
+
+    @property
+    def probs(self) -> np.ndarray:
+        return self.tree.scen_prob
+
+    @property
+    def A(self):
+        raise AttributeError(
+            "BucketedBatch has no global A tensor (that padding is the "
+            "quadratic cost bucketing exists to avoid); iterate .buckets "
+            "or disable shape_buckets for features needing batch.A")
+
+    @property
+    def is_int(self):
+        ints = [sub.is_int[:sub.c.shape[1]] for _, sub in self.buckets]
+        if any(i.any() for i in ints):
+            raise AttributeError(
+                "BucketedBatch does not expose a shared is_int pattern "
+                "(buckets differ); integer xhat diving requires an unbucketed "
+                "batch")
+        return np.zeros(self.num_vars, dtype=bool)
+
+    def nonant_mask(self) -> np.ndarray:
+        mask = np.zeros(self.num_vars, dtype=bool)
+        mask[self.tree.nonant_indices] = True
+        return mask
+
+    def padded_elements(self) -> int:
+        """Total A elements across buckets (the memory the solve pays)."""
+        return int(sum(idx.size * sub.num_rows * sub.num_vars
+                       for idx, sub in self.buckets))
+
+    def objective(self, x: np.ndarray) -> np.ndarray:
+        out = np.zeros(self.num_scenarios)
+        for idx, sub in self.buckets:
+            out[idx] = sub.objective(x[idx][:, :sub.num_vars])
+        return out

@@ -71,7 +71,29 @@ class SPBase:
 
             problems = form_bundles(problems, nbundles)
             self.all_scenario_names = [p.name for p in problems]
-        self.batch = ScenarioBatch.from_problems(problems)
+        # ragged families (e.g. uneven bundles): shape-bucket instead of
+        # padding everything to the max (SURVEY §7 hard part 2)
+        quantum = int(self.options.get("shape_bucket_quantum", 16))
+        shapes = {(p.num_vars, p.num_rows) for p in problems}
+        bucketed = None
+        # opt-in: bucketing trades the features needing a global A tensor or
+        # a shared integer pattern (cut injection, certified-bound device
+        # consts, integer diving) for compact per-shape solves
+        if len(shapes) > 1 and self.options.get("shape_buckets", False):
+            from .ir import BucketedBatch
+
+            bucketed = BucketedBatch.from_problems(problems, quantum)
+            if len(bucketed.buckets) == 1:
+                bucketed = None     # one bucket = plain padding; keep the
+                                    # full-featured ScenarioBatch surface
+        if bucketed is not None:
+            self.batch = bucketed
+            global_toc(
+                "shape-bucketed ragged family: "
+                f"{[(int(i.size), s.num_rows, s.num_vars) for i, s in bucketed.buckets]}",
+                self.verbose)
+        else:
+            self.batch = ScenarioBatch.from_problems(problems)
         self.tree = self.batch.tree
         global_toc(
             f"Built scenario batch: {self.batch.num_scenarios} scenarios, "

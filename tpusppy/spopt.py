@@ -160,6 +160,14 @@ class SPOpt(SPBase):
         lb = b.lb if self._fixed_lb is None else self._fixed_lb
         ub = b.ub if self._fixed_ub is None else self._fixed_ub
 
+        from .ir import BucketedBatch
+
+        if isinstance(b, BucketedBatch):
+            x = self._solve_loop_bucketed(b, q, q2, lb, ub, warm)
+            if ext is not None:
+                ext.post_solve()
+            return x
+
         refresh_every = int(self.options.get("solver_refresh_every", 16) or 0)
         sig = self._solve_sig(q2, lb, ub) if refresh_every > 1 else None
         sol = None
@@ -196,7 +204,64 @@ class SPOpt(SPBase):
             ext.post_solve()
         return self.local_x
 
-    def _rescue_stragglers(self, sol, q, q2, lb, ub):
+    def _solve_loop_bucketed(self, b, q, q2, lb, ub, warm):
+        """Per-bucket batched solves for ragged families (one compact
+        compiled program per shape bucket), scattered back into the
+        (S, n_max) bookkeeping layout.  Per-bucket warm states chain like
+        the homogeneous path's; factors amortization is per-bucket too.
+        """
+        S, n_max = b.c.shape
+        x_out = np.zeros((S, n_max))
+        pri = np.zeros(S)
+        dua = np.zeros(S)
+        warms = getattr(self, "_bucket_warm", None)
+        if warms is None or len(warms) != len(b.buckets):
+            warms = self._bucket_warm = [None] * len(b.buckets)
+        facts = getattr(self, "_bucket_factors", None)
+        if facts is None or len(facts) != len(b.buckets):
+            facts = self._bucket_factors = [None] * len(b.buckets)
+        refresh_every = int(self.options.get("solver_refresh_every", 16) or 0)
+        for k, (idx, sub) in enumerate(b.buckets):
+            n, m = sub.num_vars, sub.num_rows
+            qk = np.asarray(q)[idx, :n]
+            q2k = np.asarray(q2)[idx, :n]
+            lbk = np.asarray(lb)[idx, :n]
+            ubk = np.asarray(ub)[idx, :n]
+            args = (qk, q2k, sub.A, sub.cl, sub.cu, lbk, ubk)
+            # full validity signature per bucket (clamp patterns + settings,
+            # same contract as the homogeneous path's _solve_sig)
+            sig = self._solve_sig(q2k, lbk, ubk)
+            sol = None
+            prior = facts[k]
+            if (refresh_every > 1 and warm and warms[k] is not None
+                    and prior is not None and prior[2] < refresh_every
+                    and prior[1] == sig):
+                cand = admm.solve_batch_frozen(
+                    *args, prior[0], settings=self.admm_settings,
+                    warm=warms[k])
+                if int(np.asarray(cand.iters)[0]) < \
+                        self.admm_settings.max_iter:
+                    sol = cand
+                    facts[k] = (prior[0], prior[1], prior[2] + 1)
+            if sol is None:
+                sol, fac = admm.solve_batch_factored(
+                    *args, settings=self.admm_settings,
+                    warm=warms[k] if warm else None)
+                facts[k] = (fac, sig, 1)
+                sol = self._rescue_stragglers(sol, qk, q2k, lbk, ubk,
+                                              batch=sub)
+            warms[k] = (sol.x, sol.z, sol.y, sol.yx)
+            x_out[idx, :n] = np.asarray(sol.x)
+            pri[idx] = np.asarray(sol.pri_res)
+            dua[idx] = np.asarray(sol.dua_res)
+        self._warm = None          # homogeneous-path caches do not apply
+        self._factors = None
+        self.local_x = x_out
+        self.pri_res = pri
+        self.dua_res = dua
+        return x_out
+
+    def _rescue_stragglers(self, sol, q, q2, lb, ub, batch=None):
         """Host-exact re-solve of the few scenarios batched ADMM left
         unconverged (LP scenarios only).
 
@@ -219,7 +284,7 @@ class SPOpt(SPBase):
             return sol
         from .solvers import scipy_backend
 
-        b = self.batch
+        b = self.batch if batch is None else batch
         q = np.asarray(q, dtype=float)
         q2 = np.asarray(q2, dtype=float)
         lb = np.asarray(lb, dtype=float)
@@ -292,6 +357,13 @@ class SPOpt(SPBase):
         never invalid.  See :func:`tpusppy.solvers.admm.dual_objective` for
         the free-variable margin caveat.
         """
+        from .ir import BucketedBatch
+
+        if isinstance(self.batch, BucketedBatch):
+            raise RuntimeError(
+                "certified dual bounds are not available on a bucketed "
+                "batch (no global A tensor); disable shape_buckets for "
+                "bound-spoke wheels")
         if self._warm is None:
             raise RuntimeError("Edualbound requires a prior solve_loop")
         b = self.batch

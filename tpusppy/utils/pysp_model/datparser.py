@@ -105,7 +105,8 @@ def parse_dat_text(text: str, param_arity=None) -> DatData:
         t = toks[i]
         if t == "set":
             name = toks[i + 1]
-            assert toks[i + 2] == ":=", f"set {name}: expected ':='"
+            if toks[i + 2] != ":=":
+                raise ValueError(f"set {name}: expected ':='")
             body, i = until_semicolon(i + 3)
             out[name] = [_coerce(b) for b in body]
         elif t == "param":
@@ -125,14 +126,16 @@ def parse_dat_text(text: str, param_arity=None) -> DatData:
                 body, i = until_semicolon(j + 1)
                 d = {}
                 w = len(cols) + 1
-                assert len(body) % w == 0, f"param {name}: ragged table"
+                if len(body) % w != 0:
+                    raise ValueError(f"param {name}: ragged table")
                 for r in range(0, len(body), w):
                     row = _coerce(body[r])
                     for c, col in enumerate(cols):
                         d[(row, col)] = _coerce(body[r + 1 + c])
                 out[name] = d if default is None else DefaultedDict(default, d)
             else:
-                assert toks[j] == ":=", f"param {name}: expected ':='"
+                if toks[j] != ":=":
+                    raise ValueError(f"param {name}: expected ':='")
                 body, i = until_semicolon(j + 1)
                 if len(body) == 1 and name not in param_arity \
                         and default is None:
@@ -140,9 +143,10 @@ def parse_dat_text(text: str, param_arity=None) -> DatData:
                 else:
                     arity = int(param_arity.get(name, 1))
                     w = arity + 1
-                    assert len(body) % w == 0, (
-                        f"param {name}: {len(body)} tokens not divisible by "
-                        f"key arity {arity} + 1")
+                    if len(body) % w != 0:
+                        raise ValueError(
+                            f"param {name}: {len(body)} tokens not "
+                            f"divisible by key arity {arity} + 1")
                     d = {}
                     for r in range(0, len(body), w):
                         key = tuple(_coerce(b) for b in body[r:r + arity])
