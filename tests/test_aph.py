@@ -70,3 +70,30 @@ def test_aph_hub_wheel():
     ws = WheelSpinner(hub_dict, spokes).spin()
     assert ws.BestInnerBound == pytest.approx(EF_OBJ, rel=5e-3)
     assert ws.BestOuterBound <= ws.BestInnerBound + 1e-6
+
+
+def test_aph_listener_overlap_matches_inline():
+    """APHuse_listener: reductions run on the Synchronizer's listener thread
+    (aph.py:198-330 overlap architecture); with the freshness handshake the
+    trajectory matches the inline path."""
+    from tpusppy.models import farmer
+    from tpusppy.opt.aph import APH
+
+    n = 3
+    names = farmer.scenario_names_creator(n)
+    kw = {"num_scens": n}
+
+    def run(use_listener):
+        aph = APH({"PHIterLimit": 12, "defaultPHrho": 1.0,
+                   "convthresh": -1.0, "dispatch_frac": 0.67,
+                   "APHuse_listener": use_listener},
+                  names, farmer.scenario_creator,
+                  scenario_creator_kwargs=kw)
+        conv, eobj, triv = aph.APH_main()
+        return aph, conv, eobj
+
+    a1, conv1, eobj1 = run(False)
+    a2, conv2, eobj2 = run(True)
+    assert a2._synchronizer is not None          # listener really ran
+    assert eobj2 == pytest.approx(eobj1, rel=1e-6)
+    assert conv2 == pytest.approx(conv1, rel=1e-4, abs=1e-8)

@@ -114,3 +114,75 @@ def test_wxbar_checkpoint_roundtrip(tmp_path):
     # reader loads the LAST written iteration's W (file appends per iter and
     # the reader keeps overwriting -> final values win)
     np.testing.assert_allclose(ph2.W, W_final, atol=1e-12)
+
+
+def test_multistage_proper_bundles_hydro():
+    """Proper bundles on a 3-stage tree: each bundle consumes whole
+    second-stage subtrees, the bundle EF bakes inner nonanticipativity in,
+    and PH over bundles reaches the true multistage EF objective."""
+    from tpusppy.models import hydro
+
+    names = hydro.scenario_names_creator(9)
+    problems = [hydro.scenario_creator(nm) for nm in names]
+    obj_plain, _ = solve_ef(ScenarioBatch.from_problems(problems),
+                            solver="highs")
+
+    bundles = form_bundles(problems, 3)     # one stage-2 subtree per bundle
+    assert [b.name for b in bundles] == \
+        ["Bundle_0_2", "Bundle_3_5", "Bundle_6_8"]
+    # only ROOT nonants remain exposed
+    assert all(len(b.nodes) == 1 for b in bundles)
+    assert all(b.nodes[0].nonant_indices.tolist() == [0, 1, 2, 3]
+               for b in bundles)
+    bbatch = ScenarioBatch.from_problems(bundles)
+    obj_b, _ = solve_ef(bbatch, solver="highs")
+    assert obj_b == pytest.approx(obj_plain, rel=1e-9)
+
+    # misaligned bundling (does not consume whole subtrees) must refuse
+    with pytest.raises(ValueError, match="entire second-stage"):
+        form_bundles(problems, 2)
+
+    from tpusppy.opt.ph import PH
+
+    ph = PH({"defaultPHrho": 1.0, "PHIterLimit": 60, "convthresh": 1e-5,
+             "bundles_per_rank": 3},
+            names, hydro.scenario_creator)
+    assert ph.batch.num_scenarios == 3
+    conv, eobj, triv = ph.ph_main()
+    assert eobj == pytest.approx(obj_plain, rel=5e-3)
+
+
+def test_aircondB_bundles_and_pickle(tmp_path):
+    """aircondB semantics: Bundle_f_l scenario names return proper-bundle
+    EFs; pickle/unpickle dirs round-trip them (aircondB.py behavior)."""
+    from tpusppy.models import aircond, aircondB
+
+    bf = [2, 2]
+    kw = dict(aircondB.kw_creator({"branching_factors": bf}))
+    kw["num_scens"] = 4
+
+    # plain scenario passthrough
+    s0 = aircondB.scenario_creator("scen0", **dict(kw))
+    assert s0.name == "scen0"
+
+    names = aircondB.bundle_names_creator(2, 4)
+    assert names == ["Bundle_0_1", "Bundle_2_3"]
+    bundles = [aircondB.scenario_creator(nm, **dict(kw)) for nm in names]
+    assert [b.prob for b in bundles] == [0.5, 0.5]
+    bbatch = ScenarioBatch.from_problems(bundles)
+    obj_b, _ = solve_ef(bbatch, solver="highs")
+
+    plain = ScenarioBatch.from_problems(
+        [aircond.scenario_creator(f"scen{i}", **dict(kw)) for i in range(4)])
+    obj_plain, _ = solve_ef(plain, solver="highs")
+    assert obj_b == pytest.approx(obj_plain, rel=1e-8)
+
+    # pickle round-trip through the bundle dirs
+    kwp = dict(kw)
+    kwp["pickle_bundles_dir"] = str(tmp_path)
+    aircondB.scenario_creator("Bundle_0_1", **kwp)
+    kwu = dict(kw)
+    kwu["unpickle_bundles_dir"] = str(tmp_path)
+    back = aircondB.scenario_creator("Bundle_0_1", **kwu)
+    np.testing.assert_allclose(back.c, bundles[0].c)
+    assert back.prob == pytest.approx(0.5)

@@ -8,8 +8,12 @@ assembler on the member sub-batch with conditional probabilities, so the
 batched solver sees fewer, larger subproblems — same trade as the reference
 (shrinks PH subproblem count, tightens iter0 bounds).
 
-Two-stage only (the reference's "proper bundles" for multistage require
-whole-subtree alignment, utils/pickle_bundle.py docs).
+Multistage "proper bundles" (the reference's pickle_bundle semantics +
+aircondB family) are supported: bundles must consume ENTIRE second-stage
+subtrees, so every inner-stage nonanticipativity constraint lives inside one
+bundle; the merged bundle EF bakes those in (build_ef's per-node column
+merge) and exposes only the ROOT nonants — the bundled problem is two-stage
+from PH's point of view.
 """
 
 from __future__ import annotations
@@ -23,19 +27,52 @@ from .ir import ScenarioBatch, ScenarioProblem
 from .scenario_tree import ScenarioNode
 
 
+def _stage2_group_size(problems) -> int:
+    """Scenarios per second-stage subtree (contiguous by construction)."""
+    names = [p.nodes[1].name for p in problems]
+    sizes = {}
+    for nm in names:
+        sizes[nm] = sizes.get(nm, 0) + 1
+    if len(set(sizes.values())) != 1:
+        raise ValueError(
+            f"uneven second-stage subtrees {sizes}; proper bundles need "
+            "uniform branching")
+    size = next(iter(sizes.values()))
+    # contiguity: scenarios of one subtree must be adjacent
+    for i in range(0, len(names), size):
+        if len(set(names[i:i + size])) != 1:
+            raise ValueError(
+                "scenario order is not subtree-contiguous; cannot form "
+                "proper bundles")
+    return size
+
+
 def form_bundles(problems, num_bundles: int) -> list:
     """Contiguous-slice bundling (spbase.py:219-253): ``num_bundles`` merged
-    ScenarioProblems from ``len(problems)`` scenarios."""
+    ScenarioProblems from ``len(problems)`` scenarios.  Multistage problems
+    form PROPER bundles: each bundle must consume whole second-stage
+    subtrees (the reference's aircondB rule, tests/examples/aircondB.py:117).
+    """
     S = len(problems)
     if num_bundles <= 0 or num_bundles > S:
         raise ValueError(f"num_bundles={num_bundles} out of range for {S}")
-    for p in problems:
-        if len(p.nodes) != 1:
-            raise ValueError("bundling supports two-stage models only")
     if any(p.prob is None for p in problems):
         problems = [dataclasses.replace(p, prob=1.0 / S) for p in problems]
 
-    slices = np.array_split(np.arange(S), num_bundles)
+    multistage = len(problems[0].nodes) > 1
+    if multistage:
+        gsz = _stage2_group_size(problems)
+        n_groups = S // gsz
+        if num_bundles > n_groups or n_groups % num_bundles != 0:
+            raise ValueError(
+                f"proper bundles must consume entire second-stage subtrees: "
+                f"{n_groups} subtrees of {gsz} scenarios cannot split into "
+                f"{num_bundles} bundles")
+        per = (n_groups // num_bundles) * gsz
+        slices = [np.arange(b * per, (b + 1) * per)
+                  for b in range(num_bundles)]
+    else:
+        slices = np.array_split(np.arange(S), num_bundles)
     bundles = []
     for bnum, sl in enumerate(slices):
         members = [problems[i] for i in sl]
@@ -43,15 +80,19 @@ def form_bundles(problems, num_bundles: int) -> list:
         cond = [dataclasses.replace(p, prob=p.prob / bprob) for p in members]
         sub = ScenarioBatch.from_problems(cond)
         ef = build_ef(sub)
-        K = sub.tree.num_nonants
-        # build_ef allocates the shared ROOT nonant columns first: 0..K-1
+        # build_ef allocates the shared ROOT (stage-1) nonant columns first:
+        # 0..K_root-1; inner-stage nonanticipativity is baked into the EF's
+        # merged columns, so only the ROOT nonants remain exposed
+        K_root = int((sub.tree.nonant_stage == 1).sum())
+        name = (f"bundle_{bnum}" if not multistage
+                else f"Bundle_{int(sl[0])}_{int(sl[-1])}")
         bundles.append(ScenarioProblem(
-            name=f"bundle_{bnum}",
+            name=name,
             c=ef.c, q2=ef.q2, A=ef.A, cl=ef.cl, cu=ef.cu,
             lb=ef.lb, ub=ef.ub, is_int=ef.is_int,
             prob=bprob,
             nodes=[ScenarioNode("ROOT", 1.0, 1,
-                                np.arange(K, dtype=np.int32))],
+                                np.arange(K_root, dtype=np.int32))],
             var_names=None,
             const=ef.const,
         ))

@@ -339,8 +339,15 @@ class BucketedBatch:
     def from_problems(cls, problems, quantum: int = 16) -> "BucketedBatch":
         groups: dict = {}
         for i, p in enumerate(problems):
-            key = (_quantize(p.num_vars, quantum),
-                   _quantize(p.num_rows, quantum))
+            nq = _quantize(p.num_vars, quantum)
+            mq = _quantize(p.num_rows, quantum)
+            # subgroup by the PADDED integer pattern: ScenarioBatch requires
+            # one is_int pattern per batch, and shape-padding alone can make
+            # patterns differ within a quantized bucket (integer columns in
+            # the tail of the wider member)
+            patt = np.zeros(nq, dtype=bool)
+            patt[:p.num_vars] = p.is_int
+            key = (nq, mq, patt.tobytes())
             groups.setdefault(key, []).append(i)
         order = sorted(groups)          # deterministic bucket order
         probs = [p.prob for p in problems]

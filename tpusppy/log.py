@@ -1,0 +1,40 @@
+"""Per-module logger configuration.
+
+Analogue of ``mpisppy/log.py:52-67``: a root ``tpusppy`` logger writing
+messages to stdout at INFO, plus :func:`setup_logger` for components that
+want their own stream/file logger (the reference's hub/spoke modules create
+``hub.log``-style CRITICAL loggers this way; ours do the same through this
+factory).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+log_format = "%(message)s"
+
+logger = logging.getLogger("tpusppy")
+logger.setLevel(logging.INFO)
+if not logger.handlers:
+    _h = logging.StreamHandler(sys.stdout)
+    _h.setFormatter(logging.Formatter(log_format))
+    logger.addHandler(_h)
+
+
+def setup_logger(name, out, level=logging.DEBUG, mode="w", fmt=None):
+    """Set up a custom logger quickly (mpisppy/log.py:52-67 semantics):
+    ``out`` is a stream (stdout/stderr) or a filename."""
+    if fmt is None:
+        fmt = "(%(asctime)s) %(message)s"
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    lg.propagate = False
+    formatter = logging.Formatter(fmt)
+    if out in (sys.stdout, sys.stderr):
+        handler = logging.StreamHandler(out)
+    else:
+        handler = logging.FileHandler(out, mode=mode)
+    handler.setFormatter(formatter)
+    lg.addHandler(handler)
+    return lg

@@ -1,0 +1,75 @@
+"""aircondB: aircond with PROPER (whole-subtree) bundles.
+
+Behavioral analogue of ``mpisppy/tests/examples/aircondB.py``: the
+scenario_creator accepts either a plain scenario name (``scen7``, delegating
+to :mod:`tpusppy.models.aircond`) or a bundle name ``Bundle_first_last``
+(e.g. ``Bundle_0_2``), returning the merged EF of those scenarios with all
+inner-stage nonanticipativity baked in and only the ROOT nonants exposed —
+the "proper bundle" object of pickle_bundle.py.  Bundles must consume
+entire second-stage subtrees (aircondB.py:117 rule); pre-built bundles
+round-trip through :mod:`tpusppy.utils.pickle_bundle` (.npz) via
+``unpickle_bundles_dir`` / ``pickle_bundles_dir`` kwargs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+
+from . import aircond as base_aircond
+from ..bundles import form_bundles
+
+inparser_adder = base_aircond.inparser_adder
+kw_creator = base_aircond.kw_creator
+scenario_denouement = base_aircond.scenario_denouement
+
+
+def scenario_names_creator(num_scens, start=None):
+    start = start or 0
+    return [f"scen{i}" for i in range(start, start + num_scens)]
+
+
+def bundle_names_creator(num_bundles, num_scens, start=0):
+    """Bundle_first_last names covering ``num_scens`` scenarios."""
+    if num_scens % num_bundles != 0:
+        raise ValueError(f"{num_scens} scenarios do not split into "
+                         f"{num_bundles} bundles")
+    per = num_scens // num_bundles
+    return [f"Bundle_{start + b * per}_{start + (b + 1) * per - 1}"
+            for b in range(num_bundles)]
+
+
+def scenario_creator(sname, **kwargs):
+    if "scen" in sname and "Bundle" not in sname:
+        return base_aircond.scenario_creator(sname, **kwargs)
+    if "Bundle" not in sname:
+        raise RuntimeError(
+            f"Scenario name does not have scen or Bundle: {sname}")
+
+    firstnum = int(sname.split("_")[1])
+    lastnum = int(sname.split("_")[2])
+    unpickle_dir = kwargs.pop("unpickle_bundles_dir", None)
+    pickle_dir = kwargs.pop("pickle_bundles_dir", None)
+    if unpickle_dir is not None:
+        from ..utils import pickle_bundle
+
+        return pickle_bundle.dill_unpickle(
+            os.path.join(unpickle_dir, sname + ".npz"))
+
+    members = [base_aircond.scenario_creator(f"scen{i}", **kwargs)
+               for i in range(firstnum, lastnum + 1)]
+    num_scens = kwargs.get("num_scens") or int(
+        np.prod(kwargs["branching_factors"]))
+    members = [dataclasses.replace(p, prob=1.0 / num_scens)
+               for p in members]
+    bundle = form_bundles(members, 1)[0]
+    bundle = dataclasses.replace(
+        bundle, name=sname, prob=len(members) / num_scens)
+    if pickle_dir is not None:
+        from ..utils import pickle_bundle
+
+        pickle_bundle.dill_pickle(
+            bundle, os.path.join(pickle_dir, sname + ".npz"))
+    return bundle

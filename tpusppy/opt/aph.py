@@ -69,9 +69,15 @@ class APH(PHBase):
         """Per-node probability-weighted mean, broadcast back to (S, K)."""
         p = self.probs[:, None]
         num = np.einsum("skn,sk->nk", self._onehot, p * arr_sk)
-        den = np.einsum("skn,sk->nk", self._onehot,
-                        np.broadcast_to(p, arr_sk.shape))
-        avg_nk = num / np.maximum(den, 1e-300)
+        den = getattr(self, "_node_den", None)
+        if den is None:
+            # depends only on probs + tree: compute once, reuse across the
+            # three averages per reduction (worker or listener thread)
+            den = np.maximum(np.einsum(
+                "skn,sk->nk", self._onehot,
+                np.broadcast_to(p, (p.shape[0], self.nonant_length))), 1e-300)
+            self._node_den = den
+        avg_nk = num / den
         kidx = np.arange(self.nonant_length)[None, :]
         return avg_nk[self.nid_sk, kidx]
 
@@ -85,22 +91,104 @@ class APH(PHBase):
         newy = self.W + self.rho * (xk - self.z)
         self.y[dispatched] = newy[dispatched]
 
-    def Compute_Averages(self):
-        """xbar, xsqbar, ybar + the u/v/tau/phi side-gig (aph.py:198-330)."""
-        xk = self.nonants_of(self.local_x)
-        self.Compute_Xbar()                       # xbars, xsqbars
-        self.ybars = self._node_avg(self.y)
-        self.uk = xk - self.xbars
+    def _averages_from(self, xk, y, W, z):
+        """The pure reduction math (aph.py:198-330 side-gig): node averages
+        of x and y, u/v norms, tau and phi summands — computable by either
+        the worker inline or the listener thread from published copies."""
+        xbars = self._node_avg(xk)
+        xsqbars = self._node_avg(xk * xk)
+        ybars = self._node_avg(y)
+        uk = xk - xbars
         p = self.probs
-        usq = (self.uk * self.uk).sum(axis=1)
-        vsq = (self.ybars * self.ybars).sum(axis=1)
-        self.global_pusqnorm = float(p @ usq)
-        self.global_pvsqnorm = float(p @ vsq)
-        self.tau_summand = float(p @ (usq + vsq / self.APHgamma))
-        self.global_tau = self.tau_summand
-        # phi summand (aph.py:185-196)
-        self.phis = p * np.einsum("sk,sk->s", self.z - xk, self.W - self.y)
-        self.global_phi = float(self.phis.sum())
+        usq = (uk * uk).sum(axis=1)
+        vsq = (ybars * ybars).sum(axis=1)
+        phis = p * np.einsum("sk,sk->s", z - xk, W - y)
+        return {
+            "xbars": xbars, "xsqbars": xsqbars, "ybars": ybars, "uk": uk,
+            "pusqnorm": float(p @ usq), "pvsqnorm": float(p @ vsq),
+            "tau": float(p @ (usq + vsq / self.APHgamma)),
+            "phis": phis, "phi": float(phis.sum()),
+        }
+
+    def _apply_averages(self, red: dict):
+        self.xbars = red["xbars"]
+        self.xsqbars = red["xsqbars"]
+        self.ybars = red["ybars"]
+        self.uk = red["uk"]
+        self.global_pusqnorm = red["pusqnorm"]
+        self.global_pvsqnorm = red["pvsqnorm"]
+        self.tau_summand = red["tau"]
+        self.global_tau = red["tau"]
+        self.phis = red["phis"]
+        self.global_phi = red["phi"]
+
+    def Compute_Averages(self):
+        """xbar, xsqbar, ybar + the u/v/tau/phi side-gig (aph.py:198-330).
+
+        With the listener enabled (``APHuse_listener``), the worker PUBLISHES
+        its state through the Synchronizer and reads back the averages the
+        listener thread computed — possibly one publish stale, exactly the
+        reference's asynchronous reduction overlap (aph.py:198-330 +
+        listener_util.py:277-327).  Inline otherwise.
+        """
+        xk = self.nonants_of(self.local_x)
+        if getattr(self, "_synchronizer", None) is not None:
+            self._publish_and_read(xk)
+            return
+        self._apply_averages(self._averages_from(xk, self.y, self.W, self.z))
+
+    # ---- listener-thread reduction overlap (aph.py:198-330) -----------------
+    def _publish_and_read(self, xk):
+        """Publish (x, y, W, z) to the Synchronizer; read back the listener's
+        latest reduction.  Waits briefly for freshness; under load the stale
+        previous reduction is used — APH's tolerated staleness."""
+        import time
+
+        sync = self._synchronizer
+        S, K = xk.shape
+        flat = {
+            "xk": xk.ravel(), "y": self.y.ravel(),
+            "W": self.W.ravel(), "z": self.z.ravel(),
+            "serial": np.array([float(self._iter)]),
+        }
+        sync.compute_global_data(flat, enable_side_gig=True)
+        deadline = time.time() + float(
+            self.options.get("async_sleep_secs", 0.01)) * 100
+        fresh = False
+        while time.time() < deadline:
+            with sync._lock:
+                red = sync.reduced
+                if red is not None and red["serial"] >= self._iter:
+                    fresh = True
+                    break
+            time.sleep(0.0005)
+        with sync._lock:
+            red = sync.reduced
+        if red is None:           # listener never ran yet: compute inline
+            self._apply_averages(
+                self._averages_from(xk, self.y, self.W, self.z))
+            return
+        if not fresh:
+            self._stale_reductions += 1
+        self._apply_averages({k: v for k, v in red.items() if k != "serial"})
+
+    def _make_side_gig(self):
+        """The listener's side gig: recompute averages from the workers'
+        latest published state into ``sync.reduced`` (runs on the listener
+        thread, under the Synchronizer lock)."""
+        def side_gig(sync):
+            slot = sync._locals.get(0)
+            if not slot or "xk" not in slot:
+                return
+            S = self.batch.num_scenarios
+            K = self.nonant_length
+            shp = (S, K)
+            red = self._averages_from(
+                slot["xk"].reshape(shp), slot["y"].reshape(shp),
+                slot["W"].reshape(shp), slot["z"].reshape(shp))
+            red["serial"] = float(slot["serial"][0])
+            sync.reduced = red
+        return side_gig
 
     def Update_theta_zw(self):
         """theta from phi/tau; W += theta u; z step toward ybar
@@ -184,6 +272,38 @@ class APH(PHBase):
     def APH_main(self, spcomm=None, finalize=True):
         if spcomm is not None:
             self.spcomm = spcomm
+        self._stale_reductions = 0
+        self._synchronizer = None
+        if bool(self.options.get("APHuse_listener", False)):
+            # the reference's listener-thread reduction overlap
+            # (listener_util.Synchronizer driving the side gig concurrently
+            # with worker solves; aph.py:198-330 + listener_util.py:82-103)
+            from ..utils.listener_util import Synchronizer
+
+            S = self.batch.num_scenarios
+            K = self.nonant_length
+            lens = {"xk": S * K, "y": S * K, "W": S * K, "z": S * K,
+                    "serial": 1}
+            self._synchronizer = Synchronizer(
+                lens, asynch=True,
+                sleep_secs=float(self.options.get("async_sleep_secs", 0.01)))
+            self._synchronizer.reduced = None
+            out = [None]
+
+            def worker():
+                out[0] = self._APH_main_body(finalize)
+
+            self._synchronizer.run(worker,
+                                   side_gig=self._make_side_gig())
+            if self._stale_reductions:
+                global_toc(
+                    f"APH listener: {self._stale_reductions} stale "
+                    "reductions tolerated",
+                    self.options.get("display_progress", False))
+            return out[0]
+        return self._APH_main_body(finalize)
+
+    def _APH_main_body(self, finalize=True):
         self.extobject.pre_iter0()
         self._iter = 0
         self.solve_loop()                       # iter0: plain objective

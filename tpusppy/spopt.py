@@ -168,41 +168,57 @@ class SPOpt(SPBase):
                 ext.post_solve()
             return x
 
-        refresh_every = int(self.options.get("solver_refresh_every", 16) or 0)
-        sig = self._solve_sig(q2, lb, ub) if refresh_every > 1 else None
-        sol = None
-        if (refresh_every > 1 and warm and self._warm is not None
-                and self._factors is not None and sig == self._factors_sig
-                and self._factors_age < refresh_every):
-            cand = admm.solve_batch_frozen(
-                q, q2, b.A, b.cl, b.cu, lb, ub, self._factors,
-                settings=self.admm_settings, warm=self._warm,
-            )
-            # iters >= max_iter means the sweep budget ran out somewhere:
-            # fall through to the adaptive path instead of accepting it
-            if int(np.asarray(cand.iters)[0]) < self.admm_settings.max_iter:
-                sol = cand
-                self._factors_age += 1
-        if sol is None:
-            sol, factors = admm.solve_batch_factored(
-                q, q2, b.A, b.cl, b.cu, lb, ub,
-                settings=self.admm_settings,
-                warm=self._warm if warm else None,
-            )
-            self._factors = factors
-            self._factors_sig = sig
-            self._factors_age = 1
-            sol = self._rescue_stragglers(sol, q, q2, lb, ub)
-        # polished states warm-start the NEXT objective's solve well (the
-        # PH persistent-solver pattern); raw iterates matter only when
-        # re-solving the SAME problem repeatedly (e.g. the Benders root)
-        self._warm = (sol.x, sol.z, sol.y, sol.yx)
+        slot = {"warm": self._warm, "factors": self._factors,
+                "sig": self._factors_sig, "age": self._factors_age}
+        sol = self._solve_amortized(
+            (q, q2, b.A, b.cl, b.cu, lb, ub), slot, warm, None)
+        self._warm = slot["warm"]
+        self._factors = slot["factors"]
+        self._factors_sig = slot["sig"]
+        self._factors_age = slot["age"]
         self.local_x = np.asarray(sol.x)
         self.pri_res = np.asarray(sol.pri_res)
         self.dua_res = np.asarray(sol.dua_res)
         if ext is not None:
             ext.post_solve()
         return self.local_x
+
+    def _solve_amortized(self, args, slot: dict, warm: bool, rescue_batch):
+        """The factorization-amortization protocol shared by the homogeneous
+        and bucketed paths: frozen attempt under a validity signature with a
+        sweep-budget fallback, else an adaptive factored solve + straggler
+        rescue.  ``slot`` carries warm/factors/sig/age state; ``args`` is
+        the (q, q2, A, cl, cu, lb, ub) tuple.  Polished states warm-start
+        the NEXT objective's solve well (the PH persistent-solver pattern);
+        raw iterates matter only when re-solving the SAME problem repeatedly
+        (e.g. the Benders root)."""
+        refresh_every = int(self.options.get("solver_refresh_every", 16) or 0)
+        sig = (self._solve_sig(args[1], args[5], args[6])
+               if refresh_every > 1 else None)
+        sol = None
+        if (refresh_every > 1 and warm and slot.get("warm") is not None
+                and slot.get("factors") is not None
+                and slot.get("sig") == sig
+                and slot.get("age", 0) < refresh_every):
+            cand = admm.solve_batch_frozen(
+                *args, slot["factors"], settings=self.admm_settings,
+                warm=slot["warm"])
+            # iters >= max_iter means the sweep budget ran out somewhere:
+            # fall through to the adaptive path instead of accepting it
+            if int(np.asarray(cand.iters)[0]) < self.admm_settings.max_iter:
+                sol = cand
+                slot["age"] = slot.get("age", 0) + 1
+        if sol is None:
+            sol, factors = admm.solve_batch_factored(
+                *args, settings=self.admm_settings,
+                warm=slot.get("warm") if warm else None)
+            slot["factors"] = factors
+            slot["sig"] = sig
+            slot["age"] = 1
+            sol = self._rescue_stragglers(sol, args[0], args[1], args[5],
+                                          args[6], batch=rescue_batch)
+        slot["warm"] = (sol.x, sol.z, sol.y, sol.yx)
+        return sol
 
     def _solve_loop_bucketed(self, b, q, q2, lb, ub, warm):
         """Per-bucket batched solves for ragged families (one compact
@@ -214,43 +230,15 @@ class SPOpt(SPBase):
         x_out = np.zeros((S, n_max))
         pri = np.zeros(S)
         dua = np.zeros(S)
-        warms = getattr(self, "_bucket_warm", None)
-        if warms is None or len(warms) != len(b.buckets):
-            warms = self._bucket_warm = [None] * len(b.buckets)
-        facts = getattr(self, "_bucket_factors", None)
-        if facts is None or len(facts) != len(b.buckets):
-            facts = self._bucket_factors = [None] * len(b.buckets)
-        refresh_every = int(self.options.get("solver_refresh_every", 16) or 0)
+        slots = getattr(self, "_bucket_slots", None)
+        if slots is None or len(slots) != len(b.buckets):
+            slots = self._bucket_slots = [dict() for _ in b.buckets]
         for k, (idx, sub) in enumerate(b.buckets):
             n, m = sub.num_vars, sub.num_rows
-            qk = np.asarray(q)[idx, :n]
-            q2k = np.asarray(q2)[idx, :n]
-            lbk = np.asarray(lb)[idx, :n]
-            ubk = np.asarray(ub)[idx, :n]
-            args = (qk, q2k, sub.A, sub.cl, sub.cu, lbk, ubk)
-            # full validity signature per bucket (clamp patterns + settings,
-            # same contract as the homogeneous path's _solve_sig)
-            sig = self._solve_sig(q2k, lbk, ubk)
-            sol = None
-            prior = facts[k]
-            if (refresh_every > 1 and warm and warms[k] is not None
-                    and prior is not None and prior[2] < refresh_every
-                    and prior[1] == sig):
-                cand = admm.solve_batch_frozen(
-                    *args, prior[0], settings=self.admm_settings,
-                    warm=warms[k])
-                if int(np.asarray(cand.iters)[0]) < \
-                        self.admm_settings.max_iter:
-                    sol = cand
-                    facts[k] = (prior[0], prior[1], prior[2] + 1)
-            if sol is None:
-                sol, fac = admm.solve_batch_factored(
-                    *args, settings=self.admm_settings,
-                    warm=warms[k] if warm else None)
-                facts[k] = (fac, sig, 1)
-                sol = self._rescue_stragglers(sol, qk, q2k, lbk, ubk,
-                                              batch=sub)
-            warms[k] = (sol.x, sol.z, sol.y, sol.yx)
+            args = (np.asarray(q)[idx, :n], np.asarray(q2)[idx, :n],
+                    sub.A, sub.cl, sub.cu,
+                    np.asarray(lb)[idx, :n], np.asarray(ub)[idx, :n])
+            sol = self._solve_amortized(args, slots[k], warm, sub)
             x_out[idx, :n] = np.asarray(sol.x)
             pri[idx] = np.asarray(sol.pri_res)
             dua[idx] = np.asarray(sol.dua_res)

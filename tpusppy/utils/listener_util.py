@@ -33,6 +33,7 @@ class Synchronizer:
         self.asynch = asynch
         self.sleep_secs = sleep_secs
         self._lock = threading.Lock()
+        self._dirty = False             # new publications since last reduce
         self._locals = {}               # worker id -> {name: vector}
         self._global = {name: np.zeros(ln) for name, ln in self.Lens.items()}
         self.global_quitting = 0
@@ -52,6 +53,7 @@ class Synchronizer:
                 if rednames is not None and name not in rednames:
                     continue
                 slot[name] = np.array(vec, copy=True)
+                self._dirty = True
             if enable_side_gig:
                 self.enable_side_gig = True
             if global_out is not None:
@@ -73,10 +75,18 @@ class Synchronizer:
     def _unsafe_put_local_data(self, name, data: dict, worker_id=0):
         self._locals.setdefault(worker_id, {})[name] = np.array(
             data[name], copy=True)
+        self._dirty = True
 
     # ---- listener side ------------------------------------------------------
     def _reduce_once(self):
         with self._lock:
+            if not self._dirty:
+                # nothing new published: reduction output would be
+                # unchanged; skip the O(sum Lens) accumulation so an idle
+                # listener tick costs nothing (it otherwise competes with
+                # worker compute for the GIL)
+                return
+            self._dirty = False
             for name in self.Lens:
                 acc = np.zeros(self.Lens[name])
                 for slot in self._locals.values():
