@@ -143,10 +143,42 @@ def uc_metrics():
         {"spoke_class": XhatShuffleInnerBound, "opt_class": Xhat_Eval,
          "opt_kwargs": okw()},
     ]
-    t0 = time.time()
-    ws = WheelSpinner(hub_dict, spokes).spin()
-    wall = time.time() - t0
-    ib, ob = ws.BestInnerBound, ws.BestOuterBound
+    # watchdog: the wheel must never block the bench line (daemon thread +
+    # bounded join; on timeout the farmer metric still prints)
+    import threading
+
+    budget = float(os.environ.get("BENCH_UC_WHEEL_TIMEOUT", "900"))
+    result = {}
+
+    def _spin():
+        t0 = time.time()
+        try:
+            ws = WheelSpinner(hub_dict, spokes).spin()
+        except Exception as e:       # error != timeout; surface which
+            result["error"] = repr(e)
+            return
+        result["wall"] = time.time() - t0
+        result["ib"] = ws.BestInnerBound
+        result["ob"] = ws.BestOuterBound
+
+    th = threading.Thread(target=_spin, daemon=True)
+    th.start()
+    th.join(timeout=budget)
+    if "wall" not in result:
+        why = result.get("error", f"timeout after {budget:.0f}s")
+        log(f"uc wheel: {why}")
+        out = {
+            "ph_iters_per_sec": round(iters_per_sec, 4),
+            "vs_baseline": round(iters_per_sec / base_ips, 2),
+            "S": S, "wall_s_to_gap": None, "gap_pct": None,
+            "gap_target_pct": gap_target * 100, "certified": False,
+        }
+        if "error" in result:
+            out["wheel_error"] = result["error"]
+        else:
+            out["wheel_timeout_s"] = budget
+        return out
+    wall, ib, ob = result["wall"], result["ib"], result["ob"]
     gap = (ib - ob) / max(abs(ib), 1e-9) if np.isfinite(ib) else float("inf")
     log(f"uc wheel: {wall:.1f}s inner={ib:.2f} outer={ob:.2f} "
         f"gap={gap*100:.2f}%")

@@ -8,6 +8,13 @@ a virtual CPU mesh (xla_force_host_platform_device_count), per the build brief.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # the driver env presets axon (TPU)
+# Persistent compilation cache: the XLA:CPU compiler in this jaxlib has a
+# rare in-process segfault under repeated large compiles (observed at random
+# tests mid-suite, always inside backend_compile_and_load); warm cache runs
+# compile almost nothing, removing both the exposure and most suite runtime.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.expanduser("~"), ".cache", "tpusppy_xla"))
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -21,3 +28,6 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)

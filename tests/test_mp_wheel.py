@@ -36,9 +36,13 @@ def test_mp_wheel_farmer_two_spokes():
 
     hub_dict = {
         "hub_class": PHHub,
-        "hub_kwargs": {"options": {"rel_gap": 0.01}},
+        # linger: spokes are READY (constructed) when the hub starts, but
+        # their first solves may still be compiling while the hub's
+        # millisecond iterations fly by — the hub keeps syncing afterwards
+        # until the gap certifies (or the linger budget passes)
+        "hub_kwargs": {"options": {"rel_gap": 0.01, "linger_secs": 300.0}},
         "opt_class": PH,
-        "opt_kwargs": okw(20),
+        "opt_kwargs": okw(40),
     }
     spokes = [
         {"spoke_class": LagrangianOuterBound, "opt_class": PHBase,

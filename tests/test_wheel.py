@@ -65,7 +65,10 @@ def test_wheel_farmer_lagrangian_xhatshuffle():
     n = 3
     hub_dict = {
         "hub_class": PHHub,
-        "hub_kwargs": {"options": {"rel_gap": 1e-3, "abs_gap": 1.0}},
+        # linger deflakes thread timing: spoke bounds may land after the
+        # hub's own (fast) iterations finish
+        "hub_kwargs": {"options": {"rel_gap": 1e-3, "abs_gap": 1.0,
+                                   "linger_secs": 60.0}},
         "opt_class": PH,
         "opt_kwargs": _farmer_opt_kwargs(n),
     }

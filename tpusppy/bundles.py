@@ -59,6 +59,13 @@ def form_bundles(problems, num_bundles: int) -> list:
     if any(p.prob is None for p in problems):
         problems = [dataclasses.replace(p, prob=1.0 / S) for p in problems]
 
+    stage_counts = {len(p.nodes) for p in problems}
+    if len(stage_counts) != 1:
+        # a mixed list sliced naively could cut subtrees across bundle
+        # boundaries and silently DROP inner-stage nonanticipativity
+        raise ValueError(
+            f"scenarios disagree on stage structure ({stage_counts} node "
+            "counts); cannot bundle")
     multistage = len(problems[0].nodes) > 1
     if multistage:
         gsz = _stage2_group_size(problems)

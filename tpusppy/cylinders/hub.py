@@ -243,6 +243,40 @@ class PHHub(Hub):
 
     def main(self):
         self.opt.ph_main(finalize=False)
+        self._linger()
+
+    def _linger(self):
+        """Keep syncing after the hub's own iterations finish, harvesting
+        late spoke bounds until the gap certifies or ``linger_secs`` pass.
+
+        The reference hub's iterations each take an external-MIP-solve long,
+        so spokes get wall-time for free; our iterations are milliseconds,
+        and a hub that exits immediately throws away whatever the spokes are
+        mid-way through computing (acute for cross-process spokes that
+        cold-start).  Lingering costs idle time only and can only improve
+        the certified gap.
+        """
+        import time
+
+        linger = float(self.options.get("linger_secs", 0.0))
+        if linger <= 0.0 or not self.spokes:
+            return
+        t0 = time.time()
+        last_trace = 0.0
+        while time.time() - t0 < linger:
+            self.sync()
+            # quiet convergence check (is_converged prints a trace row per
+            # call — at poll frequency that floods the screen); trace at
+            # most every 5s
+            if time.time() - last_trace > 5.0:
+                last_trace = time.time()
+                if self.is_converged():
+                    global_toc("Hub linger: gap certified", True)
+                    break
+            elif self.determine_termination():
+                global_toc("Hub linger: gap certified", True)
+                break
+            time.sleep(0.5)
 
     def finalize(self):
         return self.opt.post_loops()

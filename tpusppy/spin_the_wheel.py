@@ -201,16 +201,29 @@ def _scrubbed_child_env():
     return env
 
 
+def _ready_path(fabric_name, strata_rank):
+    import os
+    import tempfile
+
+    tag = fabric_name.strip("/").replace("/", "_")
+    return os.path.join(tempfile.gettempdir(), f"{tag}.{strata_rank}.ready")
+
+
 def _spoke_worker(fabric_name, spoke_dict, strata_rank):
     """Child-process entry: attach the shm fabric, build this cylinder's opt,
     run its main loop (the per-rank role dispatch of spin_the_wheel.py:92-127,
-    as an OS process instead of an MPI rank)."""
+    as an OS process instead of an MPI rank).  A sentinel file marks
+    construction-readiness for the parent's first-contact barrier (waiting
+    for a bound Put instead would deadlock: xhat-style spokes publish only
+    AFTER receiving hub data)."""
     from .runtime.window_service import ShmWindowFabric
 
     fabric = ShmWindowFabric(fabric_name, attach=True)
     opt = spoke_dict["opt_class"](**spoke_dict["opt_kwargs"])
     comm = spoke_dict["spoke_class"](
         opt, strata_rank, fabric, **spoke_dict.get("spoke_kwargs", {}))
+    with open(_ready_path(fabric_name, strata_rank), "w") as f:
+        f.write("ready")
     try:
         comm.main()
     finally:
@@ -278,20 +291,28 @@ class MultiprocessWheelSpinner(WheelSpinner):
         )
         hub_comm.setup_hub()
         # First-contact barrier: spawned cylinders cold-start a full python +
-        # jax + XLA-compile pipeline; a fast hub would otherwise finish and
-        # kill them before they ever report a bound.  (MPI ranks start
-        # together; process spawn does not.)  Each spoke's first Put marks it
-        # live; a dead child is detected via its exit code.
+        # jax(+XLA compile) pipeline; a fast hub would otherwise finish and
+        # kill them before they ever participate.  (MPI ranks start
+        # together; process spawn does not.)  Readiness = the child
+        # CONSTRUCTED its comm (sentinel file) — NOT its first bound Put,
+        # which for xhat-style spokes only happens after hub data arrives.
         import time as _time
 
         wait = float(self.hub_dict.get("first_contact_wait", 900.0))
         t0 = _time.time()
+        ready = [_ready_path(name, i + 1)
+                 for i in range(len(self.list_of_spoke_dict))]
         while _time.time() - t0 < wait:
-            if all(mb.write_id != 0 for mb in fabric.to_hub.values()):
+            if all(os.path.exists(rp) for rp in ready):
                 break
             if any(p.exitcode not in (None, 0) for p in procs):
                 break
             _time.sleep(0.25)
+        for rp in ready:
+            try:
+                os.remove(rp)
+            except OSError:
+                pass
         try:
             try:
                 hub_comm.main()
