@@ -1,13 +1,32 @@
 """Benchmark: PH iterations/sec on a 1000-scenario farmer via batched ADMM.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} and
+ALWAYS exits 0.
+
+Orchestration (this file, parent process — imports no jax): the TPU runtime
+here is a remote tunnel that can be down, wedged, or flaky; a benchmark that
+dies with rc=1 when it is (BENCH_r02.json) loses the round's flagship number.
+So the parent
+  1. probes TPU availability in a SUBPROCESS with a hard timeout (a downed
+     tunnel makes ``import jax``/``jax.devices()`` hang, not raise),
+  2. retries the probe with backoff (transient tunnel hiccups),
+  3. runs the real workload (``--workload``) as a child with a timeout,
+  4. on persistent TPU unavailability, re-runs the child on CPU with a
+     scrubbed environment and marks the JSON with ``"tpu_unavailable": true``
+     — a CPU number beats no number,
+  5. if everything fails, still prints a JSON line with an ``error`` field.
+Children are strictly sequential: two concurrent TPU processes can wedge the
+remote-compile tunnel.
 
 The workload mirrors the reference's headline shape (SURVEY §6: PH iters/sec /
-wall-clock to gap on scenario ladders up to 1000 scenarios).  ``vs_baseline``
-measures against the reference *architecture* on this host: a serial
-one-LP-per-scenario PH iteration through an external simplex solver (HiGHS via
-scipy — the stand-in for the Gurobi/CPLEX per-rank solve loop of
-``spopt.py:226-307``), extrapolated from a timed sample of scenarios.
+wall-clock to gap on scenario ladders up to 1000 scenarios).  Baselines:
+  - ``vs_baseline``: vs the reference *architecture* on this host — a serial
+    one-LP-per-scenario PH iteration through an external simplex solver
+    (HiGHS via scipy, the stand-in for the per-rank Gurobi loop of
+    ``spopt.py:226-307``), extrapolated from a timed sample.
+  - ``vs_baseline_32rank``: the honest north-star figure (BASELINE.md:
+    ≥10x vs 32-rank MPI+solver PH) — the serial baseline divided by 32,
+    i.e. IDEAL 32-way scaling of the reference architecture, stated as such.
 
 PH iterations run on the factorization-amortized path (periodic adaptive
 refresh + sweep-only frozen steps, `sharded.make_ph_step_pair`); subproblems
@@ -16,28 +35,162 @@ solver default feasibility/optimality tolerances.
 
 Timing note: on the axon TPU plugin ``jax.block_until_ready`` returns before
 execution completes, so all timing fences are host fetches (``np.asarray``).
-Set BENCH_UC=1 for the UC metric (see bench_uc.py).
+Set BENCH_UC=1 for the UC metric alone (see bench_uc.py).
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-import numpy as np
+RANKS = 32  # north-star comparison width (BASELINE.md: 32-rank MPI PH)
 
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+# --------------------------------------------------------------------------
+# Parent-side orchestration (no jax in this process)
+# --------------------------------------------------------------------------
+
+def _scrubbed_cpu_env():
+    """Environment for a CPU-only child: drop the TPU plugin's trigger vars
+    (a sitecustomize on PYTHONPATH force-registers the remote TPU runtime and
+    proxies XLA compiles through a tunnel that may be down)."""
+    env = {
+        k: v for k, v in os.environ.items()
+        if k != "PYTHONPATH" and "AXON" not in k and not k.startswith("TPU_")
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("JAX_ENABLE_X64", "1")
+    return env
+
+
+def _run_child(args, env, timeout):
+    """Run a child; return (ok, last_json_or_None, tail). stderr streams
+    through (progress logs); stdout is captured for the JSON line."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + args,
+            env=env, stdout=subprocess.PIPE, stderr=None, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return False, None, f"timeout after {timeout}s"
+    out = proc.stdout.decode(errors="replace")
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                break
+            # a complete JSON line is a finished measurement even if the
+            # child's interpreter teardown then crashed (flaky TPU plugin):
+            # keep the number, note the rc
+            if proc.returncode != 0:
+                parsed["child_rc"] = proc.returncode
+            return True, parsed, out[-2000:]
+    return False, None, f"rc={proc.returncode} out={out[-2000:]!r}"
+
+
+def _probe_tpu(timeout):
+    """True iff a TPU backend initializes in a fresh process within timeout."""
+    code = ("import jax; d = jax.devices(); "
+            "print('PROBE_OK', d[0].platform, len(d))")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=dict(os.environ),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe hang (>{timeout}s) — tunnel down"
+    out = proc.stdout.decode(errors="replace")
+    for line in out.splitlines():
+        if line.startswith("PROBE_OK"):
+            plat = line.split()[1]
+            if plat != "cpu":
+                return True, line.strip()
+            return False, f"probe found only cpu backend: {line.strip()}"
+    return False, f"probe rc={proc.returncode}: {out[-500:]!r}"
+
+
 def main():
+    force_cpu = (os.environ.get("BENCH_FORCE_CPU")
+                 or os.environ.get("JAX_PLATFORMS") == "cpu")
+    attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", "3"))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
+    run_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", "2400"))
+    cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", "2400"))
+    backoff = float(os.environ.get("BENCH_BACKOFF", "30"))
+
+    tpu_error = None
+    if not force_cpu:
+        for attempt in range(attempts):
+            if attempt:
+                log(f"bench: backoff {backoff * attempt:.0f}s before "
+                    f"TPU attempt {attempt + 1}/{attempts}")
+                time.sleep(backoff * attempt)
+            ok, info = _probe_tpu(probe_timeout)
+            log(f"bench: TPU probe attempt {attempt + 1}/{attempts}: {info}")
+            if not ok:
+                tpu_error = info
+                continue
+            ok, line, tail = _run_child(
+                ["--workload"], dict(os.environ), run_timeout)
+            if ok and line is not None:
+                line["tpu_unavailable"] = False
+                print(json.dumps(line))
+                return
+            tpu_error = f"workload failed: {tail}"
+            log(f"bench: TPU workload attempt {attempt + 1} failed: "
+                f"{tail[:500]}")
+    else:
+        tpu_error = "forced cpu (BENCH_FORCE_CPU/JAX_PLATFORMS)"
+
+    # CPU fallback — scrubbed env so the TPU plugin can't hang the child
+    log(f"bench: falling back to CPU ({tpu_error})")
+    env = _scrubbed_cpu_env()
+    # trim the in-child UC wheel watchdog on CPU unless the caller pinned it
+    env.setdefault("BENCH_UC_WHEEL_TIMEOUT", "600")
+    ok, line, tail = _run_child(["--workload"], env, cpu_timeout)
+    if ok and line is not None:
+        line["tpu_unavailable"] = not force_cpu
+        if tpu_error and not force_cpu:
+            line["tpu_error"] = str(tpu_error)[:500]
+        print(json.dumps(line))
+        return
+
+    # Last resort: a structured failure line, rc still 0 (a parseable
+    # artifact with an error field beats a dead artifact)
+    if os.environ.get("BENCH_UC"):
+        metric = f"ph_iters_per_sec_uc{os.environ.get('BENCH_UC_SCENS', '1000')}"
+    else:
+        metric = f"ph_iters_per_sec_farmer{os.environ.get('BENCH_SCENS', '1000')}"
+    print(json.dumps({
+        "metric": metric,
+        "value": 0.0,
+        "unit": "iter/s",
+        "vs_baseline": 0.0,
+        "tpu_unavailable": True,
+        "error": f"tpu: {str(tpu_error)[:400]}; cpu: {str(tail)[:400]}",
+    }))
+
+
+# --------------------------------------------------------------------------
+# Child-side workload (runs under an already-validated backend)
+# --------------------------------------------------------------------------
+
+def workload():
     if os.environ.get("BENCH_UC"):
         import bench_uc
         bench_uc.main()
         return
 
     import jax
+    import numpy as np
 
     import tpusppy
 
@@ -116,14 +269,20 @@ def main():
         )
     t_per_scen = (time.time() - t0) / sample
     baseline_iters_per_sec = 1.0 / (t_per_scen * S)
+    base32 = baseline_iters_per_sec * RANKS  # IDEAL 32-way rank scaling
     log(f"baseline (serial HiGHS loop): {t_per_scen * 1e3:.2f} ms/scenario "
-        f"=> {baseline_iters_per_sec:.4f} PH iters/sec")
+        f"=> {baseline_iters_per_sec:.4f} PH iters/sec serial, "
+        f"{base32:.4f} at ideal {RANKS}-rank scaling")
 
     line = {
         "metric": f"ph_iters_per_sec_farmer{S}",
         "value": round(iters_per_sec, 4),
         "unit": "iter/s",
+        "platform": platform,
         "vs_baseline": round(iters_per_sec / baseline_iters_per_sec, 2),
+        # honest north-star figure: vs IDEAL 32-way scaling of the serial
+        # reference architecture (serial/32 accounting, BASELINE.md)
+        "vs_baseline_32rank": round(iters_per_sec / base32, 2),
     }
     if not os.environ.get("BENCH_SKIP_UC"):
         try:
@@ -136,4 +295,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--workload" in sys.argv[1:]:
+        workload()
+    else:
+        main()
