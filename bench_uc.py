@@ -37,14 +37,25 @@ def uc_metrics():
 
     tpusppy.disable_tictoc_output()
     from tpusppy.ir import ScenarioBatch
-    from tpusppy.models import uc_lite
     from tpusppy.parallel import sharded
     from tpusppy.solvers import scipy_backend
     from tpusppy.solvers.admm import ADMMSettings
 
+    # Default: the reference-shape scaled UC (30 gens x 24 h with min-up/
+    # down, startup ramps, reserves — models/uc.py, shared-A engine),
+    # matching examples/uc + paperruns/larger_uc in the reference.
+    # BENCH_UC_MODEL=lite selects the small self-contained family.
+    model_name = os.environ.get("BENCH_UC_MODEL", "full")
+    if model_name == "lite":
+        from tpusppy.models import uc_lite as uc_model
+        default_gens, default_horizon = 5, 12
+    else:
+        from tpusppy.models import uc as uc_model
+        default_gens, default_horizon = 30, 24
+
     S = int(os.environ.get("BENCH_UC_SCENS", "1000"))
-    gens = int(os.environ.get("BENCH_UC_GENS", "5"))
-    horizon = int(os.environ.get("BENCH_UC_HORIZON", "12"))
+    gens = int(os.environ.get("BENCH_UC_GENS", str(default_gens)))
+    horizon = int(os.environ.get("BENCH_UC_HORIZON", str(default_horizon)))
     iters = int(os.environ.get("BENCH_UC_ITERS", "30"))
     refresh_every = max(1, int(os.environ.get("BENCH_REFRESH", "16")))
     gap_target = float(os.environ.get("BENCH_UC_GAP", "0.01"))
@@ -61,11 +72,13 @@ def uc_metrics():
 
     kw = {"num_gens": gens, "horizon": horizon, "num_scens": S,
           "relax_integers": False}
-    names = uc_lite.scenario_names_creator(S)
+    names = uc_model.scenario_names_creator(S)
     batch = ScenarioBatch.from_problems(
-        [uc_lite.scenario_creator(nm, **kw) for nm in names])
-    log(f"uc batch: {batch.num_scenarios} x ({batch.num_rows} rows, "
-        f"{batch.num_vars} vars, {int(batch.is_int.sum())} ints)")
+        [uc_model.scenario_creator(nm, **kw) for nm in names])
+    log(f"uc[{model_name}] batch: {batch.num_scenarios} x "
+        f"({batch.num_rows} rows, {batch.num_vars} vars, "
+        f"{int(batch.is_int.sum())} ints, "
+        f"shared_A={batch.A_shared is not None})")
 
     # ---- metric 1: hub PH iteration rate ---------------------------------
     mesh = sharded.make_mesh()
@@ -130,7 +143,7 @@ def uc_metrics():
                         "solver_options": so,
                         "xhat_looper_options": {"scen_limit": 3}},
             "all_scenario_names": names,
-            "scenario_creator": uc_lite.scenario_creator,
+            "scenario_creator": uc_model.scenario_creator,
             "scenario_creator_kwargs": kw,
         }
 

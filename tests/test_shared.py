@@ -1,0 +1,203 @@
+"""Shared-constraint-matrix (A_shared) engine tests.
+
+The memory-wall breaker (VERDICT r2 missing #1): families whose uncertainty
+enters costs/rhs/bounds only share one A — the batch stores (m, n) instead
+of (S, m, n) and the solver keeps ONE shared (n, n) factorization
+(solvers/shared_admm.py).  Reference workload shape:
+/root/reference/paperruns/larger_uc (wind -> power-balance rhs).
+"""
+
+import numpy as np
+import pytest
+
+from tpusppy.ir import ScenarioBatch
+from tpusppy.models import uc_lite
+from tpusppy.solvers import admm, scipy_backend, shared_admm
+from tpusppy.solvers.admm import ADMMSettings
+
+
+def _uc_batch(S=6, **kw):
+    kw.setdefault("relax_integers", True)
+    names = uc_lite.scenario_names_creator(S)
+    return ScenarioBatch.from_problems(
+        [uc_lite.scenario_creator(nm, num_scens=S, **kw) for nm in names])
+
+
+def test_shared_detection():
+    batch = _uc_batch(4)
+    assert batch.A_shared is not None
+    assert batch.A_shared.shape == (batch.num_rows, batch.num_vars)
+    # .A stays a valid zero-copy per-scenario view for host code
+    assert batch.A.shape == (4, batch.num_rows, batch.num_vars)
+    assert np.array_equal(batch.A[2], batch.A_shared)
+    # scenarios still differ where they should (balance rhs)
+    assert not np.array_equal(batch.cl[0], batch.cl[1])
+
+
+def test_shared_not_detected_when_A_differs():
+    from tpusppy.models import farmer
+
+    names = farmer.scenario_names_creator(3)
+    batch = ScenarioBatch.from_problems(
+        [farmer.scenario_creator(nm, num_scens=3) for nm in names])
+    assert batch.A_shared is None  # yields enter A -> per-scenario
+
+
+def test_shared_lp_matches_scipy():
+    batch = _uc_batch(5)
+    st = ADMMSettings(max_iter=1000, restarts=10)
+    sol = shared_admm.solve_shared(
+        batch.c, batch.q2, batch.A_shared, batch.cl, batch.cu,
+        batch.lb, batch.ub, settings=st)
+    x = np.asarray(sol.x)
+    res = np.maximum(np.asarray(sol.pri_res), np.asarray(sol.dua_res))
+    # the whole batch converges to ~solver tolerance (no polish on this
+    # path; vertex-exact residue is the host rescue's job)
+    assert (res < 1e-4).all(), res
+    for s in range(batch.num_scenarios):
+        ref = scipy_backend.solve_lp(
+            batch.c[s], batch.A[s], batch.cl[s], batch.cu[s],
+            batch.lb[s], batch.ub[s])
+        ours = float(batch.c[s] @ x[s])
+        assert ours == pytest.approx(ref.obj, rel=5e-4)
+        if res[s] < 1e-6:
+            assert ours == pytest.approx(ref.obj, rel=1e-6)
+
+
+def test_shared_qp_kkt_residuals():
+    batch = _uc_batch(5)
+    idx = batch.tree.nonant_indices
+    q2 = batch.q2.copy()
+    q2[:, idx] += 2.0          # PH prox, shared across scenarios
+    st = ADMMSettings(max_iter=1000, restarts=10)
+    sol = shared_admm.solve_shared(
+        batch.c, q2, batch.A_shared, batch.cl, batch.cu,
+        batch.lb, batch.ub, settings=st)
+    assert float(np.max(np.asarray(sol.pri_res))) < 1e-3
+    assert float(np.max(np.asarray(sol.dua_res))) < 1e-3
+
+
+def test_shared_frozen_reuse():
+    """Frozen solve on a converged LP refresh + small objective drift must
+    terminate well within budget and stay at tolerance (the PH steady-state
+    pattern; cold-QP stalls are a known ADMM trait shared with the dense
+    engine and are exercised via the e2e PH test instead)."""
+    batch = _uc_batch(5)
+    idx = batch.tree.nonant_indices
+    st = ADMMSettings(max_iter=1000, restarts=10)
+    sol, fac = shared_admm.solve_shared_factored(
+        batch.c, batch.q2, batch.A_shared, batch.cl, batch.cu,
+        batch.lb, batch.ub, settings=st)
+    assert float(np.max(np.asarray(sol.pri_res))) < 1e-4
+    # PH-steady-state objective move: a late-iteration W drift is tiny
+    # (early-PH drifts move the LP basis and cost real re-solve sweeps,
+    # exactly like the dense engine)
+    q = batch.c.copy()
+    q[:, idx] += 1e-4 * np.abs(batch.c[:, idx])
+    sol2 = shared_admm.solve_shared_frozen(
+        q, batch.q2, batch.A_shared, batch.cl, batch.cu, batch.lb,
+        batch.ub, fac, settings=st, warm=sol.raw)
+    # accuracy holds through the frozen path (iteration count is governed
+    # by the 1e-8 default eps, which this family approaches asymptotically)
+    assert float(np.max(np.asarray(sol2.pri_res))) < 1e-4
+    assert float(np.max(np.asarray(sol2.dua_res))) < 1e-4
+
+
+def test_spopt_dispatches_shared():
+    """solve_loop on a shared-A batch must route to the shared engine and
+    still produce a correct PH run with certified trivial bound."""
+    from tpusppy.opt.ph import PH
+
+    S = 4
+    names = uc_lite.scenario_names_creator(S)
+    ph = PH({"defaultPHrho": 2.0, "PHIterLimit": 3, "convthresh": -1.0},
+            names, uc_lite.scenario_creator,
+            scenario_creator_kwargs={"num_scens": S, "relax_integers": True})
+    assert ph.batch.A_shared is not None
+    conv, eobj, tbound = ph.ph_main()
+    assert np.isfinite(conv) and np.isfinite(eobj)
+    # wait-and-see bound can exceed PH's E[obj] only by solver tolerance
+    assert tbound <= eobj * (1 + 1e-3) + 1.0
+
+
+def test_shared_ef_parity():
+    """EF through HiGHS vs the batched path on the shared-A family."""
+    from tpusppy.ef import solve_ef
+
+    batch = _uc_batch(3)
+    obj_h, _ = solve_ef(batch, solver="highs")
+    obj_a, _ = solve_ef(batch, solver="admm")
+    assert obj_a == pytest.approx(obj_h, rel=5e-4)
+
+
+def test_shared_dual_objective_2d_dispatch():
+    """admm.dual_objective/dual_cut accept the (m, n) shared A directly."""
+    import jax.numpy as jnp
+
+    batch = _uc_batch(3)
+    st = ADMMSettings(max_iter=400, restarts=8)
+    sol = shared_admm.solve_shared(
+        batch.c, batch.q2, batch.A_shared, batch.cl, batch.cu,
+        batch.lb, batch.ub, settings=st)
+    args3 = (jnp.asarray(batch.c), jnp.asarray(batch.q2),
+             jnp.asarray(np.array(batch.A)), jnp.asarray(batch.cl),
+             jnp.asarray(batch.cu), jnp.asarray(batch.lb),
+             jnp.asarray(batch.ub), sol.y, sol.x)
+    args2 = args3[:2] + (jnp.asarray(batch.A_shared),) + args3[3:]
+    d3 = np.asarray(admm.dual_objective(*args3))
+    d2 = np.asarray(admm.dual_objective(*args2))
+    np.testing.assert_allclose(d2, d3, rtol=1e-10)
+    # weak duality: the bound must sit below each scenario optimum
+    for s in range(batch.num_scenarios):
+        ref = scipy_backend.solve_lp(
+            batch.c[s], batch.A[s], batch.cl[s], batch.cu[s],
+            batch.lb[s], batch.ub[s])
+        assert d2[s] <= ref.obj + 1e-6 * abs(ref.obj)
+
+
+def test_shared_edualbound_certified():
+    """SPOpt.Edualbound on a shared batch: certified vs per-scenario optima."""
+    from tpusppy.phbase import PHBase
+
+    S = 4
+    names = uc_lite.scenario_names_creator(S)
+    opt = PHBase({"defaultPHrho": 1.0, "PHIterLimit": 1, "convthresh": -1.0},
+                 names, uc_lite.scenario_creator,
+                 scenario_creator_kwargs={"num_scens": S,
+                                          "relax_integers": True})
+    opt.solve_loop()
+    bound = opt.Edualbound()
+    exact = np.mean([
+        scipy_backend.solve_lp(
+            opt.batch.c[s], opt.batch.A[s], opt.batch.cl[s],
+            opt.batch.cu[s], opt.batch.lb[s], opt.batch.ub[s]).obj
+        + opt.batch.const[s]
+        for s in range(S)
+    ])
+    assert bound <= exact + 1e-6 * abs(exact)
+    assert bound >= exact - 0.02 * abs(exact)   # and not trivially weak
+
+
+def test_shared_sharded_mesh():
+    """run_ph on an 8-device CPU mesh with a shared-A batch: the jit
+    auto-partitioned shared solver must execute and agree with 1 device."""
+    import jax
+
+    from tpusppy.parallel import sharded
+
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 virtual devices (conftest sets this)")
+    S = 16
+    names = uc_lite.scenario_names_creator(S)
+    batch = ScenarioBatch.from_problems(
+        [uc_lite.scenario_creator(nm, num_scens=S, relax_integers=True)
+         for nm in names])
+    st = ADMMSettings(max_iter=200, restarts=4, scaling_iters=4)
+    mesh8 = sharded.make_mesh(8)
+    _, out8 = sharded.run_ph(batch, mesh8, iters=2, default_rho=2.0,
+                             settings=st)
+    mesh1 = sharded.make_mesh(1)
+    _, out1 = sharded.run_ph(batch, mesh1, iters=2, default_rho=2.0,
+                             settings=st)
+    assert np.isfinite(float(out8.conv))
+    assert float(out8.eobj) == pytest.approx(float(out1.eobj), rel=1e-4)

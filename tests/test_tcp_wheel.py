@@ -1,0 +1,90 @@
+"""TCP window-service fabric: raw semantics + a full cross-process wheel.
+
+The multi-host analogue of tests/test_mp_wheel.py — same wheel, same
+assertions, but the mailboxes are the C++ TCP box server
+(runtime/csrc/tcp_window_service.cpp) instead of POSIX shm, i.e. exactly
+what spokes on OTHER hosts would speak (reference:
+mpisppy/spin_the_wheel.py:219-237 over multi-node MPI RMA).
+"""
+
+import numpy as np
+import pytest
+
+from tpusppy.models import farmer
+from tpusppy.opt.ph import PH
+from tpusppy.phbase import PHBase
+from tpusppy.spin_the_wheel import MultiprocessWheelSpinner
+from tpusppy.xhat_eval import Xhat_Eval
+
+
+def test_tcp_fabric_raw_semantics():
+    """In-process server + client: write-id monotonicity, length checks,
+    kill sentinel terminality — Mailbox parity."""
+    from tpusppy.runtime.tcp_window_service import TcpWindowFabric
+
+    fab = TcpWindowFabric(spoke_lengths=[(4, 3)])
+    cli = TcpWindowFabric(connect=("127.0.0.1", fab.port))
+    try:
+        assert cli.n_spokes == 1
+        assert cli.to_spoke[1].length == 4
+        assert cli.to_hub[1].length == 3
+
+        v, wid = cli.to_spoke[1].get()
+        assert wid == 0 and np.all(v == 0)
+        assert fab.to_spoke[1].put(np.arange(4.0)) == 1
+        v, wid = cli.to_spoke[1].get()
+        assert wid == 1 and np.allclose(v, np.arange(4.0))
+        assert cli.to_hub[1].put(np.ones(3)) == 1
+        v, wid = fab.to_hub[1].get()
+        assert wid == 1 and np.allclose(v, 1.0)
+
+        with pytest.raises(RuntimeError):
+            cli.to_hub[1].put(np.ones(5))        # length mismatch
+
+        fab.send_terminate()
+        assert cli.to_spoke[1].write_id == -1    # sentinel visible remotely
+        assert fab.to_spoke[1].put(np.zeros(4)) == -1   # terminal
+        assert cli.to_hub[1].put(np.ones(3)) == 2       # reverse box alive
+    finally:
+        cli.close()
+        fab.close()
+
+
+@pytest.mark.slow
+def test_tcp_wheel_farmer_two_spokes():
+    """Same wheel + assertions as test_mp_wheel, fabric='tcp'."""
+    from tpusppy.cylinders import (LagrangianOuterBound, PHHub,
+                                   XhatShuffleInnerBound)
+
+    n = 3
+    names = farmer.scenario_names_creator(n)
+    kw = {"num_scens": n}
+
+    def okw(iters):
+        return {
+            "options": {"defaultPHrho": 1.0, "PHIterLimit": iters,
+                        "convthresh": -1.0,
+                        "xhat_looper_options": {"scen_limit": 2}},
+            "all_scenario_names": names,
+            "scenario_creator": farmer.scenario_creator,
+            "scenario_creator_kwargs": kw,
+        }
+
+    hub_dict = {
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": {"rel_gap": 0.01, "linger_secs": 300.0}},
+        "opt_class": PH,
+        "opt_kwargs": okw(40),
+    }
+    spokes = [
+        {"spoke_class": LagrangianOuterBound, "opt_class": PHBase,
+         "opt_kwargs": okw(60)},
+        {"spoke_class": XhatShuffleInnerBound, "opt_class": Xhat_Eval,
+         "opt_kwargs": okw(60)},
+    ]
+    ws = MultiprocessWheelSpinner(hub_dict, spokes, fabric="tcp").spin()
+    assert np.isfinite(ws.BestInnerBound)
+    assert np.isfinite(ws.BestOuterBound)
+    assert ws.BestOuterBound <= ws.BestInnerBound + 1e-6
+    assert ws.BestOuterBound <= -108390.0 + 60.0
+    assert ws.BestInnerBound >= -108390.0 - 60.0

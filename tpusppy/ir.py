@@ -176,7 +176,7 @@ class ScenarioBatch:
     names: list
     c: np.ndarray          # (S, n)
     q2: np.ndarray         # (S, n)
-    A: np.ndarray          # (S, m, n)
+    A: np.ndarray          # (S, m, n) — a zero-copy broadcast view when shared
     cl: np.ndarray         # (S, m)
     cu: np.ndarray         # (S, m)
     lb: np.ndarray         # (S, n)
@@ -189,6 +189,15 @@ class ScenarioBatch:
     # (e.g. cross-scenario cut injection) so cached solver factorizations
     # keyed on it (SPOpt._solve_sig) invalidate
     version: int = 0
+    # Shared constraint matrix (m, n), set when every scenario carries the
+    # SAME A object (uncertainty in costs/rhs/bounds only — the reference's
+    # headline UC is this shape: wind enters the power-balance rhs).  Model
+    # creators opt in by reusing one numpy array across their
+    # ScenarioProblems; ``.A`` is then a broadcast view (no (S, m, n) memory)
+    # and solves dispatch to the shared-A engine
+    # (tpusppy.solvers.shared_admm), which keeps ONE (n, n) factorization
+    # for the whole batch.
+    A_shared: np.ndarray | None = None
 
     @classmethod
     def from_problems(cls, problems: list[ScenarioProblem]) -> "ScenarioBatch":
@@ -203,6 +212,11 @@ class ScenarioBatch:
 
         n = max(p.num_vars for p in problems)
         m = max(p.num_rows for p in problems)
+        # identity-shared A detection BEFORE padding (padding never triggers
+        # for a shared family — all members have the same shape by
+        # construction)
+        A0 = problems[0].A
+        a_shared = all(p.A is A0 for p in problems)
         problems = [_pad_problem(p, n, m) for p in problems]
 
         tree = build_tree(problems)
@@ -216,11 +230,18 @@ class ScenarioBatch:
         if any(p.var_names != var_names for p in problems):
             var_names = None
 
+        if a_shared:
+            A_shared = np.ascontiguousarray(A0)
+            A = np.broadcast_to(A_shared[None], (len(problems), m, n))
+        else:
+            A_shared = None
+            A = np.stack([p.A for p in problems])
         return cls(
             names=[p.name for p in problems],
             c=np.stack([p.c for p in problems]),
             q2=np.stack([p.q2 for p in problems]),
-            A=np.stack([p.A for p in problems]),
+            A=A,
+            A_shared=A_shared,
             cl=np.stack([p.cl for p in problems]),
             cu=np.stack([p.cu for p in problems]),
             lb=np.stack([p.lb for p in problems]),
@@ -269,6 +290,10 @@ class ScenarioBatch:
         S, m, n = self.A.shape
         dc, dr = int(extra_cols), int(extra_rows)
         pad_c = np.zeros((S, dc))
+        # materializes per-scenario A (cut rows are written per scenario
+        # in-place later): a shared-A batch loses its sharing here — cut
+        # steering is a small/medium-family feature; at shared-A scale use
+        # the hub-side cutting-plane bound instead
         A = np.zeros((S, m + dr, n + dc))
         A[:, :m, :n] = self.A
         names = None
@@ -280,6 +305,7 @@ class ScenarioBatch:
             c=np.concatenate([self.c, pad_c], axis=1),
             q2=np.concatenate([self.q2, pad_c], axis=1),
             A=A,
+            A_shared=None,
             cl=np.concatenate([self.cl, np.full((S, dr), -INF)], axis=1),
             cu=np.concatenate([self.cu, np.full((S, dr), INF)], axis=1),
             lb=np.concatenate(

@@ -15,12 +15,22 @@ lognormal wind error walk.
 
 from __future__ import annotations
 
+import dataclasses
+
 import numpy as np
 
 from ..ir import LinearModelBuilder
 from ..scenario_tree import ScenarioNode, extract_num
 
 VOLL = 1000.0  # value of lost load ($/MWh)
+
+# Template cache: uncertainty enters ONLY the power-balance rhs, so every
+# scenario shares one constraint matrix.  Reusing the same numpy A object
+# across ScenarioProblems opts the batch into the shared-A engine
+# (ir.ScenarioBatch.A_shared / solvers.shared_admm) — the (S, m, n) tensor is
+# never materialized, which is what makes reference-scale UC (SURVEY §6,
+# paperruns/larger_uc) fit one chip.
+_TEMPLATE_CACHE: dict = {}
 
 
 def scenario_names_creator(num_scens, start=0):
@@ -60,19 +70,16 @@ def _fleet(num_gens, seedoffset):
     return pmax, pmin, mc, noload, ramp
 
 
-def scenario_creator(scenario_name, num_gens=5, horizon=12, num_scens=None,
-                     seedoffset=0, relax_integers=False):
-    scennum = extract_num(scenario_name)
+def _template(num_gens, horizon, seedoffset, relax_integers):
+    """Build the scenario-independent model ONCE per configuration; scenarios
+    only rewrite the balance-row rhs (see module docstring)."""
+    key = (num_gens, horizon, seedoffset, relax_integers)
+    cached = _TEMPLATE_CACHE.get(key)
+    if cached is not None:
+        return cached
     pmax, pmin, mc, noload, ramp = _fleet(num_gens, seedoffset)
-    stream = np.random.RandomState(31400 + scennum + seedoffset)
-    base = 0.55 * pmax.sum()
-    t = np.arange(horizon)
-    profile = base * (1.0 + 0.3 * np.sin(2 * np.pi * (t - 3) / 24.0))
-    noise = np.cumsum(stream.normal(0.0, 0.03 * base, horizon))
-    demand = np.clip(profile + noise, 0.2 * base, 0.95 * pmax.sum())
-
     as_int = not relax_integers
-    b = LinearModelBuilder(scenario_name)
+    b = LinearModelBuilder("template")
     u, p = {}, {}
     for g in range(num_gens):
         for h in range(horizon):
@@ -93,15 +100,37 @@ def scenario_creator(scenario_name, num_gens=5, horizon=12, num_scens=None,
     for h in range(horizon):
         coeffs = {p[g, h]: 1.0 for g in range(num_gens)}
         coeffs[shed[h]] = 1.0
-        b.add_ge(coeffs, float(demand[h]))                     # balance
+        b.add_ge(coeffs, 0.0)                # balance rhs set per scenario
 
-    prob = None if num_scens is None else 1.0 / num_scens
     mdl = b.build()
-    mdl.prob = prob
+    balance_rows = np.arange(mdl.num_rows - horizon, mdl.num_rows)
     nonants = np.asarray([u[g, h] for g in range(num_gens)
                           for h in range(horizon)], dtype=np.int32)
-    mdl.nodes = [ScenarioNode("ROOT", 1.0, 1, nonants)]
-    return mdl
+    _TEMPLATE_CACHE[key] = (mdl, balance_rows, nonants, pmax)
+    return _TEMPLATE_CACHE[key]
+
+
+def scenario_creator(scenario_name, num_gens=5, horizon=12, num_scens=None,
+                     seedoffset=0, relax_integers=False):
+    scennum = extract_num(scenario_name)
+    mdl, balance_rows, nonants, pmax = _template(
+        num_gens, horizon, seedoffset, relax_integers)
+    stream = np.random.RandomState(31400 + scennum + seedoffset)
+    base = 0.55 * pmax.sum()
+    t = np.arange(horizon)
+    profile = base * (1.0 + 0.3 * np.sin(2 * np.pi * (t - 3) / 24.0))
+    noise = np.cumsum(stream.normal(0.0, 0.03 * base, horizon))
+    demand = np.clip(profile + noise, 0.2 * base, 0.95 * pmax.sum())
+
+    cl = mdl.cl.copy()
+    cl[balance_rows] = demand
+    return dataclasses.replace(
+        mdl,
+        name=scenario_name,
+        cl=cl,
+        prob=None if num_scens is None else 1.0 / num_scens,
+        nodes=[ScenarioNode("ROOT", 1.0, 1, nonants)],
+    )
 
 
 def scenario_denouement(rank, scenario_name, scenario):

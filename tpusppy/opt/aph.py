@@ -227,7 +227,7 @@ class APH(PHBase):
         """Solve the dispatched sub-batch with prox center z; scatter back.
 
         Returns the dispatched row indices."""
-        from ..solvers import admm
+        from ..spopt import batch_solve_dispatch
 
         rows = self._dispatch_rows()
         b = self.batch
@@ -239,9 +239,9 @@ class APH(PHBase):
         warm = None
         if self._warm is not None:
             warm = tuple(np.asarray(w)[rows] for w in self._warm)
-        sol = admm.solve_batch(
-            q, q2, b.A[rows], b.cl[rows], b.cu[rows], b.lb[rows], b.ub[rows],
-            settings=self.admm_settings, warm=warm,
+        sol = batch_solve_dispatch(
+            b, q, q2, b.cl[rows], b.cu[rows], b.lb[rows], b.ub[rows],
+            settings=self.admm_settings, warm=warm, rows=rows,
         )
         if self.local_x is None:
             self.local_x = np.zeros((b.num_scenarios, b.num_vars))

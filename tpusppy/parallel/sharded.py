@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..solvers import admm
+from ..solvers import admm, shared_admm
 from ..solvers.admm import ADMMSettings
 
 
@@ -34,11 +34,16 @@ class PHArrays(NamedTuple):
     Leading axis S is sharded over the mesh ``scen`` axis; everything else is
     replicated.  ``onehot`` is (S, K, N) node membership (nid one-hot), the
     matmul form of per-node sub-communicators.
+
+    For a shared-A batch (``ScenarioBatch.A_shared``), ``A`` is the single
+    (m, n) matrix REPLICATED across the mesh — scenario data stays sharded,
+    and the shared-A solver's matmuls against the replicated matrix shard
+    naturally on the scenario axis under jit auto-partitioning.
     """
 
     c: jax.Array        # (S, n)
     q2: jax.Array       # (S, n)
-    A: jax.Array        # (S, m, n)
+    A: jax.Array        # (S, m, n) — or (m, n) replicated when shared
     cl: jax.Array       # (S, m)
     cu: jax.Array       # (S, m)
     lb: jax.Array       # (S, n)
@@ -116,8 +121,28 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
     unchanged between iterations — only q moves — so factors stay valid; the
     residual-driven while_loop still guards accuracy, and a periodic refresh
     re-adapts rho (see :func:`run_ph`'s ``refresh_every``).
+
+    The engine is picked PER TRACE from ``arr.A.ndim`` (jit specializes on
+    shapes, so the branch is free): 3-D A runs the dense per-scenario solver
+    (shard_mapped over the mesh), 2-D A the shared-A solver — invoked
+    WITHOUT shard_map, under jit auto-partitioning: its cross-scenario
+    reductions (shared-rho adaptation, the all-done termination vote) lower
+    to psums over the mesh, so every device sees the SAME shared factors and
+    per-device factor divergence is structurally impossible.
     """
     idx = jnp.asarray(nonant_idx)
+
+    def shared_refresh(q, q2, A, cl, cu, lb, ub, x, z, y, yx):
+        with jax.default_matmul_precision("highest"):
+            return shared_admm._solve_shared_impl(
+                q, q2, A, cl, cu, lb, ub, settings, (x, z, y, yx),
+                want_factors=True)
+
+    def shared_frozen(q, q2, A, cl, cu, lb, ub, x, z, y, yx, factors):
+        with jax.default_matmul_precision("highest"):
+            return shared_admm._solve_shared_frozen_impl(
+                q, q2, A, cl, cu, lb, ub, factors, (x, z, y, yx),
+                settings)
 
     def local_refresh(q, q2, A, cl, cu, lb, ub, x, z, y, yx):
         with jax.default_matmul_precision("highest"):
@@ -128,7 +153,8 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
     def local_frozen(q, q2, A, cl, cu, lb, ub, x, z, y, yx, factors):
         with jax.default_matmul_precision("highest"):
             return admm._solve_frozen_impl(
-                q, q2, A, cl, cu, lb, ub, factors, (x, z, y, yx), settings)
+                q, q2, A, cl, cu, lb, ub, factors, (x, z, y, yx),
+                settings)
 
     if mesh is not None:
         sp = jax.sharding.PartitionSpec(axis)
@@ -174,7 +200,8 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
     @jax.jit
     def refresh_step(state: PHState, arr: PHArrays, prox_on):
         q, q2, W, rho = _objective(arr, state, prox_on)
-        sol, factors = refresh_solve(
+        solve = shared_refresh if arr.A.ndim == 2 else refresh_solve
+        sol, factors = solve(
             q, q2, arr.A, arr.cl, arr.cu, arr.lb, arr.ub,
             state.x, state.z, state.y, state.yx,
         )
@@ -184,7 +211,8 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
     @jax.jit
     def frozen_step(state: PHState, arr: PHArrays, prox_on, factors):
         q, q2, W, rho = _objective(arr, state, prox_on)
-        sol = frozen_solve(
+        solve = shared_frozen if arr.A.ndim == 2 else frozen_solve
+        sol = solve(
             q, q2, arr.A, arr.cl, arr.cu, arr.lb, arr.ub,
             state.x, state.z, state.y, state.yx, factors,
         )
@@ -246,10 +274,15 @@ def shard_batch(batch, mesh: Mesh, axis: str = "scen") -> PHArrays:
     def put(a, spec=shard):
         return jax.device_put(jnp.asarray(a), spec)
 
+    A_shared = getattr(batch, "A_shared", None)
+    if A_shared is not None:
+        A_dev = put(A_shared, NamedSharding(mesh, P()))  # replicated (m, n)
+    else:
+        A_dev = put(padded(batch.A))
     return PHArrays(
         c=put(padded(batch.c)),
         q2=put(padded(batch.q2)),
-        A=put(padded(batch.A)),
+        A=A_dev,
         cl=put(padded(batch.cl)),
         cu=put(padded(batch.cu)),
         lb=put(padded(batch.lb)),

@@ -1,0 +1,216 @@
+"""ctypes bindings for the C++ TCP window service — the MULTI-HOST fabric.
+
+Same Mailbox/WindowFabric API as the shm service
+(:mod:`tpusppy.runtime.window_service`), but over TCP so cylinders can live
+on different hosts, the way the reference's wheel spans nodes over MPI RMA
+(mpisppy/spin_the_wheel.py:219-237).  The hub process serves the boxes
+in-memory (its own accesses are local, mutex-guarded, no sockets); every
+spoke — local or remote — connects by ``host:port``.
+
+Multi-host launch recipe (see doc/multihost.md):
+  hub host:   fabric = TcpWindowFabric(spoke_lengths=[...], port=7077)
+              ... WheelSpinner hub side with this fabric ...
+  spoke host: fabric = TcpWindowFabric(connect=("hub-host", 7077))
+              ... build the spoke opt + comm, comm.main() ...
+``MultiprocessWheelSpinner(..., fabric="tcp")`` drives the same path with
+spawned local processes (the single-host degenerate case and the CI test).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "csrc",
+                    "tcp_window_service.cpp")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "csrc",
+                         "libtcp_window_service.so")
+_lib = None
+_lib_lock = threading.Lock()
+
+KILL_ID = -1
+_LEN_ERR = -2
+_IO_ERR = -4
+
+
+def load_library() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_LIB_PATH)
+                or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)):
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                 _SRC, "-o", _LIB_PATH],
+                check=True, capture_output=True,
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.tws_serve.restype = ctypes.c_void_p
+        lib.tws_serve.argtypes = [ctypes.c_int, ctypes.c_int,
+                                  ctypes.POINTER(ctypes.c_int64)]
+        lib.tws_connect.restype = ctypes.c_void_p
+        lib.tws_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                    ctypes.c_int64]
+        lib.tws_port.restype = ctypes.c_int
+        lib.tws_port.argtypes = [ctypes.c_void_p]
+        for fn, argt in [
+            ("tws_num_boxes", [ctypes.c_void_p]),
+            ("tws_length", [ctypes.c_void_p, ctypes.c_int]),
+            ("tws_write_id", [ctypes.c_void_p, ctypes.c_int]),
+            ("tws_kill", [ctypes.c_void_p, ctypes.c_int]),
+        ]:
+            getattr(lib, fn).restype = ctypes.c_int64
+            getattr(lib, fn).argtypes = argt
+        lib.tws_put.restype = ctypes.c_int64
+        lib.tws_put.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                ctypes.POINTER(ctypes.c_double),
+                                ctypes.c_int64]
+        lib.tws_get.restype = ctypes.c_int64
+        lib.tws_get.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                ctypes.POINTER(ctypes.c_double),
+                                ctypes.c_int64]
+        lib.tws_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class TcpEndpoint:
+    """A server (hub) or client (spoke) handle over the box set."""
+
+    def __init__(self, lengths=None, port: int = 0, connect=None,
+                 connect_timeout: float = 60.0):
+        self._lib = load_library()
+        if connect is not None:
+            host, prt = connect
+            handle = self._lib.tws_connect(
+                str(host).encode(), int(prt), int(connect_timeout * 1000))
+            if not handle:
+                raise RuntimeError(
+                    f"cannot connect to window service at {host}:{prt}")
+            self.port = int(prt)
+            self.is_server = False
+        else:
+            arr = (ctypes.c_int64 * len(lengths))(*[int(x) for x in lengths])
+            handle = self._lib.tws_serve(int(port), len(lengths), arr)
+            if not handle:
+                raise RuntimeError(f"cannot serve window service on :{port}")
+            self.is_server = True
+            self._handle = ctypes.c_void_p(handle)
+            self.port = int(self._lib.tws_port(self._handle))
+            return
+        self._handle = ctypes.c_void_p(handle)
+
+    @property
+    def num_boxes(self) -> int:
+        return self._check(self._lib.tws_num_boxes(self._handle))
+
+    def length(self, box: int) -> int:
+        return self._check(self._lib.tws_length(self._handle, box))
+
+    def _check(self, rc: int) -> int:
+        if rc == _IO_ERR:
+            raise RuntimeError("TCP window service connection lost")
+        return int(rc)
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.tws_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class TcpMailbox:
+    """Mailbox-API view over one box (put/get/kill/write_id, −1 sentinel)."""
+
+    KILL_ID = KILL_ID
+
+    def __init__(self, ep: TcpEndpoint, box: int, name: str = ""):
+        self.ep = ep
+        self.box = int(box)
+        self.name = name
+        self.length = ep.length(box)
+
+    def put(self, values) -> int:
+        values = np.ascontiguousarray(values, dtype=np.float64)
+        if values.shape != (self.length,):
+            raise RuntimeError(
+                f"TcpMailbox {self.name}: putting length {values.shape} "
+                f"into buffer of length {self.length}")
+        rc = self.ep._check(self.ep._lib.tws_put(
+            self.ep._handle, self.box,
+            values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            self.length))
+        if rc == _LEN_ERR:
+            raise RuntimeError("length mismatch in tws_put")
+        return rc
+
+    def get(self, timeout=None):
+        """(values, write_id) snapshot; always immediate (server-side boxes
+        are mutex-consistent — no seqlock wait states)."""
+        out = np.empty(self.length, dtype=np.float64)
+        wid = self.ep._check(self.ep._lib.tws_get(
+            self.ep._handle, self.box,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            self.length))
+        if wid == _LEN_ERR:
+            raise RuntimeError("length mismatch in tws_get")
+        return out, int(wid)
+
+    def kill(self):
+        self.ep._check(self.ep._lib.tws_kill(self.ep._handle, self.box))
+
+    @property
+    def write_id(self) -> int:
+        return self.ep._check(
+            self.ep._lib.tws_write_id(self.ep._handle, self.box))
+
+
+class TcpWindowFabric:
+    """WindowFabric API over TCP: 2 boxes per spoke (hub->spoke, spoke->hub).
+
+    Hub side: ``TcpWindowFabric(spoke_lengths=[(h2s, s2h), ...], port=0)``
+    (port 0 = kernel-assigned; read ``fabric.port``).  Spoke side (any
+    host): ``TcpWindowFabric(connect=(host, port))``.
+    """
+
+    def __init__(self, spoke_lengths=None, port: int = 0, connect=None,
+                 connect_timeout: float = 60.0):
+        if connect is not None:
+            self.ep = TcpEndpoint(connect=connect,
+                                  connect_timeout=connect_timeout)
+            n = self.ep.num_boxes // 2
+        else:
+            lengths = []
+            for (h2s, s2h) in spoke_lengths:
+                lengths.extend([h2s, s2h])
+            self.ep = TcpEndpoint(lengths=lengths, port=port)
+            n = len(spoke_lengths)
+        self.port = self.ep.port
+        self.to_spoke = {}
+        self.to_hub = {}
+        for i in range(1, n + 1):
+            self.to_spoke[i] = TcpMailbox(self.ep, 2 * (i - 1),
+                                          f"hub->spoke{i}")
+            self.to_hub[i] = TcpMailbox(self.ep, 2 * (i - 1) + 1,
+                                        f"spoke{i}->hub")
+
+    @property
+    def n_spokes(self) -> int:
+        return len(self.to_spoke)
+
+    def send_terminate(self):
+        for mb in self.to_spoke.values():
+            mb.kill()
+
+    def close(self):
+        self.ep.close()

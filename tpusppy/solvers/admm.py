@@ -775,6 +775,11 @@ def solve_batch_frozen(c, q2, A, cl, cu, lb, ub, factors: Factors,
                                   settings, P)
 
 
+def _Aty(A, y):
+    """A'y per scenario; A may be (S, m, n) or a shared (m, n)."""
+    return y @ A if A.ndim == 2 else jnp.einsum("smn,sm->sn", A, y)
+
+
 @jax.jit
 def dual_objective(c, q2, A, cl, cu, lb, ub, y, x_hint, margin_scale=100.0):
     """(S,) LOWER bounds on each scenario optimum from row duals ``y``.
@@ -822,7 +827,7 @@ def dual_objective_margin(c, q2, A, cl, cu, lb, ub, y, x_hint,
     fin_lb, fin_ub = lb > -BIG / 2, ub < BIG / 2
     y = jnp.where(~(cu < BIG / 2) & (y > 0), 0.0, y)
     y = jnp.where(~(cl > -BIG / 2) & (y < 0), 0.0, y)
-    g = c + jnp.einsum("smn,sm->sn", A, y)
+    g = c + _Aty(A, y)
     X = margin_scale * (1.0 + jnp.max(jnp.abs(x_hint), axis=1, keepdims=True))
     # linear coords: value at the capped side is g*(+-X); widening multiplies
     # the capped side by `widen`, decreasing the minimum by |g|*(widen-1)*X.
@@ -872,7 +877,7 @@ def dual_cut(c, q2, A, cl, cu, lb, ub, y, x_hint, clamp_mask,
     X = margin_scale * (1.0 + jnp.max(jnp.abs(x_hint), axis=1, keepdims=True))
     L = jnp.where(fin_lb, lb, -X)
     U = jnp.where(fin_ub, ub, X)
-    g = c + jnp.einsum("smn,sm->sn", A, y)
+    g = c + _Aty(A, y)
     quad = q2 > 1e-14
     xq = jnp.clip(jnp.where(quad, -g / jnp.where(quad, q2, 1.0), 0.0), L, U)
     val_quad = 0.5 * q2 * xq * xq + g * xq

@@ -1,0 +1,463 @@
+"""Shared-constraint-matrix ADMM: the memory-wall breaker for big families.
+
+Most stochastic-programming families at scale (the reference's headline
+1000-scenario UC above all — ``paperruns/larger_uc``, wind uncertainty enters
+the power-balance rhs) have scenarios that differ only in costs, rhs and
+bounds: the constraint matrix ``A`` is IDENTICAL across scenarios.  The dense
+batched solver (:mod:`tpusppy.solvers.admm`) stores (S, m, n) A plus an
+(S, n, n) KKT inverse — at reference UC scale (30 gens x 48 h, S=1000) that is
+~67 GB and cannot fit one chip's HBM.  Here:
+
+- ``A`` is stored ONCE as (m, n): memory drops S-fold (67 GB -> 67 MB);
+- Ruiz scaling, row penalties and the KKT matrix are shared, so there is ONE
+  (n, n) factorization instead of S of them;
+- the hot x-update becomes ``rhs @ Kinv`` — a single large (S, n) x (n, n)
+  MXU matmul, and the constraint matvecs are (S, m) x (m, n) matmuls: the
+  best-possible TPU shapes (large, static, batched on the leading axis).
+
+Per-scenario DIAGONAL deviations (PH rho vectors that differ across
+scenarios, per-scenario clamp boosting) are handled by iterative refinement:
+the shared ``K`` is the preconditioner, and the exact per-scenario system
+``K_s = K + diag(dq2_s)`` is applied matrix-free in the refinement residual.
+Row penalties and the scaling stay shared — scenarios in one family are
+near-identically conditioned, which is exactly why they form a family.
+
+No active-set polish on this path (a per-scenario (n+m)^2 KKT batch is the
+memory wall all over again): outer bounds stay certified through weak duality
+(:func:`tpusppy.solvers.admm.dual_objective` handles 2-D A), and LP-exact
+primal residue is delegated to the host straggler rescue
+(``spopt.SPOpt._rescue_stragglers``).
+
+Reference analogue: the per-rank persistent-solver loop (spopt.py:85-307);
+this module is its shape-shared fast path, dispatched automatically by
+``SPOpt.solve_loop`` when ``ScenarioBatch.A_shared`` is set.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .admm import (ADMMSettings, BatchSolution, BIG, _clean_bounds,
+                   _explicit_inverse)
+
+
+class SharedFactors(NamedTuple):
+    """Reusable solve state for the frozen path (shared-A analogue of
+    :class:`tpusppy.solvers.admm.Factors`)."""
+
+    D: jax.Array       # (n,) Ruiz column scaling (shared)
+    E: jax.Array       # (m,) Ruiz row scaling (shared)
+    cost: jax.Array    # scalar objective scaling (shared)
+    rho_a: jax.Array   # (m,) row penalties actually used last
+    rho_x: jax.Array   # (n,) variable-box penalties actually used last
+    gamma: jax.Array   # (S,) per-scenario penalty scales actually used last
+    Kinv: jax.Array    # (n, n) explicit inverse of the shared x-update system
+    K: jax.Array       # (n, n) exact shared K for refinement
+    q2ref: jax.Array   # (n,) scaled q2 the K was built with
+
+
+class _Masks(NamedTuple):
+    fin_cl: jax.Array  # (S, m)
+    fin_cu: jax.Array  # (S, m)
+    fin_lb: jax.Array  # (S, n)
+    fin_ub: jax.Array  # (S, n)
+    eq: jax.Array      # (m,) equality row in EVERY scenario (shared classes)
+    loose: jax.Array   # (m,) two-sided-infinite row in every scenario
+    eqx: jax.Array     # (n,) zero-width variable box in every scenario
+
+
+def _ruiz_shared(A, q2ref, iters):
+    """Ruiz equilibration of the single shared A; returns (D (n,), E (m,))."""
+    m, n = A.shape
+    D = jnp.ones((n,), A.dtype)
+    E = jnp.ones((m,), A.dtype)
+
+    def body(_, DE):
+        D, E = DE
+        As = A * E[:, None] * D[None, :]
+        Ps = q2ref * D * D
+        col = jnp.maximum(jnp.max(jnp.abs(As), axis=0), jnp.abs(Ps))
+        row = jnp.max(jnp.abs(As), axis=1)
+        col = jnp.where(col < 1e-12, 1.0, col)
+        row = jnp.where(row < 1e-12, 1.0, row)
+        return D / jnp.sqrt(col), E / jnp.sqrt(row)
+
+    D, E = jax.lax.fori_loop(0, iters, body, (D, E))
+    return D, E
+
+
+def _factor_shared(q2ref, A, rho_a, rho_x, sigma):
+    """(Kinv, K) of the SHARED K = diag(q2ref + rho_x) + sigma I + A'RA —
+    one (n, n) system for the whole scenario batch."""
+    n = A.shape[1]
+    K = jnp.einsum("mn,m,mk->nk", A, rho_a, A)
+    K = K + jnp.eye(n, dtype=A.dtype) * sigma
+    K = K + jnp.diag(q2ref + rho_x)
+    return _explicit_inverse(K[None])[0], K
+
+
+def _solve_shared_K(Kinv, K, dq2, gamma, b, refine, extra_if_dq2=2):
+    """x s.t. (gamma_s K + diag(dq2_s)) x_s = b_s per scenario, via the shared
+    inverse + matrix-free refinement against the exact per-scenario system.
+
+    ``gamma`` (S, 1) is the per-scenario penalty scale: rho_a, rho_x and
+    sigma are all free ADMM parameters, so scaling the WHOLE penalty profile
+    by a per-scenario scalar keeps the x-update system an exact multiple of
+    the shared K (plus the diagonal objective deviation dq2) — per-scenario
+    rho adaptation without per-scenario factorizations.  The refinement
+    iteration matrix has spectral radius max_j dq2_j / (gamma K_jj) — the
+    adaptation clamps gamma so this stays < 1 (see the QP clamp in the
+    restart loop); ``extra_if_dq2`` adds passes only when a nonzero dq2 is
+    actually present (LP batches skip them at runtime via lax.cond)."""
+    def steps(x, k):
+        for _ in range(k):
+            r = b - (gamma * (x @ K) + dq2 * x)
+            x = x + (r / gamma) @ Kinv
+        return x
+
+    x = steps((b / gamma) @ Kinv, refine)
+    if extra_if_dq2 > 0:
+        x = jax.lax.cond(jnp.any(dq2 != 0),
+                         lambda v: steps(v, extra_if_dq2), lambda v: v, x)
+    return x
+
+
+class _IterState(NamedTuple):
+    x: jax.Array
+    z: jax.Array
+    zx: jax.Array
+    y: jax.Array
+    yx: jax.Array
+    gamma: jax.Array   # (S,) per-scenario penalty scale — adapts IN-loop
+    pri: jax.Array
+    dua: jax.Array
+    prinorm: jax.Array
+    duanorm: jax.Array
+    k: jax.Array
+
+
+def _core(q, q2s, q2ref, A, cl, cu, lb, ub, state, Kinv, K, rho_a, rho_x,
+          glo, ghi, st: ADMMSettings):
+    """Inner ADMM sweep at a fixed shared rho profile with IN-LOOP
+    per-scenario gamma adaptation.
+
+    Scaling the whole penalty profile (rho_a, rho_x, sigma) by gamma_s keeps
+    the x-update system an exact multiple of the shared K — so adapting
+    gamma needs NO refactorization and runs every residual checkpoint
+    (OSQP's adaptive rho at zero factorization cost).  Restarts are only
+    needed to move the SHARED profile (base rho, row boosts).  All matvecs
+    are (S, m) @ (m, n) or (S, n) @ (n, n) matmuls against shared matrices.
+    ``glo``/``ghi`` bound gamma: wide for LP batches (dq2 = 0, exact at any
+    gamma), clamped near 1 for QP (keeps the dq2 refinement contractive).
+    """
+    alpha = st.alpha
+    AT = A.T
+
+    def block(x, z, zx, y, yx, Ax, gamma):
+        g = gamma[:, None]
+        sigma_s = g * st.sigma           # (S, 1): scaled prox parameter
+        rho_a_s = g * rho_a[None, :]     # (S, m)
+        rho_x_s = g * rho_x[None, :]     # (S, n)
+        dq2 = q2s - g * q2ref[None, :]
+
+        for _ in range(max(1, st.check_every)):
+            rhs = (sigma_s * x - q + (rho_a_s * z - y) @ A
+                   + (rho_x_s * zx - yx))
+            xt = _solve_shared_K(Kinv, K, dq2, g, rhs, st.solve_refine)
+            Axt = xt @ AT
+            x_new = alpha * xt + (1 - alpha) * x
+            Ax_new = alpha * Axt + (1 - alpha) * Ax
+
+            za_arg = alpha * Axt + (1 - alpha) * z + y / rho_a_s
+            z_new = jnp.clip(za_arg, cl, cu)
+            y_new = y + rho_a_s * (alpha * Axt + (1 - alpha) * z - z_new)
+
+            zx_arg = alpha * xt + (1 - alpha) * zx + yx / rho_x_s
+            zx_new = jnp.clip(zx_arg, lb, ub)
+            yx_new = yx + rho_x_s * (alpha * xt + (1 - alpha) * zx - zx_new)
+            x, z, zx, y, yx, Ax = x_new, z_new, zx_new, y_new, yx_new, Ax_new
+        return x, z, zx, y, yx, Ax
+
+    def residuals(x, z, zx, y, yx, Ax):
+        pri = jnp.maximum(
+            jnp.max(jnp.abs(Ax - z), axis=1),
+            jnp.max(jnp.abs(x - zx), axis=1),
+        )
+        Aty = y @ A
+        Pxv = q2s * x
+        dua = jnp.max(jnp.abs(Pxv + q + Aty + yx), axis=1)
+        prinorm = jnp.maximum(
+            jnp.max(jnp.abs(Ax), axis=1), jnp.max(jnp.abs(z), axis=1))
+        duanorm = jnp.maximum(
+            jnp.maximum(jnp.max(jnp.abs(Pxv), axis=1),
+                        jnp.max(jnp.abs(Aty), axis=1)),
+            jnp.max(jnp.abs(q), axis=1))
+        return pri, dua, prinorm, duanorm
+
+    def cont(carry):
+        s, _ = carry
+        eps_pri = st.eps_abs + st.eps_rel * jnp.maximum(s.prinorm, 1.0)
+        eps_dua = st.eps_abs + st.eps_rel * jnp.maximum(s.duanorm, 1.0)
+        done = (s.pri < eps_pri) & (s.dua < eps_dua)
+        return (s.k < st.max_iter) & ~jnp.all(done)
+
+    def multi_step(carry):
+        s, Ax = carry
+        x, z, zx, y, yx, Ax = block(s.x, s.z, s.zx, s.y, s.yx, Ax, s.gamma)
+        Ax = x @ AT    # re-anchor carried Ax (see admm._admm_core)
+        pri, dua, prinorm, duanorm = residuals(x, z, zx, y, yx, Ax)
+        # OSQP-style per-scenario adaptation on normalized residual ratios.
+        # Cadence matters: adapting every checkpoint thrashes (early ratios
+        # are always imbalanced and rho oscillates); every ~128 sweeps
+        # matches the restart cadence that converges, at zero
+        # refactorization cost.
+        eps_pri = st.eps_abs + st.eps_rel * jnp.maximum(prinorm, 1.0)
+        eps_dua = st.eps_abs + st.eps_rel * jnp.maximum(duanorm, 1.0)
+        done = (pri < eps_pri) & (dua < eps_dua)
+        pri_rel = pri / jnp.maximum(prinorm, 1e-10)
+        dua_rel = dua / jnp.maximum(duanorm, 1e-10)
+        ratio = jnp.sqrt(
+            jnp.maximum(pri_rel, 1e-12) / jnp.maximum(dua_rel, 1e-12))
+        ck = max(1, st.check_every)
+        period = max(1, 128 // ck)
+        k_next = s.k + ck
+        due = (k_next // ck) % period == 0
+        move = due & ((ratio > 5.0) | (ratio < 0.2))
+        gnew = jnp.clip(s.gamma * jnp.clip(ratio, 0.1, 10.0), glo, ghi)
+        gamma = jnp.where(done | ~move, s.gamma, gnew)
+        return (_IterState(x, z, zx, y, yx, gamma, pri, dua, prinorm,
+                           duanorm, s.k + max(1, st.check_every)), Ax)
+
+    Ax0 = state.x @ AT
+    state, _ = jax.lax.while_loop(cont, multi_step, (state, Ax0))
+    return state
+
+
+def _prep_shared(c, q2, A, cl, cu, lb, ub, settings):
+    dt = settings.jdtype()
+    c, q2, A = jnp.asarray(c, dt), jnp.asarray(q2, dt), jnp.asarray(A, dt)
+    cl, cu = _clean_bounds(jnp.asarray(cl, dt), jnp.asarray(cu, dt))
+    lb, ub = _clean_bounds(jnp.asarray(lb, dt), jnp.asarray(ub, dt))
+    masks = _Masks(
+        fin_cl=cl > -BIG / 2, fin_cu=cu < BIG / 2,
+        fin_lb=lb > -BIG / 2, fin_ub=ub < BIG / 2,
+        # shared row/column penalty classes: a row is boosted only when it is
+        # an equality in EVERY scenario (families share structure, so in
+        # practice these are uniform; a non-uniform row just loses the boost,
+        # never correctness)
+        eq=jnp.all(jnp.abs(cu - cl) < 1e-10, axis=0),
+        loose=jnp.all((cl <= -BIG / 2) & (cu >= BIG / 2), axis=0),
+        eqx=jnp.all(jnp.abs(ub - lb) < 1e-10, axis=0),
+    )
+    return c, q2, A, cl, cu, lb, ub, masks
+
+
+def _scale_shared(c, q2, A, cl, cu, lb, ub, D, E, cost, warm, dt):
+    As = A * E[:, None] * D[None, :]
+    q2s = q2 * (D * D)[None, :] * cost
+    qs = c * D[None, :] * cost
+    cls, cus = cl * E[None, :], cu * E[None, :]
+    lbs, ubs = lb / D[None, :], ub / D[None, :]
+    if warm is not None:
+        x0, z0, y0, yx0 = warm
+        warm = (
+            jnp.asarray(x0, dt) / D[None, :],
+            jnp.asarray(z0, dt) * E[None, :],
+            jnp.asarray(y0, dt) / E[None, :] * cost,
+            jnp.asarray(yx0, dt) * D[None, :] * cost,
+        )
+    return qs, q2s, As, cls, cus, lbs, ubs, warm
+
+
+def _solve_shared_impl(c, q2, A, cl, cu, lb, ub, settings, warm,
+                       want_factors=False):
+    dt = settings.jdtype()
+    c, q2, A, cl, cu, lb, ub, masks = _prep_shared(
+        c, q2, A, cl, cu, lb, ub, settings)
+    S, n = c.shape
+    m = A.shape[0]
+
+    q2ref_raw = jnp.mean(q2, axis=0)
+    D, E = _ruiz_shared(A, q2ref_raw, settings.scaling_iters)
+    # shared scalar objective scaling (median scenario magnitude): scenarios
+    # in a family have comparable cost scales, and a shared scalar keeps the
+    # scaled q2 — hence the K — shared
+    cost = 1.0 / jnp.maximum(
+        jnp.median(jnp.max(jnp.abs(c * D[None, :]), axis=1)), 1e-8)
+    qs, q2s, As, cls, cus, lbs, ubs, warm = _scale_shared(
+        c, q2, A, cl, cu, lb, ub, D, E, cost, warm, dt)
+    q2ref = jnp.mean(q2s, axis=0)
+
+    st = settings
+    eq, loose, eqx = masks.eq, masks.loose, masks.eqx
+
+    def rho_vec(base):
+        r = jnp.where(eq, base * st.rho_eq_scale, base)
+        return jnp.where(loose, st.rho_min, r)
+
+    def rho_x_vec(base):
+        return jnp.where(eqx, base * st.rho_eq_scale,
+                         jnp.full((n,), base, dt))
+
+    if warm is None:
+        x0 = jnp.zeros((S, n), dt)
+        z0 = jnp.clip(jnp.zeros((S, m), dt), cls, cus)
+        y0 = jnp.zeros((S, m), dt)
+        yx0 = jnp.zeros((S, n), dt)
+    else:
+        x0, z0, y0, yx0 = warm
+    zx0 = jnp.clip(x0, lbs, ubs)
+    inf = jnp.full((S,), jnp.inf, dt)
+    one = jnp.ones((S,), dt)
+    state0 = _IterState(x0, z0, zx0, y0, yx0, jnp.ones((S,), dt),
+                        inf, inf, one, one, jnp.zeros((), jnp.int32))
+
+    # Per-scenario gamma runs FREE for (near-)LP batches: dq2 = 0 there, so
+    # the shared inverse solves every scenario's x-update exactly at any
+    # gamma.  Significant q2 (PH prox solves) clamps gamma near 1 to keep
+    # the dq2 = q2(1-gamma) refinement contractive (radius <= |1-gamma|/
+    # gamma) — prox solves are strongly convex and need little adaptation.
+    lp_like = jnp.max(jnp.abs(q2s)) < 1e-12
+    glo = jnp.where(lp_like, 1e-4, 0.6)
+    ghi = jnp.where(lp_like, 1e4, 1.8)
+
+    def restart(carry, _):
+        state, base, total, mult, multx = carry[:5]
+        rho_a = rho_vec(base)
+        rho_x = rho_x_vec(base)
+        if st.rho_row_adapt:
+            rho_a = jnp.minimum(rho_a * mult, st.rho_row_max)
+            rho_x = jnp.minimum(rho_x * multx, st.rho_row_max)
+        Kinv, K = _factor_shared(q2ref, As, rho_a, rho_x, st.sigma)
+        state = _core(qs, q2s, q2ref, As, cls, cus, lbs, ubs,
+                      state._replace(k=jnp.zeros((), jnp.int32)),
+                      Kinv, K, rho_a, rho_x, glo, ghi, st)
+        total = total + state.k
+        eps_pri = st.eps_abs + st.eps_rel * jnp.maximum(state.prinorm, 1.0)
+        eps_dua = st.eps_abs + st.eps_rel * jnp.maximum(state.duanorm, 1.0)
+        done = (state.pri < eps_pri) & (state.dua < eps_dua)
+        pri_rel = state.pri / jnp.maximum(state.prinorm, 1e-10)
+        dua_rel = state.dua / jnp.maximum(state.duanorm, 1e-10)
+        ratio = jnp.sqrt(
+            jnp.maximum(pri_rel, 1e-12) / jnp.maximum(dua_rel, 1e-12))
+        # shared base: adapt on the geometric-mean ratio of UNCONVERGED
+        # scenarios (converged ones would anchor the ratio at its stale
+        # value); per-scenario adaptation lives in-loop via gamma
+        logr = jnp.where(done, 0.0, jnp.log(jnp.clip(ratio, 0.1, 10.0)))
+        denom = jnp.maximum(jnp.sum(~done), 1)
+        gmean = jnp.exp(jnp.sum(logr) / denom)
+        base = jnp.where(jnp.all(done), base,
+                         jnp.clip(base * gmean, st.rho_min, st.rho_max))
+        if st.rho_row_adapt:
+            stuck = (state.pri > 100.0 * eps_pri)[:, None]
+            gate = jnp.maximum(0.3 * state.pri, 10.0 * eps_pri)[:, None]
+            Ax = state.x @ As.T
+            viol = jnp.maximum(cls - Ax, Ax - cus)
+            hit = jnp.any(stuck & (viol > gate), axis=0)       # max over S
+            mult = jnp.where(hit, mult * st.rho_row_boost, mult)
+            violx = jnp.maximum(lbs - state.x, state.x - ubs)
+            hitx = jnp.any(stuck & (violx > gate), axis=0)
+            multx = jnp.where(hitx, multx * st.rho_row_boost, multx)
+        return (state, base, total, mult, multx,
+                rho_a, rho_x, Kinv, K), None
+
+    zK = jnp.zeros((n, n), dt)
+    carry0 = (state0, jnp.asarray(st.rho, dt), jnp.zeros((), jnp.int32),
+              jnp.ones((m,), dt), jnp.ones((n,), dt),
+              jnp.zeros((m,), dt), jnp.zeros((n,), dt), zK, zK)
+    (state, _, total, _, _, rho_a, rho_x, Kinv, K), _ = jax.lax.scan(
+        restart, carry0, None, length=st.restarts)
+    gamma = state.gamma
+
+    def unscale(s):
+        return (s.x * D[None, :], s.z / E[None, :],
+                s.y * E[None, :] / cost, s.yx / D[None, :] / cost)
+
+    x, z, y, yx = unscale(state)
+    sol = BatchSolution(
+        x=x, z=z, y=y, yx=yx,
+        pri_res=state.pri, dua_res=state.dua,
+        iters=jnp.broadcast_to(total, (S,)),
+        raw=(x, z, y, yx),
+    )
+    if want_factors:
+        return sol, SharedFactors(D=D, E=E, cost=cost, rho_a=rho_a,
+                                  rho_x=rho_x, gamma=gamma, Kinv=Kinv, K=K,
+                                  q2ref=q2ref)
+    return sol
+
+
+def _solve_shared_frozen_impl(c, q2, A, cl, cu, lb, ub,
+                              factors: SharedFactors, warm, settings):
+    """Sweep-only shared solve reusing a refresh's :class:`SharedFactors`.
+    Valid while (A, bounds structure) are unchanged; per-scenario q2 drift is
+    absorbed by the refinement against K + diag(dq2)."""
+    dt = settings.jdtype()
+    c, q2, A, cl, cu, lb, ub, _ = _prep_shared(
+        c, q2, A, cl, cu, lb, ub, settings)
+    D, E, cost = factors.D, factors.E, factors.cost
+    qs, q2s, As, cls, cus, lbs, ubs, warm = _scale_shared(
+        c, q2, A, cl, cu, lb, ub, D, E, cost, warm, dt)
+    S, n = c.shape
+    m = A.shape[0]
+    if warm is None:
+        x0 = jnp.zeros((S, n), dt)
+        z0 = jnp.clip(jnp.zeros((S, m), dt), cls, cus)
+        y0 = jnp.zeros((S, m), dt)
+        yx0 = jnp.zeros((S, n), dt)
+    else:
+        x0, z0, y0, yx0 = warm
+    zx0 = jnp.clip(x0, lbs, ubs)
+    inf = jnp.full((S,), jnp.inf, dt)
+    one = jnp.ones((S,), dt)
+    state0 = _IterState(x0, z0, zx0, y0, yx0, factors.gamma,
+                        inf, inf, one, one, jnp.zeros((), jnp.int32))
+
+    lp_like = jnp.max(jnp.abs(q2s)) < 1e-12
+    glo = jnp.where(lp_like, 1e-4, 0.6)
+    ghi = jnp.where(lp_like, 1e4, 1.8)
+    state = _core(qs, q2s, factors.q2ref, As, cls, cus, lbs, ubs, state0,
+                  factors.Kinv, factors.K, factors.rho_a, factors.rho_x,
+                  glo, ghi, settings)
+    x, z, y, yx = (state.x * D[None, :], state.z / E[None, :],
+                   state.y * E[None, :] / cost,
+                   state.yx / D[None, :] / cost)
+    return BatchSolution(
+        x=x, z=z, y=y, yx=yx,
+        pri_res=state.pri, dua_res=state.dua,
+        iters=jnp.broadcast_to(state.k, (S,)),
+        raw=(x, z, y, yx),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("settings",))
+def solve_shared(c, q2, A, cl, cu, lb, ub,
+                 settings: ADMMSettings = ADMMSettings(),
+                 warm=None) -> BatchSolution:
+    """Solve a shared-A batch: A is (m, n); everything else (S, ...)."""
+    with jax.default_matmul_precision("highest"):
+        return _solve_shared_impl(c, q2, A, cl, cu, lb, ub, settings, warm)
+
+
+@functools.partial(jax.jit, static_argnames=("settings",))
+def solve_shared_factored(c, q2, A, cl, cu, lb, ub,
+                          settings: ADMMSettings = ADMMSettings(),
+                          warm=None):
+    """Adaptive shared-A solve that also returns :class:`SharedFactors`."""
+    with jax.default_matmul_precision("highest"):
+        return _solve_shared_impl(c, q2, A, cl, cu, lb, ub, settings, warm,
+                                  want_factors=True)
+
+
+@functools.partial(jax.jit, static_argnames=("settings",))
+def solve_shared_frozen(c, q2, A, cl, cu, lb, ub, factors: SharedFactors,
+                        settings: ADMMSettings = ADMMSettings(),
+                        warm=None) -> BatchSolution:
+    """Jitted frozen-factor shared-A solve."""
+    with jax.default_matmul_precision("highest"):
+        return _solve_shared_frozen_impl(c, q2, A, cl, cu, lb, ub, factors,
+                                         warm, settings)

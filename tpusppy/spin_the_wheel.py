@@ -209,20 +209,31 @@ def _ready_path(fabric_name, strata_rank):
     return os.path.join(tempfile.gettempdir(), f"{tag}.{strata_rank}.ready")
 
 
-def _spoke_worker(fabric_name, spoke_dict, strata_rank):
-    """Child-process entry: attach the shm fabric, build this cylinder's opt,
-    run its main loop (the per-rank role dispatch of spin_the_wheel.py:92-127,
-    as an OS process instead of an MPI rank).  A sentinel file marks
-    construction-readiness for the parent's first-contact barrier (waiting
-    for a bound Put instead would deadlock: xhat-style spokes publish only
-    AFTER receiving hub data)."""
-    from .runtime.window_service import ShmWindowFabric
+def _spoke_worker(fabric_spec, spoke_dict, strata_rank):
+    """Child-process entry: attach the window fabric, build this cylinder's
+    opt, run its main loop (the per-rank role dispatch of
+    spin_the_wheel.py:92-127, as an OS process instead of an MPI rank).
+    ``fabric_spec`` is ("shm", name) or ("tcp", host, port, tag) — the
+    latter is exactly what a REMOTE host's spoke launcher passes
+    (doc/multihost.md; ``tag`` names the readiness sentinel file).
+    A sentinel file marks construction-readiness for the parent's
+    first-contact barrier (waiting for a bound Put instead would deadlock:
+    xhat-style spokes publish only AFTER receiving hub data)."""
+    kind = fabric_spec[0]
+    if kind == "shm":
+        from .runtime.window_service import ShmWindowFabric
 
-    fabric = ShmWindowFabric(fabric_name, attach=True)
+        tag = fabric_spec[1]
+        fabric = ShmWindowFabric(tag, attach=True)
+    else:
+        from .runtime.tcp_window_service import TcpWindowFabric
+
+        _, host, port, tag = fabric_spec
+        fabric = TcpWindowFabric(connect=(host, port))
     opt = spoke_dict["opt_class"](**spoke_dict["opt_kwargs"])
     comm = spoke_dict["spoke_class"](
         opt, strata_rank, fabric, **spoke_dict.get("spoke_kwargs", {}))
-    with open(_ready_path(fabric_name, strata_rank), "w") as f:
+    with open(_ready_path(tag, strata_rank), "w") as f:
         f.write("ready")
     try:
         comm.main()
@@ -236,20 +247,27 @@ class MultiprocessWheelSpinner(WheelSpinner):
 
     The reference gives each cylinder its own process group and exchanges
     one-sided RMA windows (spin_the_wheel.py:219-237, spcommunicator.py:
-    93-120); here each cylinder is an OS process and the windows are seqlock
-    shm mailboxes (runtime/csrc/window_service.cpp) with identical write-id /
-    kill-sentinel semantics.  Intended for CPU cylinders or multi-host
-    deployments where each process owns its own device slice; on the shared
-    single-TPU dev box, the in-process (threaded) WheelSpinner remains the
-    default.
+    93-120); here each cylinder is an OS process and the windows are either
+    seqlock shm mailboxes (runtime/csrc/window_service.cpp, single host) or
+    the TCP box server (runtime/csrc/tcp_window_service.cpp, any host) with
+    identical write-id / kill-sentinel semantics — pick with
+    ``fabric="shm"|"tcp"``.  Spokes on OTHER hosts join a "tcp" wheel by
+    connecting to ``(hub_host, fabric.port)`` — see doc/multihost.md.
+    Intended for CPU cylinders or multi-host deployments where each process
+    owns its own device slice; on the shared single-TPU dev box, the
+    in-process (threaded) WheelSpinner remains the default.
     """
+
+    def __init__(self, hub_dict, list_of_spoke_dict, fabric: str = "shm"):
+        super().__init__(hub_dict, list_of_spoke_dict)
+        if fabric not in ("shm", "tcp"):
+            raise ValueError(f"fabric must be 'shm' or 'tcp', got {fabric!r}")
+        self.fabric_kind = fabric
 
     def run(self):
         import multiprocessing as mp
         import os
         import uuid
-
-        from .runtime.window_service import ShmWindowFabric
 
         hub = self.hub_dict
         hub_opt = hub["opt_class"](**hub["opt_kwargs"])
@@ -265,8 +283,17 @@ class MultiprocessWheelSpinner(WheelSpinner):
             lengths.append((h2s, s2h))
         hub_opt.spcomm = None
 
-        name = f"/tpusppy_wheel_{os.getpid()}_{uuid.uuid4().hex[:8]}"
-        fabric = ShmWindowFabric(name, spoke_lengths=lengths)
+        tag = f"/tpusppy_wheel_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+        if self.fabric_kind == "shm":
+            from .runtime.window_service import ShmWindowFabric
+
+            fabric = ShmWindowFabric(tag, spoke_lengths=lengths)
+            spec = ("shm", tag)
+        else:
+            from .runtime.tcp_window_service import TcpWindowFabric
+
+            fabric = TcpWindowFabric(spoke_lengths=lengths)
+            spec = ("tcp", "127.0.0.1", fabric.port, tag)
 
         ctx = mp.get_context("spawn")
         procs = []
@@ -276,7 +303,7 @@ class MultiprocessWheelSpinner(WheelSpinner):
         try:
             for i, sd in enumerate(self.list_of_spoke_dict):
                 p = ctx.Process(
-                    target=_spoke_worker, args=(name, sd, i + 1),
+                    target=_spoke_worker, args=(spec, sd, i + 1),
                     name=sd["spoke_class"].__name__, daemon=True,
                 )
                 p.start()
@@ -300,7 +327,7 @@ class MultiprocessWheelSpinner(WheelSpinner):
 
         wait = float(self.hub_dict.get("first_contact_wait", 900.0))
         t0 = _time.time()
-        ready = [_ready_path(name, i + 1)
+        ready = [_ready_path(tag, i + 1)
                  for i in range(len(self.list_of_spoke_dict))]
         while _time.time() - t0 < wait:
             if all(os.path.exists(rp) for rp in ready):

@@ -48,6 +48,29 @@ def _np_dual_cut(q, A, cl, cu, lb, ub, y, x_hint, clamp_mask,
     return base, g
 
 
+def batch_solve_dispatch(b, q, q2, cl, cu, lb, ub, settings, warm=None,
+                         rows=None, tile=1):
+    """One-shot batched solve honoring shared-A.
+
+    Callers pass their (possibly row-sliced / replica-tiled) objective and
+    bound arrays; the constraint matrix is taken from the batch: the single
+    (m, n) ``A_shared`` when present (NEVER materializing the (S, m, n)
+    broadcast view — that is the memory wall shared-A exists to break),
+    else the dense per-scenario tensor sliced by ``rows`` / repeated
+    ``tile`` times to match the leading axis.
+    """
+    from .solvers import shared_admm
+
+    if getattr(b, "A_shared", None) is not None:
+        return shared_admm.solve_shared(q, q2, b.A_shared, cl, cu, lb, ub,
+                                        settings=settings, warm=warm)
+    A = b.A if rows is None else b.A[rows]
+    if tile > 1:
+        A = np.repeat(A, tile, axis=0)
+    return admm.solve_batch(q, q2, A, cl, cu, lb, ub, settings=settings,
+                            warm=warm)
+
+
 def _pick_dual_sign(q, A, cl, cu, lb, ub, duals, x, obj):
     """scipy's marginal sign convention is opposite ours and varies by
     constraint shape; rather than trust it, pick the sign whose dual
@@ -114,7 +137,10 @@ class SPOpt(SPBase):
         key = (getattr(b, "version", 0), str(dt))
         cached = getattr(self, "_dev_consts", None)
         if cached is None or cached[0] != key:
-            cached = (key, (jnp.asarray(b.A, dt), jnp.asarray(b.cl, dt),
+            # shared-A batches upload the single (m, n) matrix, not the
+            # (S, m, n) broadcast view (which would materialize S copies)
+            A_src = b.A if getattr(b, "A_shared", None) is None else b.A_shared
+            cached = (key, (jnp.asarray(A_src, dt), jnp.asarray(b.cl, dt),
                             jnp.asarray(b.cu, dt)))
             self._dev_consts = cached
         return cached[1]
@@ -168,10 +194,13 @@ class SPOpt(SPBase):
                 ext.post_solve()
             return x
 
+        shared = getattr(b, "A_shared", None) is not None
+        A_arg = b.A_shared if shared else b.A
         slot = {"warm": self._warm, "factors": self._factors,
                 "sig": self._factors_sig, "age": self._factors_age}
         sol = self._solve_amortized(
-            (q, q2, b.A, b.cl, b.cu, lb, ub), slot, warm, None)
+            (q, q2, A_arg, b.cl, b.cu, lb, ub), slot, warm, None,
+            shared=shared)
         self._warm = slot["warm"]
         self._factors = slot["factors"]
         self._factors_sig = slot["sig"]
@@ -183,15 +212,24 @@ class SPOpt(SPBase):
             ext.post_solve()
         return self.local_x
 
-    def _solve_amortized(self, args, slot: dict, warm: bool, rescue_batch):
+    def _solve_amortized(self, args, slot: dict, warm: bool, rescue_batch,
+                         shared: bool = False):
         """The factorization-amortization protocol shared by the homogeneous
         and bucketed paths: frozen attempt under a validity signature with a
         sweep-budget fallback, else an adaptive factored solve + straggler
         rescue.  ``slot`` carries warm/factors/sig/age state; ``args`` is
-        the (q, q2, A, cl, cu, lb, ub) tuple.  Polished states warm-start
+        the (q, q2, A, cl, cu, lb, ub) tuple (A is (m, n) when ``shared``,
+        dispatching to the shared-A engine).  Polished states warm-start
         the NEXT objective's solve well (the PH persistent-solver pattern);
         raw iterates matter only when re-solving the SAME problem repeatedly
         (e.g. the Benders root)."""
+        if shared:
+            from .solvers import shared_admm
+            frozen_fn = shared_admm.solve_shared_frozen
+            factored_fn = shared_admm.solve_shared_factored
+        else:
+            frozen_fn = admm.solve_batch_frozen
+            factored_fn = admm.solve_batch_factored
         refresh_every = int(self.options.get("solver_refresh_every", 16) or 0)
         sig = (self._solve_sig(args[1], args[5], args[6])
                if refresh_every > 1 else None)
@@ -200,7 +238,7 @@ class SPOpt(SPBase):
                 and slot.get("factors") is not None
                 and slot.get("sig") == sig
                 and slot.get("age", 0) < refresh_every):
-            cand = admm.solve_batch_frozen(
+            cand = frozen_fn(
                 *args, slot["factors"], settings=self.admm_settings,
                 warm=slot["warm"])
             # iters >= max_iter means the sweep budget ran out somewhere:
@@ -209,7 +247,7 @@ class SPOpt(SPBase):
                 sol = cand
                 slot["age"] = slot.get("age", 0) + 1
         if sol is None:
-            sol, factors = admm.solve_batch_factored(
+            sol, factors = factored_fn(
                 *args, settings=self.admm_settings,
                 warm=slot.get("warm") if warm else None)
             slot["factors"] = factors

@@ -84,7 +84,7 @@ class Xhat_Eval(SPOpt):
         """
         import numpy as np
 
-        from .solvers import admm
+        from .spopt import batch_solve_dispatch
 
         b = self.batch
         ints = b.is_int
@@ -93,8 +93,8 @@ class Xhat_Eval(SPOpt):
         ub = np.array(ub, copy=True)
         x = None
         for _ in range(rounds):
-            sol = admm.solve_batch(b.c, b.q2, b.A, b.cl, b.cu, lb, ub,
-                                   settings=self.admm_settings)
+            sol = batch_solve_dispatch(b, b.c, b.q2, b.cl, b.cu, lb, ub,
+                                       settings=self.admm_settings)
             x = np.asarray(sol.x)
             self.local_x = x
             self.pri_res = np.asarray(sol.pri_res)
@@ -121,7 +121,7 @@ class Xhat_Eval(SPOpt):
         """
         import numpy as np
 
-        from .solvers import admm
+        from .spopt import batch_solve_dispatch
 
         b = self.batch
         cap = max(1, int(self.options.get("xhat_dive_retry_batch", 512)))
@@ -140,13 +140,14 @@ class Xhat_Eval(SPOpt):
         for c0 in range(0, bad.size, chunk):
             sel = bad[c0:c0 + chunk]
             tile = lambda a: np.repeat(a[sel], R, axis=0)
-            c_t, q2_t, A_t = tile(b.c), tile(b.q2), tile(b.A)
+            c_t, q2_t = tile(b.c), tile(b.q2)
             cl_t, cu_t = tile(b.cl), tile(b.cu)
             lb_t, ub_t = tile(lb0), tile(ub0)
             x = None
             for _ in range(rounds):
-                sol = admm.solve_batch(c_t, q2_t, A_t, cl_t, cu_t, lb_t,
-                                       ub_t, settings=self.admm_settings)
+                sol = batch_solve_dispatch(
+                    b, c_t, q2_t, cl_t, cu_t, lb_t, ub_t,
+                    settings=self.admm_settings, rows=sel, tile=R)
                 x = np.asarray(sol.x)
                 nxt = self._dive_round(x, ints, lb_t, ub_t,
                                        lambda B: rng.rand(B) < 0.5)
