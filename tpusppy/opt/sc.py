@@ -1,22 +1,21 @@
-"""SchurComplement: distributed interior-point entry point (continuous SPs).
+"""SchurComplement: batched Schur-complement interior point (continuous SPs).
 
-API analogue of ``mpisppy/opt/sc.py:59-106``.  The reference is a thin
-wrapper over parapint's MPI Schur-complement interior point with MA27 linear
-algebra (sc.py:4,95-97) — all the numerics live in external native code.  On
-TPU the same block-arrowhead KKT structure is what the batched ADMM already
-exploits: scenario blocks factor independently (the batched Cholesky) and the
-coupling (Schur) system is the nonant consensus, handled by the node-grouped
-reductions.  So this class keeps the reference's constructor/solve surface
-and solves the continuous extensive form through the merged-column EF +
-batched first-order path, refusing integer problems exactly as the reference
-does (sc.py:18-21).
+Analogue of ``mpisppy/opt/sc.py:59-106``.  The reference wraps parapint's
+MPI block-structured interior point with MA27 linear algebra (sc.py:4,
+95-97): each rank factors its scenario's KKT block and a dense Schur system
+couples the first-stage variables.  Here the numerics are NATIVE to the
+batch (:mod:`tpusppy.solvers.ipm`): every IP iteration condenses all
+scenario KKT systems in one batched (S, n, n) factorization on the MXU, and
+the nonant coupling is one small dense Schur solve — same algorithmic
+structure, no external solver.  Continuous problems only, refused exactly as
+the reference does (sc.py:18-21).
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-from ..ef import build_ef, solve_ef
+from ..solvers import ipm
 from ..spbase import SPBase
 
 
@@ -34,8 +33,14 @@ class SchurComplement(SPBase):
 
     def solve(self):
         """Solve the continuous SP; returns the objective (sc.py:89-106)."""
-        obj, x = solve_ef(self.batch, solver="admm")
-        self.local_x = x
+        settings = ipm.IPMSettings(
+            tol=float(self.options.get("sc_tol", 1e-6)),
+            max_iter=int(self.options.get("sc_max_iter", 100)),
+        )
+        res = ipm.solve_sc(self.batch, settings)
+        self.local_x = res.x
+        self.ipm_result = res
         self.first_stage_solution_available = True
-        self.objective_value = obj
-        return obj
+        self.objective_value = res.obj + float(
+            self.probs @ self.batch.const)
+        return self.objective_value

@@ -1,0 +1,417 @@
+"""Batched Schur-complement primal-dual interior point (continuous SPs).
+
+The TPU-native replacement for the reference's parapint delegation
+(``mpisppy/opt/sc.py:59-106``: MPI block-structured IP with MA27 factoring
+each scenario's KKT block and a dense Schur system on the coupling).  Here
+the same block-arrowhead structure maps onto the batch dimension:
+
+- each IP iteration condenses every scenario's KKT system to
+  ``H_s = diag(Dx_s) + A_s' diag(Dz_s) A_s`` — ONE batched (S, n, n)
+  factorization on the MXU (the analogue of parapint's per-rank MA27 calls);
+- the coupling (nonanticipativity) unknowns form the dense Schur system
+  ``C Δw = b`` with ``C = Σ_s p_s Π_s T_s^{-1} Π_s'``, ``T_s`` the
+  K x K coupling block of ``H_s^{-1}`` — a single small dense solve
+  (multistage trees scatter per-scenario blocks into (node, slot) pairs).
+
+Formulation per scenario (slack form; E selects the nonant columns):
+
+    min c'x + 0.5 x' diag(q2) x
+    s.t. A x = z,  cl <= z <= cu,  lb <= x <= ub,  E'x = w_sel(s)
+
+with log barriers on every FINITE bound; w are the per-(node, slot)
+consensus variables, and stationarity in w is the probability-weighted sum
+of the coupling multipliers.  Plain path-following (fraction-to-boundary,
+sigma-damped mu) — continuous problems only, like the reference.
+
+Zero-width boxes (equality rows, clamped columns) are widened by ``EQ_EPS``
+so the barrier stays defined; the induced constraint error is O(EQ_EPS).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .admm import BIG, _clean_bounds, _explicit_inverse
+
+EQ_EPS = 1e-7
+
+
+@dataclasses.dataclass(frozen=True)
+class IPMSettings:
+    tol: float = 1e-7          # residual + mu tolerance (equilibrated units)
+    max_iter: int = 100
+    sigma: float = 0.2         # centering parameter
+    tau: float = 0.995         # fraction-to-boundary
+    dtype: str = "float64"
+
+
+class IPMResult(NamedTuple):
+    x: np.ndarray          # (S, n)
+    w: np.ndarray          # (N, K) consensus values (nan at invalid pairs)
+    obj: float             # probability-weighted objective (no const)
+    mu: float
+    res: float
+    iters: int
+    converged: bool
+
+
+def _prep(batch, dt):
+    A = jnp.asarray(np.asarray(batch.A), dt)
+    c = jnp.asarray(batch.c, dt)
+    q2 = jnp.asarray(batch.q2, dt)
+    cl, cu = _clean_bounds(jnp.asarray(batch.cl, dt), jnp.asarray(batch.cu, dt))
+    lb, ub = _clean_bounds(jnp.asarray(batch.lb, dt), jnp.asarray(batch.ub, dt))
+    # row/box classification on UNSCALED widths (scaling would reclassify
+    # narrow range rows as equalities whenever Ruiz shrinks their rows)
+    eq_unscaled = cu - cl < EQ_EPS
+    eqx_unscaled = ub - lb < EQ_EPS
+
+    # Ruiz equilibration of the WHOLE stacked system with a SHARED column
+    # scaling D (n,) — per-scenario D would break the nonant consensus
+    # (x_s[k] = w would couple differently-scaled coordinates); rows scale
+    # per scenario.  Equilibration tames cond(H) by ~||A||^2, which the
+    # late-barrier Newton systems need.
+    D = jnp.ones((A.shape[2],), dt)
+    E = jnp.ones(A.shape[:2], dt)
+    for _ in range(8):
+        As = A * E[:, :, None] * D[None, None, :]
+        col = jnp.max(jnp.abs(As), axis=(0, 1))
+        row = jnp.max(jnp.abs(As), axis=2)
+        col = jnp.where(col < 1e-12, 1.0, col)
+        row = jnp.where(row < 1e-12, 1.0, row)
+        D = D / jnp.sqrt(col)
+        E = E / jnp.sqrt(row)
+    big = jnp.asarray(BIG, dt)
+    # finiteness decided BEFORE scaling; infinite sides stay pinned at +-BIG
+    fzL, fzU = cl > -BIG / 2, cu < BIG / 2
+    fxL, fxU = lb > -BIG / 2, ub < BIG / 2
+    A = A * E[:, :, None] * D[None, None, :]
+    c = c * D[None, :]
+    q2 = q2 * (D * D)[None, :]
+    cl = jnp.where(fzL, cl * E, -big)
+    cu = jnp.where(fzU, cu * E, big)
+    lb = jnp.where(fxL, lb / D[None, :], -big)
+    ub = jnp.where(fxU, ub / D[None, :], big)
+
+    # Equality ROWS (cl == cu) carry no barrier at all: they are handled as
+    # true equalities with a fixed dual regularization (Dz = 1/delta in the
+    # condensed system — the same elimination algebra, mu-INDEPENDENT
+    # conditioning).  A widened-box barrier instead pinches from both sides
+    # and drives cond(H) -> inf as mu -> 0 (observed late divergence).
+    eq = eq_unscaled
+    fzL = fzL & ~eq
+    fzU = fzU & ~eq
+    # zero-width x boxes (clamped columns) are rare in SC usage; widen them
+    lb = jnp.where(eqx_unscaled, lb - EQ_EPS, lb)
+    ub = jnp.where(eqx_unscaled, ub + EQ_EPS, ub)
+    return A, c, q2, cl, cu, lb, ub, D, (fxL, fxU, fzL, fzU, eq)
+
+
+class _Consts(NamedTuple):
+    """Problem constants for the jitted IP step (module-level jit: one
+    compile per problem SHAPE, not per solve_sc call; the arrays are traced
+    arguments, never baked-in XLA constants)."""
+
+    A: jax.Array
+    c: jax.Array
+    q2: jax.Array
+    cl: jax.Array
+    cu: jax.Array
+    lb: jax.Array
+    ub: jax.Array
+    fxL: jax.Array
+    fxU: jax.Array
+    fzL: jax.Array
+    fzU: jax.Array
+    eq: jax.Array
+    probs: jax.Array
+    idx: jax.Array        # (K,) nonant columns
+    flat_idx: jax.Array   # (S, K) -> w slot
+    valid: jax.Array      # (NK,) live (node, slot) pairs
+
+
+def _gaps(con, x, z):
+    """Positive barrier gaps (floored: cancellation at O(1e-7) widened-box
+    widths can make the raw difference negative and poison the barrier)."""
+    dt = x.dtype
+    one = jnp.asarray(1.0, dt)
+    floor = jnp.asarray(1e-12, dt)
+    gxL = jnp.where(con.fxL, jnp.maximum(x - con.lb, floor), one)
+    gxU = jnp.where(con.fxU, jnp.maximum(con.ub - x, floor), one)
+    gzL = jnp.where(con.fzL, jnp.maximum(z - con.cl, floor), one)
+    gzU = jnp.where(con.fzU, jnp.maximum(con.cu - z, floor), one)
+    return gxL, gxU, gzL, gzU
+
+
+def _mu_of(con, x, z, piL, piU, sL, sU):
+    gxL, gxU, gzL, gzU = _gaps(con, x, z)
+    num = (jnp.sum(piL * gxL * con.fxL) + jnp.sum(piU * gxU * con.fxU)
+           + jnp.sum(sL * gzL * con.fzL) + jnp.sum(sU * gzU * con.fzU))
+    den = (jnp.sum(con.fxL) + jnp.sum(con.fxU)
+           + jnp.sum(con.fzL) + jnp.sum(con.fzU))
+    return num / jnp.maximum(den, 1.0)
+
+
+@functools.partial(jax.jit, static_argnames=("st",))
+def _ipm_step(con: _Consts, x, z, y, piL, piU, sL, sU, nu, w, mu,
+              st: IPMSettings):
+    """One primal-dual step.  The returned ``res`` is the KKT residual of
+    the INPUT iterate (that is what this step linearized); callers must
+    attribute it to the pre-step state."""
+    dt = x.dtype
+    A, c, q2 = con.A, con.c, con.q2
+    cl, cu, lb, ub = con.cl, con.cu, con.lb, con.ub
+    fxL, fxU, fzL, fzU, eq = con.fxL, con.fxU, con.fzL, con.fzU, con.eq
+    probs, idx, flat_idx, valid = con.probs, con.idx, con.flat_idx, con.valid
+    S, m, n = A.shape
+    K = idx.shape[0]
+    NK = valid.shape[0]
+
+    gxL, gxU, gzL, gzU = _gaps(con, x, z)
+    w_sel = w[flat_idx]                          # (S, K)
+
+    # residuals of the KKT system
+    Enu = jnp.zeros((S, n), dt).at[:, idx].add(nu)
+    r1 = -(q2 * x + c + jnp.einsum("smn,sm->sn", A, y)
+           - piL + piU + Enu)                                 # stat_x
+    r2 = jnp.where(eq, 0.0, -(-y - sL + sU))                  # stat_z
+    r3 = -(jnp.einsum("smn,sn->sm", A, x) - z)                # prim_e
+    r4 = -(x[:, idx] - w_sel)                                 # prim_c
+    r5 = -(jnp.zeros((NK,), dt).at[flat_idx].add(
+        probs[:, None] * nu))                                 # stat_w
+
+    # condensed diagonal terms (masked at infinite bounds)
+    Dx = q2 + jnp.where(fxL, piL / gxL, 0.0) + jnp.where(
+        fxU, piU / gxU, 0.0)
+    Dz = jnp.where(fzL, sL / gzL, 0.0) + jnp.where(
+        fzU, sU / gzU, 0.0)
+    # equality rows: regularized equality with a mu-HOMOTOPY stiffness.
+    # A fixed 1/delta = 1e8 makes the cold Newton step equality-dominated
+    # (|dx| ~ 1e8 * violation, clamped to ~1e-3 steps forever); tying
+    # delta to mu keeps equalities soft while far from the central path
+    # and machine-stiff at convergence.
+    stiff = 1.0 / jnp.clip(1e-3 * mu, 1e-7, 1e2)
+    Dz = jnp.where(eq, stiff, jnp.maximum(Dz, 1e-8))
+
+    cxL = jnp.where(fxL, (mu - piL * gxL) / gxL, 0.0)
+    cxU = jnp.where(fxU, (mu - piU * gxU) / gxU, 0.0)
+    czL = jnp.where(fzL, (mu - sL * gzL) / gzL, 0.0)
+    czU = jnp.where(fzU, (mu - sU * gzU) / gzU, 0.0)
+    rhs_x = r1 + cxL - cxU
+    # equality rows have no stat_z equation: their elimination is the
+    # regularized equality  A dx - delta dy = r_e  (Dz = 1/delta, rz = 0)
+    r_z = jnp.where(eq, 0.0, r2 + czL - czU)
+    r_e = r3
+    r_c = r4
+
+    H = jnp.einsum("smn,sm,smk->snk", A, Dz, A)
+    H = H + jax.vmap(jnp.diag)(Dx + jnp.asarray(1e-11, dt))
+    Hinv = _explicit_inverse(H)
+    # Newton refinement of the inverses (X <- X(2I - MX)) squares the
+    # inverse residual: the regularized-equality rows put ~1e8 blocks in
+    # H, and near convergence the barrier terms push cond(H) (and the
+    # coupling block T it induces) past what one Cholesky inverse holds;
+    # unrefined T was the observed failure (Schur system went garbage)
+    eyeN = jnp.eye(n, dtype=dt)[None]
+    for _ in range(2):
+        Hinv = Hinv + jnp.einsum(
+            "snk,skj->snj", Hinv, eyeN - jnp.einsum(
+                "snk,skj->snj", H, Hinv))
+
+    rt = rhs_x + jnp.einsum("smn,sm->sn", A, Dz * r_e + r_z)
+    Hr = jnp.einsum("snk,sk->sn", Hinv, rt)
+    T = Hinv[:, idx[:, None], idx[None, :]]      # (S, K, K)
+    T = T + jnp.eye(K, dtype=dt)[None] * 1e-13
+    Tinv = _explicit_inverse(T)
+    eyeK = jnp.eye(K, dtype=dt)[None]
+    for _ in range(2):
+        Tinv = Tinv + jnp.einsum(
+            "skj,sjl->skl", Tinv, eyeK - jnp.einsum(
+                "skj,sjl->skl", T, Tinv))
+    g = Hr[:, idx]
+
+    # dense Schur system over (node, slot) consensus pairs
+    Cm = jnp.zeros((NK, NK), dt).at[
+        flat_idx[:, :, None], flat_idx[:, None, :]].add(
+        probs[:, None, None] * Tinv)
+    b = jnp.zeros((NK,), dt).at[flat_idx].add(
+        probs[:, None] * jnp.einsum("skj,sj->sk", Tinv, g - r_c)) - r5
+    Cm = Cm + jnp.diag(jnp.where(valid, 1e-12, 1.0))
+    dw = jnp.linalg.solve(Cm, b)
+
+    dnu = jnp.einsum("skj,sj->sk", Tinv, g - dw[flat_idx] - r_c)
+    Ednu = jnp.zeros((S, n), dt).at[:, idx].add(dnu)
+    dx = Hr - jnp.einsum("snk,sk->sn", Hinv, Ednu)
+    dy = Dz * (jnp.einsum("smn,sn->sm", A, dx) - r_e) - r_z
+    # equality slacks stay pinned at b: their dz would otherwise be
+    # dy/stiffness, which drifts z off the equality at soft stiffness
+    dz = jnp.where(eq, 0.0, (r_z + dy) / Dz)
+    dpiL = jnp.where(fxL, cxL - piL * dx / gxL, 0.0)
+    dpiU = jnp.where(fxU, cxU + piU * dx / gxU, 0.0)
+    dsL = jnp.where(fzL, czL - sL * dz / gzL, 0.0)
+    dsU = jnp.where(fzU, czU + sU * dz / gzU, 0.0)
+
+    # fraction-to-boundary step sizes
+    def max_step(v, dv, finite):
+        r = jnp.where(finite & (dv < 0), -v / jnp.where(
+            dv < 0, dv, -1.0), jnp.inf)
+        return jnp.min(r)
+
+    ap = jnp.minimum(
+        jnp.minimum(max_step(gxL, dx, fxL), max_step(gxU, -dx, fxU)),
+        jnp.minimum(max_step(gzL, dz, fzL), max_step(gzU, -dz, fzU)))
+    ad = jnp.minimum(
+        jnp.minimum(max_step(piL, dpiL, fxL), max_step(piU, dpiU, fxU)),
+        jnp.minimum(max_step(sL, dsL, fzL), max_step(sU, dsU, fzU)))
+    ap = jnp.minimum(st.tau * ap, 1.0)
+    ad = jnp.minimum(st.tau * ad, 1.0)
+
+    x2 = x + ap * dx
+    z2 = z + ap * dz
+    w2 = w + ap * dw
+    y2 = y + ad * dy
+    nu2 = nu + ad * dnu
+    piL2 = piL + ad * dpiL
+    piU2 = piU + ad * dpiU
+    sL2 = sL + ad * dsL
+    sU2 = sU + ad * dsU
+    # duals stay strictly positive (fraction-to-boundary guarantees it
+    # analytically; the floor guards rounding at tiny magnitudes)
+    tiny = jnp.asarray(1e-16, dt)
+    piL2 = jnp.where(fxL, jnp.maximum(piL2, tiny), 0.0)
+    piU2 = jnp.where(fxU, jnp.maximum(piU2, tiny), 0.0)
+    sL2 = jnp.where(fzL, jnp.maximum(sL2, tiny), 0.0)
+    sU2 = jnp.where(fzU, jnp.maximum(sU2, tiny), 0.0)
+    mu2 = jnp.maximum(
+        st.sigma * _mu_of(con, x2, z2, piL2, piU2, sL2, sU2), tiny)
+
+    res = jnp.maximum(
+        jnp.maximum(jnp.max(jnp.abs(r1)), jnp.max(jnp.abs(r2))),
+        jnp.maximum(
+            jnp.maximum(jnp.max(jnp.abs(r3)), jnp.max(jnp.abs(r4))),
+            jnp.max(jnp.abs(r5))))
+    return x2, z2, y2, piL2, piU2, sL2, sU2, nu2, w2, mu2, res, ap, ad
+
+
+def solve_sc(batch, settings: IPMSettings = IPMSettings()) -> IPMResult:
+    """Solve the continuous SP by Schur-complement interior point."""
+    st = settings
+    dt = jnp.dtype(st.dtype)
+    if dt == jnp.dtype(jnp.float64) and not jax.config.jax_enable_x64:
+        # scoped: never flip the process-global x64 flag from library code
+        with jax.enable_x64(True):
+            return _solve_sc(batch, st, jnp.dtype(jnp.float64))
+    return _solve_sc(batch, st, dt)
+
+
+def _solve_sc(batch, st, dt):
+    A, c, q2, cl, cu, lb, ub, D, masks = _prep(batch, dt)
+    S, m, n = A.shape
+    tree = batch.tree
+    idx = jnp.asarray(tree.nonant_indices)
+    K = int(idx.shape[0])
+    N = tree.num_nodes
+    nid = jnp.asarray(tree.nid_sk())              # (S, K) node ids
+    probs = jnp.asarray(batch.probs, dt)
+    NK = N * K
+    flat_idx = nid * K + jnp.arange(K)[None, :]   # (S, K) -> w slot
+    fxL, fxU, fzL, fzU, eq = masks
+    one = jnp.asarray(1.0, dt)
+
+    # strictly interior start: midpoint of doubly-finite boxes, a unit
+    # inside single-sided ones, 0 when free
+    def interior(v, lo, hi, finL, finU):
+        mid = jnp.where(finL & finU, 0.5 * (lo + hi), 0.0)
+        v = jnp.where(finL & finU, mid, v)
+        v = jnp.where(finL & ~finU, jnp.maximum(v, lo + 1.0), v)
+        v = jnp.where(~finL & finU, jnp.minimum(v, hi - 1.0), v)
+        return v
+
+    x = interior(jnp.zeros((S, n), dt), lb, ub, fxL, fxU)
+    z = interior(jnp.einsum("smn,sn->sm", A, x), cl, cu, fzL, fzU)
+    z = jnp.where(eq, cl, z)          # equality rows: z pinned to b
+    y = jnp.zeros((S, m), dt)
+    piL = jnp.where(fxL, one, 0.0)
+    piU = jnp.where(fxU, one, 0.0)
+    sL = jnp.where(fzL, one, 0.0)
+    sU = jnp.where(fzU, one, 0.0)
+    nu = jnp.zeros((S, K), dt)
+    # w starts at the prob-weighted nonant average
+    w0 = jnp.zeros((NK,), dt).at[flat_idx].add(
+        probs[:, None] * x[:, idx])
+    wden = jnp.zeros((NK,), dt).at[flat_idx].add(
+        jnp.broadcast_to(probs[:, None], flat_idx.shape))
+    valid = wden > 1e-300
+    w = jnp.where(valid, w0 / jnp.maximum(wden, 1e-300), 0.0)
+
+    con = _Consts(A=A, c=c, q2=q2, cl=cl, cu=cu, lb=lb, ub=ub,
+                  fxL=fxL, fxU=fxU, fzL=fzL, fzU=fzU, eq=eq, probs=probs,
+                  idx=idx, flat_idx=flat_idx, valid=valid)
+
+    import os
+
+    debug = bool(os.environ.get("TPUSPPY_IPM_DEBUG"))
+    with jax.default_matmul_precision("highest"):
+        mu = _mu_of(con, x, z, piL, piU, sL, sU)
+        res = np.inf
+        it = 0
+        # equilibrated system => absolute tolerances
+        best = None
+        best_merit = np.inf
+        stale = 0
+        mu0 = float(mu)
+        for it in range(1, st.max_iter + 1):
+            # _ipm_step's res describes the PRE-step iterate: pair
+            # snapshots and the convergence test with prev, not the
+            # (unevaluated) post-step state
+            prev = (x, w, float(mu))
+            x, z, y, piL, piU, sL, sU, nu, w, mu, res, ap, ad = _ipm_step(
+                con, x, z, y, piL, piU, sL, sU, nu, w, mu, st)
+            if debug:
+                print(f"ipm it={it} res={float(res):.3e} "
+                      f"mu={prev[2]:.3e} ap={float(ap):.4f} "
+                      f"ad={float(ad):.4f}", flush=True)
+            merit = float(res) + prev[2]
+            # the mu-homotopy makes early residuals meaningless (soft
+            # equalities): snapshots and endgame guards engage only once
+            # the path parameter has dropped well below its start
+            endgame = prev[2] < 1e-3 * max(mu0, 1.0)
+            if np.isfinite(merit) and endgame and merit < best_merit:
+                best_merit = merit
+                best = (prev[0], prev[1], prev[2], float(res))
+                stale = 0
+            elif endgame:
+                stale += 1
+            if not np.isfinite(merit):
+                break          # diverged: the best iterate is the answer
+            if best is not None and merit > 1e3 * max(best_merit, 1e-300):
+                break
+            if stale >= 4:
+                break          # endgame stagnation (barrier conditioning)
+            if float(res) < st.tol and prev[2] < st.tol:
+                best = (prev[0], prev[1], prev[2], float(res))
+                break
+    if best is not None:
+        x, w, mu_f, res_f = best
+    else:
+        mu_f, res_f = float(mu), float(res)
+
+    # unscale (the loop ran on the Ruiz-equilibrated system)
+    D_np = np.asarray(D)
+    xs = np.asarray(x) * D_np[None, :]
+    obj = float(np.asarray(batch.probs) @ (
+        np.einsum("sn,sn->s", np.asarray(batch.c, float), xs)
+        + 0.5 * np.einsum("sn,sn->s", np.asarray(batch.q2, float),
+                          xs * xs)))
+    w_np = np.asarray(w).reshape(N, K) * D_np[np.asarray(idx)][None, :]
+    w_np = np.where(np.asarray(valid).reshape(N, K), w_np, np.nan)
+    return IPMResult(
+        x=xs, w=w_np, obj=obj, mu=float(mu_f), res=float(res_f), iters=it,
+        converged=bool(res_f < st.tol and mu_f < st.tol),
+    )

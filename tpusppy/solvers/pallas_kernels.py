@@ -106,11 +106,16 @@ def _sweeps_kernel(q_ref, A_ref, At_ref, Kinv_ref, K_ref, cl_ref, cu_ref,
 
 @functools.partial(jax.jit,
                    static_argnames=("n_sweeps", "n_refine", "sigma", "alpha",
-                                    "bs"))
+                                    "bs", "interpret"))
 def fused_sweeps(q, A, At, Kinv, K, cl, cu, lb, ub, rho_a, rho_x,
-                 x, z, zx, y, yx, Ax, n_sweeps, n_refine, sigma, alpha, bs):
+                 x, z, zx, y, yx, Ax, n_sweeps, n_refine, sigma, alpha, bs,
+                 interpret=False):
     """Run ``n_sweeps`` sweeps; ALL arrays in scenario-last layout
-    (m,n,S)/(n,S) etc.  Returns transposed-state (x, z, zx, y, yx, Ax)."""
+    (m,n,S)/(n,S) etc.  Returns transposed-state (x, z, zx, y, yx, Ax).
+
+    ``interpret=True`` runs the kernel through the Pallas interpreter —
+    platform-independent, used by the CPU correctness tests
+    (tests/test_pallas.py) to pin the kernel to the XLA sweep semantics."""
     m, n, S = A.shape
     grid = ((S + bs - 1) // bs,)
 
@@ -152,6 +157,7 @@ def fused_sweeps(q, A, At, Kinv, K, cl, cu, lb, ub, rho_a, rho_x,
         out_specs=[spec2(n), spec2(m), spec2(n), spec2(m), spec2(n),
                    spec2(m)],
         out_shape=out_shape,
+        interpret=interpret,
     )(q, A, At, Kinv, K, cl, cu, lb, ub, rho_a, rho_x, x, z, zx, y, yx, Ax)
 
 
