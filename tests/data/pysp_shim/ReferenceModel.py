@@ -1,0 +1,48 @@
+# Two-product capacity/production model written in the classic PySP Pyomo
+# dialect, exercising the restricted AbstractModel shim surface: indexed
+# Sets/Params/Vars, bounds rules, domains, Expression, tuple constraints.
+from pyomo.environ import (AbstractModel, Set, Param, Var, Expression,
+                           Objective, Constraint, NonNegativeReals, minimize)
+
+model = AbstractModel()
+model.PRODUCTS = Set()
+model.BuildCost = Param(model.PRODUCTS)
+model.Revenue = Param(model.PRODUCTS)
+model.Demand = Param(model.PRODUCTS, default=0.0)
+model.MaxCap = Param(initialize=100.0)
+
+
+def cap_bounds(m, p):
+    return (0.0, m.MaxCap)
+
+
+model.x = Var(model.PRODUCTS, bounds=cap_bounds)          # first stage
+model.y = Var(model.PRODUCTS, within=NonNegativeReals)    # recourse
+
+
+def first_cost(m):
+    return sum(m.BuildCost[p] * m.x[p] for p in m.PRODUCTS)
+
+
+model.FirstStageCost = Expression(rule=first_cost)
+
+
+def ylimit_rule(m, p):
+    return m.y[p] <= m.x[p]
+
+
+model.YLimit = Constraint(model.PRODUCTS, rule=ylimit_rule)
+
+
+def demand_rule(m, p):
+    return (None, m.y[p], m.Demand[p])
+
+
+model.DemandCap = Constraint(model.PRODUCTS, rule=demand_rule)
+
+
+def obj_rule(m):
+    return m.FirstStageCost - sum(m.Revenue[p] * m.y[p] for p in m.PRODUCTS)
+
+
+model.Obj = Objective(rule=obj_rule, sense=minimize)

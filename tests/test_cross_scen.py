@@ -26,6 +26,7 @@ def _cfg(n=3):
     return cfg
 
 
+@pytest.mark.slow
 def test_cross_scenario_cut_wheel():
     n = 3
     cfg = _cfg(n)

@@ -178,6 +178,7 @@ def test_shared_edualbound_certified():
     assert bound >= exact - 0.02 * abs(exact)   # and not trivially weak
 
 
+@pytest.mark.slow
 def test_shared_sharded_mesh():
     """run_ph on an 8-device CPU mesh with a shared-A batch: the jit
     auto-partitioned shared solver must execute and agree with 1 device."""

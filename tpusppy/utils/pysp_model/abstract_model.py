@@ -1,0 +1,553 @@
+"""Restricted Pyomo-compatible AbstractModel shim: old PySP ReferenceModel.py
+files run UNCHANGED (no Pyomo in the image, none needed).
+
+The reference ingests a Pyomo ``ReferenceModel.py`` + ``.dat`` data through
+``mpisppy/utils/pysp_model/instance_factory.py`` (888 LoC over the full
+Pyomo stack).  Here the LINEAR modeling subset PySP models actually use is
+reimplemented directly against the tpusppy IR: ``load_reference_model``
+executes the user's model file with ``pyomo.environ`` mapped to this
+module, the declared ``AbstractModel`` is instantiated per scenario from
+parsed ``.dat`` data (:mod:`.datparser`), and every constraint/objective
+rule is evaluated over affine expression objects that lower straight to a
+:class:`~tpusppy.ir.ScenarioProblem`.
+
+Supported surface (the PySP test fixtures + typical PySP models):
+``AbstractModel``/``ConcreteModel``, ``Set`` (initialize/within/dimen),
+``RangeSet``, ``Param`` (initialize/default/mutable/within, any arity),
+``Var`` (index sets, bounds tuple or rule, within domains), ``Expression``,
+``Objective`` (rule, sense), ``Constraint`` (rule; ``Constraint.Skip``;
+tuple ``(lo, body, hi)`` or ``inequality``), ``minimize``/``maximize``,
+``value``, ``summation``/``sum_product``.  Nonlinear expressions raise.
+"""
+
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+INF = float("inf")
+
+
+# ---------------------------------------------------------------------------
+# affine expressions
+# ---------------------------------------------------------------------------
+
+class LinExpr:
+    """Affine expression: sum coefs[var] * var + const."""
+
+    __slots__ = ("coefs", "const")
+
+    def __init__(self, coefs=None, const=0.0):
+        self.coefs = dict(coefs or {})
+        self.const = float(const)
+
+    @staticmethod
+    def of(v):
+        if isinstance(v, LinExpr):
+            return v
+        if isinstance(v, numbers.Number):
+            return LinExpr({}, float(v))
+        raise TypeError(
+            f"non-affine or unsupported term in expression: {v!r} "
+            "(the PySP shim supports linear models only)")
+
+    def _add(self, other, sign):
+        other = LinExpr.of(other)
+        coefs = dict(self.coefs)
+        for k, c in other.coefs.items():
+            coefs[k] = coefs.get(k, 0.0) + sign * c
+        return LinExpr(coefs, self.const + sign * other.const)
+
+    def __add__(self, other):
+        return self._add(other, 1.0)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._add(other, -1.0)
+
+    def __rsub__(self, other):
+        return (-self)._add(other, 1.0)
+
+    def __neg__(self):
+        return LinExpr({k: -c for k, c in self.coefs.items()}, -self.const)
+
+    def __pos__(self):
+        return self
+
+    def __mul__(self, other):
+        if not isinstance(other, numbers.Number):
+            raise TypeError(
+                "product of two expressions is nonlinear; the PySP shim "
+                "supports linear models only")
+        s = float(other)
+        return LinExpr({k: c * s for k, c in self.coefs.items()},
+                       self.const * s)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self.__mul__(1.0 / float(other))
+
+    def __le__(self, other):
+        d = self._add(other, -1.0)
+        return Relation(LinExpr(d.coefs), -INF, -d.const)
+
+    def __ge__(self, other):
+        d = self._add(other, -1.0)
+        return Relation(LinExpr(d.coefs), -d.const, INF)
+
+    def __eq__(self, other):  # noqa: A003 - Pyomo semantics
+        d = self._add(other, -1.0)
+        return Relation(LinExpr(d.coefs), -d.const, -d.const)
+
+    __hash__ = None
+
+
+class Relation:
+    """lo <= body <= hi with the constant folded into lo/hi."""
+
+    __slots__ = ("body", "lo", "hi")
+
+    def __init__(self, body, lo, hi):
+        self.body = body
+        self.lo = float(lo)
+        self.hi = float(hi)
+
+
+def inequality(lower, body, upper):
+    body = LinExpr.of(body)
+    return Relation(LinExpr(body.coefs), float(lower) - body.const,
+                    float(upper) - body.const)
+
+
+def value(v):
+    if isinstance(v, LinExpr):
+        if v.coefs:
+            raise ValueError("value() of a non-constant expression")
+        return v.const
+    return float(v)
+
+
+# ---------------------------------------------------------------------------
+# domains
+# ---------------------------------------------------------------------------
+
+class _Domain:
+    def __init__(self, lb=-INF, ub=INF, integer=False):
+        self.lb, self.ub, self.integer = lb, ub, integer
+
+
+Reals = _Domain()
+NonNegativeReals = _Domain(lb=0.0)
+NonPositiveReals = _Domain(ub=0.0)
+PositiveReals = _Domain(lb=0.0)
+Integers = _Domain(integer=True)
+NonNegativeIntegers = _Domain(lb=0.0, integer=True)
+PositiveIntegers = _Domain(lb=1.0, integer=True)
+Binary = _Domain(lb=0.0, ub=1.0, integer=True)
+Boolean = Binary
+UnitInterval = _Domain(lb=0.0, ub=1.0)
+PercentFraction = UnitInterval
+Any = _Domain()
+
+minimize = 1
+maximize = -1
+
+
+# ---------------------------------------------------------------------------
+# abstract components
+# ---------------------------------------------------------------------------
+
+class _Component:
+    def __init__(self, *index_sets, **kw):
+        self.index_sets = index_sets
+        self.kw = kw
+        self.name = None
+
+
+class Set(_Component):
+    pass
+
+
+class RangeSet(_Component):
+    def __init__(self, *bounds, **kw):
+        super().__init__(**kw)
+        self.bounds = bounds
+
+
+class Param(_Component):
+    pass
+
+
+class Var(_Component):
+    pass
+
+
+class Expression(_Component):
+    pass
+
+
+class Objective(_Component):
+    pass
+
+
+class _Skip:
+    pass
+
+
+class Constraint(_Component):
+    Skip = _Skip()
+    Feasible = _Skip()
+
+
+def summation(*terms):
+    """summation(c, x) = sum_i c[i]*x[i]; summation(x) = sum_i x[i]."""
+    if len(terms) == 1:
+        acc = LinExpr()
+        for v in terms[0].values():
+            acc = acc + v
+        return acc
+    if len(terms) == 2:
+        c, x = terms
+        acc = LinExpr()
+        for k in x:
+            acc = acc + float(c[k]) * x[k]
+        return acc
+    raise TypeError("summation supports 1 or 2 args in the PySP shim")
+
+
+sum_product = summation
+dot_product = summation
+
+
+class AbstractModel:
+    """Collects component declarations in order; ``create_instance`` builds
+    a concrete, data-resolved instance."""
+
+    def __init__(self, *a, **kw):
+        object.__setattr__(self, "_decls", [])
+
+    def __setattr__(self, name, comp):
+        if isinstance(comp, _Component):
+            comp.name = name
+            self._decls.append(comp)
+            object.__setattr__(self, name, comp)
+        else:
+            object.__setattr__(self, name, comp)
+
+    def create_instance(self, data=None, name="instance"):
+        return _Instance(self, data or {}, name)
+
+
+ConcreteModel = AbstractModel
+
+
+# ---------------------------------------------------------------------------
+# instance construction
+# ---------------------------------------------------------------------------
+
+class _ParamView(dict):
+    def __init__(self, items, default=None):
+        super().__init__(items)
+        self._default = default
+
+    def __missing__(self, key):
+        if self._default is not None:
+            return self._default
+        raise KeyError(key)
+
+    def values(self):  # iteration order = key order
+        return [self[k] for k in self]
+
+
+class _VarView:
+    """Indexed variable accessor: x[i] / x[i, j] -> LinExpr references."""
+
+    def __init__(self, name, keys):
+        self._name = name
+        self._keys = list(keys)
+
+    def _vname(self, key):
+        if isinstance(key, tuple):
+            return f"{self._name}[{','.join(str(k) for k in key)}]"
+        return f"{self._name}[{key}]"
+
+    def __getitem__(self, key):
+        return LinExpr({self._vname(key): 1.0})
+
+    def __iter__(self):
+        return iter(self._keys)
+
+    def keys(self):
+        return list(self._keys)
+
+    def values(self):
+        return [self[k] for k in self._keys]
+
+
+class _ExprView(dict):
+    pass
+
+
+def _index_product(sets):
+    if not sets:
+        return [()]
+    out = [()]
+    for s in sets:
+        out = [t + (v,) for t in out for v in s]
+    return out
+
+
+def _resolve_index_sets(inst, comp):
+    sets = []
+    for s in comp.index_sets:
+        if isinstance(s, _Component):
+            sets.append(inst._sets[s.name])
+        elif isinstance(s, (list, tuple, range)):
+            sets.append(list(s))
+        else:
+            raise TypeError(f"bad index set for {comp.name}: {s!r}")
+    return sets
+
+
+class _Instance:
+    def __init__(self, model, data, name):
+        self.name = name
+        self._sets = {}
+        self._vars = {}      # name -> (keys, lb, ub, integer) per flat key
+        self._var_order = []
+        self._cons = []      # (name, Relation)
+        self._objective = None
+        self._obj_sense = minimize
+        get = data.get if hasattr(data, "get") else lambda k, d=None: d
+
+        for comp in model._decls:
+            kw = comp.kw
+            if isinstance(comp, RangeSet):
+                if comp.name in data:
+                    vals = list(data[comp.name])
+                elif len(comp.bounds) == 1:
+                    vals = list(range(1, int(_val(self, comp.bounds[0])) + 1))
+                else:
+                    vals = list(range(int(_val(self, comp.bounds[0])),
+                                      int(_val(self, comp.bounds[1])) + 1))
+                self._sets[comp.name] = vals
+                setattr(self, comp.name, vals)
+            elif isinstance(comp, Set):
+                if comp.name in data:
+                    vals = list(data[comp.name])
+                else:
+                    init = kw.get("initialize")
+                    if callable(init):
+                        init = init(self)
+                    vals = list(init) if init is not None else []
+                self._sets[comp.name] = vals
+                setattr(self, comp.name, vals)
+            elif isinstance(comp, Param):
+                self._build_param(comp, data)
+            elif isinstance(comp, Var):
+                self._build_var(comp)
+            elif isinstance(comp, Expression):
+                self._build_expression(comp)
+            elif isinstance(comp, Constraint):
+                self._build_constraint(comp)
+            elif isinstance(comp, Objective):
+                self._build_objective(comp)
+            else:
+                raise TypeError(f"unsupported component {comp!r}")
+
+    # ---- components -----------------------------------------------------
+    def _build_param(self, comp, data):
+        kw = comp.kw
+        sets = _resolve_index_sets(self, comp)
+        default = kw.get("default")
+        init = kw.get("initialize")
+        src = data[comp.name] if comp.name in data else None
+        if not sets:
+            if src is not None:
+                v = float(src) if isinstance(src, numbers.Number) else src
+            elif init is not None:
+                v = init(self) if callable(init) else init
+            elif default is not None:
+                v = default
+            else:
+                raise ValueError(f"no value for scalar Param {comp.name}")
+            setattr(self, comp.name, v)
+            return
+        keys = _index_product(sets)
+        flat = [k[0] if len(k) == 1 else k for k in keys]
+        items = {}
+        for k in flat:
+            if src is not None and hasattr(src, "get") and k in src:
+                items[k] = src[k]
+            elif src is not None and hasattr(src, "get") and k not in src \
+                    and getattr(src, "_default", None) is not None:
+                items[k] = src[k]
+            elif callable(init):
+                items[k] = init(self, *(k if isinstance(k, tuple) else (k,)))
+            elif isinstance(init, dict):
+                items[k] = init[k]
+            elif init is not None:
+                items[k] = init
+            elif default is not None:
+                items[k] = default
+            else:
+                raise ValueError(f"no value for Param {comp.name}[{k}]")
+        setattr(self, comp.name, _ParamView(items, default))
+
+    def _build_var(self, comp):
+        kw = comp.kw
+        sets = _resolve_index_sets(self, comp)
+        dom = kw.get("within", kw.get("domain", Reals))
+        bounds = kw.get("bounds")
+        if not sets:
+            lb, ub = dom.lb, dom.ub
+            if bounds is not None:
+                b = bounds(self) if callable(bounds) else bounds
+                lb = max(lb, _num(b[0], -INF))
+                ub = min(ub, _num(b[1], INF))
+            self._var_order.append((comp.name, lb, ub, dom.integer))
+            setattr(self, comp.name, LinExpr({comp.name: 1.0}))
+            return
+        keys = _index_product(sets)
+        flat = [k[0] if len(k) == 1 else k for k in keys]
+        view = _VarView(comp.name, flat)
+        for k in flat:
+            lb, ub = dom.lb, dom.ub
+            if bounds is not None:
+                b = (bounds(self, *(k if isinstance(k, tuple) else (k,)))
+                     if callable(bounds) else bounds)
+                lb = max(lb, _num(b[0], -INF))
+                ub = min(ub, _num(b[1], INF))
+            self._var_order.append((view._vname(k), lb, ub, dom.integer))
+        setattr(self, comp.name, view)
+
+    def _build_expression(self, comp):
+        rule = comp.kw.get("rule", comp.kw.get("initialize"))
+        sets = _resolve_index_sets(self, comp)
+        if not sets:
+            setattr(self, comp.name, LinExpr.of(rule(self)))
+            return
+        keys = _index_product(sets)
+        view = _ExprView()
+        for k in keys:
+            kk = k[0] if len(k) == 1 else k
+            view[kk] = LinExpr.of(rule(self, *k))
+        setattr(self, comp.name, view)
+
+    def _build_constraint(self, comp):
+        rule = comp.kw.get("rule", comp.kw.get("expr"))
+        sets = _resolve_index_sets(self, comp)
+        for k in _index_product(sets):
+            rel = rule(self, *k) if callable(rule) else rule
+            if isinstance(rel, _Skip):
+                continue
+            if isinstance(rel, tuple):
+                rel = inequality(_num(rel[0], -INF), rel[1],
+                                 _num(rel[2], INF))
+            if not isinstance(rel, Relation):
+                raise TypeError(
+                    f"constraint {comp.name}[{k}] rule returned {rel!r}")
+            self._cons.append((comp.name, rel))
+
+    def _build_objective(self, comp):
+        if self._objective is not None:
+            raise ValueError("multiple objectives are not supported")
+        rule = comp.kw.get("rule", comp.kw.get("expr"))
+        self._obj_sense = comp.kw.get("sense", minimize)
+        self._objective = LinExpr.of(rule(self) if callable(rule) else rule)
+
+    # ---- lowering -------------------------------------------------------
+    def to_problem(self, name=None):
+        """Lower to a :class:`tpusppy.ir.ScenarioProblem`."""
+        from ...ir import LinearModelBuilder
+
+        b = LinearModelBuilder(name or self.name)
+        index = {}
+        for (vn, lb, ub, is_int) in self._var_order:
+            index[vn] = b.add_var(vn, lb=lb, ub=ub, integer=is_int)
+        sense = 1.0 if self._obj_sense == minimize else -1.0
+        for vn, ccoef in self._objective.coefs.items():
+            b.set_cost(index[vn], sense * ccoef)
+        b.const = sense * self._objective.const
+        for (cn, rel) in self._cons:
+            coeffs = {index[vn]: c for vn, c in rel.body.coefs.items()
+                      if c != 0.0}
+            b.add_row(coeffs, rel.lo, rel.hi)
+        return b.build()
+
+
+def _num(v, default):
+    return default if v is None else float(v)
+
+
+def _val(inst, v):
+    if isinstance(v, _Component):
+        return getattr(inst, v.name)
+    return v
+
+
+# ---------------------------------------------------------------------------
+# model-file loading (the instance_factory entry)
+# ---------------------------------------------------------------------------
+
+def load_reference_model(path):
+    """Execute a PySP ``ReferenceModel.py`` with ``pyomo.environ`` mapped to
+    this shim; returns the declared AbstractModel (conventionally named
+    ``model``, else the unique AbstractModel global).
+
+    Reference analogue: instance_factory.py:1-120 (which imports the real
+    Pyomo); only the linear PySP modeling subset is honored here.
+    """
+    import sys
+    import types
+
+    fake_env = types.ModuleType("pyomo.environ")
+    for k, v in globals().items():
+        if not k.startswith("_"):
+            fake_env.__dict__[k] = v
+    fake_pyomo = types.ModuleType("pyomo")
+    fake_pyomo.environ = fake_env
+    fake_core = types.ModuleType("pyomo.core")
+    fake_core.__dict__.update(fake_env.__dict__)
+    fake_pyomo.core = fake_core
+
+    saved = {k: sys.modules.get(k)
+             for k in ("pyomo", "pyomo.environ", "pyomo.core")}
+    sys.modules["pyomo"] = fake_pyomo
+    sys.modules["pyomo.environ"] = fake_env
+    sys.modules["pyomo.core"] = fake_core
+    try:
+        ns = {"__file__": path, "__name__": "_pysp_reference_model"}
+        with open(path) as f:
+            code = compile(f.read(), path, "exec")
+        exec(code, ns)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                sys.modules.pop(k, None)
+            else:
+                sys.modules[k] = v
+    mdl = ns.get("model")
+    if not isinstance(mdl, AbstractModel):
+        cands = [v for v in ns.values() if isinstance(v, AbstractModel)]
+        if len(cands) != 1:
+            raise ValueError(
+                f"{path} must declare exactly one AbstractModel "
+                "(conventionally named 'model')")
+        mdl = cands[0]
+    return mdl
+
+
+def reference_model_creator(path):
+    """``instance_creator(data, scenario_name)`` for a ReferenceModel.py —
+    plugs straight into :class:`~tpusppy.utils.pysp_model.PySPModel`."""
+    mdl = load_reference_model(path)
+
+    def creator(data, scenario_name):
+        return mdl.create_instance(data, scenario_name).to_problem(
+            scenario_name)
+
+    return creator

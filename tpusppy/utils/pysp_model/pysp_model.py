@@ -35,7 +35,9 @@ class PySPModel:
 
     - ``instance_creator``: callable ``(data: DatData, scenario_name) ->
       ScenarioProblem`` (a module exposing ``pysp_instance_creator`` also
-      works) — the Pyomo-less ReferenceModel;
+      works) — the Pyomo-less ReferenceModel; OR a path to an actual Pyomo
+      ``ReferenceModel.py``, ingested unchanged through the restricted
+      AbstractModel shim (:mod:`.abstract_model`);
     - ``scenario_structure``: path to ScenarioStructure.dat (or a parsed
       :class:`ScenarioStructure`);
     - ``data_dir``: directory of the .dat files (defaults to the structure
@@ -44,7 +46,16 @@ class PySPModel:
 
     def __init__(self, instance_creator, scenario_structure, data_dir=None,
                  param_arity=None):
-        if hasattr(instance_creator, "pysp_instance_creator"):
+        if isinstance(instance_creator, (str, os.PathLike)):
+            instance_creator = os.fspath(instance_creator)
+            # a path to an actual Pyomo ReferenceModel.py: ingest it through
+            # the restricted AbstractModel shim (abstract_model.py) — old
+            # PySP models run unchanged, like the reference's
+            # instance_factory.py does with real Pyomo
+            from .abstract_model import reference_model_creator
+
+            instance_creator = reference_model_creator(instance_creator)
+        elif hasattr(instance_creator, "pysp_instance_creator"):
             instance_creator = instance_creator.pysp_instance_creator
         self._creator = instance_creator
         if isinstance(scenario_structure, ScenarioStructure):
