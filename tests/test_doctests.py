@@ -1,0 +1,33 @@
+"""Doc doctest tier: every >>> block in doc/*.md must run.
+
+The analogue of the reference's ``make doctest`` CI step (straight.yml):
+documentation examples are executable and checked, so the docs cannot rot.
+"""
+
+import doctest
+import glob
+import os
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DOC = os.path.join(HERE, "..", "doc")
+
+_DOC_FILES = sorted(glob.glob(os.path.join(DOC, "*.md")))
+
+
+@pytest.mark.parametrize("path", _DOC_FILES, ids=os.path.basename)
+def test_doc_doctests(path, monkeypatch):
+    # run from the repo root so relative fixture paths in examples resolve
+    monkeypatch.chdir(os.path.join(HERE, ".."))
+    try:
+        fails, attempts = doctest.testfile(
+            path, module_relative=False,
+            optionflags=doctest.NORMALIZE_WHITESPACE | doctest.ELLIPSIS)
+    finally:
+        # doc examples flip process-global toggles (disable_tictoc_output);
+        # never leak them into later tests in the same process
+        import tpusppy
+
+        tpusppy.reenable_tictoc_output()
+    assert fails == 0, f"{fails}/{attempts} doctest failures in {path}"
