@@ -1,0 +1,54 @@
+"""Battery wheel CLI: PH hub + Lagrangian + xhatshuffle on the
+solar-battery Lagrangian relaxation (reference: examples/battery/
+batterymain.py).  Usage:
+
+    python battery_cylinders.py --num-scens 20 --battery-lam 0.1 \
+        --default-rho 0.5 --max-iterations 20 --rel-gap 0.01 \
+        --lagrangian --xhatshuffle
+"""
+
+import sys
+
+from tpusppy.models import battery
+from tpusppy.spin_the_wheel import WheelSpinner
+from tpusppy.utils import cfg_vanilla as vanilla
+from tpusppy.utils.config import Config
+
+
+def _parse(args):
+    cfg = Config()
+    cfg.popular_args()
+    cfg.num_scens_required()
+    cfg.ph_args()
+    cfg.two_sided_args()
+    cfg.lagrangian_args()
+    cfg.xhatshuffle_args()
+    battery.inparser_adder(cfg)
+    cfg.parse_command_line("battery_cylinders", args)
+    return cfg
+
+
+def main(args=None):
+    cfg = _parse(args)
+    kw = battery.kw_creator(cfg)
+    names = battery.scenario_names_creator(cfg.num_scens)
+    hub = vanilla.ph_hub(cfg, battery.scenario_creator,
+                         all_scenario_names=names,
+                         scenario_creator_kwargs=kw)
+    spokes = []
+    if cfg.lagrangian:
+        spokes.append(vanilla.lagrangian_spoke(
+            cfg, battery.scenario_creator, all_scenario_names=names,
+            scenario_creator_kwargs=kw))
+    if cfg.xhatshuffle:
+        spokes.append(vanilla.xhatshuffle_spoke(
+            cfg, battery.scenario_creator, all_scenario_names=names,
+            scenario_creator_kwargs=kw))
+    ws = WheelSpinner(hub, spokes).spin()
+    print(f"BestInnerBound={ws.BestInnerBound:.4f} "
+          f"BestOuterBound={ws.BestOuterBound:.4f}")
+    return ws
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

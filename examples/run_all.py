@@ -53,6 +53,10 @@ RUNS = [
      ["--num-scens", "4", "--uc-num-gens", "3", "--uc-horizon", "6",
       "--max-iterations", "20", "--default-rho", "50.0",
       "--rel-gap", "0.02", "--lagrangian", "--xhatshuffle"]),
+    ("battery/battery_cylinders.py",
+     ["--num-scens", "6", "--battery-lam", "0.1", "--battery-use-lp",
+      "--max-iterations", "8", "--default-rho", "0.5",
+      "--rel-gap", "0.02", "--lagrangian", "--xhatshuffle"]),
 ]
 
 

@@ -1,0 +1,68 @@
+"""Battery family (Singh-Knueven hybrid solar-battery Lagrangian
+relaxation) — analogue of /root/reference/examples/battery."""
+
+import numpy as np
+import pytest
+
+from tpusppy.ef import solve_ef
+from tpusppy.ir import ScenarioBatch
+from tpusppy.models import battery
+
+
+def _batch(S=10, **kw):
+    kw.setdefault("use_LP", True)
+    names = battery.scenario_names_creator(S)
+    return ScenarioBatch.from_problems(
+        [battery.scenario_creator(nm, num_scens=S, **kw) for nm in names])
+
+
+def test_battery_ef_parity():
+    batch = _batch(10)
+    oh, xh = solve_ef(batch, solver="highs")
+    oa, _ = solve_ef(batch, solver="admm")
+    assert oa == pytest.approx(oh, rel=5e-3)
+    # selling revenue dominates: objective is negative (profit)
+    assert oh < 0
+
+
+def test_battery_ph_matches_ef():
+    S = 10
+    names = battery.scenario_names_creator(S)
+    from tpusppy.opt.ph import PH
+
+    ph = PH({"defaultPHrho": 0.5, "PHIterLimit": 20, "convthresh": 1e-8},
+            names, battery.scenario_creator,
+            scenario_creator_kwargs={"num_scens": S, "use_LP": True})
+    conv, eobj, tbound = ph.ph_main()
+    batch = _batch(S)
+    oh, _ = solve_ef(batch, solver="highs")
+    assert eobj == pytest.approx(oh, rel=1e-5)
+    assert tbound <= oh + 1e-6
+
+
+def test_battery_lambda_prices_indicator():
+    """Raising the chance-constraint multiplier must not increase the
+    indicator's optimal level (Lagrangian relaxation monotonicity)."""
+    def zlevel(lam):
+        batch = _batch(8, lam=lam)
+        _, x = solve_ef(batch, solver="highs")
+        zcol = batch.var_names.index("z")
+        return float(np.mean(x[:, zcol]))
+
+    assert zlevel(5.0) <= zlevel(0.01) + 1e-6
+
+
+def test_battery_flow_balance_holds():
+    batch = _batch(6)
+    _, x = solve_ef(batch, solver="highs")
+    names = batch.var_names
+    xi = [names.index(f"x[{t}]") for t in range(battery.T)]
+    pi = [names.index(f"p[{t}]") for t in range(battery.T)]
+    qi = [names.index(f"q[{t}]") for t in range(battery.T)]
+    for s in range(batch.num_scenarios):
+        assert x[s, xi[0]] == pytest.approx(battery.X0, abs=1e-6)
+        for t in range(battery.T - 1):
+            lhs = x[s, xi[t + 1]]
+            rhs = (x[s, xi[t]] + battery.EFF * x[s, pi[t]]
+                   - x[s, qi[t]] / battery.EFF)
+            assert lhs == pytest.approx(rhs, abs=1e-5)
