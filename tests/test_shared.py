@@ -202,3 +202,31 @@ def test_shared_sharded_mesh():
                              settings=st)
     assert np.isfinite(float(out8.conv))
     assert float(out8.eobj) == pytest.approx(float(out1.eobj), rel=1e-4)
+
+
+@pytest.mark.slow
+def test_shared_2d_mesh_row_sharding():
+    """Scenario x row 2-D mesh (make_mesh_2d): the shared A and all row
+    state shard over the row axis (tensor-parallel analogue); results agree
+    with a single device.  Odd row count exercises the row padding."""
+    import jax
+
+    from tpusppy.parallel import sharded
+
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 virtual devices (conftest sets this)")
+    S = 8
+    names = uc_lite.scenario_names_creator(S)
+    batch = ScenarioBatch.from_problems(
+        [uc_lite.scenario_creator(nm, num_scens=S, num_gens=3, horizon=5,
+                                  relax_integers=True) for nm in names])
+    assert batch.num_rows % 2 == 1          # row padding engaged
+    st = ADMMSettings(max_iter=200, restarts=4, scaling_iters=4)
+    mesh2d = sharded.make_mesh_2d(4, 2)
+    _, out2 = sharded.run_ph(batch, mesh2d, iters=2, default_rho=2.0,
+                             settings=st)
+    mesh1 = sharded.make_mesh(1)
+    _, out1 = sharded.run_ph(batch, mesh1, iters=2, default_rho=2.0,
+                             settings=st)
+    assert np.isfinite(float(out2.conv))
+    assert float(out2.eobj) == pytest.approx(float(out1.eobj), rel=1e-4)

@@ -242,12 +242,33 @@ def make_mesh(n_devices: int | None = None, axis: str = "scen") -> Mesh:
     return Mesh(np.asarray(devs), (axis,))
 
 
+def make_mesh_2d(n_scen: int, n_row: int, scen_axis: str = "scen",
+                 row_axis: str = "row") -> Mesh:
+    """2-D mesh for the shared-A engine: scenarios x constraint ROWS.
+
+    The row axis is the tensor-parallel analogue (SURVEY §5 "constraint-axis
+    available for intra-problem sharding"): the shared (m, n) A and all
+    (S, m) row-state shard over it, so huge-m families scale past one
+    chip's HBM/FLOPs.  Under jit auto-partitioning the m-contractions
+    (A'y, A'diag(rho)A) lower to psum over the row axis — no manual
+    collectives.  Dense (per-scenario A) batches use the 1-D mesh.
+    """
+    devs = jax.devices()[: n_scen * n_row]
+    if len(devs) < n_scen * n_row:
+        raise ValueError(
+            f"need {n_scen * n_row} devices, have {len(devs)}")
+    return Mesh(np.asarray(devs).reshape(n_scen, n_row),
+                (scen_axis, row_axis))
+
+
 def shard_batch(batch, mesh: Mesh, axis: str = "scen") -> PHArrays:
     """Place a :class:`~tpusppy.ir.ScenarioBatch` on the mesh, scenario-sharded.
 
     Pads S up to a multiple of the mesh axis size with zero-probability copies
     of scenario 0 — inert in every reduction (the batched analogue of uneven
-    scenario-to-rank maps, sputils.py:807-812).
+    scenario-to-rank maps, sputils.py:807-812).  On a 2-D mesh
+    (:func:`make_mesh_2d`) with a shared-A batch, the row dimension
+    additionally shards over the "row" axis (m padded to a multiple of it).
     """
     S = batch.num_scenarios
     nsh = mesh.shape[axis]
@@ -270,21 +291,53 @@ def shard_batch(batch, mesh: Mesh, axis: str = "scen") -> PHArrays:
         onehot = np.concatenate([onehot, np.zeros((pad, K, N))], axis=0)
 
     shard = NamedSharding(mesh, P(axis))
+    A_shared = getattr(batch, "A_shared", None)
+    row_axis = "row" if ("row" in mesh.axis_names
+                         and A_shared is not None) else None
 
     def put(a, spec=shard):
         return jax.device_put(jnp.asarray(a), spec)
 
-    A_shared = getattr(batch, "A_shared", None)
+    def pad_rows(a, row_dim):
+        """Pad dim ``row_dim`` to a multiple of the row-axis size (inert
+        padded rows are neutralized by the caller: zero A rows with
+        -inf/inf bounds)."""
+        if row_axis is None:
+            return a
+        rsh = mesh.shape[row_axis]
+        rpad = (-a.shape[row_dim]) % rsh
+        if rpad == 0:
+            return a
+        widths = [(0, 0)] * a.ndim
+        widths[row_dim] = (0, rpad)
+        return np.pad(a, widths)
+
     if A_shared is not None:
-        A_dev = put(A_shared, NamedSharding(mesh, P()))  # replicated (m, n)
+        if row_axis is not None:
+            A_dev = put(pad_rows(np.asarray(A_shared), 0),
+                        NamedSharding(mesh, P(row_axis, None)))
+        else:
+            A_dev = put(A_shared, NamedSharding(mesh, P()))
+        row_spec = NamedSharding(mesh, P(axis, row_axis))
+        cl_p = pad_rows(padded(batch.cl), 1)
+        cu_p = pad_rows(padded(batch.cu), 1)
+        m0 = batch.cl.shape[1]
+        if cl_p.shape[1] != m0:
+            # inert padded rows: -inf <= (zero row) x <= +inf
+            cl_p[:, m0:] = -np.inf
+            cu_p[:, m0:] = np.inf
+        cl_dev = put(cl_p, row_spec)
+        cu_dev = put(cu_p, row_spec)
     else:
         A_dev = put(padded(batch.A))
+        cl_dev = put(padded(batch.cl))
+        cu_dev = put(padded(batch.cu))
     return PHArrays(
         c=put(padded(batch.c)),
         q2=put(padded(batch.q2)),
         A=A_dev,
-        cl=put(padded(batch.cl)),
-        cu=put(padded(batch.cu)),
+        cl=cl_dev,
+        cu=cu_dev,
         lb=put(padded(batch.lb)),
         ub=put(padded(batch.ub)),
         const=put(padded(batch.const)),
