@@ -1,15 +1,17 @@
-"""Stochastic unit-commitment cylinders driver (UC-lite family).
+"""Stochastic unit-commitment cylinders driver.
 
-The analogue of ``examples/uc/uc_cylinders.py``: PH hub + bound spokes on the
-self-contained UC-lite model (the reference reads Egret/Prescient data files;
-see tpusppy/models/uc_lite.py).  Example::
+The analogue of ``examples/uc/uc_cylinders.py``: PH hub + bound spokes on
+either UC family — ``--uc-model full`` (default: tpusppy/models/uc.py, the
+reference-shape fleet with min-up/down, ramps and reserves on the shared-A
+engine) or ``--uc-model lite`` (the small self-contained uc_lite).  The
+reference reads Egret/Prescient data files; both families here are seeded
+self-contained.  Example::
 
     python uc_cylinders.py --num-scens 10 --uc-num-gens 10 --uc-horizon 24 \
         --max-iterations 50 --default-rho 100 --rel-gap 0.005 \
         --lagrangian --xhatshuffle
 """
 
-from tpusppy.models import uc_lite
 from tpusppy.spin_the_wheel import WheelSpinner
 from tpusppy.utils import cfg_vanilla as vanilla
 from tpusppy.utils import config
@@ -25,18 +27,37 @@ def _parse_args():
     cfg.fwph_args()
     cfg.lagrangian_args()
     cfg.xhatshuffle_args()
-    uc_lite.inparser_adder(cfg)
+    cfg.add_to_config("uc_model",
+                      "UC family: 'full' (reference-shape) or 'lite'",
+                      str, "full")
+    # both families share the uc_num_gens / uc_horizon arg names; register
+    # WITHOUT defaults so each family's kw_creator fallbacks (30/24 full,
+    # 5/12 lite) apply when the flags are not passed
+    cfg.add_to_config("uc_num_gens", "number of generators", int, None)
+    cfg.add_to_config("uc_horizon", "scheduling horizon (hours)", int, None)
+    cfg.add_to_config("uc_wind_frac",
+                      "mean wind share of peak thermal capacity (full model)",
+                      float, 0.25)
     cfg.parse_command_line("uc_cylinders")
+    if cfg.uc_model not in ("full", "lite"):
+        raise ValueError(f"--uc-model must be 'full' or 'lite', "
+                         f"got {cfg.uc_model!r}")
     return cfg
 
 
 def main():
     cfg = _parse_args()
-    kwargs = uc_lite.kw_creator(cfg)
-    names = uc_lite.scenario_names_creator(cfg.num_scens)
+    if cfg.uc_model == "lite":
+        from tpusppy.models import uc_lite as uc_model
+    else:
+        from tpusppy.models import uc as uc_model
+    kwargs = uc_model.kw_creator(cfg)
+    # drop unset shared args so each family's own defaults apply
+    kwargs = {k: v for k, v in kwargs.items() if v is not None}
+    names = uc_model.scenario_names_creator(cfg.num_scens)
     beans = dict(
-        cfg=cfg, scenario_creator=uc_lite.scenario_creator,
-        scenario_denouement=uc_lite.scenario_denouement,
+        cfg=cfg, scenario_creator=uc_model.scenario_creator,
+        scenario_denouement=uc_model.scenario_denouement,
         all_scenario_names=names, scenario_creator_kwargs=kwargs,
     )
     hub_dict = vanilla.ph_hub(**beans)

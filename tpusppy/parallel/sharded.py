@@ -292,8 +292,10 @@ def shard_batch(batch, mesh: Mesh, axis: str = "scen") -> PHArrays:
 
     shard = NamedSharding(mesh, P(axis))
     A_shared = getattr(batch, "A_shared", None)
-    row_axis = "row" if ("row" in mesh.axis_names
-                         and A_shared is not None) else None
+    # any second mesh axis (beyond the scenario axis) is the row axis —
+    # make_mesh_2d's row_axis name passes through automatically
+    extra = [ax for ax in mesh.axis_names if ax != axis]
+    row_axis = (extra[0] if (extra and A_shared is not None) else None)
 
     def put(a, spec=shard):
         return jax.device_put(jnp.asarray(a), spec)
