@@ -230,3 +230,21 @@ def test_shared_2d_mesh_row_sharding():
                              settings=st)
     assert np.isfinite(float(out2.conv))
     assert float(out2.eobj) == pytest.approx(float(out1.eobj), rel=1e-4)
+
+
+def test_lshaped_on_shared_batch():
+    """Two-stage Benders on a shared-A family must route every batched
+    solve through the shared engine and reach EF parity."""
+    from tpusppy.ef import solve_ef
+    from tpusppy.opt.lshaped import LShapedMethod
+
+    S = 4
+    names = uc_lite.scenario_names_creator(S)
+    ls = LShapedMethod(
+        {"max_iter": 40, "tol": 1e-5}, names, uc_lite.scenario_creator,
+        scenario_creator_kwargs={"num_scens": S, "relax_integers": True})
+    assert ls.batch.A_shared is not None
+    obj = ls.lshaped_algorithm()
+    batch = _uc_batch(S)
+    ref, _ = solve_ef(batch, solver="highs")
+    assert obj == pytest.approx(ref, rel=1e-4)

@@ -41,8 +41,9 @@ def make_clamp_cuts(opt, xhat_sk: np.ndarray) -> np.ndarray:
     ub = np.array(b.ub, copy=True)
     lb[:, idx] = xhat_sk
     ub[:, idx] = xhat_sk
-    sol = admm.solve_batch(q, b.q2, b.A, b.cl, b.cu, lb, ub,
-                           settings=opt.admm_settings)
+    from ..spopt import batch_solve_dispatch, dispatch_A
+    sol = batch_solve_dispatch(b, q, b.q2, b.cl, b.cu, lb, ub,
+                               settings=opt.admm_settings)
     x = np.asarray(sol.x)
     Q = (np.einsum("sn,sn->s", q, x)
          + 0.5 * np.einsum("sn,sn->s", b.q2, x * x) + b.const)
@@ -52,7 +53,8 @@ def make_clamp_cuts(opt, xhat_sk: np.ndarray) -> np.ndarray:
 
     dt = opt.admm_settings.jdtype()
     base, g_full = admm.dual_cut(
-        jnp.asarray(q, dt), jnp.asarray(b.q2, dt), jnp.asarray(b.A, dt),
+        jnp.asarray(q, dt), jnp.asarray(b.q2, dt),
+        jnp.asarray(np.asarray(dispatch_A(b)), dt),
         jnp.asarray(b.cl, dt), jnp.asarray(b.cu, dt),
         jnp.asarray(lb, dt), jnp.asarray(ub, dt),
         sol.y, sol.x, jnp.asarray(b.nonant_mask()))

@@ -29,7 +29,7 @@ import numpy as np
 
 from .. import global_toc
 from ..solvers import admm
-from ..spopt import SPOpt
+from ..spopt import SPOpt, batch_solve_dispatch, dispatch_A
 
 
 class LShapedMethod(SPOpt):
@@ -99,8 +99,8 @@ class LShapedMethod(SPOpt):
             # _create_root_with_scenarios eta-bound estimation)
             q = np.array(b.c, copy=True)
             q[:, idx] = 0.0
-            sol = admm.solve_batch(q, b.q2, b.A, b.cl, b.cu, b.lb, b.ub,
-                                   settings=self.admm_settings)
+            sol = batch_solve_dispatch(b, q, b.q2, b.cl, b.cu, b.lb, b.ub,
+                                       settings=self.admm_settings)
             x = np.asarray(sol.x)
             Qws = np.einsum("sn,sn->s", q, x) + 0.5 * np.einsum(
                 "sn,sn->s", b.q2, x * x) + b.const
@@ -217,8 +217,8 @@ class LShapedMethod(SPOpt):
         ub = np.array(b.ub, copy=True)
         lb[:, idx] = xhat[None, :]
         ub[:, idx] = xhat[None, :]
-        sol = admm.solve_batch(q, b.q2, b.A, b.cl, b.cu, lb, ub,
-                               settings=self.admm_settings)
+        sol = batch_solve_dispatch(b, q, b.q2, b.cl, b.cu, lb, ub,
+                                   settings=self.admm_settings)
         pri = np.asarray(sol.pri_res)
         tol = max(self.options.get("feas_tol", 1e-3),
                   10.0 * self.admm_settings.eps_rel)
@@ -233,7 +233,8 @@ class LShapedMethod(SPOpt):
 
         dt = self.admm_settings.jdtype()
         cut_base, g_full = admm.dual_cut(
-            jnp.asarray(q, dt), jnp.asarray(b.q2, dt), jnp.asarray(b.A, dt),
+            jnp.asarray(q, dt), jnp.asarray(b.q2, dt),
+            jnp.asarray(np.asarray(dispatch_A(b)), dt),
             jnp.asarray(b.cl, dt), jnp.asarray(b.cu, dt),
             jnp.asarray(lb, dt), jnp.asarray(ub, dt),
             sol.y, sol.x, jnp.asarray(b.nonant_mask()))

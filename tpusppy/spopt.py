@@ -71,6 +71,14 @@ def batch_solve_dispatch(b, q, q2, cl, cu, lb, ub, settings, warm=None,
                             warm=warm)
 
 
+def dispatch_A(b):
+    """The A to hand device code: the single (m, n) shared matrix when the
+    batch has one (never the (S, m, n) broadcast view), else the dense
+    per-scenario tensor."""
+    A_shared = getattr(b, "A_shared", None)
+    return b.A if A_shared is None else A_shared
+
+
 def _pick_dual_sign(q, A, cl, cu, lb, ub, duals, x, obj):
     """scipy's marginal sign convention is opposite ours and varies by
     constraint shape; rather than trust it, pick the sign whose dual
