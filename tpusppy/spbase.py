@@ -76,9 +76,10 @@ class SPBase:
         quantum = int(self.options.get("shape_bucket_quantum", 16))
         shapes = {(p.num_vars, p.num_rows) for p in problems}
         bucketed = None
-        # opt-in: bucketing trades the features needing a global A tensor or
-        # a shared integer pattern (cut injection, certified-bound device
-        # consts, integer diving) for compact per-shape solves
+        # opt-in: bucketing trades the features needing a global A tensor
+        # or a shared integer pattern (cut injection, integer diving,
+        # device-const caching) for compact per-shape solves; certified
+        # dual bounds work per bucket (_Edualbound_bucketed)
         if len(shapes) > 1 and self.options.get("shape_buckets", False):
             from .ir import BucketedBatch
 
