@@ -199,6 +199,63 @@ class Xhat_Eval(SPOpt):
         self.dua_res = np.zeros(S)
         return xs
 
+    def _fix_and_solve_bucketed(self, nonant_cache):
+        """Ragged (bucketed) fix-and-evaluate with INTEGER support: each
+        bucket runs the full homogeneous machinery (clamp, dive, batched
+        retries, host-MILP residue) on its compact sub-batch, results
+        scattered back to the bookkeeping layout.  Valid because bundle
+        construction keeps the packed nonant-slot order identical between
+        the global tree and every bucket's local tree (same root nonants,
+        same order — only the column indices differ)."""
+        import numpy as np
+
+        from .ir import BucketedBatch
+
+        b = self.batch
+        assert isinstance(b, BucketedBatch)
+        cache = np.asarray(nonant_cache, dtype=float)
+        if cache.ndim == 1:
+            cache = np.broadcast_to(cache, (b.num_scenarios, cache.shape[0]))
+        S, n_max = b.c.shape
+        x_out = np.zeros((S, n_max))
+        pri = np.zeros(S)
+        dua = np.zeros(S)
+        # snapshot EVERY solver-state attribute the solve path touches
+        # (including caches keyed on the batch — they'd go stale against the
+        # sub-batches otherwise).  No cross-call amortization is lost here:
+        # the homogeneous clamp path itself solves cold (solve_loop with
+        # warm=False; clamped geometry makes stale duals counterproductive).
+        saved = {k: getattr(self, k, None) for k in (
+            "batch", "tree", "nid_sk", "_warm", "_factors", "_factors_sig",
+            "_factors_age", "local_x", "pri_res", "dua_res", "_fixed_lb",
+            "_fixed_ub", "_dev_consts", "_bucket_dev_consts",
+            "_cached_nonants")}
+        try:
+            for idx_arr, sub in b.buckets:
+                self.batch = sub
+                self.tree = sub.tree
+                self.nid_sk = sub.tree.nid_sk()
+                self._warm = None
+                self._factors = None
+                self._factors_sig = None
+                self._factors_age = 0
+                self.local_x = None
+                self.pri_res = None
+                self.dua_res = None
+                x = self._fix_and_solve(cache[idx_arr])
+                x_out[idx_arr, :sub.num_vars] = np.asarray(x)
+                if self.pri_res is not None:
+                    pri[idx_arr] = np.asarray(self.pri_res)
+                if self.dua_res is not None:
+                    dua[idx_arr] = np.asarray(self.dua_res)
+        finally:
+            for k, v in saved.items():
+                setattr(self, k, v)
+        self.local_x = x_out
+        self.pri_res = pri
+        self.dua_res = dua
+        return x_out
+
     def _fix_and_solve(self, nonant_cache):
         """Clamp nonants to the candidate and solve the whole batch.
 
@@ -208,6 +265,10 @@ class Xhat_Eval(SPOpt):
         """
         import numpy as np
 
+        from .ir import BucketedBatch
+
+        if isinstance(self.batch, BucketedBatch):
+            return self._fix_and_solve_bucketed(nonant_cache)
         self.fix_nonants(nonant_cache)
         try:
             b = self.batch
