@@ -21,7 +21,7 @@ def test_sc_farmer_parity():
     sc = SchurComplement({}, names, farmer.scenario_creator,
                          scenario_creator_kwargs={"num_scens": n})
     obj = sc.solve()
-    assert obj == pytest.approx(-108390.0, rel=1e-3)
+    assert obj == pytest.approx(-108390.0, rel=1e-4)
     # first-stage consensus: the golden acres {170, 80, 250}
     w = sc.ipm_result.w[0][:3]
     np.testing.assert_allclose(np.sort(w), [80.0, 170.0, 250.0], atol=1.0)

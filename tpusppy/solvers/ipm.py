@@ -199,17 +199,6 @@ def _ipm_step(con: _Consts, x, z, y, piL, piU, sL, sU, nu, w, mu,
     stiff = 1.0 / jnp.clip(1e-3 * mu, 1e-7, 1e2)
     Dz = jnp.where(eq, stiff, jnp.maximum(Dz, 1e-8))
 
-    cxL = jnp.where(fxL, (mu - piL * gxL) / gxL, 0.0)
-    cxU = jnp.where(fxU, (mu - piU * gxU) / gxU, 0.0)
-    czL = jnp.where(fzL, (mu - sL * gzL) / gzL, 0.0)
-    czU = jnp.where(fzU, (mu - sU * gzU) / gzU, 0.0)
-    rhs_x = r1 + cxL - cxU
-    # equality rows have no stat_z equation: their elimination is the
-    # regularized equality  A dx - delta dy = r_e  (Dz = 1/delta, rz = 0)
-    r_z = jnp.where(eq, 0.0, r2 + czL - czU)
-    r_e = r3
-    r_c = r4
-
     H = jnp.einsum("smn,sm,smk->snk", A, Dz, A)
     H = H + jax.vmap(jnp.diag)(Dx + jnp.asarray(1e-11, dt))
     Hinv = _explicit_inverse(H)
@@ -224,8 +213,6 @@ def _ipm_step(con: _Consts, x, z, y, piL, piU, sL, sU, nu, w, mu,
             "snk,skj->snj", Hinv, eyeN - jnp.einsum(
                 "snk,skj->snj", H, Hinv))
 
-    rt = rhs_x + jnp.einsum("smn,sm->sn", A, Dz * r_e + r_z)
-    Hr = jnp.einsum("snk,sk->sn", Hinv, rt)
     T = Hinv[:, idx[:, None], idx[None, :]]      # (S, K, K)
     T = T + jnp.eye(K, dtype=dt)[None] * 1e-13
     Tinv = _explicit_inverse(T)
@@ -234,62 +221,106 @@ def _ipm_step(con: _Consts, x, z, y, piL, piU, sL, sU, nu, w, mu,
         Tinv = Tinv + jnp.einsum(
             "skj,sjl->skl", Tinv, eyeK - jnp.einsum(
                 "skj,sjl->skl", T, Tinv))
-    g = Hr[:, idx]
 
-    # dense Schur system over (node, slot) consensus pairs
+    # dense Schur matrix over (node, slot) consensus pairs — rhs-independent,
+    # shared by the predictor and corrector solves
     Cm = jnp.zeros((NK, NK), dt).at[
         flat_idx[:, :, None], flat_idx[:, None, :]].add(
         probs[:, None, None] * Tinv)
-    b = jnp.zeros((NK,), dt).at[flat_idx].add(
-        probs[:, None] * jnp.einsum("skj,sj->sk", Tinv, g - r_c)) - r5
     Cm = Cm + jnp.diag(jnp.where(valid, 1e-12, 1.0))
-    dw = jnp.linalg.solve(Cm, b)
+    r_e = r3
+    r_c = r4
 
-    dnu = jnp.einsum("skj,sj->sk", Tinv, g - dw[flat_idx] - r_c)
-    Ednu = jnp.zeros((S, n), dt).at[:, idx].add(dnu)
-    dx = Hr - jnp.einsum("snk,sk->sn", Hinv, Ednu)
-    dy = Dz * (jnp.einsum("smn,sn->sm", A, dx) - r_e) - r_z
-    # equality slacks stay pinned at b: their dz would otherwise be
-    # dy/stiffness, which drifts z off the equality at soft stiffness
-    dz = jnp.where(eq, 0.0, (r_z + dy) / Dz)
-    dpiL = jnp.where(fxL, cxL - piL * dx / gxL, 0.0)
-    dpiU = jnp.where(fxU, cxU + piU * dx / gxU, 0.0)
-    dsL = jnp.where(fzL, czL - sL * dz / gzL, 0.0)
-    dsU = jnp.where(fzU, czU + sU * dz / gzU, 0.0)
+    def kkt_solve(cxL, cxU, czL, czU):
+        """Direction for given centering vectors, reusing the factored
+        H/T/Schur operators (the predictor-corrector pays ONE factorization
+        for two solves)."""
+        rhs_x = r1 + cxL - cxU
+        r_z = jnp.where(eq, 0.0, r2 + czL - czU)
+        rt = rhs_x + jnp.einsum("smn,sm->sn", A, Dz * r_e + r_z)
+        Hr = jnp.einsum("snk,sk->sn", Hinv, rt)
+        g = Hr[:, idx]
+        b = jnp.zeros((NK,), dt).at[flat_idx].add(
+            probs[:, None] * jnp.einsum("skj,sj->sk", Tinv, g - r_c)) - r5
+        dw = jnp.linalg.solve(Cm, b)
+        dnu = jnp.einsum("skj,sj->sk", Tinv, g - dw[flat_idx] - r_c)
+        Ednu = jnp.zeros((S, n), dt).at[:, idx].add(dnu)
+        dx = Hr - jnp.einsum("snk,sk->sn", Hinv, Ednu)
+        dy = Dz * (jnp.einsum("smn,sn->sm", A, dx) - r_e) - r_z
+        # equality slacks stay pinned at b: their dz would otherwise be
+        # dy/stiffness, which drifts z off the equality at soft stiffness
+        dz = jnp.where(eq, 0.0, (r_z + dy) / Dz)
+        dpiL = jnp.where(fxL, cxL - piL * dx / gxL, 0.0)
+        dpiU = jnp.where(fxU, cxU + piU * dx / gxU, 0.0)
+        dsL = jnp.where(fzL, czL - sL * dz / gzL, 0.0)
+        dsU = jnp.where(fzU, czU + sU * dz / gzU, 0.0)
+        return dx, dz, dw, dy, dnu, dpiL, dpiU, dsL, dsU
 
-    # fraction-to-boundary step sizes
     def max_step(v, dv, finite):
         r = jnp.where(finite & (dv < 0), -v / jnp.where(
             dv < 0, dv, -1.0), jnp.inf)
         return jnp.min(r)
 
-    ap = jnp.minimum(
-        jnp.minimum(max_step(gxL, dx, fxL), max_step(gxU, -dx, fxU)),
-        jnp.minimum(max_step(gzL, dz, fzL), max_step(gzU, -dz, fzU)))
-    ad = jnp.minimum(
-        jnp.minimum(max_step(piL, dpiL, fxL), max_step(piU, dpiU, fxU)),
-        jnp.minimum(max_step(sL, dsL, fzL), max_step(sU, dsU, fzU)))
-    ap = jnp.minimum(st.tau * ap, 1.0)
-    ad = jnp.minimum(st.tau * ad, 1.0)
+    def steps(dx, dz, dpiL, dpiU, dsL, dsU, tau):
+        ap = jnp.minimum(
+            jnp.minimum(max_step(gxL, dx, fxL), max_step(gxU, -dx, fxU)),
+            jnp.minimum(max_step(gzL, dz, fzL), max_step(gzU, -dz, fzU)))
+        ad = jnp.minimum(
+            jnp.minimum(max_step(piL, dpiL, fxL),
+                        max_step(piU, dpiU, fxU)),
+            jnp.minimum(max_step(sL, dsL, fzL), max_step(sU, dsU, fzU)))
+        return jnp.minimum(tau * ap, 1.0), jnp.minimum(tau * ad, 1.0)
 
-    x2 = x + ap * dx
-    z2 = z + ap * dz
-    w2 = w + ap * dw
-    y2 = y + ad * dy
-    nu2 = nu + ad * dnu
-    piL2 = piL + ad * dpiL
-    piU2 = piU + ad * dpiU
-    sL2 = sL + ad * dsL
-    sU2 = sU + ad * dsU
-    # duals stay strictly positive (fraction-to-boundary guarantees it
-    # analytically; the floor guards rounding at tiny magnitudes)
+    # --- Mehrotra predictor: pure Newton (sigma = 0) ---------------------
+    # The affine centering vectors are the mu=0 case of
+    # c = (mu - dual*gap)/gap, i.e. simply -dual on every finite side
+    # (the same vector feeds the rhs AND the dual-update formulas).
+    aff = kkt_solve(jnp.where(fxL, -piL, 0.0), jnp.where(fxU, -piU, 0.0),
+                    jnp.where(fzL, -sL, 0.0), jnp.where(fzU, -sU, 0.0))
+    (dx_a, dz_a, _, _, _, dpiL_a, dpiU_a, dsL_a, dsU_a) = aff
+    ap_a, ad_a = steps(dx_a, dz_a, dpiL_a, dpiU_a, dsL_a, dsU_a, 1.0)
+    mu_aff = _mu_of(con, x + ap_a * dx_a, z + ap_a * dz_a,
+                    piL + ad_a * dpiL_a, piU + ad_a * dpiU_a,
+                    sL + ad_a * dsL_a, sU + ad_a * dsU_a)
+    sigma = jnp.clip((mu_aff / jnp.maximum(mu, 1e-300)) ** 3, 1e-4, 0.99)
+    smu = sigma * mu
+
+    # --- corrector: centering + second-order complementarity terms ------
+    cxL = jnp.where(fxL, (smu - piL * gxL - dpiL_a * dx_a) / gxL, 0.0)
+    cxU = jnp.where(fxU, (smu - piU * gxU + dpiU_a * dx_a) / gxU, 0.0)
+    czL = jnp.where(fzL, (smu - sL * gzL - dsL_a * dz_a) / gzL, 0.0)
+    czU = jnp.where(fzU, (smu - sU * gzU + dsU_a * dz_a) / gzU, 0.0)
+    dx, dz, dw, dy, dnu, dpiL, dpiU, dsL, dsU = kkt_solve(
+        cxL, cxU, czL, czU)
+    ap, ad = steps(dx, dz, dpiL, dpiU, dsL, dsU, st.tau)
+
     tiny = jnp.asarray(1e-16, dt)
-    piL2 = jnp.where(fxL, jnp.maximum(piL2, tiny), 0.0)
-    piU2 = jnp.where(fxU, jnp.maximum(piU2, tiny), 0.0)
-    sL2 = jnp.where(fzL, jnp.maximum(sL2, tiny), 0.0)
-    sU2 = jnp.where(fzU, jnp.maximum(sU2, tiny), 0.0)
-    mu2 = jnp.maximum(
-        st.sigma * _mu_of(con, x2, z2, piL2, piU2, sL2, sU2), tiny)
+
+    def advance(ap, ad):
+        x2 = x + ap * dx
+        z2 = z + ap * dz
+        w2 = w + ap * dw
+        y2 = y + ad * dy
+        nu2 = nu + ad * dnu
+        # duals stay strictly positive (fraction-to-boundary guarantees it
+        # analytically; the floor guards rounding at tiny magnitudes)
+        piL2 = jnp.where(fxL, jnp.maximum(piL + ad * dpiL, tiny), 0.0)
+        piU2 = jnp.where(fxU, jnp.maximum(piU + ad * dpiU, tiny), 0.0)
+        sL2 = jnp.where(fzL, jnp.maximum(sL + ad * dsL, tiny), 0.0)
+        sU2 = jnp.where(fzU, jnp.maximum(sU + ad * dsU, tiny), 0.0)
+        # Mehrotra: the carried mu is the MEASURED complementarity of the
+        # new iterate (the adaptive sigma already did the centering damping)
+        mu2 = jnp.maximum(
+            _mu_of(con, x2, z2, piL2, piU2, sL2, sU2), tiny)
+        return x2, z2, w2, y2, nu2, piL2, piU2, sL2, sU2, mu2
+
+    out = advance(ap, ad)
+    # safeguard: a step that INFLATES complementarity 10x (dual blow-up in
+    # the soft-equality phase) is retaken short
+    bad = out[-1] > 10.0 * mu
+    ap = jnp.where(bad, 0.2 * ap, ap)
+    ad = jnp.where(bad, 0.2 * ad, ad)
+    x2, z2, w2, y2, nu2, piL2, piU2, sL2, sU2, mu2 = advance(ap, ad)
 
     res = jnp.maximum(
         jnp.maximum(jnp.max(jnp.abs(r1)), jnp.max(jnp.abs(r2))),
