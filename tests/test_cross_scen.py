@@ -120,3 +120,37 @@ def test_cut_spoke_cuts_valid():
     fs_cost = ev.batch.c[:, idx] @ other[0]
     cut_vals = cuts[:, :3] @ other[0] + cuts[:, 3]
     assert (cut_vals <= vals - fs_cost + 1.0).all()
+
+
+def test_cut_slots_roll_past_preallocation():
+    """Beyond max_cut_rounds the oldest device slot is overwritten (every
+    cut is individually valid, so dropping one only loosens): steering
+    continues instead of freezing (r2 known-gap)."""
+    from tpusppy.extensions.cross_scen_extension import CrossScenarioExtension
+    from tpusppy.opt.ph import PH
+
+    n = 3
+    names = farmer.scenario_names_creator(n)
+    ph = PH({"defaultPHrho": 1.0, "PHIterLimit": 1, "convthresh": -1.0,
+             "cross_scen_options": {"max_cut_rounds": 2}},
+            names, farmer.scenario_creator,
+            scenario_creator_kwargs={"num_scens": n})
+    ext = CrossScenarioExtension(ph)
+    ph.extobject = ext
+    ext.pre_iter0()
+    K = ph.tree.nonant_indices.shape[0]
+    b = ph.batch
+
+    def round_rows(const):
+        r = np.zeros((n, K + 1))
+        r[:, -1] = const
+        return r
+
+    ext.add_cuts(round_rows(1.0))
+    ext.add_cuts(round_rows(2.0))
+    row0 = ext._cut_row0
+    cl_before = b.cl[:, row0].copy()
+    ext.add_cuts(round_rows(3.0))          # wraps onto slot 0
+    assert ext._next_row == 3
+    assert not np.allclose(b.cl[:, row0], cl_before)  # slot 0 overwritten
+    assert len(ext._cuts) == 3             # host list keeps generations

@@ -29,7 +29,11 @@ class CrossScenarioExtension(Extension):
         self.check_bound_iterations = so.get("check_bound_improve_iterations",
                                              4)
         self.max_cut_rounds = int(so.get("max_cut_rounds", 32))
-        self._cuts = []            # list of (S, K+1) arrays
+        from collections import deque
+
+        # bounded: the host cutting-plane LP pays per retained round, and
+        # the device slots roll (see add_cuts) — keep a few generations
+        self._cuts = deque(maxlen=4 * self.max_cut_rounds)
         self._last_lb = -np.inf
         self._phi_col = None       # set by pre_iter0's batch reform
         self._cut_row0 = None
@@ -103,14 +107,16 @@ class CrossScenarioExtension(Extension):
 
         written as the row  phi - G_s.x >= C_s  (cl finite, cu = +inf).
         """
+        if self.max_cut_rounds <= 0:
+            return                 # device cut slots disabled
         valid = ~np.isnan(rows).any(axis=1)
         if not valid.any():
             return
-        if self._next_row is not None and self._next_row >= self.max_cut_rounds:
-            # slots exhausted: further cuts can no longer steer the batch,
-            # and unbounded _cuts growth would make every bound check pay a
-            # growing host LP — stop accumulating (hub keeps existing cuts)
-            return
+        # Device cut slots ROLL: past max_cut_rounds the oldest slot is
+        # overwritten (every cut is individually valid, so dropping one can
+        # only loosen the relaxation, never invalidate it) — steering
+        # continues indefinitely instead of freezing at the preallocation
+        # (r2 known-gap).
         # scenarios whose cut row is invalid (NaN) CANNOT simply be omitted
         # from the aggregate: Q2 can be negative, so dropping a term would
         # raise the aggregate "lower bound" above the true sum — an invalid
@@ -121,7 +127,10 @@ class CrossScenarioExtension(Extension):
             clean[~valid, -1] = self._q2lb[~valid]
         elif not valid.all():
             return      # no safe substitute available: skip this round
-        self._cuts.append(rows[valid])
+        # store the FULL round (NaN rows kept): compute_outer_bound binds
+        # row s to scenario s's eta by POSITION, so filtering would
+        # misalign cuts with etas and could certify an invalid bound
+        self._cuts.append(rows)
         if self._phi_col is None:
             return
         opt = self.opt
@@ -132,7 +141,7 @@ class CrossScenarioExtension(Extension):
         C_tot = float(p @ clean[:, -1])
         G_s = G_tot[None, :] - p[:, None] * clean[:, :-1]     # (S, K)
         C_s = C_tot - p * clean[:, -1]                        # (S,)
-        row = self._cut_row0 + self._next_row
+        row = self._cut_row0 + (self._next_row % self.max_cut_rounds)
         b.A[:, row, :] = 0.0
         b.A[:, row, idx] = -G_s
         b.A[:, row, self._phi_col] = 1.0
@@ -247,9 +256,7 @@ class CrossScenarioExtension(Extension):
             # ARGMIN (hub iterates cluster near one point, so spoke cuts
             # alone leave the relaxation loose away from it; cutting at the
             # relaxation's own minimizer is the classical convergent choice).
-            # Skipped once slots are exhausted — the refinement solve would
-            # be pure cost with nowhere to put the result.
-            if self._next_row is not None and self._next_row < self.max_cut_rounds:
+            if self._next_row is not None:
                 from ..cylinders.cross_scen_spoke import make_clamp_cuts
 
                 S = self.opt.batch.num_scenarios
