@@ -1,0 +1,39 @@
+"""Declarative farmer run through the Amalgamator (reference:
+examples/farmer/farmer_ama.py): the model module's protocol (scenario_creator,
+scenario_names_creator, inparser_adder, kw_creator) is turned into an EF
+solve or a wheel spin from the command line alone.  Examples::
+
+    python farmer_ama.py --num-scens 3 --EF-solver-name admm
+    python farmer_ama.py --num-scens 3 --max-iterations 20 \
+        --default-rho 1.0 --rel-gap 0.005 --lagrangian --xhatshuffle
+"""
+
+import sys
+
+from tpusppy.utils.amalgamator import from_module
+from tpusppy.utils.config import Config
+
+
+def main(args=None):
+    args = sys.argv[1:] if args is None else args
+    cfg = Config()
+    if any(a.startswith("--EF-solver-name") or a == "--EF" for a in args):
+        cfg.add_and_assign("EF_2stage", "2stage EF", bool, None, True)
+    else:
+        cfg.add_and_assign("2stage", "2stage", bool, None, True)
+        spokes = [s[2:] for s in args
+                  if s in ("--lagrangian", "--xhatshuffle", "--fwph",
+                           "--lagranger", "--xhatlooper")]
+        cfg.quick_assign("cylinders", list, ["ph"] + spokes)
+    ama = from_module("tpusppy.models.farmer", cfg, args=args)
+    ama.run()
+    if getattr(ama, "EF_Obj", None) is not None:
+        print(f"EF objective: {ama.EF_Obj:.2f}")
+    else:
+        print(f"inner bound: {ama.best_inner_bound:.2f}  "
+              f"outer bound: {ama.best_outer_bound:.2f}")
+    return ama
+
+
+if __name__ == "__main__":
+    main()

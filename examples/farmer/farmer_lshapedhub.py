@@ -1,0 +1,39 @@
+"""Farmer with an L-shaped (Benders) HUB and an xhatshuffle spoke
+(reference: examples/farmer/farmer_lshapedhub.py).  Example::
+
+    python farmer_lshapedhub.py --num-scens 3 --max-iterations 40 \
+        --rel-gap 0.001 --xhatshuffle
+"""
+
+import sys
+
+from tpusppy.models import farmer
+from tpusppy.spin_the_wheel import WheelSpinner
+from tpusppy.utils import cfg_vanilla as vanilla
+from tpusppy.utils.config import Config
+
+
+def main(args=None):
+    cfg = Config()
+    cfg.popular_args()
+    cfg.num_scens_required()
+    cfg.two_sided_args()
+    cfg.xhatshuffle_args()
+    cfg.parse_command_line("farmer_lshapedhub",
+                           sys.argv[1:] if args is None else args)
+    names = farmer.scenario_names_creator(cfg.num_scens)
+    kw = {"num_scens": cfg.num_scens}
+    beans = dict(cfg=cfg, scenario_creator=farmer.scenario_creator,
+                 all_scenario_names=names, scenario_creator_kwargs=kw)
+    hub_dict = vanilla.lshaped_hub(**beans)
+    spokes = []
+    if cfg.xhatshuffle:
+        spokes.append(vanilla.xhatshuffle_spoke(**beans))
+    ws = WheelSpinner(hub_dict, spokes).spin()
+    print(f"BestInnerBound={ws.BestInnerBound:.4f} "
+          f"BestOuterBound={ws.BestOuterBound:.4f}")
+    return ws
+
+
+if __name__ == "__main__":
+    main()

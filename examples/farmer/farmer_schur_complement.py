@@ -1,0 +1,27 @@
+"""Farmer through the batched Schur-complement interior point (reference:
+examples/farmer/schur_complement.py over parapint).  Example::
+
+    python farmer_schur_complement.py --num-scens 10
+"""
+
+import argparse
+
+from tpusppy.models import farmer
+from tpusppy.opt.sc import SchurComplement
+
+
+def main(args=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-scens", type=int, default=3)
+    ns = ap.parse_args(args)
+    names = farmer.scenario_names_creator(ns.num_scens)
+    sc = SchurComplement({}, names, farmer.scenario_creator,
+                         scenario_creator_kwargs={"num_scens": ns.num_scens})
+    obj = sc.solve()
+    print(f"objective: {obj:.2f}  (crossover={sc.crossover_applied}, "
+          f"ipm iters={sc.ipm_result.iters})")
+    return sc
+
+
+if __name__ == "__main__":
+    main()

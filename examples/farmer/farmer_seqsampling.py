@@ -1,0 +1,36 @@
+"""Sequential-sampling confidence interval on farmer (reference:
+examples/farmer/farmer_seqsampling.py): Bayraksan-Pierre-Louis stopping to a
+fixed-width CI around the candidate's optimality gap.  Example::
+
+    python farmer_seqsampling.py --BPL-eps 2000 --max-iterations 8
+"""
+
+import argparse
+
+from tpusppy.confidence_intervals.seqsampling import (
+    SeqSampling, xhat_generator_farmer)
+from tpusppy.utils.config import Config
+
+
+def main(args=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--BPL-eps", type=float, default=2000.0)
+    ap.add_argument("--BPL-c0", type=int, default=12)
+    ap.add_argument("--max-iterations", type=int, default=8)
+    ns = ap.parse_args(args)
+    cfg = Config()
+    cfg.quick_assign("solver_name", str, "admm")
+    cfg.quick_assign("BPL_eps", float, ns.BPL_eps)
+    cfg.quick_assign("BPL_c0", int, ns.BPL_c0)
+    cfg.quick_assign("xhat_gen_kwargs", dict, {"crops_multiplier": 1})
+    ss = SeqSampling("tpusppy.models.farmer", xhat_generator_farmer, cfg,
+                     stochastic_sampling=False, stopping_criterion="BPL",
+                     solving_type="EF_2stage")
+    res = ss.run(maxit=ns.max_iterations)
+    print(f"T={res['T']}  CI=[{res['CI'][0]:.2f}, {res['CI'][1]:.2f}]  "
+          f"candidate={res['Candidate_solution']['ROOT']}")
+    return res
+
+
+if __name__ == "__main__":
+    main()

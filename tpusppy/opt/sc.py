@@ -73,7 +73,7 @@ class SchurComplement(SPBase):
                                             np.asarray(sol.dua_res))))
             # feas_tol convention as in xhat_eval: the cleanup value is used
             # only when the clamped solve certifies feasibility
-            tol = max(float(self.options.get("feas_tol", 1e-4)),
+            tol = max(float(self.options.get("feas_tol", 1e-3)),
                       10.0 * st.eps_rel)
             self.crossover_applied = resid < tol
             if self.crossover_applied:
