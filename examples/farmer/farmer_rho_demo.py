@@ -1,0 +1,56 @@
+"""Gradient-based rho demo on farmer (reference:
+examples/farmer/farmer_rho_demo.py over pynumero): run a few PH iterations,
+compute gradient-based costs (jax.grad replaces pynumero) and
+denominator-based rho suggestions, write them to CSV, and re-run PH with
+the suggested rho.  Example::
+
+    python farmer_rho_demo.py --num-scens 3
+"""
+
+import argparse
+import os
+import tempfile
+
+from tpusppy.models import farmer
+from tpusppy.opt.ph import PH
+from tpusppy.utils.find_rho import Find_Rho, Set_Rho
+from tpusppy.utils.gradient import Find_Grad
+
+
+def main(args=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-scens", type=int, default=3)
+    ap.add_argument("--iters", type=int, default=10)
+    ns = ap.parse_args(args)
+    names = farmer.scenario_names_creator(ns.num_scens)
+    kw = {"num_scens": ns.num_scens}
+
+    ph = PH({"defaultPHrho": 1.0, "PHIterLimit": ns.iters,
+             "convthresh": -1.0}, names, farmer.scenario_creator,
+            scenario_creator_kwargs=kw)
+    ph.ph_main()
+
+    grads = Find_Grad(ph, {}).compute_grad()   # jax.grad replaces pynumero
+    print("per-scenario objective gradients at the nonants (first rows):")
+    for s in range(min(2, grads.shape[0])):
+        print(f"  {names[s]}: {grads[s]}")
+
+    fr = Find_Rho(ph, {"order_stat": 0.5})
+    rho = fr.compute_rho()
+    print("suggested rho:", rho)
+
+    from tpusppy.utils.rho_utils import rhos_to_csv
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "rho.csv")
+        rhos_to_csv(rho, path)
+        setter = Set_Rho({"rho_path": path}).rho_setter
+        ph2 = PH({"defaultPHrho": 1.0, "PHIterLimit": ns.iters,
+                  "convthresh": -1.0}, names, farmer.scenario_creator,
+                 scenario_creator_kwargs=kw, rho_setter=setter)
+        conv, eobj, _ = ph2.ph_main()
+        print(f"PH with suggested rho: conv={conv:.3e} eobj={eobj:.2f}")
+
+
+if __name__ == "__main__":
+    main()
