@@ -107,3 +107,25 @@ def test_amalgamator_wheel():
     ama.run()
     assert ama.best_inner_bound == pytest.approx(-108390.0, rel=5e-3)
     assert ama.best_outer_bound <= ama.best_inner_bound + 1e-6
+
+
+def test_sputils_compat_surface():
+    """Reference-namespace aliases (mpisppy.utils.sputils migration)."""
+    import numpy as np
+
+    from tpusppy.ir import ScenarioBatch
+    from tpusppy.models import farmer
+    from tpusppy.utils import sputils
+
+    assert sputils.extract_num("Scenario12") == 12
+    assert sputils.create_nodenames_from_BFs([2]) == ["ROOT", "ROOT_0",
+                                                      "ROOT_1"]
+    names = farmer.scenario_names_creator(3)
+    ef = sputils.create_EF(names, farmer.scenario_creator, {"num_scens": 3})
+    assert ef.__class__.__name__ == "EFProblem"
+    batch = ScenarioBatch.from_problems(
+        [farmer.scenario_creator(nm, num_scens=3) for nm in names])
+    triples = list(sputils.ef_nonants(batch))
+    assert [round(v) for (_, _, v) in triples] == [170, 80, 250]
+    assert sputils.option_string_to_dict("mipgap=0.01 th=2 x") == {
+        "mipgap": 0.01, "th": 2, "x": True}

@@ -1,0 +1,94 @@
+"""sputils: reference-namespace compatibility aliases.
+
+``mpisppy.utils.sputils`` is the most-imported helper module in reference
+user code (``attach_root_node``, ``extract_num``,
+``create_nodenames_from_BFs``, EF construction, solution writers).  The
+tpusppy natives live where they architecturally belong (scenario_tree, ir,
+ef, spin_the_wheel); this module re-exports them under the names a
+migrating user will reach for, so ``from tpusppy.utils import sputils``
+works like ``from mpisppy.utils import sputils`` (see
+doc/porting_from_mpisppy.md).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ef import build_ef, solve_ef
+from ..scenario_tree import (ScenarioNode, attach_root_node,
+                             create_nodenames_from_branching_factors,
+                             extract_num)
+
+__all__ = [
+    "ScenarioNode", "attach_root_node", "extract_num",
+    "create_nodenames_from_BFs", "create_nodenames_from_branching_factors",
+    "create_EF", "build_ef", "solve_ef", "ef_nonants",
+    "first_stage_nonant_npy_serializer", "write_ef_first_stage_solution",
+    "option_string_to_dict",
+]
+
+# the reference's historical name (sputils.py:934)
+create_nodenames_from_BFs = create_nodenames_from_branching_factors
+
+
+def create_EF(scenario_names, scenario_creator, scenario_creator_kwargs=None,
+              **ignored):
+    """Reference-shaped EF constructor (sputils.py:127-341): returns the
+    merged-column EF problem for the named scenarios."""
+    from ..ir import ScenarioBatch
+
+    kwargs = scenario_creator_kwargs or {}
+    batch = ScenarioBatch.from_problems(
+        [scenario_creator(nm, **kwargs) for nm in scenario_names])
+    return build_ef(batch)
+
+
+def ef_nonants(ef_or_batch):
+    """Yield (node-ish name, var name, value) triples for a SOLVED EF —
+    the reference's ``sputils.ef_nonants`` generator surface."""
+    obj, x, batch = _solved(ef_or_batch)
+    names = batch.var_names or [f"x[{j}]" for j in range(batch.num_vars)]
+    root_slots = np.where(batch.tree.nonant_stage == 1)[0]
+    for k in root_slots:
+        j = int(batch.tree.nonant_indices[k])
+        yield ("ROOT", names[j], float(x[0, j]))
+
+
+def _solved(ef_or_batch):
+    from ..ir import ScenarioBatch
+
+    if isinstance(ef_or_batch, ScenarioBatch):
+        obj, x = solve_ef(ef_or_batch, solver="highs")
+        return obj, x, ef_or_batch
+    raise TypeError(
+        "pass the ScenarioBatch (tpusppy EFs are solved via ef.solve_ef)")
+
+
+def first_stage_nonant_npy_serializer(batch, x, solution_file_name):
+    """Write the root-stage nonant values as .npy (sputils.py:37-68)."""
+    root_slots = np.where(batch.tree.nonant_stage == 1)[0]
+    idx = batch.tree.nonant_indices[root_slots]
+    np.save(solution_file_name, np.asarray(x)[0, idx])
+
+
+write_ef_first_stage_solution = first_stage_nonant_npy_serializer
+
+
+def option_string_to_dict(option_string):
+    """Parse 'key=val key2=val2' solver-option strings (sputils surface)."""
+    if not option_string:
+        return None
+    out = {}
+    for tok in option_string.split():
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            try:
+                out[k] = int(v)
+            except ValueError:
+                try:
+                    out[k] = float(v)
+                except ValueError:
+                    out[k] = v
+        else:
+            out[tok] = True
+    return out
