@@ -1,0 +1,44 @@
+"""Farmer with cross-scenario cuts (reference: examples/farmer/cs_farmer.py):
+a CrossScenarioHub whose spoke solves per-scenario relaxations to generate
+optimality cuts that steer the hub's subproblems and feed a cutting-plane
+outer bound.  Example::
+
+    python cs_farmer.py --num-scens 3 --max-iterations 30 \
+        --default-rho 1.0 --rel-gap 0.005 --xhatshuffle
+"""
+
+import sys
+
+from tpusppy.models import farmer
+from tpusppy.spin_the_wheel import WheelSpinner
+from tpusppy.utils import cfg_vanilla as vanilla
+from tpusppy.utils.config import Config
+
+
+def main(args=None):
+    cfg = Config()
+    cfg.popular_args()
+    cfg.num_scens_required()
+    cfg.two_sided_args()
+    cfg.ph_args()
+    cfg.cross_scenario_cuts_args()
+    cfg.xhatshuffle_args()
+    cfg.parse_command_line("cs_farmer",
+                           sys.argv[1:] if args is None else args)
+    cfg.cross_scenario_cuts = True
+    names = farmer.scenario_names_creator(cfg.num_scens)
+    kw = {"num_scens": cfg.num_scens}
+    beans = dict(cfg=cfg, scenario_creator=farmer.scenario_creator,
+                 all_scenario_names=names, scenario_creator_kwargs=kw)
+    hub_dict = vanilla.ph_hub(**beans)
+    spokes = [vanilla.cross_scenario_cuts_spoke(**beans)]
+    if cfg.xhatshuffle:
+        spokes.append(vanilla.xhatshuffle_spoke(**beans))
+    ws = WheelSpinner(hub_dict, spokes).spin()
+    print(f"BestInnerBound={ws.BestInnerBound:.4f} "
+          f"BestOuterBound={ws.BestOuterBound:.4f}")
+    return ws
+
+
+if __name__ == "__main__":
+    main()
