@@ -20,8 +20,8 @@ write_solution = True
 
 def _parse_args():
     cfg = config.Config()
-    cfg.multistage()
-    cfg.popular_args()
+    cfg.multistage()   # includes popular_args
+    cfg.num_scens_optional()   # multistage: scenario count = prod(BFs)
     cfg.two_sided_args()
     cfg.ph_args()
     cfg.fwph_args()

@@ -18,8 +18,7 @@ write_solution = True
 
 def _parse_args():
     cfg = config.Config()
-    cfg.multistage()
-    cfg.popular_args()
+    cfg.multistage()   # includes popular_args
     cfg.two_sided_args()
     cfg.ph_args()
     cfg.fwph_args()
