@@ -1,0 +1,33 @@
+"""Scrubbed child environment for the example harnesses.
+
+Same recipe as ``bench.py``'s ``_scrubbed_cpu_env``: the ambient environment
+may carry a sitecustomize on PYTHONPATH that force-registers a remote TPU
+runtime whose tunnel can be down — with it present every driver hangs or dies
+in jax init.  The harnesses therefore run children with AXON*/TPU_* dropped,
+PYTHONPATH replaced (repo root only), and JAX_PLATFORMS pinned to cpu, so
+``run_all.py``/``afew.py`` are green in any shell (reference CI posture:
+``straight.yml`` runs anywhere).
+
+Set ``EXAMPLES_KEEP_ENV=1`` to opt out (e.g. to run the examples on real TPU
+hardware through a known-good ambient env).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def child_env(repo_root: str) -> dict:
+    """Environment for an example-driver child process."""
+    if os.environ.get("EXAMPLES_KEEP_ENV"):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        return env
+    env = {
+        k: v for k, v in os.environ.items()
+        if k != "PYTHONPATH" and "AXON" not in k and not k.startswith("TPU_")
+    }
+    env["PYTHONPATH"] = repo_root
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("JAX_ENABLE_X64", "1")
+    return env

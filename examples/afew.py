@@ -12,6 +12,8 @@ import subprocess
 import sys
 
 EXDIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, EXDIR)
+from _harness_env import child_env  # noqa: E402
 
 RUNS = [
     ("farmer/farmer_ef.py",
@@ -35,10 +37,9 @@ def main():
         path = os.path.join(EXDIR, script)
         cmd = [sys.executable, path] + args
         print("==>", " ".join(cmd), flush=True)
-        # drivers import tpusppy from the repo root regardless of caller cwd
-        env = dict(os.environ)
-        root = os.path.dirname(EXDIR)
-        env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        # scrubbed env: repo root on PYTHONPATH, broken-TPU-plugin vars
+        # dropped, cpu pinned (EXAMPLES_KEEP_ENV=1 opts out)
+        env = child_env(os.path.dirname(EXDIR))
         res = subprocess.run(cmd, cwd=os.path.dirname(path), env=env)
         if res.returncode != 0:
             badguys.append(script)

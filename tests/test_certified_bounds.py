@@ -109,3 +109,37 @@ def test_straggler_rescue_repairs_residuals():
     bound = opt.Edualbound()
     assert bound <= ef_obj + 1e-6 * abs(ef_obj)
     assert bound == pytest.approx(_wait_and_see(batch), rel=1e-5)
+
+
+def test_straggler_rescue_repairs_qp_stall():
+    """QP (prox-on) stragglers get the same host-exact rescue as LPs: a
+    starved batch solve with q2 != 0 must come back with residuals under
+    tolerance and per-scenario optima matching an accurate host QP solve
+    (this used to warn 'stalled QP scenario(s) not rescued')."""
+    from tpusppy.solvers.scipy_backend import solve_qp_with_duals
+
+    n = 5
+    kw = {"num_gens": 3, "horizon": 6, "num_scens": n, "relax_integers": False}
+    names = uc_lite.scenario_names_creator(n)
+    opt = SPOpt({"solver_options": {"eps_abs": 1e-8, "eps_rel": 1e-8,
+                                    "max_iter": 8, "restarts": 1},
+                 "straggler_tol": 1e-6},
+                names, uc_lite.scenario_creator,
+                scenario_creator_kwargs=kw)
+    batch = opt.batch
+    # a prox-style diagonal Hessian on the nonant coordinates
+    q2 = np.zeros((n, batch.num_vars))
+    q2[:, batch.tree.nonant_indices] = 2.0
+    rng = np.random.default_rng(7)
+    q = batch.c + 0.1 * rng.normal(size=(n, batch.num_vars))
+    opt.solve_loop(q=q, q2=q2)
+    # the starved batch cannot have converged on its own everywhere; the
+    # rescue must have cleared every scenario
+    assert opt.pri_res.max() < 1e-6
+    assert opt.dua_res.max() < 1e-6
+    for s in range(n):
+        ref = solve_qp_with_duals(q[s], q2[s], batch.A[s], batch.cl[s],
+                                  batch.cu[s], batch.lb[s], batch.ub[s])
+        obj_s = (q[s] @ opt.local_x[s]
+                 + 0.5 * q2[s] @ (opt.local_x[s] ** 2))
+        assert obj_s == pytest.approx(ref.obj, rel=1e-6, abs=1e-6)

@@ -23,7 +23,8 @@ def test_tcp_fabric_raw_semantics():
     from tpusppy.runtime.tcp_window_service import TcpWindowFabric
 
     fab = TcpWindowFabric(spoke_lengths=[(4, 3)])
-    cli = TcpWindowFabric(connect=("127.0.0.1", fab.port))
+    cli = TcpWindowFabric(connect=("127.0.0.1", fab.port),
+                          secret=fab.secret)
     try:
         assert cli.n_spokes == 1
         assert cli.to_spoke[1].length == 4
@@ -47,6 +48,44 @@ def test_tcp_fabric_raw_semantics():
         assert cli.to_hub[1].put(np.ones(3)) == 2       # reverse box alive
     finally:
         cli.close()
+        fab.close()
+
+
+def test_tcp_fabric_security():
+    """Hardened service semantics: wrong/missing shared secret is refused,
+    oversized requests can't allocate attacker-sized scratch (connection
+    dropped), and out-of-range boxes on the hub-local handle report errors
+    instead of UB."""
+    import ctypes
+    import socket
+    import struct
+
+    from tpusppy.runtime.tcp_window_service import (TcpEndpoint,
+                                                    TcpWindowFabric,
+                                                    load_library)
+
+    fab = TcpWindowFabric(spoke_lengths=[(4, 3)])
+    try:
+        # wrong secret: immediate refusal (no retry loop)
+        with pytest.raises(RuntimeError):
+            TcpEndpoint(connect=("127.0.0.1", fab.port),
+                        secret=(fab.secret ^ 1), connect_timeout=0.0)
+        # raw socket, correct hello, then a PUT with n far beyond the
+        # largest configured box: server hangs up without allocating
+        s = socket.create_connection(("127.0.0.1", fab.port), timeout=5)
+        s.sendall(struct.pack("<QQ", 0x7470757370707931, fab.secret))
+        assert struct.unpack("<q", s.recv(8))[0] == 0       # hello ack
+        s.sendall(struct.pack("<B3xiq", 1, 0, 1 << 30))     # huge PUT
+        assert s.recv(8) == b""                             # closed
+        s.close()
+        # hub-local handle: out-of-range box -> length-error, not UB
+        lib = load_library()
+        buf = (ctypes.c_double * 4)()
+        assert lib.tws_write_id(fab.ep._handle, 99) == -2
+        assert lib.tws_kill(fab.ep._handle, -1) == -2
+        assert lib.tws_put(fab.ep._handle, 99, buf, 4) == -2
+        assert lib.tws_get(fab.ep._handle, 99, buf, 4) == -2
+    finally:
         fab.close()
 
 

@@ -11,11 +11,17 @@
 // snapshots (mutex per box here; seqlock in shm).
 //
 // Protocol (little-endian, one request in flight per connection):
+//   hello    { u64 magic; u64 secret; } -> { i64 0 } ack, or closed on
+//            mismatch (shared-secret handshake; the hub hands the secret to
+//            its spokes out-of-band, e.g. on the spawn command line)
 //   request  { u8 op; u8 pad[3]; i32 box; i64 n; }   [+ n doubles for PUT]
 //   reply    { i64 id; }                              [+ n doubles for GET]
 //   ops: 1=PUT 2=GET 3=WRITE_ID 4=KILL 5=INFO
 //   INFO reply: id = n_boxes, followed by n_boxes i64 lengths.
 //   id == -2 signals a length mismatch (no payload follows).
+// Requests with n above the largest configured box length close the
+// connection (no attacker-sized scratch allocations).  The server binds
+// 127.0.0.1 unless an explicit bind address is supplied.
 //
 // C ABI mirrors ws_*: tws_serve / tws_connect / tws_put / tws_get /
 // tws_write_id / tws_kill / tws_port / tws_num_boxes / tws_length /
@@ -29,10 +35,13 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <sys/time.h>
+
 #include <atomic>
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
+#include <memory>
 #include <mutex>
 #include <thread>
 #include <vector>
@@ -41,6 +50,7 @@ namespace {
 
 constexpr int64_t kKillId = -1;
 constexpr int64_t kLenErr = -2;
+constexpr uint64_t kMagic = 0x7470757370707931ULL;  // "tpusppy1"
 
 struct Request {
   uint8_t op;
@@ -49,10 +59,21 @@ struct Request {
   int64_t n;
 };
 
+struct Hello {
+  uint64_t magic;
+  uint64_t secret;
+};
+
 struct Box {
   std::mutex mu;
   int64_t write_id = 0;
   std::vector<double> payload;
+};
+
+struct Conn {
+  std::thread th;
+  int fd = -1;
+  std::atomic<bool> done{false};
 };
 
 struct Server {
@@ -61,9 +82,12 @@ struct Server {
   std::atomic<bool> stop{false};
   std::thread accept_thread;
   std::mutex conn_mu;
-  std::vector<std::thread> conn_threads;
-  std::vector<int> conn_fds;  // for shutdown() at close
+  // finished connections (rejected handshakes, disconnected spokes) are
+  // reaped on the next accept, so hostile probing cannot grow this
+  std::vector<std::unique_ptr<Conn>> conns;
   std::vector<Box> boxes;
+  uint64_t secret = 0;
+  int64_t max_len = 0;  // largest configured box; caps request n
 };
 
 struct Handle {
@@ -115,9 +139,29 @@ int64_t local_get(Box& b, double* out, int64_t n) {
   return b.write_id;
 }
 
-void serve_connection(Server* s, int fd) {
+void serve_connection(Server* s, Conn* conn) {
+  const int fd = conn->fd;
+  struct MarkDone {
+    Conn* c;
+    ~MarkDone() { c->done.store(true, std::memory_order_release); }
+  } mark{conn};
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // shared-secret handshake before any request is honored; the hello read
+  // is time-bounded so a half-open probe cannot pin this thread (and its
+  // Conn slot) forever — after the timeout the reap loop frees it
+  timeval tv{10, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  Hello hello{};
+  if (!read_full(fd, &hello, sizeof(hello)) || hello.magic != kMagic ||
+      hello.secret != s->secret) {
+    close(fd);
+    return;
+  }
+  int64_t ack = 0;
+  if (!write_full(fd, &ack, sizeof(ack))) { close(fd); return; }
+  timeval off{0, 0};  // authenticated: back to blocking reads
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &off, sizeof(off));
   std::vector<double> scratch;
   Request req;
   while (!s->stop.load(std::memory_order_relaxed)) {
@@ -127,7 +171,7 @@ void serve_connection(Server* s, int fd) {
     int64_t id = kLenErr;
     switch (req.op) {
       case 1: {  // PUT: payload follows regardless; must be drained
-        if (req.n < 0 || req.n > (int64_t(1) << 32)) { close(fd); return; }
+        if (req.n < 0 || req.n > s->max_len) { close(fd); return; }
         scratch.resize(static_cast<size_t>(req.n));
         if (!read_full(fd, scratch.data(), req.n * sizeof(double))) {
           close(fd);
@@ -138,7 +182,7 @@ void serve_connection(Server* s, int fd) {
         break;
       }
       case 2: {  // GET
-        if (req.n < 0 || req.n > (int64_t(1) << 32)) { close(fd); return; }
+        if (req.n < 0 || req.n > s->max_len) { close(fd); return; }
         scratch.resize(box_ok ? static_cast<size_t>(req.n) : 0);
         if (box_ok) id = local_get(s->boxes[req.box], scratch.data(), req.n);
         if (!write_full(fd, &id, sizeof(id))) { close(fd); return; }
@@ -197,8 +241,19 @@ void accept_loop(Server* s) {
       return;  // listener closed
     }
     std::lock_guard<std::mutex> lock(s->conn_mu);
-    s->conn_fds.push_back(fd);
-    s->conn_threads.emplace_back(serve_connection, s, fd);
+    // reap finished connections before tracking the new one
+    for (auto it = s->conns.begin(); it != s->conns.end();) {
+      if ((*it)->done.load(std::memory_order_acquire)) {
+        if ((*it)->th.joinable()) (*it)->th.join();
+        it = s->conns.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    auto conn = std::make_unique<Conn>();
+    conn->fd = fd;
+    conn->th = std::thread(serve_connection, s, conn.get());
+    s->conns.push_back(std::move(conn));
   }
 }
 
@@ -207,15 +262,23 @@ void accept_loop(Server* s) {
 extern "C" {
 
 // Start a box server on `port` (0 = kernel-assigned; read back via
-// tws_port).  Binds 0.0.0.0 so spokes on other hosts can connect.
-void* tws_serve(int port, int n_boxes, const int64_t* lengths) {
+// tws_port).  Binds `bind_addr` — 127.0.0.1 when null/empty; pass
+// "0.0.0.0" EXPLICITLY to accept spokes from other hosts (the handshake
+// secret still gates every connection).
+void* tws_serve(const char* bind_addr, int port, int n_boxes,
+                const int64_t* lengths, uint64_t secret) {
   int fd = socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return nullptr;
   int one = 1;
   setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  const char* baddr =
+      (bind_addr && bind_addr[0]) ? bind_addr : "127.0.0.1";
+  if (inet_pton(AF_INET, baddr, &addr.sin_addr) != 1) {
+    close(fd);
+    return nullptr;
+  }
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
       listen(fd, 64) != 0) {
@@ -228,9 +291,12 @@ void* tws_serve(int port, int n_boxes, const int64_t* lengths) {
   auto* s = new Server();
   s->listen_fd = fd;
   s->port = ntohs(addr.sin_port);
+  s->secret = secret;
   s->boxes = std::vector<Box>(static_cast<size_t>(n_boxes));
-  for (int i = 0; i < n_boxes; ++i)
+  for (int i = 0; i < n_boxes; ++i) {
     s->boxes[i].payload.assign(static_cast<size_t>(lengths[i]), 0.0);
+    if (lengths[i] > s->max_len) s->max_len = lengths[i];
+  }
   s->accept_thread = std::thread(accept_loop, s);
   auto* h = new Handle();
   h->server = s;
@@ -238,8 +304,10 @@ void* tws_serve(int port, int n_boxes, const int64_t* lengths) {
 }
 
 // Connect to a server, retrying for up to timeout_ms (spokes may start
-// before the hub finishes binding).
-void* tws_connect(const char* host, int port, int64_t timeout_ms) {
+// before the hub finishes binding).  Sends the shared-secret hello and
+// waits for the ack; a secret mismatch fails immediately (server closes).
+void* tws_connect(const char* host, int port, int64_t timeout_ms,
+                  uint64_t secret) {
   addrinfo hints{};
   hints.ai_family = AF_INET;
   hints.ai_socktype = SOCK_STREAM;
@@ -255,6 +323,24 @@ void* tws_connect(const char* host, int port, int64_t timeout_ms) {
         freeaddrinfo(res);
         int one = 1;
         setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        // the handshake itself is bounded by the remaining budget (a
+        // non-tpusppy listener would otherwise hang the ack read forever)
+        int64_t left = timeout_ms - waited;
+        if (left < 1000) left = 1000;
+        timeval tv{static_cast<time_t>(left / 1000),
+                   static_cast<suseconds_t>((left % 1000) * 1000)};
+        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+        setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+        Hello hello{kMagic, secret};
+        int64_t ack = -1;
+        if (!write_full(fd, &hello, sizeof(hello)) ||
+            !read_full(fd, &ack, sizeof(ack)) || ack != 0) {
+          close(fd);
+          return nullptr;  // bad secret / not our service; don't retry
+        }
+        timeval off{0, 0};  // back to blocking for normal operation
+        setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &off, sizeof(off));
+        setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &off, sizeof(off));
         auto* h = new Handle();
         h->sock = fd;
         return h;
@@ -328,21 +414,34 @@ int64_t tws_length(void* handle, int box) {
   return lens[static_cast<size_t>(box)];
 }
 
+// The hub-local (server-handle) branches apply the same box-range check as
+// the socket path (box_ok): out-of-range ids report kLenErr, never UB.
+static bool server_box_ok(const Server* s, int box) {
+  return box >= 0 && box < static_cast<int>(s->boxes.size());
+}
+
 int64_t tws_put(void* handle, int box, const double* values, int64_t n) {
   auto* h = static_cast<Handle*>(handle);
-  if (h->server) return local_put(h->server->boxes[box], values, n);
+  if (h->server) {
+    if (!server_box_ok(h->server, box)) return kLenErr;
+    return local_put(h->server->boxes[box], values, n);
+  }
   return request_reply(h, 1, box, n, values, nullptr);
 }
 
 int64_t tws_get(void* handle, int box, double* out, int64_t n) {
   auto* h = static_cast<Handle*>(handle);
-  if (h->server) return local_get(h->server->boxes[box], out, n);
+  if (h->server) {
+    if (!server_box_ok(h->server, box)) return kLenErr;
+    return local_get(h->server->boxes[box], out, n);
+  }
   return request_reply(h, 2, box, n, nullptr, out);
 }
 
 int64_t tws_write_id(void* handle, int box) {
   auto* h = static_cast<Handle*>(handle);
   if (h->server) {
+    if (!server_box_ok(h->server, box)) return kLenErr;
     std::lock_guard<std::mutex> lock(h->server->boxes[box].mu);
     return h->server->boxes[box].write_id;
   }
@@ -352,6 +451,7 @@ int64_t tws_write_id(void* handle, int box) {
 int64_t tws_kill(void* handle, int box) {
   auto* h = static_cast<Handle*>(handle);
   if (h->server) {
+    if (!server_box_ok(h->server, box)) return kLenErr;
     std::lock_guard<std::mutex> lock(h->server->boxes[box].mu);
     h->server->boxes[box].write_id = kKillId;
     return kKillId;
@@ -371,10 +471,10 @@ void tws_close(void* handle) {
       // unblock every handler (recv returns 0 after shutdown), then JOIN:
       // detaching would let a late request dereference the freed Server
       std::lock_guard<std::mutex> lock(s->conn_mu);
-      for (int fd : s->conn_fds) shutdown(fd, SHUT_RDWR);
+      for (auto& c : s->conns) shutdown(c->fd, SHUT_RDWR);
     }
-    for (auto& t : s->conn_threads)
-      if (t.joinable()) t.join();
+    for (auto& c : s->conns)
+      if (c->th.joinable()) c->th.join();
     delete s;
   } else if (h->sock >= 0) {
     close(h->sock);

@@ -8,9 +8,12 @@ in-memory (its own accesses are local, mutex-guarded, no sockets); every
 spoke — local or remote — connects by ``host:port``.
 
 Multi-host launch recipe (see doc/multihost.md):
-  hub host:   fabric = TcpWindowFabric(spoke_lengths=[...], port=7077)
+  hub host:   fabric = TcpWindowFabric(spoke_lengths=[...], port=7077,
+                                       bind="0.0.0.0")  # default is loopback
               ... WheelSpinner hub side with this fabric ...
-  spoke host: fabric = TcpWindowFabric(connect=("hub-host", 7077))
+              # hand (host, port, fabric.secret) to the spoke launchers
+  spoke host: fabric = TcpWindowFabric(connect=("hub-host", 7077),
+                                       secret=<hub's fabric.secret>)
               ... build the spoke opt + comm, comm.main() ...
 ``MultiprocessWheelSpinner(..., fabric="tcp")`` drives the same path with
 spawned local processes (the single-host degenerate case and the CI test).
@@ -51,11 +54,12 @@ def load_library() -> ctypes.CDLL:
             )
         lib = ctypes.CDLL(_LIB_PATH)
         lib.tws_serve.restype = ctypes.c_void_p
-        lib.tws_serve.argtypes = [ctypes.c_int, ctypes.c_int,
-                                  ctypes.POINTER(ctypes.c_int64)]
+        lib.tws_serve.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                                  ctypes.POINTER(ctypes.c_int64),
+                                  ctypes.c_uint64]
         lib.tws_connect.restype = ctypes.c_void_p
         lib.tws_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
-                                    ctypes.c_int64]
+                                    ctypes.c_int64, ctypes.c_uint64]
         lib.tws_port.restype = ctypes.c_int
         lib.tws_port.argtypes = [ctypes.c_void_p]
         for fn, argt in [
@@ -80,25 +84,43 @@ def load_library() -> ctypes.CDLL:
 
 
 class TcpEndpoint:
-    """A server (hub) or client (spoke) handle over the box set."""
+    """A server (hub) or client (spoke) handle over the box set.
+
+    The server binds 127.0.0.1 by default; pass ``bind="0.0.0.0"`` (or a
+    specific interface) to accept spokes from other hosts.  Every
+    connection must present the server's ``secret`` (a random 64-bit token
+    generated here unless supplied) — hand it to remote spoke launchers
+    out-of-band along with host:port."""
 
     def __init__(self, lengths=None, port: int = 0, connect=None,
-                 connect_timeout: float = 60.0):
+                 connect_timeout: float = 60.0, bind: str = "127.0.0.1",
+                 secret: int | None = None):
         self._lib = load_library()
         if connect is not None:
             host, prt = connect
+            self.secret = int(secret or 0)
             handle = self._lib.tws_connect(
-                str(host).encode(), int(prt), int(connect_timeout * 1000))
+                str(host).encode(), int(prt), int(connect_timeout * 1000),
+                ctypes.c_uint64(self.secret))
             if not handle:
                 raise RuntimeError(
-                    f"cannot connect to window service at {host}:{prt}")
+                    f"cannot connect to window service at {host}:{prt} "
+                    f"(down, or shared secret rejected)")
             self.port = int(prt)
             self.is_server = False
         else:
+            if secret is None:
+                import secrets as _secrets
+
+                secret = _secrets.randbits(64)
+            self.secret = int(secret)
             arr = (ctypes.c_int64 * len(lengths))(*[int(x) for x in lengths])
-            handle = self._lib.tws_serve(int(port), len(lengths), arr)
+            handle = self._lib.tws_serve(
+                str(bind).encode(), int(port), len(lengths), arr,
+                ctypes.c_uint64(self.secret))
             if not handle:
-                raise RuntimeError(f"cannot serve window service on :{port}")
+                raise RuntimeError(f"cannot serve window service on "
+                                   f"{bind}:{port}")
             self.is_server = True
             self._handle = ctypes.c_void_p(handle)
             self.port = int(self._lib.tws_port(self._handle))
@@ -179,23 +201,30 @@ class TcpWindowFabric:
     """WindowFabric API over TCP: 2 boxes per spoke (hub->spoke, spoke->hub).
 
     Hub side: ``TcpWindowFabric(spoke_lengths=[(h2s, s2h), ...], port=0)``
-    (port 0 = kernel-assigned; read ``fabric.port``).  Spoke side (any
-    host): ``TcpWindowFabric(connect=(host, port))``.
+    (port 0 = kernel-assigned; read ``fabric.port``; loopback bind by
+    default — pass ``bind="0.0.0.0"`` for cross-host wheels).  Spoke side
+    (any host): ``TcpWindowFabric(connect=(host, port),
+    secret=<hub fabric.secret>)`` — the handshake rejects missing/wrong
+    secrets.
     """
 
     def __init__(self, spoke_lengths=None, port: int = 0, connect=None,
-                 connect_timeout: float = 60.0):
+                 connect_timeout: float = 60.0, bind: str = "127.0.0.1",
+                 secret: int | None = None):
         if connect is not None:
             self.ep = TcpEndpoint(connect=connect,
-                                  connect_timeout=connect_timeout)
+                                  connect_timeout=connect_timeout,
+                                  secret=secret)
             n = self.ep.num_boxes // 2
         else:
             lengths = []
             for (h2s, s2h) in spoke_lengths:
                 lengths.extend([h2s, s2h])
-            self.ep = TcpEndpoint(lengths=lengths, port=port)
+            self.ep = TcpEndpoint(lengths=lengths, port=port, bind=bind,
+                                  secret=secret)
             n = len(spoke_lengths)
         self.port = self.ep.port
+        self.secret = self.ep.secret
         self.to_spoke = {}
         self.to_hub = {}
         for i in range(1, n + 1):

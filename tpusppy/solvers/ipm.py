@@ -62,6 +62,21 @@ class IPMResult(NamedTuple):
 
 
 def _prep(batch, dt):
+    # The condensed-KKT algebra below uses per-scenario row scalings and
+    # (S, n, n) factorizations, so a shared-A batch is DENSIFIED here to
+    # (S, m, n).  That silently defeats the shared-A memory savings at
+    # scale, so refuse loudly rather than OOM: SchurComplement is for
+    # small-to-medium batches; large shared-A families belong on the
+    # shared-ADMM PH/Lagrangian path (solvers/shared_admm.py).
+    if batch.A_shared is not None:
+        S = batch.num_scenarios
+        m, n = batch.A_shared.shape
+        gib = S * m * n * np.dtype(dt).itemsize / 2**30
+        if gib > 2.0:
+            raise ValueError(
+                f"solve_sc would densify this shared-A batch to "
+                f"(S={S}, m={m}, n={n}) = {gib:.1f} GiB; use the "
+                f"shared-A ADMM path (SPOpt/PH) for families this large")
     A = jnp.asarray(np.asarray(batch.A), dt)
     c = jnp.asarray(batch.c, dt)
     q2 = jnp.asarray(batch.q2, dt)

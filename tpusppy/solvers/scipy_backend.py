@@ -90,6 +90,151 @@ def solve_lp_with_duals(c, A, cl, cu, lb, ub, const=0.0) -> SolveResult:
                        duals=duals, status=str(res.status), feasible=res.status == 0)
 
 
+def solve_qp_with_duals(c, q2, A, cl, cu, lb, ub, const=0.0,
+                        tol=1e-9, max_iter=60) -> SolveResult:
+    """Host-exact diagonal-Hessian QP with row duals: the QP sibling of
+    :func:`solve_lp_with_duals` for the straggler rescue (scipy's HiGHS
+    wrapper is LP/MILP only, so this is a self-contained dense Mehrotra
+    predictor-corrector IPM in numpy).
+
+        min c.x + 0.5 x'diag(q2)x   s.t. cl <= Ax <= cu, lb <= x <= ub
+
+    Returns x and row duals y in the framework's convention (y > 0 active
+    at cu, y < 0 at cl — the convention :func:`tpusppy.solvers.admm.
+    dual_objective` certifies bounds with).  Sizes here are one scenario
+    (n, m in the hundreds-to-thousands): a dense (n, n) Cholesky per
+    iteration is microseconds-to-milliseconds, and the rescue calls this
+    for a handful of scenarios once per refresh.  Reference analogue:
+    subproblem solves are always solver-exact (mpisppy/spopt.py:85-223).
+    """
+    c = np.asarray(c, float)
+    q2 = np.asarray(q2, float)
+    A = np.asarray(A, float)
+    m, n = A.shape
+    big = 1e18
+    cl = np.where(np.isfinite(cl), np.asarray(cl, float), -big)
+    cu = np.where(np.isfinite(cu), np.asarray(cu, float), big)
+    lb = np.where(np.isfinite(lb), np.asarray(lb, float), -big)
+    ub = np.where(np.isfinite(ub), np.asarray(ub, float), big)
+    eq = cu - cl < 1e-9
+    fzL = (cl > -big / 2) & ~eq
+    fzU = (cu < big / 2) & ~eq
+    fxL = lb > -big / 2
+    fxU = ub < big / 2
+
+    scale = max(1.0, np.abs(c).max(initial=0.0), np.abs(q2).max(initial=0.0))
+
+    def interior(v, lo, hi, finL, finU):
+        mid = np.where(finL & finU, 0.5 * (lo + hi), v)
+        v = np.where(finL & finU, mid, v)
+        v = np.where(finL & ~finU, np.maximum(v, lo + 1.0), v)
+        v = np.where(~finL & finU, np.minimum(v, hi - 1.0), v)
+        return v
+
+    x = interior(np.zeros(n), lb, ub, fxL, fxU)
+    z = interior(A @ x, cl, cu, fzL, fzU)
+    z = np.where(eq, cl, z)
+    y = np.zeros(m)
+    sL = np.where(fzL, 1.0, 0.0)
+    sU = np.where(fzU, 1.0, 0.0)
+    piL = np.where(fxL, 1.0, 0.0)
+    piU = np.where(fxU, 1.0, 0.0)
+    delta_eq = 1e9              # fixed equality-row dual regularization
+
+    def gaps():
+        gL = np.where(fzL, np.maximum(z - cl, 1e-14), 1.0)
+        gU = np.where(fzU, np.maximum(cu - z, 1e-14), 1.0)
+        hL = np.where(fxL, np.maximum(x - lb, 1e-14), 1.0)
+        hU = np.where(fxU, np.maximum(ub - x, 1e-14), 1.0)
+        return gL, gU, hL, hU
+
+    n_compl = int(fzL.sum() + fzU.sum() + fxL.sum() + fxU.sum())
+    res = mu = np.inf
+    for _ in range(max_iter):
+        gL, gU, hL, hU = gaps()
+        rd = -(c + q2 * x + A.T @ y - piL + piU)
+        rp = -(A @ x - z)
+        ry = -(y - sU + sL)
+        mu = ((sL @ np.where(fzL, gL, 0.0) + sU @ np.where(fzU, gU, 0.0)
+               + piL @ np.where(fxL, hL, 0.0)
+               + piU @ np.where(fxU, hU, 0.0)) / max(n_compl, 1))
+        res = max(np.abs(rd).max(initial=0.0) / scale,
+                  np.abs(rp).max(initial=0.0),
+                  np.abs(np.where(eq, 0.0, ry)).max(initial=0.0))
+        if res < tol and mu < tol:
+            break
+
+        Dz = np.where(eq, delta_eq, sL / gL * fzL + sU / gU * fzU)
+        Dx = piL / hL * fxL + piU / hU * fxU
+        H = (A.T * Dz) @ A
+        H[np.diag_indices(n)] += q2 + Dx + 1e-11 * scale
+
+        def newton(mu_t, dsL0, dsU0, dpiL0, dpiU0, dz0, dx0):
+            # complementarity rhs with optional Mehrotra second-order terms
+            cL = mu_t - sL * gL * fzL - dsL0 * dz0 * fzL
+            cU = mu_t - sU * gU * fzU + dsU0 * dz0 * fzU
+            bL = mu_t - piL * hL * fxL - dpiL0 * dx0 * fxL
+            bU = mu_t - piU * hU * fxU + dpiU0 * dx0 * fxU
+            rhs_y = np.where(
+                eq, 0.0,
+                ry + np.where(fzU, cU / gU, 0.0) - np.where(fzL, cL / gL, 0.0))
+            rhs_x = rd + np.where(fxL, bL / hL, 0.0) - np.where(fxU, bU / hU, 0.0)
+            rhs = rhs_x + A.T @ (Dz * rp - rhs_y)
+            try:
+                L = np.linalg.cholesky(H)
+                dx = np.linalg.solve(L.T, np.linalg.solve(L, rhs))
+            except np.linalg.LinAlgError:
+                dx = np.linalg.lstsq(H, rhs, rcond=None)[0]
+            dy = Dz * (A @ dx - rp) + rhs_y
+            dz = np.where(eq, 0.0, A @ dx - rp)
+            dsL = np.where(fzL, (cL - sL * dz) / gL, 0.0)
+            dsU = np.where(fzU, (cU + sU * dz) / gU, 0.0)
+            dpiL = np.where(fxL, (bL - piL * dx) / hL, 0.0)
+            dpiU = np.where(fxU, (bU + piU * dx) / hU, 0.0)
+            return dx, dz, dy, dsL, dsU, dpiL, dpiU
+
+        def steplen(dz, dx, dsL, dsU, dpiL, dpiU):
+            def ratio(v, dv, mask):
+                r = np.where(mask & (dv < 0), -v / np.where(dv < 0, dv, -1.0),
+                             np.inf)
+                return r.min(initial=np.inf)
+            ap = min(ratio(gL, dz, fzL), ratio(gU, -dz, fzU),
+                     ratio(hL, dx, fxL), ratio(hU, -dx, fxU))
+            ad = min(ratio(sL, dsL, fzL), ratio(sU, dsU, fzU),
+                     ratio(piL, dpiL, fxL), ratio(piU, dpiU, fxU))
+            return min(1.0, 0.995 * ap), min(1.0, 0.995 * ad)
+
+        dx_a, dz_a, dy_a, dsL_a, dsU_a, dpiL_a, dpiU_a = newton(
+            0.0, 0.0 * sL, 0.0 * sU, 0.0 * piL, 0.0 * piU, 0.0 * z, 0.0 * x)
+        ap_a, ad_a = steplen(dz_a, dx_a, dsL_a, dsU_a, dpiL_a, dpiU_a)
+        mu_aff = (((sL + ad_a * dsL_a) @ np.where(fzL, gL + ap_a * dz_a, 0.0))
+                  + ((sU + ad_a * dsU_a) @ np.where(fzU, gU - ap_a * dz_a, 0.0))
+                  + ((piL + ad_a * dpiL_a) @ np.where(fxL, hL + ap_a * dx_a, 0.0))
+                  + ((piU + ad_a * dpiU_a) @ np.where(fxU, hU - ap_a * dx_a, 0.0))
+                  ) / max(n_compl, 1)
+        sigma = min(1.0, max(0.0, (mu_aff / max(mu, 1e-300)))) ** 3
+        dx, dz, dy, dsL, dsU, dpiL, dpiU = newton(
+            sigma * mu, dsL_a, dsU_a, dpiL_a, dpiU_a, dz_a, dx_a)
+        ap, ad = steplen(dz, dx, dsL, dsU, dpiL, dpiU)
+        x = x + ap * dx
+        z = np.where(eq, cl, z + ap * dz)
+        y = y + ad * dy
+        sL = np.where(fzL, sL + ad * dsL, 0.0)
+        sU = np.where(fzU, sU + ad * dsU, 0.0)
+        piL = np.where(fxL, piL + ad * dpiL, 0.0)
+        piU = np.where(fxU, piU + ad * dpiU, 0.0)
+
+    # optimal means KKT residuals AND complementarity both small — a
+    # max_iter exit with small residuals but mu ~ 1e-3 is NOT a valid
+    # rescue (x/y would be installed as exact while O(mu) off-optimal)
+    feasible = bool(res < max(1e3 * tol, 1e-6)
+                    and mu < max(1e3 * tol, 1e-6))
+    obj = float(c @ x + 0.5 * (q2 @ (x * x)) + const)
+    return SolveResult(x=x, obj=obj if feasible else np.inf,
+                       duals=y, status=f"ipm_res={res:.2e}_mu={mu:.2e}",
+                       feasible=feasible)
+
+
 def solve_batch(batch, mip=True, **kw):
     """Solve every scenario of a ScenarioBatch independently (validation path)."""
     out = []

@@ -213,9 +213,10 @@ def _spoke_worker(fabric_spec, spoke_dict, strata_rank):
     """Child-process entry: attach the window fabric, build this cylinder's
     opt, run its main loop (the per-rank role dispatch of
     spin_the_wheel.py:92-127, as an OS process instead of an MPI rank).
-    ``fabric_spec`` is ("shm", name) or ("tcp", host, port, tag) — the
-    latter is exactly what a REMOTE host's spoke launcher passes
-    (doc/multihost.md; ``tag`` names the readiness sentinel file).
+    ``fabric_spec`` is ("shm", name) or ("tcp", host, port, tag, secret) —
+    the latter is exactly what a REMOTE host's spoke launcher passes
+    (doc/multihost.md; ``tag`` names the readiness sentinel file and
+    ``secret`` is the hub fabric's shared handshake token).
     A sentinel file marks construction-readiness for the parent's
     first-contact barrier (waiting for a bound Put instead would deadlock:
     xhat-style spokes publish only AFTER receiving hub data)."""
@@ -228,8 +229,8 @@ def _spoke_worker(fabric_spec, spoke_dict, strata_rank):
     else:
         from .runtime.tcp_window_service import TcpWindowFabric
 
-        _, host, port, tag = fabric_spec
-        fabric = TcpWindowFabric(connect=(host, port))
+        _, host, port, tag, secret = fabric_spec
+        fabric = TcpWindowFabric(connect=(host, port), secret=secret)
     opt = spoke_dict["opt_class"](**spoke_dict["opt_kwargs"])
     comm = spoke_dict["spoke_class"](
         opt, strata_rank, fabric, **spoke_dict.get("spoke_kwargs", {}))
@@ -293,7 +294,7 @@ class MultiprocessWheelSpinner(WheelSpinner):
             from .runtime.tcp_window_service import TcpWindowFabric
 
             fabric = TcpWindowFabric(spoke_lengths=lengths)
-            spec = ("tcp", "127.0.0.1", fabric.port, tag)
+            spec = ("tcp", "127.0.0.1", fabric.port, tag, fabric.secret)
 
         ctx = mp.get_context("spawn")
         procs = []

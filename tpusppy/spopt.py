@@ -13,9 +13,25 @@ from __future__ import annotations
 
 import numpy as np
 
+import itertools
+
 from . import global_toc
 from .spbase import SPBase
 from .solvers import admm
+
+_BATCH_TOKENS = itertools.count(1)
+
+
+def _batch_token(b):
+    """Monotone identity token for cache keys: unlike ``id()`` it is never
+    reused after the batch is collected, and unlike the object itself it is
+    safely ``==``-comparable inside key tuples (dataclass ``__eq__`` on
+    numpy fields raises)."""
+    tok = getattr(b, "_sig_token", None)
+    if tok is None:
+        tok = next(_BATCH_TOKENS)
+        b._sig_token = tok
+    return tok
 
 
 def _np_dual_objective(q, A, cl, cu, lb, ub, y, x_hint, margin_scale=100.0):
@@ -142,7 +158,10 @@ class SPOpt(SPBase):
         import jax.numpy as jnp
 
         b = self.batch
-        key = (getattr(b, "version", 0), str(dt))
+        # the batch token in the key: version numbers can collide across
+        # DIFFERENT batch objects (e.g. sub-batches temporarily installed
+        # by _fix_and_solve_bucketed, all at version 0)
+        key = (_batch_token(b), getattr(b, "version", 0), str(dt))
         cached = getattr(self, "_dev_consts", None)
         if cached is None or cached[0] != key:
             # shared-A batches upload the single (m, n) matrix, not the
@@ -167,6 +186,7 @@ class SPOpt(SPBase):
                 + 2 * (lb > -admm.BIG / 2).astype(np.uint8)
                 + 4 * (ub < admm.BIG / 2).astype(np.uint8))
         return (float(np.sum(np.asarray(q2))), hash(patt.tobytes()),
+                _batch_token(self.batch),
                 getattr(self.batch, "version", 0), self.admm_settings)
 
     # ---- the hot loop -------------------------------------------------------
@@ -297,15 +317,19 @@ class SPOpt(SPBase):
 
     def _rescue_stragglers(self, sol, q, q2, lb, ub, batch=None):
         """Host-exact re-solve of the few scenarios batched ADMM left
-        unconverged (LP scenarios only).
+        unconverged.
 
         Strongly-coupled LPs (UC ramp/genlim rows) occasionally stall a
         handful of scenarios at ~1e-1 residuals regardless of sweep budget.
-        Re-solving that straggler slice through HiGHS — primal AND dual, so
+        Re-solving that straggler slice host-exact — primal AND dual, so
         bounds stay certified — costs milliseconds per scenario once per
-        refresh, while the batch stays the hot path.  The hybrid mirrors the
-        reference's posture: an exact solver where exactness matters
-        (spopt.py:85-223), tensor batching everywhere else.
+        refresh, while the batch stays the hot path.  LP scenarios go
+        through HiGHS; QP scenarios (prox-on PH-hub solves) through the
+        dense Mehrotra IPM (:func:`scipy_backend.solve_qp_with_duals`),
+        whose dual convention is ours, so no sign vote is needed.  The
+        hybrid mirrors the reference's posture: an exact solver where
+        exactness matters (spopt.py:85-223), tensor batching everywhere
+        else.
         """
         if not self.options.get("straggler_rescue", True):
             return sol
@@ -328,23 +352,25 @@ class SPOpt(SPBase):
         pri = pri.copy()
         dua = dua.copy()
         n_resc = 0
-        n_qp_skipped = 0
         for s in bad:
             if np.any(q2[s] != 0.0):
-                # QP scenario (e.g. a prox-on PH-hub solve): scipy has no QP
-                # path, so exact rescue is LP-only — surface the skip rather
-                # than silently leaving a stalled iterate
-                n_qp_skipped += 1
-                continue
-            res = scipy_backend.solve_lp_with_duals(
-                q[s], b.A[s], b.cl[s], b.cu[s], lb[s], ub[s])
-            if not res.feasible or res.duals is None:
-                continue            # genuine infeasibility: leave residuals
-            xs = res.x
-            obj_s = float(q[s] @ xs)
-            ys = _pick_dual_sign(q[s], b.A[s], b.cl[s], b.cu[s],
-                                 lb[s], ub[s], res.duals, xs, obj_s)
-            yxs = -(q[s] + b.A[s].T @ ys)      # stationarity-exact bound duals
+                # QP scenario: dense host IPM; duals are in our convention
+                res = scipy_backend.solve_qp_with_duals(
+                    q[s], q2[s], b.A[s], b.cl[s], b.cu[s], lb[s], ub[s])
+                if not res.feasible or res.duals is None:
+                    continue        # genuine infeasibility: leave residuals
+                xs, ys = res.x, res.duals
+            else:
+                res = scipy_backend.solve_lp_with_duals(
+                    q[s], b.A[s], b.cl[s], b.cu[s], lb[s], ub[s])
+                if not res.feasible or res.duals is None:
+                    continue        # genuine infeasibility: leave residuals
+                xs = res.x
+                obj_s = float(q[s] @ xs)
+                ys = _pick_dual_sign(q[s], b.A[s], b.cl[s], b.cu[s],
+                                     lb[s], ub[s], res.duals, xs, obj_s)
+            # stationarity-exact bound duals
+            yxs = -(q[s] + q2[s] * xs + b.A[s].T @ ys)
             x[s], y[s], yx[s] = xs, ys, yxs
             z[s] = b.A[s] @ xs
             pri[s] = 0.0
@@ -354,11 +380,6 @@ class SPOpt(SPBase):
             global_toc(
                 f"straggler rescue: {n_resc}/{b.num_scenarios} scenarios "
                 "re-solved host-exact", self.options.get("verbose", False))
-        if n_qp_skipped:
-            global_toc(
-                f"WARNING: {n_qp_skipped} stalled QP scenario(s) not "
-                "rescued (LP-only host path); residuals remain above "
-                "tolerance", True)
         return sol._replace(x=x, z=z, y=y, yx=yx, pri_res=pri, dua_res=dua,
                             raw=(x, z, y, yx))
 
@@ -465,7 +486,8 @@ class SPOpt(SPBase):
         import jax.numpy as jnp
 
         b = self.batch
-        key = (getattr(b, "version", 0), str(dt), len(b.buckets))
+        key = (_batch_token(b), getattr(b, "version", 0), str(dt),
+               len(b.buckets))
         cached = getattr(self, "_bucket_dev_consts", None)
         if cached is None or cached[0] != key:
             consts = [(jnp.asarray(sub.A, dt), jnp.asarray(sub.cl, dt),
