@@ -143,3 +143,37 @@ def test_straggler_rescue_repairs_qp_stall():
         obj_s = (q[s] @ opt.local_x[s]
                  + 0.5 * q2[s] @ (opt.local_x[s] ** 2))
         assert obj_s == pytest.approx(ref.obj, rel=1e-6, abs=1e-6)
+
+
+def test_qp_batch_ipm_uc_equality_rows():
+    """The batched host QP IPM must converge on the FULL uc family (120
+    equality logic rows, |c| ~ 1e4, |A| rows ~ 1e3) — the round-3 serial
+    IPM diverged here (res ~ 1e4) because penalized equalities plus an
+    unequilibrated system exceed f64 conditioning.  Pins the augmented-KKT
+    + Ruiz treatment, batch/serial agreement, and constraint feasibility."""
+    from tpusppy.models import uc
+    from tpusppy.solvers.scipy_backend import (solve_qp_batch_with_duals,
+                                               solve_qp_with_duals)
+
+    S = 3
+    kw = {"num_gens": 10, "horizon": 12, "num_scens": S,
+          "relax_integers": False}
+    names = uc.scenario_names_creator(S)
+    batch = ScenarioBatch.from_problems(
+        [uc.scenario_creator(nm, **kw) for nm in names])
+    rng = np.random.default_rng(0)
+    q = np.asarray(batch.c) + 0.05 * rng.normal(size=(S, batch.num_vars))
+    q2 = np.zeros((S, batch.num_vars))
+    q2[:, batch.tree.nonant_indices] = 20.0
+    xb, yb, feas = solve_qp_batch_with_duals(
+        q, q2, batch.A_shared, batch.cl, batch.cu, batch.lb, batch.ub)
+    assert feas.all()
+    for s in range(S):
+        r = solve_qp_with_duals(q[s], q2[s], batch.A[s], batch.cl[s],
+                                batch.cu[s], batch.lb[s], batch.ub[s])
+        assert r.feasible
+        ob_batch = q[s] @ xb[s] + 0.5 * q2[s] @ (xb[s] ** 2)
+        assert ob_batch == pytest.approx(r.obj, rel=1e-6, abs=1e-4)
+        Ax = batch.A[s] @ xb[s]
+        assert (Ax >= batch.cl[s] - 1e-6).all()
+        assert (Ax <= batch.cu[s] + 1e-6).all()
