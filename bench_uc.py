@@ -56,12 +56,15 @@ def uc_metrics():
     platform = jax.devices()[0].platform
     # CPU fallback (tunnel down): degrade scenario count AND problem shape
     # so the fallback artifact lands within its timeout (full shape costs
-    # ~8 min of XLA:CPU compile alone) — flagged in the output
+    # ~8 min of XLA:CPU compile alone) — flagged in the output.  The fleet
+    # stays at 20 gens, NOT fewer: the Lagrangian duality gap of this
+    # family scales like 1/gens (measured ~1.5 % at 10 gens — above the 1 %
+    # certification target no matter how good the W and incumbent are)
     degraded = platform == "cpu" and not os.environ.get("BENCH_UC_SCENS")
-    S = int(os.environ.get("BENCH_UC_SCENS", "64" if degraded else "1000"))
+    S = int(os.environ.get("BENCH_UC_SCENS", "16" if degraded else "1000"))
     gens = int(os.environ.get(
         "BENCH_UC_GENS",
-        str(min(10, default_gens) if degraded else default_gens)))
+        str(min(20, default_gens) if degraded else default_gens)))
     horizon = int(os.environ.get(
         "BENCH_UC_HORIZON",
         str(min(12, default_horizon) if degraded else default_horizon)))
@@ -138,24 +141,45 @@ def uc_metrics():
 
     # ---- metric 2: wall-clock to certified MIP gap (full wheel) ----------
     from tpusppy.cylinders import (
-        LagrangianOuterBound, PHHub, XhatShuffleInnerBound)
+        LagrangianOuterBound, PHHub, SlamMaxHeuristic, XhatRestrictedEF,
+        XhatShuffleInnerBound, XhatXbarInnerBound)
     from tpusppy.opt.ph import PH
     from tpusppy.phbase import PHBase
     from tpusppy.spin_the_wheel import WheelSpinner
     from tpusppy.xhat_eval import Xhat_Eval
 
-    # cold UC batches need the full adaptive budget (per-row rho boosts act
-    # between restarts); warm/frozen iterations terminate early on residuals,
-    # and the straggler-rescue path host-solves whatever still resists
-    so = {"dtype": dtype, "eps_abs": eps, "eps_rel": eps, "max_iter": 1000,
-          "restarts": 6, "scaling_iters": 10, "polish_passes": 1}
+    # trimmed adaptive budget: UC prox/LP batches plateau around 1e-3
+    # primal regardless of sweeps, so a deep budget only burns time — the
+    # rescue-tolerance ladder + host rescue covers the tail, and frozen
+    # iterations accept at the ladder (spopt._solve_amortized)
+    so = {"dtype": dtype, "eps_abs": eps, "eps_rel": eps, "max_iter": 300,
+          "restarts": 3, "scaling_iters": 10, "polish_passes": 1}
+
+    # host-MILP budgets scale with problem size: the degraded CPU shape
+    # solves scenario MIPs in ~0.5-2 s (full lifts + dual ascent are
+    # affordable); the reference 30x24 shape costs 20-120 s per MIP, so
+    # lifts are partial there (still certified — any completed subset is)
+    lift_budget = float(os.environ.get("BENCH_UC_LIFT_S",
+                                       "45" if degraded else "120"))
+    ascent_budget = float(os.environ.get("BENCH_UC_ASCENT_S",
+                                         "90" if degraded else "120"))
 
     def okw(iters=60):
         return {
-            "options": {"defaultPHrho": 20.0, "PHIterLimit": iters,
+            "options": {"defaultPHrho": 500.0, "PHIterLimit": iters,
                         "convthresh": -1.0, "xhat_dive_rounds": 16,
                         "solver_options": so,
-                        "xhat_looper_options": {"scen_limit": 3}},
+                        "xhat_looper_options": {"scen_limit": 3},
+                        "xhat_xbar_options": {
+                            "thresholds": [0.5, 0.4, 0.35, 0.3, 0.25]},
+                        "xhat_ef_options": {"every": 4, "ksub": 6,
+                                            "time_limit": 60.0},
+                        "lagrangian_milp_lift": {"budget_s": lift_budget,
+                                                 "mip_rel_gap": 1e-4,
+                                                 "time_limit": 30.0},
+                        "lagrangian_milp_ascent": {
+                            "steps": 10, "budget_s": ascent_budget,
+                            "mip_rel_gap": 1e-3, "time_limit": 30.0}},
             "all_scenario_names": names,
             "scenario_creator": uc_model.scenario_creator,
             "scenario_creator_kwargs": kw,
@@ -166,12 +190,18 @@ def uc_metrics():
         "hub_kwargs": {"options": {"rel_gap": gap_target}},
         "opt_class": PH,
         "opt_kwargs": okw(int(os.environ.get(
-            "BENCH_UC_PH_ITERS", "8" if degraded else "40"))),
+            "BENCH_UC_PH_ITERS", "40" if degraded else "120"))),
     }
     spokes = [
         {"spoke_class": LagrangianOuterBound, "opt_class": PHBase,
          "opt_kwargs": okw()},
         {"spoke_class": XhatShuffleInnerBound, "opt_class": Xhat_Eval,
+         "opt_kwargs": okw()},
+        {"spoke_class": XhatXbarInnerBound, "opt_class": Xhat_Eval,
+         "opt_kwargs": okw()},
+        {"spoke_class": SlamMaxHeuristic, "opt_class": Xhat_Eval,
+         "opt_kwargs": okw()},
+        {"spoke_class": XhatRestrictedEF, "opt_class": Xhat_Eval,
          "opt_kwargs": okw()},
     ]
     # watchdog: the wheel must never block the bench line (daemon thread +

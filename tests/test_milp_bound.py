@@ -1,0 +1,153 @@
+"""MILP lift + integer dual ascent + consensus-guided incumbents.
+
+The reference's bound spokes inherit a MIP solver, so their Lagrangian
+bounds close integrality (mpisppy/cylinders/lagrangian_bounder.py with a
+persistent MIP solver); these tests pin tpusppy's host-MILP analogues:
+partial lifts are valid at any completed subset, ascent iterates are
+monotone-valid, and the restricted-EF / ladder incumbents are true upper
+bounds.  Ground truth via the HiGHS EF MIP.
+"""
+
+import numpy as np
+import pytest
+
+from tpusppy.ef import build_ef, solve_ef
+from tpusppy.ir import ScenarioBatch
+from tpusppy.models import uc_lite
+from tpusppy.opt.ph import PH
+from tpusppy.solvers.milp_bound import milp_dual_ascent, milp_lift
+from tpusppy.spopt import SPOpt
+
+N = 5
+KW = {"num_gens": 3, "horizon": 6, "num_scens": N, "relax_integers": False}
+SO = {"eps_abs": 1e-8, "eps_rel": 1e-8, "max_iter": 400, "restarts": 3}
+
+
+def _batch():
+    names = uc_lite.scenario_names_creator(N)
+    return names, ScenarioBatch.from_problems(
+        [uc_lite.scenario_creator(nm, **KW) for nm in names])
+
+
+@pytest.fixture(scope="module")
+def ef_mip_obj():
+    _, batch = _batch()
+    obj, _ = solve_ef(batch, solver="highs", mip=True)
+    return obj
+
+
+def test_milp_lift_tightens_and_stays_valid(ef_mip_obj):
+    names, batch = _batch()
+    opt = SPOpt({"solver_options": SO}, names, uc_lite.scenario_creator,
+                scenario_creator_kwargs=KW)
+    opt.solve_loop()
+    base = opt.Edualbound_perscen()
+    lp_bound = float(opt.probs @ base)
+    lifted, n = milp_lift(batch, np.asarray(batch.c), base, budget_s=60)
+    assert n == N
+    mip_bound = float(opt.probs @ lifted)
+    # tighter than LP, still below the EF MIP optimum (certified)
+    assert mip_bound >= lp_bound - 1e-9
+    assert mip_bound <= ef_mip_obj + 1e-6 * abs(ef_mip_obj)
+    # W = 0: the lift equals the integer wait-and-see bound, which must
+    # strictly exceed the LP wait-and-see on a family with integrality gap
+    assert mip_bound > lp_bound + 1e-6 * abs(lp_bound)
+
+
+def test_milp_lift_partial_budget_valid(ef_mip_obj):
+    names, batch = _batch()
+    opt = SPOpt({"solver_options": SO}, names, uc_lite.scenario_creator,
+                scenario_creator_kwargs=KW)
+    opt.solve_loop()
+    base = opt.Edualbound_perscen()
+    # a ~zero budget lifts nothing (or very little) — and stays valid
+    lifted, n = milp_lift(batch, np.asarray(batch.c), base, budget_s=0.0)
+    assert n == 0
+    assert np.allclose(lifted, base)
+
+
+def test_milp_dual_ascent_monotone_valid(ef_mip_obj):
+    names, batch = _batch()
+    ph = PH({"defaultPHrho": 10.0, "PHIterLimit": 10, "convthresh": -1.0,
+             "solver_options": SO}, names, uc_lite.scenario_creator,
+            scenario_creator_kwargs=KW)
+    ph.ph_main()
+    ph.W_on, ph.prox_on = True, False
+
+    def base_fn(W):
+        ph.W = np.asarray(W, dtype=float)
+        q, q2 = ph._augmented_q()
+        ph.solve_loop(q=q, q2=q2)
+        return q, ph.Edualbound_perscen(q=q, q2=q2)
+
+    q0, base0 = base_fn(np.asarray(ph.W))
+    start, _ = milp_lift(batch, q0, base0, budget_s=60)
+    start_val = float(ph.probs @ start)
+    best, bestW = milp_dual_ascent(batch, ph.W, base_fn, steps=4,
+                                   budget_s=120)
+    assert best >= start_val - 1e-9          # keeps the best iterate
+    assert best <= ef_mip_obj + 1e-6 * abs(ef_mip_obj)   # still certified
+    # zero-mean invariant of the returned weights
+    assert np.abs(ph.probs @ bestW).max() < 1e-8
+
+
+def test_restricted_ef_wheel_incumbent(ef_mip_obj):
+    from tpusppy.cylinders import (LagrangianOuterBound, PHHub,
+                                   XhatRestrictedEF)
+    from tpusppy.phbase import PHBase
+    from tpusppy.spin_the_wheel import WheelSpinner
+    from tpusppy.xhat_eval import Xhat_Eval
+
+    names, _ = _batch()
+
+    def okw(iters):
+        return {"options": {"defaultPHrho": 10.0, "PHIterLimit": iters,
+                            "convthresh": -1.0, "solver_options": SO,
+                            "xhat_ef_options": {"every": 1, "ksub": N,
+                                                "time_limit": 30},
+                            "lagrangian_milp_lift": {"budget_s": 20}},
+                "all_scenario_names": names,
+                "scenario_creator": uc_lite.scenario_creator,
+                "scenario_creator_kwargs": KW}
+
+    hub = {"hub_class": PHHub, "hub_kwargs": {"options": {"rel_gap": 1e-6}},
+           "opt_class": PH, "opt_kwargs": okw(6)}
+    spokes = [
+        {"spoke_class": LagrangianOuterBound, "opt_class": PHBase,
+         "opt_kwargs": okw(20)},
+        {"spoke_class": XhatRestrictedEF, "opt_class": Xhat_Eval,
+         "opt_kwargs": okw(20)},
+    ]
+    ws = WheelSpinner(hub, spokes).spin()
+    ib, ob = ws.BestInnerBound, ws.BestOuterBound
+    # a certified sandwich around the true EF MIP optimum
+    assert np.isfinite(ib) and np.isfinite(ob)
+    assert ob <= ef_mip_obj + 1e-6 * abs(ef_mip_obj)
+    assert ib >= ef_mip_obj - 1e-6 * abs(ef_mip_obj)
+    # the sandwich must certify a single-digit gap on this tiny family (at
+    # 6 hub iterations the consensus guiding the restriction is still
+    # rough, so exact optimality is not guaranteed — validity is)
+    assert (ib - ob) / abs(ib) < 0.05
+
+
+def test_xbar_ladder_rounding_valid(ef_mip_obj):
+    """Threshold-ladder xbar candidates: integer-snapped, and every finite
+    evaluation is a true upper bound for the EF MIP optimum."""
+    from tpusppy.cylinders.xhatxbar_bounder import xbar_candidate
+    from tpusppy.xhat_eval import Xhat_Eval
+
+    names, batch = _batch()
+    xe = Xhat_Eval({"solver_options": SO}, names, uc_lite.scenario_creator,
+                   scenario_creator_kwargs=KW)
+    xe.solve_loop()
+    xk = np.asarray(xe.local_x)[:, batch.tree.nonant_indices]
+    ints = batch.is_int[batch.tree.nonant_indices].astype(bool)
+    seen_finite = False
+    for th in (0.5, 0.35, 0.25):
+        cand = xbar_candidate(xe, xk, threshold=th)
+        assert np.allclose(cand[:, ints], np.round(cand[:, ints]))
+        obj = xe.evaluate(cand)
+        if np.isfinite(obj):
+            seen_finite = True
+            assert obj >= ef_mip_obj - 1e-6 * abs(ef_mip_obj)
+    assert seen_finite

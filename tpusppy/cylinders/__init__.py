@@ -24,6 +24,7 @@ from .xhatlooper_bounder import XhatLooperInnerBound
 from .xhatshufflelooper_bounder import ScenarioCycler, XhatShuffleInnerBound
 from .xhatspecific_bounder import XhatSpecificInnerBound
 from .xhatxbar_bounder import XhatXbarInnerBound
+from .xhat_ef_restricted import XhatRestrictedEF
 
 __all__ = [
     "KILL_ID", "Mailbox", "SPCommunicator", "WindowFabric",
@@ -36,5 +37,5 @@ __all__ = [
     "SlamMaxHeuristic", "SlamMinHeuristic", "ScenarioCycler",
     "XhatLooperInnerBound", "XhatLShapedInnerBound",
     "XhatShuffleInnerBound", "XhatSpecificInnerBound",
-    "XhatXbarInnerBound",
+    "XhatXbarInnerBound", "XhatRestrictedEF",
 ]

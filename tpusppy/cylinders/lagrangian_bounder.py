@@ -33,13 +33,33 @@ class LagrangianOuterBound(OuterBoundWSpoke):
 
         The objective comes from the opt object's own ``_augmented_q`` (with
         W on, prox off per ``lagrangian_prep``) so the assembly stays single-
-        sourced with PH."""
+        sourced with PH.
+
+        With ``lagrangian_milp_lift`` in the opt options (a dict of
+        :func:`tpusppy.solvers.milp_bound.milp_lift` kwargs plus ``every``),
+        per-scenario LP certificates are lifted to host MILP dual bounds on
+        integer families — the reference spoke's integer subproblem minima
+        (its persistent solver is a MIP solver), which close the integrality
+        gap a pure LP-relaxation bound cannot.  The lift is budget-elastic
+        and valid at ANY completed subset of scenarios.
+        """
         opt = self.opt
         q, q2 = opt._augmented_q()
         opt.solve_loop(q=q, q2=q2)
         # CERTIFIED bound: dual objective of the W-augmented subproblems
         # (weak duality absorbs solver tolerance; an inexact primal objective
         # can overshoot the true bound and falsely certify rel_gap)
+        lift_cfg = opt.options.get("lagrangian_milp_lift")
+        if lift_cfg and bool(np.asarray(opt.batch.is_int).any()):
+            every = max(1, int(lift_cfg.get("every", 1)))
+            if getattr(self, "dk_iter", 1) % every == 0:
+                from ..solvers.milp_bound import milp_lift
+
+                base = opt.Edualbound_perscen(q=q, q2=q2)
+                kw = {k: v for k, v in lift_cfg.items() if k != "every"}
+                lifted, n = milp_lift(opt.batch, q, base, **kw)
+                self.last_milp_lift_count = n
+                return float(opt.probs @ lifted)
         return opt.Edualbound(q=q, q2=q2)
 
     def _set_weights_and_solve(self) -> float:
@@ -62,8 +82,34 @@ class LagrangianOuterBound(OuterBoundWSpoke):
                 self.dk_iter += 1
 
     def finalize(self):
-        """One final pass with the last Ws (lagrangian_bounder.py:85-95)."""
+        """One final pass with the last Ws (lagrangian_bounder.py:85-95).
+
+        With ``lagrangian_milp_ascent`` in the opt options (kwargs for
+        :func:`tpusppy.solvers.milp_bound.milp_dual_ascent`), the final W is
+        additionally polished by projected subgradient ascent on the INTEGER
+        Lagrangian dual — every iterate is a certified bound, the best one
+        is reported.  This is the reference Lagranger spoke's own-steps
+        posture (lagranger_bounder.py) with MIP subproblem minima.
+        """
         self.final_bound = self._set_weights_and_solve()
         if np.isfinite(self.final_bound):
             self.bound = self.final_bound
+        ascent_cfg = self.opt.options.get("lagrangian_milp_ascent")
+        if ascent_cfg and bool(np.asarray(self.opt.batch.is_int).any()):
+            from ..solvers.milp_bound import milp_dual_ascent
+
+            opt = self.opt
+
+            def base_fn(W):
+                opt.W = np.asarray(W, dtype=float)
+                q, q2 = opt._augmented_q()
+                opt.solve_loop(q=q, q2=q2)
+                return q, opt.Edualbound_perscen(q=q, q2=q2)
+
+            best, _ = milp_dual_ascent(
+                opt.batch, opt.W, base_fn, **dict(ascent_cfg))
+            if np.isfinite(best) and (not np.isfinite(self.final_bound)
+                                      or best > self.final_bound):
+                self.final_bound = best
+                self.bound = best
         return self.final_bound

@@ -19,15 +19,32 @@ class _SlamHeuristic(InnerBoundNonantSpoke):
     converger_spoke_char = 'S'
     how = None  # "max" / "min"
 
-    def main(self):
+    def _slam_once(self):
         ints = self.opt.batch.is_int[self.opt.tree.nonant_indices]
+        cand = slam_cache(self.opt, self.localnonants, how=self.how)
+        if ints.any():
+            # directional rounding keeps the slam semantics on
+            # fractional (LP-relaxation) inputs: a max-slam commits
+            # anything any scenario wants committed (ceil), a
+            # min-slam only what every scenario agrees on (floor)
+            snap = (np.ceil(cand - 1e-9) if self.how == "max"
+                    else np.floor(cand + 1e-9))
+            cand = np.where(ints[None, :], snap, cand)
+        obj = self.opt.evaluate(cand)
+        self.update_if_improving(obj)
+
+    def main(self):
+        self._seen = False
         while not self.got_kill_signal():
             if self.new_nonants:
-                cand = slam_cache(self.opt, self.localnonants, how=self.how)
-                if ints.any():
-                    cand = np.where(ints[None, :], np.round(cand), cand)
-                obj = self.opt.evaluate(cand)
-                self.update_if_improving(obj)
+                self._seen = True
+                self._slam_once()
+
+    def finalize(self):
+        """Final slam pass with the last hub nonants (see XhatShuffle)."""
+        if getattr(self, "_seen", False):
+            self._slam_once()
+        return super().finalize()
 
 
 class SlamMaxHeuristic(_SlamHeuristic):

@@ -1,0 +1,143 @@
+"""Restricted-EF MILP polish spoke: consensus-guided incumbents.
+
+Generic relax-and-fix over the hub's consensus (a RINS-flavored heuristic —
+no counterpart module in the reference, which gets integral candidates for
+free because its subproblems are solved by a MIP solver; this spoke is how
+tpusppy's LP-relaxation device path recovers MIP-quality first stages):
+
+1. integer nonant coordinates the hub's scenarios AGREE on are fixed
+   (mean >= ``hi`` -> 1, mean <= ``lo`` -> 0);
+2. the few contested coordinates stay binary, and a probability-
+   renormalized extensive form over a small scenario subsample is solved on
+   the host (HiGHS MILP) — with only dozens of free binaries this cracks in
+   seconds;
+3. the resulting first stage is evaluated on the FULL batch on device
+   (``Xhat_Eval``) — a certified incumbent like any other xhat.
+
+Two-stage families only (the restricted EF shares one nonant block; a
+multistage restriction would need per-node blocks) — the spoke is silently
+idle otherwise, and on continuous families (nothing to fix) it defers to
+the cheaper xbar/looper spokes.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .spoke import InnerBoundNonantSpoke
+
+
+class XhatRestrictedEF(InnerBoundNonantSpoke):
+    """'E' spoke: host-MILP restricted EF on the hub's consensus."""
+
+    converger_spoke_char = 'E'
+
+    def xhat_prep(self):
+        opts = self.opt.options.get("xhat_ef_options", {})
+        self.every = max(1, int(opts.get("every", 4)))
+        self.ksub = int(opts.get("ksub", 6))
+        self.hi = float(opts.get("hi", 0.75))
+        self.lo = float(opts.get("lo", 0.10))
+        self.time_limit = float(opts.get("time_limit", 60.0))
+        self.mip_rel_gap = float(opts.get("mip_rel_gap", 1e-4))
+        b = self.opt.batch
+        self.enabled = (
+            self.opt.tree.num_stages == 2
+            and bool(np.asarray(b.is_int).any())
+            and getattr(b, "buckets", None) is None)
+        self._iter = 0
+        self._last_fix = None
+
+    def _restricted_candidate(self, xk):
+        """Solve the restricted subsample EF; returns a (K,) candidate or
+        None (MILP failed / consensus unchanged since last call)."""
+        import scipy.optimize as sopt
+        import scipy.sparse as sp
+
+        b = self.opt.batch
+        nid = np.asarray(b.tree.nonant_indices)
+        ints = np.asarray(b.is_int)[nid].astype(bool)
+        probs = np.asarray(self.opt.probs)
+        xbar = probs @ xk
+        fix1 = ints & (xbar >= self.hi)
+        fix0 = ints & (xbar <= self.lo)
+        key = (fix1.tobytes(), fix0.tobytes())
+        if key == self._last_fix:
+            return None              # same restriction: nothing new to try
+        self._last_fix = key
+        S = b.num_scenarios
+        K = nid.size
+        other = np.setdiff1d(np.arange(b.num_vars), nid)
+        no = other.size
+        sub = np.unique(np.linspace(0, S - 1, min(self.ksub, S)).astype(int))
+        w = probs[sub] / probs[sub].sum()
+        k = sub.size
+        NV = K + k * no
+        c_ef = np.zeros(NV)
+        blocks, cls, cus = [], [], []
+        m = b.num_rows
+        A_sh = getattr(b, "A_shared", None)
+        for j, s in enumerate(sub):
+            cs = np.asarray(b.c[s], float)
+            c_ef[:K] += w[j] * cs[nid]
+            c_ef[K + j * no: K + (j + 1) * no] = w[j] * cs[other]
+            As = np.asarray(A_sh if A_sh is not None else b.A[s])
+            Ar = sp.lil_matrix((m, NV))
+            Ar[:, :K] = As[:, nid]
+            Ar[:, K + j * no: K + (j + 1) * no] = As[:, other]
+            blocks.append(Ar.tocsr())
+            cls.append(np.asarray(b.cl[s]))
+            cus.append(np.asarray(b.cu[s]))
+        lb_u = np.where(fix1, 1.0, np.asarray(b.lb[0])[nid])
+        ub_u = np.where(fix0, 0.0, np.asarray(b.ub[0])[nid])
+        lb = np.concatenate(
+            [lb_u] + [np.asarray(b.lb[s])[other] for s in sub])
+        ub = np.concatenate(
+            [ub_u] + [np.asarray(b.ub[s])[other] for s in sub])
+        integ = np.zeros(NV)
+        integ[:K] = np.asarray(b.is_int)[nid]
+        res = sopt.milp(
+            c=c_ef,
+            constraints=sopt.LinearConstraint(
+                sp.vstack(blocks), np.concatenate(cls), np.concatenate(cus)),
+            bounds=sopt.Bounds(lb, ub), integrality=integ,
+            options={"time_limit": self.time_limit,
+                     "mip_rel_gap": self.mip_rel_gap})
+        if res.x is None:
+            return None
+        cand = res.x[:K]
+        return np.where(ints, np.round(cand), cand)
+
+    def _polish_once(self):
+        t0 = time.time()
+        cand = self._restricted_candidate(self.localnonants)
+        if cand is None:
+            return
+        obj = self.opt.evaluate(cand)
+        if self.update_if_improving(obj):
+            from .. import global_toc
+            global_toc(
+                f"XhatRestrictedEF incumbent {obj:.4e} "
+                f"({time.time() - t0:.1f}s)",
+                self.opt.options.get("verbose", False))
+
+    def main(self):
+        self.xhat_prep()
+        self._seen = False
+        while not self.got_kill_signal():
+            if self.new_nonants and self.enabled:
+                self._seen = True
+                self._iter += 1
+                if self._iter % self.every:
+                    continue
+                self._polish_once()
+
+    def finalize(self):
+        """Final restricted-EF polish with the last hub consensus (the
+        reference's spokes also sweep once after the kill sentinel)."""
+        if getattr(self, "_seen", False) and self.enabled:
+            self._last_fix = None        # always re-try at the final state
+            self._polish_once()
+        return super().finalize()

@@ -64,23 +64,35 @@ class XhatShuffleInnerBound(InnerBoundNonantSpoke):
             opts.get("xhat_looper_options", {}).get("scen_limit", 3)
         )
 
-    def _try_candidates(self):
+    def _try_candidates(self, final=False):
         """Try up to scen_limit donors against the current hub nonants.
 
         Aborts early on the kill sentinel via ``peek_kill_signal`` so a
         nonant payload posted mid-evaluation keeps its freshness for the
-        next main-loop poll."""
+        next main-loop poll — except on the finalize pass, where the
+        sentinel is permanently set and all donors should be tried."""
         xk = self.localnonants
         for _ in range(self.scen_limit):
             donor = self.cycler.get_next()
             cache = donor_cache(self.opt, xk, donor)
             obj = self.opt.evaluate(cache)
             self.update_if_improving(obj)
-            if self.peek_kill_signal():
+            if not final and self.peek_kill_signal():
                 return
 
     def main(self):
         self.xhatbase_prep()
+        self._seen = False
         while not self.got_kill_signal():
             if self.new_nonants:
+                self._seen = True
                 self._try_candidates()
+
+    def finalize(self):
+        """One final candidate pass with the last hub nonants (the
+        reference's spokes also sweep once after the kill sentinel —
+        without it a fast hub can outrun the spoke and terminate with a
+        stale incumbent, which made short wheels timing-flaky)."""
+        if getattr(self, "_seen", False):
+            self._try_candidates(final=True)
+        return super().finalize()

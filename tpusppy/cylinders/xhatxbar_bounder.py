@@ -13,9 +13,15 @@ import numpy as np
 from .spoke import InnerBoundNonantSpoke
 
 
-def xbar_candidate(opt, xk: np.ndarray) -> np.ndarray:
+def xbar_candidate(opt, xk: np.ndarray, threshold: float = 0.5) -> np.ndarray:
     """(S, K) per-node weighted mean of xk, integer slots rounded
-    (xhatxbar_bounder.py:31-80 semantics on the batched layout)."""
+    (xhatxbar_bounder.py:31-80 semantics on the batched layout).
+
+    ``threshold``: integer slots round UP when their fractional part is at
+    least this (0.5 = nearest).  Lower thresholds commit more — on UC-like
+    families where under-commitment prices VOLL shedding, a small ladder of
+    thresholds beats nearest-rounding by an order of magnitude.
+    """
     onehot = opt.tree.onehot_sk_n()           # (S, K, N)
     p = opt.probs[:, None]
     num = np.einsum("skn,sk->nk", onehot, p * xk)
@@ -25,18 +31,43 @@ def xbar_candidate(opt, xk: np.ndarray) -> np.ndarray:
     cand = xbar_nk[opt.nid_sk, kidx]
     ints = opt.batch.is_int[opt.tree.nonant_indices]
     if ints.any():
-        cand = np.where(ints[None, :], np.round(cand), cand)
+        cand = np.where(ints[None, :],
+                        np.floor(cand + (1.0 - threshold)), cand)
     return cand
 
 
 class XhatXbarInnerBound(InnerBoundNonantSpoke):
-    """'X' spoke (xhatxbar_bounder.py:31-118)."""
+    """'X' spoke (xhatxbar_bounder.py:31-118).
+
+    ``xhat_xbar_options: {"thresholds": [...]}`` evaluates a rounding
+    ladder per fresh nonants (default [0.5]; integer families benefit from
+    adding commit-biased entries like 0.35/0.25).
+    """
 
     converger_spoke_char = 'X'
 
+    def _sweep(self, xk, final=False):
+        for th in self._thresholds:
+            cand = xbar_candidate(self.opt, xk, threshold=th)
+            obj = self.opt.evaluate(cand)
+            self.update_if_improving(obj)
+            # mid-run sweeps yield to fresher nonants; the finalize pass
+            # must NOT take this exit — the sentinel is permanently set by
+            # then, and the whole point is to finish the ladder
+            if not final and self.peek_kill_signal():
+                return
+
     def main(self):
+        self._thresholds = list(self.opt.options.get(
+            "xhat_xbar_options", {}).get("thresholds", [0.5]))
+        self._seen = False
         while not self.got_kill_signal():
             if self.new_nonants:
-                cand = xbar_candidate(self.opt, self.localnonants)
-                obj = self.opt.evaluate(cand)
-                self.update_if_improving(obj)
+                self._seen = True
+                self._sweep(self.localnonants)
+
+    def finalize(self):
+        """Final ladder pass with the last hub nonants (see XhatShuffle)."""
+        if getattr(self, "_seen", False):
+            self._sweep(self.localnonants, final=True)
+        return super().finalize()

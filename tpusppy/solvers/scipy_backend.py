@@ -23,6 +23,9 @@ class SolveResult:
     duals: np.ndarray | None
     status: str
     feasible: bool
+    # MIP solves: HiGHS's best dual (lower) bound — certified even when the
+    # solve stops on a gap/time limit; None for LP/IPM paths
+    dual_bound: float | None = None
 
 
 def solve_lp(c, A, cl, cu, lb, ub, is_int=None, q2=None, const=0.0,
@@ -57,9 +60,14 @@ def solve_lp(c, A, cl, cu, lb, ub, is_int=None, q2=None, const=0.0,
     feasible = res.x is not None and res.status in (0, 1)
     x = res.x if res.x is not None else np.zeros(n)
     obj = float(c @ x + const) if res.x is not None else np.inf
+    db = getattr(res, "mip_dual_bound", None)
+    if db is None and res.status == 0:
+        db = obj                 # LP optimal: the solve itself is the bound
+    elif db is not None:
+        db = float(db + const)
     # scipy.milp does not expose duals; LP duals come from linprog when needed.
     return SolveResult(x=x, obj=obj, duals=None, status=str(res.status),
-                       feasible=feasible)
+                       feasible=feasible, dual_bound=db)
 
 
 def solve_lp_with_duals(c, A, cl, cu, lb, ub, const=0.0) -> SolveResult:

@@ -392,24 +392,30 @@ class SPOpt(SPBase):
         n_resc = 0
         qp_bad = bad[is_qp[bad]]
         if qp_bad.size:
-            # QP scenarios: ONE batched host IPM over the straggler slice
+            # QP scenarios: batched host IPM over the straggler slice
             # (duals already in our convention); shared-A families pass the
-            # single (m, n) A through with zero extra memory
+            # single (m, n) A through with zero extra memory.  Chunked: the
+            # IPM's KKT workspace is k*(n+me)^2 doubles, so an unbounded k
+            # (hundreds of stalled prox solves at reference UC shape) would
+            # OOM the host for no throughput gain
             A_shared = getattr(b, "A_shared", None)
-            A_arg = A_shared if A_shared is not None else b.A[qp_bad]
-            xb, yb, feas = scipy_backend.solve_qp_batch_with_duals(
-                q[qp_bad], q2[qp_bad], A_arg,
-                b.cl[qp_bad], b.cu[qp_bad], lb[qp_bad], ub[qp_bad])
-            for j, s in enumerate(qp_bad):
-                if not feas[j]:
-                    continue        # genuine infeasibility: leave residuals
-                xs, ys = xb[j], yb[j]
-                yx[s] = -(q[s] + q2[s] * xs + b.A[s].T @ ys)
-                x[s], y[s] = xs, ys
-                z[s] = b.A[s] @ xs
-                pri[s] = 0.0
-                dua[s] = 0.0
-                n_resc += 1
+            chunk = max(1, int(self.options.get("straggler_qp_chunk", 16)))
+            for lo in range(0, qp_bad.size, chunk):
+                sl = qp_bad[lo:lo + chunk]
+                A_arg = A_shared if A_shared is not None else b.A[sl]
+                xb, yb, feas = scipy_backend.solve_qp_batch_with_duals(
+                    q[sl], q2[sl], A_arg,
+                    b.cl[sl], b.cu[sl], lb[sl], ub[sl])
+                for j, s in enumerate(sl):
+                    if not feas[j]:
+                        continue    # genuine infeasibility: leave residuals
+                    xs, ys = xb[j], yb[j]
+                    yx[s] = -(q[s] + q2[s] * xs + b.A[s].T @ ys)
+                    x[s], y[s] = xs, ys
+                    z[s] = b.A[s] @ xs
+                    pri[s] = 0.0
+                    dua[s] = 0.0
+                    n_resc += 1
         for s in bad[~is_qp[bad]]:
             res = scipy_backend.solve_lp_with_duals(
                 q[s], b.A[s], b.cl[s], b.cu[s], lb[s], ub[s])
@@ -452,7 +458,13 @@ class SPOpt(SPBase):
         return float(self.probs @ vals)
 
     def Edualbound(self, q=None, q2=None) -> float:
-        """CERTIFIED expected outer bound from the last solve's row duals.
+        """Expectation of :meth:`Edualbound_perscen` (see there)."""
+        return float(self.probs @ self.Edualbound_perscen(q, q2))
+
+    def Edualbound_perscen(self, q=None, q2=None) -> np.ndarray:
+        """CERTIFIED per-scenario outer bounds ((S,)) from the last solve's
+        row duals; ``Edualbound`` is their expectation, and the MILP lift
+        (:mod:`tpusppy.solvers.milp_bound`) raises individual entries.
 
         ``Ebound`` evaluates the primal objective of an inexact solve — valid
         only to solver tolerance (the reference gets exactness from its
@@ -465,7 +477,7 @@ class SPOpt(SPBase):
         from .ir import BucketedBatch
 
         if isinstance(self.batch, BucketedBatch):
-            return self._Edualbound_bucketed(q, q2)
+            return self._Edualbound_bucketed_perscen(q, q2)
         if self._warm is None:
             raise RuntimeError("Edualbound requires a prior solve_loop")
         b = self.batch
@@ -488,9 +500,9 @@ class SPOpt(SPBase):
         # sloppy duals pay for their conditionality honestly.
         margin = np.asarray(admm.dual_objective_margin(*args), dtype=float)
         self.last_bound_margin = margin
-        return float(self.probs @ (dvals - margin + b.const))
+        return dvals - margin + b.const
 
-    def _Edualbound_bucketed(self, q=None, q2=None) -> float:
+    def _Edualbound_bucketed_perscen(self, q=None, q2=None) -> np.ndarray:
         """Certified dual bound for RAGGED (bucketed) batches: the weak-
         duality construction per compact bucket, scattered back — closes
         the r2 limitation where bound-spoke wheels required unbucketed
@@ -527,7 +539,7 @@ class SPOpt(SPBase):
             vals[idx_arr] = dv
             margin_out[idx_arr] = mg
         self.last_bound_margin = margin_out
-        return float(self.probs @ (vals - margin_out + b.const))
+        return vals - margin_out + b.const
 
     def _bucket_device_consts(self, dt):
         """Per-bucket device-resident (A, cl, cu), cached on batch.version —

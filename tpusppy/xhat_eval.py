@@ -256,12 +256,37 @@ class Xhat_Eval(SPOpt):
         self.dua_res = dua
         return x_out
 
+    def _round_int_nonants(self, cache):
+        """Snap integer nonant coordinates of a candidate to integers (see
+        :meth:`_fix_and_solve`); no-op for continuous families."""
+        import numpy as np
+
+        if not self.options.get("xhat_round_ints", True):
+            return cache
+        nid = np.asarray(self.batch.tree.nonant_indices)
+        ints = np.asarray(self.batch.is_int)[nid].astype(bool)
+        if not ints.any():
+            return cache
+        cache = np.array(cache, dtype=float, copy=True)
+        cache[..., ints] = np.round(cache[..., ints])
+        return cache
+
     def _fix_and_solve(self, nonant_cache):
         """Clamp nonants to the candidate and solve the whole batch.
 
         ``nonant_cache``: (K,) single candidate shared by all scenarios, or
         (S, K) per-scenario (multistage xhats fix per-node values; scenarios of
         one node must carry identical values there).
+
+        Integer nonant coordinates are snapped to the nearest integer first
+        (``xhat_round_ints``, default on): device-path donors carry
+        LP-relaxation values, so families whose integers are ALL first-stage
+        (UC commitment) would otherwise be "evaluated" at fractional
+        commitments — never a valid incumbent, and catastrophically priced
+        when fractional capacity triggers VOLL shedding.  The reference
+        never faces this: its donors come from MIP subproblem solves and are
+        integral already (xhatshufflelooper_bounder.py donor caches).
+        Snapping preserves per-node equality, so multistage fixing is safe.
         """
         import numpy as np
 
@@ -269,6 +294,7 @@ class Xhat_Eval(SPOpt):
 
         if isinstance(self.batch, BucketedBatch):
             return self._fix_and_solve_bucketed(nonant_cache)
+        nonant_cache = self._round_int_nonants(nonant_cache)
         self.fix_nonants(nonant_cache)
         try:
             b = self.batch
