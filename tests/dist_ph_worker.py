@@ -1,0 +1,39 @@
+"""Worker for tests/test_distributed.py: one process of a 2-process
+jax.distributed PH job (CPU, virtual devices).  Prints one JSON line."""
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    coord = os.environ["DIST_COORD"]
+    nproc = int(os.environ["DIST_NPROC"])
+    pid = int(os.environ["DIST_PID"])
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=nproc, process_id=pid)
+    jax.config.update("jax_enable_x64", True)
+
+    from tpusppy.models import farmer
+    from tpusppy.parallel.distributed import distributed_ph
+
+    n = int(os.environ.get("DIST_SCENS", "6"))
+    names = farmer.scenario_names_creator(n)
+    res = distributed_ph(
+        names, farmer.scenario_creator,
+        scenario_creator_kwargs={"num_scens": n},
+        options={"defaultPHrho": 1.0, "PHIterLimit": 200,
+                 "solver_options": {"dtype": "float64", "eps_abs": 1e-8,
+                                    "eps_rel": 1e-8, "max_iter": 300,
+                                    "restarts": 3}})
+    print(json.dumps({
+        "pid": pid, "conv": res.conv, "eobj": res.eobj,
+        "iters": res.iters, "xbars": np.asarray(res.xbars).tolist(),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,268 @@
+"""Multi-controller (multi-host) scenario parallelism within one cylinder.
+
+The reference's core scaling axis: ONE cylinder spans hundreds of MPI ranks,
+each rank owning a contiguous slice of scenarios, with per-tree-node
+``Allreduce`` reductions (``mpisppy/utils/sputils.py:774-840`` scenario->rank
+maps, ``spbase.py:184-216`` rank assignment, 4000 ranks in paperruns).
+
+The TPU-native equivalent is multi-controller JAX: each host process builds
+ONLY its own scenario shard (so no host materializes the global batch — the
+same memory scaling as rank-local scenario lists), assembles global
+scenario-sharded ``jax.Array``s via ``make_array_from_process_local_data``
+over a mesh spanning every process's devices, and runs the SAME jitted PH
+step as the single-controller path (:mod:`tpusppy.parallel.sharded`) — the
+scenario-axis contractions inside it lower to psums that ride ICI within a
+host and DCN across hosts.  No communicator management, no send/recv: the
+mesh is the communicator.
+
+Launch (per host)::
+
+    jax.distributed.initialize(coordinator, num_processes, process_id)
+    ...
+    result = distributed_ph(all_names, creator, kwargs, options)
+
+See ``doc/multihost.md`` ("Scaling one cylinder across hosts") for the
+two-host recipe, and ``tests/test_distributed.py`` for the 2-process CPU
+harness (the same wire format the driver's multi-chip dryrun validates).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+
+class DistPHResult(NamedTuple):
+    conv: float
+    eobj: float
+    xbars: np.ndarray        # (K,) root-stage consensus (replicated)
+    iters: int
+
+
+def scen_to_process(num_scenarios: int, num_processes: int,
+                    process_id: int | None = None):
+    """Contiguous block scenario->process map (sputils.py:774-812 analogue:
+    uneven counts spread the remainder over the leading processes).
+
+    Returns the (start, stop) slice for ``process_id``, or the full list of
+    slices when ``process_id`` is None.
+    """
+    base, rem = divmod(num_scenarios, num_processes)
+    slices = []
+    lo = 0
+    for p in range(num_processes):
+        hi = lo + base + (1 if p < rem else 0)
+        slices.append((lo, hi))
+        lo = hi
+    if process_id is None:
+        return slices
+    return slices[process_id]
+
+
+def process_rows(mesh, S_global, axis: str = "scen"):
+    """Padded-global scenario rows owned by THIS process under the mesh's
+    device layout, and the padded total Sp.
+
+    THE scenario->process map: ownership follows the mesh (a 1-D
+    scenario-sharded array places each padded-global row on exactly one
+    device), so partitioning any other way would strand real scenarios on
+    inert fill rows.  Rows >= S_global are padding.  Reference analogue:
+    the scen->rank maps of sputils.py:774-840, except here the mesh IS the
+    map.
+    """
+    import jax
+
+    nsh = mesh.shape[axis]
+    pad = (-S_global) % nsh
+    Sp = S_global + pad
+    per_dev = Sp // nsh
+    dev_order = list(mesh.devices.ravel())
+    rows = []
+    for i, d in enumerate(dev_order):
+        if d.process_index == jax.process_index():
+            rows.extend(range(i * per_dev, (i + 1) * per_dev))
+    return np.asarray(sorted(rows)), Sp
+
+
+def _global_scen_arrays(batch_local, S_global, owned_rows, mesh, axis,
+                        settings, probs_local=None):
+    """Assemble globally-sharded PHArrays from a process-LOCAL batch.
+
+    ``owned_rows``: the padded-global row ids this process's devices hold
+    (:func:`process_rows`); the local batch's scenarios correspond to its
+    entries that are < S_global, in order.  Pad rows (>= S_global) are
+    filled with inert zero-probability copies of the local row 0.  Every
+    real row is owned by exactly one process, so probabilities and node
+    memberships stay globally consistent.  Every process must call this
+    collectively with the same global shapes.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .sharded import PHArrays
+
+    rows = np.asarray(owned_rows)
+    nsh = mesh.shape[axis]
+    pad = (-S_global) % nsh
+    Sp = S_global + pad
+
+    b = batch_local
+    dt = np.dtype(settings.dtype)
+    shard = NamedSharding(mesh, P(axis))
+    owned = rows < S_global
+    local_index = {r: j for j, r in enumerate(rows[owned])}
+
+    def mk(get_row, dtype, extra_shape=(), inert=None):
+        """Stack local rows: real rows map through the local batch, pad
+        rows take ``inert`` (default: a copy of local row 0)."""
+        fill = get_row(0) if inert is None else inert
+        local = np.stack([
+            get_row(local_index[r]) if ok else fill
+            for r, ok in zip(rows, owned)]).astype(dtype)
+        return jax.make_array_from_process_local_data(
+            shard, local, (Sp,) + extra_shape)
+
+    n = b.num_vars
+    m = b.num_rows
+    nid_sk = b.tree.nid_sk()
+    onehot = b.tree.onehot_sk_n()
+    K = nid_sk.shape[1]
+    N = onehot.shape[2]
+    if probs_local is None:
+        probs_local = np.asarray(b.tree.scen_prob, dtype=float)
+    probs_local = np.asarray(probs_local, dtype=float)
+    const_local = np.broadcast_to(np.asarray(b.const),
+                                  (int(owned.sum()),))
+
+    A_shared = getattr(b, "A_shared", None)
+    if A_shared is not None:
+        A_arr = jnp.asarray(np.asarray(A_shared), dt)   # replicated
+    else:
+        A_arr = mk(lambda i: np.asarray(b.A[i]), dt, (m, n))
+
+    return PHArrays(
+        c=mk(lambda i: np.asarray(b.c[i]), dt, (n,)),
+        q2=mk(lambda i: np.asarray(b.q2[i]), dt, (n,)),
+        A=A_arr,
+        cl=mk(lambda i: np.asarray(b.cl[i]), dt, (m,)),
+        cu=mk(lambda i: np.asarray(b.cu[i]), dt, (m,)),
+        lb=mk(lambda i: np.asarray(b.lb[i]), dt, (n,)),
+        ub=mk(lambda i: np.asarray(b.ub[i]), dt, (n,)),
+        const=mk(lambda i: const_local[i], dt),
+        probs=mk(lambda i: probs_local[i], dt, inert=np.float64(0.0)),
+        onehot=mk(lambda i: onehot[i], dt, (K, N),
+                  inert=np.zeros((K, N))),
+        nid_sk=mk(lambda i: nid_sk[i], np.int32, (K,)),
+    )
+
+
+def _init_state_dist(arr, default_rho, settings):
+    """Distributed-safe :func:`tpusppy.parallel.sharded.init_state`: zeros
+    are produced INSIDE a jit with explicit output shardings —
+    ``device_put`` of host arrays cannot target non-addressable devices in
+    a multi-controller job."""
+    import jax
+    import jax.numpy as jnp
+
+    from .sharded import PHState
+
+    dt = settings.jdtype()
+    S, n = arr.c.shape
+    m = arr.cl.shape[1]
+    K = arr.nid_sk.shape[1]
+    like = PHState(
+        W=arr.nid_sk.sharding, xbars=arr.nid_sk.sharding,
+        rho=arr.nid_sk.sharding, x=arr.c.sharding, z=arr.cl.sharding,
+        y=arr.cl.sharding, yx=arr.c.sharding)
+
+    def init():
+        z = lambda shape: jnp.zeros(shape, dt)
+        return PHState(
+            W=z((S, K)), xbars=z((S, K)),
+            rho=jnp.full((S, K), default_rho, dt),
+            x=z((S, n)), z=z((S, m)), y=z((S, m)), yx=z((S, n)))
+
+    return jax.jit(init, out_shardings=like)()
+
+
+def distributed_ph(all_scenario_names, scenario_creator,
+                   scenario_creator_kwargs=None, options=None,
+                   mesh=None, axis: str = "scen"):
+    """Run scenario-sharded PH with scenarios partitioned across PROCESSES.
+
+    Call collectively from every process of an initialized
+    ``jax.distributed`` job.  Each process instantiates only its own
+    scenario slice (:func:`scen_to_process`), so the global family never
+    materializes on one host — the reference's rank-local scenario lists
+    (spbase.py:184-216).  Returns a :class:`DistPHResult` (identical on
+    every process; the consensus xbar is fully reduced).
+    """
+    import jax
+
+    from ..ir import ScenarioBatch
+    from ..solvers.admm import ADMMSettings
+    from . import sharded
+
+    from ..solvers.admm import ADMMSettings as _AS
+
+    options = dict(options or {})
+    kwargs = dict(scenario_creator_kwargs or {})
+    S = len(all_scenario_names)
+    if mesh is None:
+        from . import sharded as _sh
+
+        mesh = _sh.make_mesh(axis=axis)
+    rows, _ = process_rows(mesh, S, axis)
+    local_ids = [int(r) for r in rows if r < S]
+    local_names = [all_scenario_names[i] for i in local_ids]
+    problems = [scenario_creator(nm, **kwargs) for nm in local_names]
+    # the local slice's probabilities sum to its GLOBAL share, not 1 —
+    # renormalize for the local tree build (which validates sum == 1) and
+    # carry the true global probabilities into the sharded arrays
+    import dataclasses as _dc
+
+    raw = [p.prob for p in problems]
+    if all(pr is None for pr in raw):
+        true_probs = np.full(len(problems), 1.0 / S)
+    else:
+        true_probs = np.asarray([float(pr) for pr in raw])
+        share = float(true_probs.sum())
+        problems = [_dc.replace(p, prob=float(pr) / share)
+                    for p, pr in zip(problems, true_probs)]
+    batch_local = ScenarioBatch.from_problems(problems)
+
+    so = dict(options.get("solver_options", {}))
+    so.setdefault("dtype", "float64")
+    settings = ADMMSettings(**so)
+
+    arr = _global_scen_arrays(batch_local, S, rows, mesh, axis, settings,
+                              probs_local=true_probs)
+    refresh, frozen = sharded.make_ph_step_pair(
+        batch_local.tree.nonant_indices, settings, mesh, axis)
+    state = _init_state_dist(
+        arr, float(options.get("defaultPHrho", 1.0)), settings)
+
+    iters = int(options.get("PHIterLimit", 10))
+    refresh_every = max(1, int(options.get("solver_refresh_every", 16)))
+    convthresh = float(options.get("convthresh", -1.0))
+    state, out, factors = refresh(state, arr, 0.0)   # iter0: plain objective
+    conv = eobj = np.inf
+    it = 0
+    for it in range(1, iters + 1):
+        if (it - 1) % refresh_every == 0:
+            state, out, factors = refresh(state, arr, 1.0)
+        else:
+            state, out = frozen(state, arr, 1.0, factors)
+        conv = float(np.asarray(out.conv))
+        eobj = float(np.asarray(out.eobj))
+        if 0 <= convthresh and conv < convthresh:
+            break
+
+    # consensus nonants: replicated per-node xbar, gathered host-side from
+    # the addressable shard (identical across processes post-psum)
+    xb = np.asarray(
+        jax.device_get(state.xbars.addressable_shards[0].data))[0]
+    return DistPHResult(conv=conv, eobj=eobj, xbars=np.asarray(xb),
+                        iters=it)
