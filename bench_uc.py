@@ -45,29 +45,50 @@ def uc_metrics():
     # down, startup ramps, reserves — models/uc.py, shared-A engine),
     # matching examples/uc + paperruns/larger_uc in the reference.
     # BENCH_UC_MODEL=lite selects the small self-contained family.
-    model_name = os.environ.get("BENCH_UC_MODEL", "full")
+    # Platform-matched defaults: the TPU run benches the reference's OWN
+    # wind-ladder dataset when mounted (85-gen WECC-240; its LP relaxation
+    # is ~0.07% tight, so 1% certification rides LP-quality bounds); the
+    # CPU fallback degrades to the small self-contained family — the
+    # 1-core fallback host cannot spin a 5-cylinder wheel on a 20+ gen
+    # fleet inside the watchdog, and the artifact's job there is to prove
+    # the certified pipeline end-to-end, flagged degraded.
+    _wind_dir = os.environ.get(
+        "BENCH_UC_DATA",
+        "/root/reference/paperruns/larger_uc/1000scenarios_wind")
+    platform = jax.devices()[0].platform
+    if "BENCH_UC_MODEL" in os.environ:
+        model_name = os.environ["BENCH_UC_MODEL"]
+    elif platform == "cpu":
+        model_name = "lite"
+    elif os.path.isdir(_wind_dir):
+        model_name = "data"
+    else:
+        model_name = "full"
     if model_name == "lite":
         from tpusppy.models import uc_lite as uc_model
         default_gens, default_horizon = 5, 12
+    elif model_name == "data":
+        # the reference's ACTUAL WECC-240 datasets (85 gens; demand
+        # uncertainty in *scenarios_r1, wind ladders in paperruns) —
+        # data-comparable benchmarking when the reference tree is mounted
+        from tpusppy.models import uc_data as uc_model
+        default_gens, default_horizon = 85, 48
     else:
         from tpusppy.models import uc as uc_model
         default_gens, default_horizon = 30, 24
 
-    platform = jax.devices()[0].platform
     # CPU fallback (tunnel down): degrade scenario count AND problem shape
-    # so the fallback artifact lands within its timeout (full shape costs
-    # ~8 min of XLA:CPU compile alone) — flagged in the output.  The fleet
-    # stays at 20 gens, NOT fewer: the Lagrangian duality gap of this
-    # family scales like 1/gens (measured ~1.5 % at 10 gens — above the 1 %
-    # certification target no matter how good the W and incumbent are)
+    # so the fallback artifact lands within its timeout — flagged in the
+    # output (degraded_cpu_run + the model name in the metric)
     degraded = platform == "cpu" and not os.environ.get("BENCH_UC_SCENS")
     S = int(os.environ.get("BENCH_UC_SCENS", "16" if degraded else "1000"))
     gens = int(os.environ.get(
         "BENCH_UC_GENS",
-        str(min(20, default_gens) if degraded else default_gens)))
+        str(min(5, default_gens) if degraded else default_gens)))
     horizon = int(os.environ.get(
         "BENCH_UC_HORIZON",
-        str(min(12, default_horizon) if degraded else default_horizon)))
+        str(min(12, default_horizon) if degraded
+            else min(24, default_horizon))))
     iters = int(os.environ.get("BENCH_UC_ITERS", "4" if degraded else "30"))
     refresh_every = max(1, int(os.environ.get("BENCH_REFRESH", "16")))
     gap_target = float(os.environ.get("BENCH_UC_GAP", "0.01"))
@@ -80,9 +101,21 @@ def uc_metrics():
         scaling_iters=6, polish_passes=1,
     )
 
-    kw = {"num_gens": gens, "horizon": horizon, "num_scens": S,
-          "relax_integers": False}
-    names = uc_model.scenario_names_creator(S)
+    if model_name == "data":
+        data_dir = _wind_dir
+        if os.environ.get("BENCH_UC_GENS"):
+            log("uc[data]: fleet comes from the dataset; "
+                "BENCH_UC_GENS ignored (use BENCH_UC_HORIZON/SCENS)")
+        names = uc_model.scenario_names_creator(data_dir=data_dir)
+        if len(names) > S:
+            names = names[:S]
+        S = len(names)
+        kw = {"data_dir": data_dir, "horizon": horizon,
+              "relax_integers": False, "num_scens": S}
+    else:
+        kw = {"num_gens": gens, "horizon": horizon, "num_scens": S,
+              "relax_integers": False}
+        names = uc_model.scenario_names_creator(S)
     batch = ScenarioBatch.from_problems(
         [uc_model.scenario_creator(nm, **kw) for nm in names])
     log(f"uc[{model_name}] batch: {batch.num_scenarios} x "
@@ -229,6 +262,7 @@ def uc_metrics():
         why = result.get("error", f"timeout after {budget:.0f}s")
         log(f"uc wheel: {why}")
         out = {
+            "model": model_name,
             "ph_iters_per_sec": round(iters_per_sec, 4),
             "vs_baseline": round(iters_per_sec / base_ips, 2),
             "vs_baseline_32rank": round(iters_per_sec / base32, 2),
@@ -247,6 +281,7 @@ def uc_metrics():
         f"gap={gap*100:.2f}%")
 
     return {
+        "model": model_name,
         "ph_iters_per_sec": round(iters_per_sec, 4),
         "vs_baseline": round(iters_per_sec / base_ips, 2),
         "vs_baseline_32rank": round(iters_per_sec / base32, 2),

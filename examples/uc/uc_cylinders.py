@@ -28,8 +28,13 @@ def _parse_args():
     cfg.lagrangian_args()
     cfg.xhatshuffle_args()
     cfg.add_to_config("uc_model",
-                      "UC family: 'full' (reference-shape) or 'lite'",
+                      "UC family: 'full' (reference-shape), 'lite', or "
+                      "'data' (real reference datasets via --uc-data)",
                       str, "full")
+    cfg.add_to_config("uc_data",
+                      "reference UC scenario directory (uc_model='data'): "
+                      "examples/uc/*scenarios_r1 or a paperruns wind ladder",
+                      str, None)
     # both families share the uc_num_gens / uc_horizon arg names; register
     # WITHOUT defaults so each family's kw_creator fallbacks (30/24 full,
     # 5/12 lite) apply when the flags are not passed
@@ -39,9 +44,11 @@ def _parse_args():
                       "mean wind share of peak thermal capacity (full model)",
                       float, 0.25)
     cfg.parse_command_line("uc_cylinders")
-    if cfg.uc_model not in ("full", "lite"):
-        raise ValueError(f"--uc-model must be 'full' or 'lite', "
+    if cfg.uc_model not in ("full", "lite", "data"):
+        raise ValueError(f"--uc-model must be 'full', 'lite' or 'data', "
                          f"got {cfg.uc_model!r}")
+    if cfg.uc_model == "data" and not cfg.uc_data:
+        raise ValueError("--uc-model data requires --uc-data <directory>")
     return cfg
 
 
@@ -49,12 +56,21 @@ def main():
     cfg = _parse_args()
     if cfg.uc_model == "lite":
         from tpusppy.models import uc_lite as uc_model
+    elif cfg.uc_model == "data":
+        from tpusppy.models import uc_data as uc_model
     else:
         from tpusppy.models import uc as uc_model
     kwargs = uc_model.kw_creator(cfg)
     # drop unset shared args so each family's own defaults apply
     kwargs = {k: v for k, v in kwargs.items() if v is not None}
-    names = uc_model.scenario_names_creator(cfg.num_scens)
+    if cfg.uc_model == "data":
+        names = uc_model.scenario_names_creator(
+            cfg.num_scens, data_dir=cfg.uc_data)
+        if len(names) < cfg.num_scens:
+            print(f"uc_cylinders: --num-scens {cfg.num_scens} truncated to "
+                  f"the {len(names)} scenarios in {cfg.uc_data}")
+    else:
+        names = uc_model.scenario_names_creator(cfg.num_scens)
     beans = dict(
         cfg=cfg, scenario_creator=uc_model.scenario_creator,
         scenario_denouement=uc_model.scenario_denouement,

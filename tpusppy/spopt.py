@@ -399,6 +399,21 @@ class SPOpt(SPBase):
             # (hundreds of stalled prox solves at reference UC shape) would
             # OOM the host for no throughput gain
             A_shared = getattr(b, "A_shared", None)
+            max_n = int(self.options.get("straggler_qp_max_n", 2000))
+            if b.num_vars > max_n:
+                # the host IPM is dense ((n, n) factorization per Newton
+                # step): past ~2k vars one rescue costs minutes and stalls
+                # the wheel worse than the inexact prox solves it repairs.
+                # PH tolerates the inexactness; certified bounds never come
+                # from prox solves (weak duality / LP rescue paths).
+                if not getattr(self, "_qp_rescue_size_warned", False):
+                    self._qp_rescue_size_warned = True
+                    global_toc(
+                        f"straggler rescue: {qp_bad.size} stalled QP "
+                        f"scenario(s) left at batch accuracy (n="
+                        f"{b.num_vars} > straggler_qp_max_n={max_n})",
+                        True)
+                qp_bad = np.empty(0, dtype=int)
             chunk = max(1, int(self.options.get("straggler_qp_chunk", 16)))
             for lo in range(0, qp_bad.size, chunk):
                 sl = qp_bad[lo:lo + chunk]

@@ -110,6 +110,30 @@ def parse_dat_text(text: str, param_arity=None) -> DatData:
             body, i = until_semicolon(i + 3)
             out[name] = [_coerce(b) for b in body]
         elif t == "param":
+            if toks[i + 1] == ":":
+                # unnamed AMPL table ``param: A B C := key v v v ... ;`` —
+                # each column is its own param keyed by the row key(s) (the
+                # reference UC datasets' fleet/Demand/ReserveRequirement
+                # form).  Key arity from param_arity via the FIRST column.
+                j = i + 2
+                cols = []
+                while toks[j] != ":=":
+                    cols.append(str(toks[j]))
+                    j += 1
+                body, i = until_semicolon(j + 1)
+                arity = int(param_arity.get(cols[0], 1))
+                w = arity + len(cols)
+                if len(body) % w != 0:
+                    raise ValueError(
+                        f"param: {cols}: ragged table ({len(body)} toks)")
+                store = {c: out.setdefault(c, {}) for c in cols}
+                for r in range(0, len(body), w):
+                    key = tuple(_coerce(b) for b in body[r:r + arity])
+                    if arity == 1:
+                        key = key[0]
+                    for c, colname in enumerate(cols):
+                        store[colname][key] = _coerce(body[r + arity + c])
+                continue
             name = toks[i + 1]
             j = i + 2
             default = None
@@ -117,7 +141,10 @@ def parse_dat_text(text: str, param_arity=None) -> DatData:
                 default = _coerce(toks[j + 1])
                 j += 2
             if toks[j] == ":":
-                # tabular: columns up to ':=', then rows of 1 key + values
+                # tabular: columns up to ':=', then rows of key(s) + values.
+                # Key arity defaults to 1; multi-key rows (the UC datasets'
+                # ``param: Demand :=`` is (bus, hour) -> value) pass their
+                # arity through param_arity exactly like keyed params.
                 j += 1
                 cols = []
                 while toks[j] != ":=":
@@ -125,13 +152,23 @@ def parse_dat_text(text: str, param_arity=None) -> DatData:
                     j += 1
                 body, i = until_semicolon(j + 1)
                 d = {}
-                w = len(cols) + 1
+                arity = int(param_arity.get(name, 1))
+                w = len(cols) + arity
                 if len(body) % w != 0:
                     raise ValueError(f"param {name}: ragged table")
+                single = len(cols) == 1 and cols[0] == name
                 for r in range(0, len(body), w):
-                    row = _coerce(body[r])
+                    key = tuple(_coerce(b) for b in body[r:r + arity])
+                    if arity == 1:
+                        key = key[0]
                     for c, col in enumerate(cols):
-                        d[(row, col)] = _coerce(body[r + 1 + c])
+                        val = _coerce(body[r + arity + c])
+                        if single:
+                            d[key] = val
+                        elif arity == 1:
+                            d[(key, col)] = val
+                        else:
+                            d[key + (col,)] = val
                 out[name] = d if default is None else DefaultedDict(default, d)
             else:
                 if toks[j] != ":=":
