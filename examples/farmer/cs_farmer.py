@@ -31,6 +31,7 @@ def main(args=None):
     beans = dict(cfg=cfg, scenario_creator=farmer.scenario_creator,
                  all_scenario_names=names, scenario_creator_kwargs=kw)
     hub_dict = vanilla.ph_hub(**beans)
+    vanilla.add_cross_scenario_cuts(hub_dict, cfg)
     spokes = [vanilla.cross_scenario_cuts_spoke(**beans)]
     if cfg.xhatshuffle:
         spokes.append(vanilla.xhatshuffle_spoke(**beans))

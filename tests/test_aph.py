@@ -104,3 +104,63 @@ def test_aph_listener_overlap_matches_inline():
         # reductions are tolerated BY DESIGN, so only sanity holds
         assert np.isfinite(eobj2)
         assert eobj2 == pytest.approx(eobj1, rel=0.05)
+
+
+def test_aph_listener_true_overlap():
+    """Full-overlap mode (APH_listener_wait_secs=0): the listener thread
+    must run reductions WHILE the worker is inside its (deliberately
+    slowed) solve — the point of the reference's listener architecture
+    (aph.py:198-330: reductions concurrent with subproblem solves) — and
+    fractional dispatch runs simultaneously (VERDICT r3 next #6)."""
+    import threading
+    import time as _time
+
+    from tpusppy.models import farmer
+    from tpusppy.opt.aph import APH
+
+    n = 3
+    names = farmer.scenario_names_creator(n)
+    aph = APH({"PHIterLimit": 8, "defaultPHrho": 1.0, "convthresh": -1.0,
+               "dispatch_frac": 0.67, "APHuse_listener": True,
+               "APH_listener_wait_secs": 0.0},
+              names, farmer.scenario_creator,
+              scenario_creator_kwargs={"num_scens": n})
+
+    solve_windows = []
+    orig_solve = aph.APH_solve_loop
+
+    def slow_solve():
+        t0 = _time.time()
+        rows = orig_solve()
+        _time.sleep(0.05)            # widen the overlap window
+        solve_windows.append((t0, _time.time()))
+        return rows
+
+    aph.APH_solve_loop = slow_solve
+
+    gig_times = []
+    orig_make = aph._make_side_gig
+
+    def make_timed():
+        gig = orig_make()
+
+        def timed(sync):
+            gig(sync)
+            gig_times.append((_time.time(),
+                              threading.current_thread().name))
+        return timed
+
+    aph._make_side_gig = make_timed
+    conv, eobj, triv = aph.APH_main()
+    assert np.isfinite(eobj)
+    # reductions really ran on the listener thread...
+    assert gig_times and all(
+        name == "SynchronizerListener" for _, name in gig_times)
+    # ...and at least one of them DURING a worker solve window (overlap)
+    overlapped = any(
+        any(lo <= t <= hi for lo, hi in solve_windows)
+        for t, _ in gig_times)
+    assert overlapped, (gig_times, solve_windows)
+    # zero-wait mode tolerates staleness by design; the counter proves the
+    # worker did not silently fall back to inline reductions
+    assert aph._stale_reductions >= 1

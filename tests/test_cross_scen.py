@@ -55,8 +55,9 @@ def test_cross_scenario_cut_wheel():
 
 
 def test_cut_injection_reshapes_batch_and_bounds():
-    """pre_iter0 reform adds the phi column + cut slots; add_cuts activates
-    rows; the EF-relaxation check yields a certified bound above WS."""
+    """pre_iter0 reform adds the eta VECTOR (one epigraph column per
+    scenario, as the reference) + cut slots; add_cuts activates rows; the
+    EF-relaxation check yields a certified bound above WS."""
     from tpusppy.extensions.cross_scen_extension import CrossScenarioExtension
     from tpusppy.opt.ph import PH
 
@@ -69,8 +70,9 @@ def test_cut_injection_reshapes_batch_and_bounds():
     ext = ph.extobject
     n_vars0 = ph.batch.num_vars
     ext.pre_iter0()
-    assert ph.batch.num_vars == n_vars0 + 1
-    assert ph.batch.lb[:, -1].min() > -1e8      # certified finite phi lb
+    assert ph.batch.num_vars == n_vars0 + n
+    # certified finite eta lbs
+    assert ph.batch.lb[:, -n:].min() > -1e8
 
     # a true cut at the EF solution for every scenario
     from tpusppy.cylinders.spcommunicator import WindowFabric
@@ -148,9 +150,86 @@ def test_cut_slots_roll_past_preallocation():
 
     ext.add_cuts(round_rows(1.0))
     ext.add_cuts(round_rows(2.0))
-    row0 = ext._cut_row0
+    row0 = ext._cut_row0               # first row of round-slot 0
     cl_before = b.cl[:, row0].copy()
     ext.add_cuts(round_rows(3.0))          # wraps onto slot 0
     assert ext._next_row == 3
     assert not np.allclose(b.cl[:, row0], cl_before)  # slot 0 overwritten
     assert len(ext._cuts) == 3             # host list keeps generations
+
+
+
+def test_cuts_keep_shared_A():
+    """The eta-vector formulation writes identical cut coefficients into
+    every scenario model, so a shared-A family STAYS shared through reform
+    and cut rounds (r3 weak #5: the aggregated design densified it) — at
+    S=256 the matrix stays one (m', n') array, not (S, m', n')."""
+    from tpusppy.extensions.cross_scen_extension import CrossScenarioExtension
+    from tpusppy.models import uc_lite
+    from tpusppy.opt.ph import PH
+
+    n = 256
+    names = uc_lite.scenario_names_creator(n)
+    kw = {"num_gens": 3, "horizon": 6, "num_scens": n,
+          "relax_integers": True}
+    ph = PH({"defaultPHrho": 1.0, "PHIterLimit": 1, "convthresh": -1.0,
+             "cross_scen_options": {"max_cut_rounds": 2},
+             "solver_options": {"max_iter": 60, "restarts": 1}},
+            names, uc_lite.scenario_creator, scenario_creator_kwargs=kw)
+    assert ph.batch.A_shared is not None
+    n_vars0 = ph.batch.num_vars
+    ext = CrossScenarioExtension(ph)
+    ph.extobject = ext
+    ext.pre_iter0()
+    b = ph.batch
+    assert b.A_shared is not None                  # sharing SURVIVED reform
+    assert b.num_vars == n_vars0 + n               # the eta VECTOR landed
+    assert b.A.base is not None                    # broadcast view, not copy
+    K = ph.tree.nonant_indices.shape[0]
+    rng = np.random.default_rng(0)
+    rows = np.concatenate(
+        [rng.normal(size=(n, K)) * 1e-3, np.full((n, 1), -1e5)], axis=1)
+    ext.add_cuts(rows)
+    assert b.A_shared is not None
+    # the cut rows landed in the SHARED matrix and every scenario sees them
+    r0 = ext._cut_row0
+    assert np.allclose(b.A[0, r0:r0 + n, ext._eta0:ext._eta0 + n],
+                       np.eye(n))
+    assert np.shares_memory(b.A, b.A_shared)
+
+
+def test_cut_wheel_shared_family_ef_parity():
+    """EF parity for the cut-steered wheel on a shared-A family: bounds
+    certified, incumbent near the EF optimum, sharing intact end-to-end."""
+    from tpusppy.ef import solve_ef
+    from tpusppy.ir import ScenarioBatch
+    from tpusppy.models import uc_lite
+    from tpusppy.utils import cfg_vanilla as vanilla
+
+    n = 6
+    names = uc_lite.scenario_names_creator(n)
+    kw = {"num_gens": 3, "horizon": 6, "num_scens": n,
+          "relax_integers": True}
+    batch = ScenarioBatch.from_problems(
+        [uc_lite.scenario_creator(nm, **kw) for nm in names])
+    ef_obj, _ = solve_ef(batch, solver="highs", mip=False)
+
+    cfg = _cfg(n)
+    cfg.max_iterations = 40
+    beans = dict(cfg=cfg, scenario_creator=uc_lite.scenario_creator,
+                 all_scenario_names=names, scenario_creator_kwargs=kw)
+    hub_dict = vanilla.ph_hub(**beans)
+    vanilla.add_cross_scenario_cuts(hub_dict, cfg)
+    spokes = [
+        vanilla.cross_scenario_cuts_spoke(**beans),
+        vanilla.xhatshuffle_spoke(**beans),
+    ]
+    ws = WheelSpinner(hub_dict, spokes).spin()
+    # the cut bound must be certified-valid and essentially close the
+    # relaxation (measured: within 0.02% of the EF optimum); the incumbent
+    # is donor-quality at 40 iterations, so only sanity is pinned there
+    assert ws.BestOuterBound <= ef_obj + 1e-6 * abs(ef_obj)
+    assert ws.BestOuterBound >= ef_obj - 0.01 * abs(ef_obj)
+    assert ws.BestInnerBound == pytest.approx(ef_obj, rel=0.06)
+    assert ws.BestOuterBound <= ws.BestInnerBound + 1e-6
+    assert ws.opt.batch.A_shared is not None       # shared through the wheel

@@ -28,34 +28,45 @@ class CrossScenarioExtension(Extension):
         so = opt.options.get("cross_scen_options", {})
         self.check_bound_iterations = so.get("check_bound_improve_iterations",
                                              4)
-        self.max_cut_rounds = int(so.get("max_cut_rounds", 32))
+        # rounds preallocate S rows each (the eta-vector form), so the
+        # default scales the retained generations to a ~4k-row budget:
+        # small families keep 32 rounds, S=1000 keeps 4
+        S = opt.batch.num_scenarios
+        self.max_cut_rounds = int(so.get(
+            "max_cut_rounds", max(2, min(32, 4096 // max(S, 1)))))
         from collections import deque
 
         # bounded: the host cutting-plane LP pays per retained round, and
         # the device slots roll (see add_cuts) — keep a few generations
         self._cuts = deque(maxlen=4 * self.max_cut_rounds)
         self._last_lb = -np.inf
-        self._phi_col = None       # set by pre_iter0's batch reform
+        self._eta0 = None          # first eta column (pre_iter0 reform)
         self._cut_row0 = None
         self._next_row = None
         self._q2lb = None          # certified per-scenario Q2 lower bounds
 
     # ---- in-batch reform (cross_scen_extension.py:120-283 analogue) --------
     def pre_iter0(self):
-        """Reshape the scenario batch: one aggregate ``phi`` column (the
-        epigraph of the OTHER scenarios' probability-weighted costs — the
-        reference's per-scenario eta vector, aggregated so the column count
-        stays O(1)) plus preallocated cut-row slots.  Regular PH solves are
-        unaffected (phi has zero cost and only cut rows touch it); the
-        periodic ``_check_bound`` alt-objective solve uses it to turn every
-        subproblem into a certified EF relaxation."""
+        """Reshape the scenario batch: the reference's per-scenario ETA
+        VECTOR (one epigraph column per scenario, added to every model —
+        cross_scen_extension.py:120-283) plus ``max_cut_rounds`` rounds of
+        preallocated cut-row slots, S rows per round.
+
+        The eta-vector form is what keeps shared-A families shared: the cut
+        for scenario s' (``eta_{s'} >= g_{s'}.x + c_{s'}``) has IDENTICAL
+        coefficients in every scenario's model, so rows write once into the
+        single shared matrix (r3 weak #5: the aggregated-phi design needed
+        per-scenario coefficients and densified the family).  Regular PH
+        solves are unaffected (etas cost zero and only cut rows touch
+        them); the periodic ``_check_bound`` alt-objective solve prices
+        them to turn every subproblem into a certified EF relaxation."""
         opt = self.opt
         if opt.tree.num_stages != 2:
             raise RuntimeError(
                 "CrossScenarioExtension supports two-stage problems only "
                 "(as the reference, cross_scen_extension.py:120-122)")
         b = opt.batch
-        self._phi_col = b.num_vars
+        self._eta0 = b.num_vars
         self._cut_row0 = b.num_rows
         self._next_row = 0
         # a CERTIFIED finite phi lower bound (the reference's valid_eta_bound,
@@ -85,68 +96,69 @@ class CrossScenarioExtension(Extension):
         dvals = (np.asarray(admm.dual_objective(*args), dtype=float)
                  - np.asarray(admm.dual_objective_margin(*args), dtype=float))
         self._q2lb = dvals + b.const - 1.0       # Q2_s(x) >= _q2lb[s], all x
-        if "phi_lb" in so:
-            phi_lb = np.full(b.num_scenarios, float(so["phi_lb"]))
-        else:
-            d = opt.probs * self._q2lb
-            phi_lb = d.sum() - d
+        S = b.num_scenarios
+        eta_lb = (np.full(S, float(so["eta_lb"]))
+                  if "eta_lb" in so else self._q2lb)
         opt.batch = b.augment(
-            1, self.max_cut_rounds, col_lb=0.0, col_ub=np.inf,
-            col_names=["_cross_scen_phi"])
-        opt.batch.lb[:, self._phi_col] = phi_lb
+            S, self.max_cut_rounds * S, col_lb=0.0, col_ub=np.inf,
+            col_names=[f"_cs_eta[{s}]" for s in range(S)])
+        # every scenario model carries the full eta vector with the same
+        # certified lower bounds (the reference's valid_eta_bound)
+        opt.batch.lb[:, self._eta0:self._eta0 + S] = eta_lb[None, :]
         # shapes changed: the PH warm chain and cached factors are void
         opt._warm = None
         opt._factors = None
         opt._factors_sig = None
 
     def add_cuts(self, rows: np.ndarray):
-        """Accept a (S, K+1) payload from the cut spoke (NaN rows dropped)
-        and inject the aggregate cut into every scenario's preallocated slot:
+        """Accept a (S, K+1) payload from the cut spoke and inject one cut
+        ROUND — for every scenario s' the row
 
-            phi_s >= sum_{s' != s} p_s' [g_s' . x + const_s']
+            eta_{s'} - g_{s'} . x >= c_{s'}        (cl finite, cu = +inf)
 
-        written as the row  phi - G_s.x >= C_s  (cl finite, cu = +inf).
+        into the preallocated slots.  Coefficients are identical across
+        scenario models, so for a shared-A family the round writes ONCE
+        into the shared matrix; each cut is individually certified, so a
+        NaN (failed) payload row degrades to the constant certified cut
+        ``eta_{s'} >= q2lb_{s'}`` without touching the others.
         """
         if self.max_cut_rounds <= 0:
             return                 # device cut slots disabled
         valid = ~np.isnan(rows).any(axis=1)
         if not valid.any():
             return
-        # Device cut slots ROLL: past max_cut_rounds the oldest slot is
-        # overwritten (every cut is individually valid, so dropping one can
-        # only loosen the relaxation, never invalidate it) — steering
-        # continues indefinitely instead of freezing at the preallocation
-        # (r2 known-gap).
-        # scenarios whose cut row is invalid (NaN) CANNOT simply be omitted
-        # from the aggregate: Q2 can be negative, so dropping a term would
-        # raise the aggregate "lower bound" above the true sum — an invalid
-        # cut that can push the EF-relaxation bound above the optimum.
-        # Substitute the certified constant cut Q2_t(x) >= _q2lb[t] instead.
-        clean = np.where(valid[:, None], rows, 0.0)
-        if self._q2lb is not None:
-            clean[~valid, -1] = self._q2lb[~valid]
-        elif not valid.all():
-            return      # no safe substitute available: skip this round
         # store the FULL round (NaN rows kept): compute_outer_bound binds
         # row s to scenario s's eta by POSITION, so filtering would
         # misalign cuts with etas and could certify an invalid bound
         self._cuts.append(rows)
-        if self._phi_col is None:
+        if getattr(self, "_eta0", None) is None:
             return
         opt = self.opt
         b = opt.batch
         idx = opt.tree.nonant_indices
-        p = opt.probs                             # every scenario contributes
-        G_tot = p @ clean[:, :-1]                 # (K,)
-        C_tot = float(p @ clean[:, -1])
-        G_s = G_tot[None, :] - p[:, None] * clean[:, :-1]     # (S, K)
-        C_s = C_tot - p * clean[:, -1]                        # (S,)
-        row = self._cut_row0 + (self._next_row % self.max_cut_rounds)
-        b.A[:, row, :] = 0.0
-        b.A[:, row, idx] = -G_s
-        b.A[:, row, self._phi_col] = 1.0
-        b.cl[:, row] = C_s
-        b.cu[:, row] = np.inf
+        S = b.num_scenarios
+        clean = np.where(valid[:, None], rows, 0.0)
+        # failed payload rows degrade to the constant certified cut
+        # eta_{s'} >= q2lb_{s'} (pre_iter0 always computes _q2lb)
+        consts = np.where(valid, clean[:, -1], self._q2lb)
+        grads = np.where(valid[:, None], clean[:, :-1], 0.0)
+        # Device cut slots ROLL by round: past max_cut_rounds the oldest
+        # round is overwritten (each cut is individually valid, so dropping
+        # one can only loosen the relaxation) — steering continues
+        # indefinitely instead of freezing at the preallocation.
+        r0 = self._cut_row0 + (self._next_row % self.max_cut_rounds) * S
+        if b.A_shared is not None:
+            A_rows = b.A_shared[r0:r0 + S]        # write ONCE, all models
+        else:
+            A_rows = b.A[:, r0:r0 + S, :]         # same values per scenario
+        A_rows[..., :] = 0.0
+        tgt = A_rows if b.A_shared is not None else A_rows[0]
+        tgt[:, idx] = -grads
+        tgt[np.arange(S), self._eta0 + np.arange(S)] = 1.0
+        if b.A_shared is None:
+            A_rows[:] = A_rows[0][None]
+        b.cl[:, r0:r0 + S] = consts[None, :]
+        b.cu[:, r0:r0 + S] = np.inf
         b.version += 1
         self._next_row += 1
 
@@ -157,13 +169,17 @@ class CrossScenarioExtension(Extension):
         valid EF outer bound (the reference's EF_Obj flip + max reduce,
         cross_scen_extension.py:72-117)."""
         opt = self.opt
-        if self._phi_col is None or self._next_row == 0:
+        if getattr(self, "_eta0", None) is None or self._next_row == 0:
             return None
         b = opt.batch
         nm = b.nonant_mask()
         p = opt.probs
+        S = b.num_scenarios
         q = np.where(nm[None, :], b.c, b.c * p[:, None])
-        q[:, self._phi_col] = 1.0
+        # price the OTHER scenarios' epigraphs (own second stage is real):
+        # q[s, eta_{s'}] = p_{s'} for s' != s, 0 on the own column
+        q[:, self._eta0:self._eta0 + S] = p[None, :]
+        q[np.arange(S), self._eta0 + np.arange(S)] = 0.0
         q2 = np.where(nm[None, :], b.q2, b.q2 * p[:, None])
         # hold the PH warm chain harmless across the side solve
         saved = (opt._warm, opt._factors, opt._factors_sig, opt._factors_age)

@@ -290,12 +290,19 @@ class ScenarioBatch:
         S, m, n = self.A.shape
         dc, dr = int(extra_cols), int(extra_rows)
         pad_c = np.zeros((S, dc))
-        # materializes per-scenario A (cut rows are written per scenario
-        # in-place later): a shared-A batch loses its sharing here — cut
-        # steering is a small/medium-family feature; at shared-A scale use
-        # the hub-side cutting-plane bound instead
-        A = np.zeros((S, m + dr, n + dc))
-        A[:, :m, :n] = self.A
+        if self.A_shared is not None:
+            # sharedness SURVIVES augmentation: the new slots start zero in
+            # the single (m+dr, n+dc) matrix and later in-place writes must
+            # go through ``A_shared`` (identical coefficients for every
+            # scenario — the eta-vector cut formulation guarantees this;
+            # per-scenario structure belongs in costs/rhs/bounds)
+            A_shared = np.zeros((m + dr, n + dc))
+            A_shared[:m, :n] = self.A_shared
+            A = np.broadcast_to(A_shared[None], (S, m + dr, n + dc))
+        else:
+            A_shared = None
+            A = np.zeros((S, m + dr, n + dc))
+            A[:, :m, :n] = self.A
         names = None
         if self.var_names is not None:
             names = self.var_names + list(
@@ -305,7 +312,7 @@ class ScenarioBatch:
             c=np.concatenate([self.c, pad_c], axis=1),
             q2=np.concatenate([self.q2, pad_c], axis=1),
             A=A,
-            A_shared=None,
+            A_shared=A_shared,
             cl=np.concatenate([self.cl, np.full((S, dr), -INF)], axis=1),
             cu=np.concatenate([self.cu, np.full((S, dr), INF)], axis=1),
             lb=np.concatenate(

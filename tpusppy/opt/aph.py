@@ -152,15 +152,25 @@ class APH(PHBase):
             "serial": np.array([float(self._iter)]),
         }
         sync.compute_global_data(flat, enable_side_gig=True)
-        deadline = time.time() + float(
-            self.options.get("async_sleep_secs", 0.01)) * 100
+        # freshness wait: by default the worker gives the listener ~100
+        # sleep quanta to produce THIS iteration's reduction (near-inline
+        # trajectory).  APH_listener_wait_secs=0 is the full-overlap mode:
+        # read whatever reduction exists — one publish stale — and let the
+        # listener crunch the new publication WHILE the next solve runs
+        # (the reference's tolerated staleness, aph.py:198-330).
+        wait = self.options.get("APH_listener_wait_secs")
+        if wait is None:
+            wait = float(self.options.get("async_sleep_secs", 0.01)) * 100
+        deadline = time.time() + float(wait)
         fresh = False
-        while time.time() < deadline:
+        while True:
             with sync._lock:
                 red = sync.reduced
                 if red is not None and red["serial"] >= self._iter:
                     fresh = True
                     break
+            if time.time() >= deadline:
+                break
             time.sleep(0.0005)
         with sync._lock:
             red = sync.reduced
