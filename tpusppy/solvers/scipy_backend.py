@@ -71,12 +71,17 @@ def solve_lp(c, A, cl, cu, lb, ub, is_int=None, q2=None, const=0.0,
 
 
 def solve_lp_with_duals(c, A, cl, cu, lb, ub, const=0.0) -> SolveResult:
-    """Continuous LP with row duals via linprog (for Benders/Lagrangian checks)."""
+    """Continuous LP with row duals via linprog (for Benders/Lagrangian
+    checks and the straggler rescue).  ``A`` goes through scipy.sparse:
+    UC-scale matrices are ~0.3% dense, and linprog's dense input path
+    both copies and scans the full (m, n) array per call."""
     # linprog wants A_ub x <= b_ub and A_eq x = b_eq; split rows.
+    A = sp.csr_matrix(np.asarray(A))
     eq = np.isfinite(cl) & np.isfinite(cu) & (cl == cu)
     ub_rows = np.isfinite(cu) & ~eq
     lb_rows = np.isfinite(cl) & ~eq
-    A_ub = np.vstack([A[ub_rows], -A[lb_rows]]) if (ub_rows.any() or lb_rows.any()) else None
+    A_ub = (sp.vstack([A[ub_rows], -A[lb_rows]], format="csr")
+            if (ub_rows.any() or lb_rows.any()) else None)
     b_ub = np.concatenate([cu[ub_rows], -cl[lb_rows]]) if A_ub is not None else None
     A_eq = A[eq] if eq.any() else None
     b_eq = cl[eq] if eq.any() else None

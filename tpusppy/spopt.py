@@ -431,7 +431,16 @@ class SPOpt(SPBase):
                     pri[s] = 0.0
                     dua[s] = 0.0
                     n_resc += 1
-        for s in bad[~is_qp[bad]]:
+        lp_bad = bad[~is_qp[bad]]
+        max_lp = int(self.options.get("straggler_lp_max", 64))
+        if lp_bad.size > max_lp:
+            # big-batch stall tails (hundreds of mildly-stalled scenarios at
+            # reference scale) would serialize hundreds of host LPs per
+            # solve; rescue the worst offenders, leave the rest at batch
+            # accuracy (bounds stay certified via weak duality regardless)
+            worst = np.argsort(-np.maximum(pri[lp_bad], dua[lp_bad]))
+            lp_bad = lp_bad[worst[:max_lp]]
+        for s in lp_bad:
             res = scipy_backend.solve_lp_with_duals(
                 q[s], b.A[s], b.cl[s], b.cu[s], lb[s], ub[s])
             if not res.feasible or res.duals is None:
