@@ -122,7 +122,7 @@ def main():
                  or os.environ.get("JAX_PLATFORMS") == "cpu")
     attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", "3"))
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
-    run_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", "2400"))
+    run_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", "3200"))
     cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", "2400"))
     backoff = float(os.environ.get("BENCH_BACKOFF", "30"))
 
@@ -194,7 +194,8 @@ def workload():
 
     import tpusppy
 
-    tpusppy.disable_tictoc_output()
+    if not os.environ.get("BENCH_TRACE"):
+        tpusppy.disable_tictoc_output()
     from tpusppy.ir import ScenarioBatch
     from tpusppy.models import farmer
     from tpusppy.parallel import sharded

@@ -94,7 +94,22 @@ class LagrangianOuterBound(OuterBoundWSpoke):
         self.final_bound = self._set_weights_and_solve()
         if np.isfinite(self.final_bound):
             self.bound = self.final_bound
-        ascent_cfg = self.opt.options.get("lagrangian_milp_ascent")
+        ascent_cfg = dict(self.opt.options.get("lagrangian_milp_ascent")
+                          or {})
+        # the hub ships its current (outer, inner) bounds in the W payload
+        # tail: when the wheel has ALREADY certified a gap at or below
+        # ``skip_if_gap_at``, the ascent polish can only burn the wall
+        # clock the watchdog is counting
+        skip_at = float(ascent_cfg.pop("skip_if_gap_at", 0.0))
+        if ascent_cfg and skip_at > 0.0 and self._locals.shape[0] >= 2:
+            ob, ib = self.hub_outer_bound, self.hub_inner_bound
+            # the HUB's own gap convention (hub.py): minimization,
+            # (ib - ob)/|ob|; a negative difference means crossed bounds —
+            # never a reason to skip
+            if (self.opt.is_minimizing and np.isfinite(ob)
+                    and np.isfinite(ib) and abs(ob) > 0
+                    and 0 <= (ib - ob) / abs(ob) <= skip_at):
+                ascent_cfg = None
         if ascent_cfg and bool(np.asarray(self.opt.batch.is_int).any()):
             from ..solvers.milp_bound import milp_dual_ascent
 

@@ -42,6 +42,7 @@ class SchurComplement(SPBase):
         settings = ipm.IPMSettings(
             tol=float(self.options.get("sc_tol", 1e-6)),
             max_iter=int(self.options.get("sc_max_iter", 100)),
+            crossover=bool(self.options.get("sc_crossover", True)),
         )
         res = ipm.solve_sc(self.batch, settings)
         self.ipm_result = res

@@ -134,8 +134,12 @@ class PHBase(SPOpt):
 
             tol = max(self.options.get("feas_tol", 1e-3),
                       10.0 * self.admm_settings.eps_rel)
-            bad = np.flatnonzero(np.asarray(self.pri_res) > tol)
-            worst = bad[np.argsort(-np.asarray(self.pri_res)[bad])][:16]
+            pri0 = np.asarray(self.pri_res)
+            # ~(pri <= tol), NOT (pri > tol): NaN residuals (diverged
+            # solves) must land in the check set, not slip past it
+            bad = np.flatnonzero(~(pri0 <= tol))
+            key = np.where(np.isnan(pri0[bad]), np.inf, pri0[bad])
+            worst = bad[np.argsort(-key)][:16]
             b = self.batch
             truly_bad = []
             for s in worst:
@@ -150,10 +154,13 @@ class PHBase(SPOpt):
                     f"{feas:.4f}, host-verified infeasible scenarios "
                     f"{truly_bad} (cf. phbase.py:818-823 hard quit)"
                 )
+            checked_all = len(worst) == bad.size
             global_toc(
                 f"iter0: {bad.size} scenario(s) above feas_tol are a "
-                "solver plateau (host feasibility check passed on the "
-                f"{len(worst)} worst) — continuing", True)
+                "solver plateau (host feasibility check passed on "
+                + ("ALL of them" if checked_all
+                   else f"the {len(worst)} worst — a sampled check")
+                + ") — continuing", True)
         self.trivial_bound = self.Ebound()
         self.best_bound = self.trivial_bound
         self.Compute_Xbar()

@@ -400,15 +400,26 @@ def _crossover_ef(batch, xs, q2_any, masks=None):
         np.logical_or.at(dual_ub, ef.col_of.ravel(), v_ub.ravel())
     tight_lb = np.isfinite(lb) & (x0 - lb < 1e-5 * (1 + np.abs(x0)))
     tight_ub = np.isfinite(ub) & (ub - x0 < 1e-5 * (1 + np.abs(x0)))
+    # rung order: restricted solves are cheap warm paths, but ONLY the
+    # unrestricted rung is guaranteed optimal — when it is affordable
+    # (nv <= 4096) it runs LAST and its result WINS over any restricted
+    # rung, so an accepted point from this function is the true EF optimum
+    # whenever that rung exists; callers gate the restricted-only case on
+    # interior-point quality (see _solve_sc)
     fix_sets = [
         ((dual_lb | tight_lb) & np.isfinite(lb),
          (dual_ub | tight_ub) & np.isfinite(ub)),
         (tight_lb, tight_ub & ~tight_lb),
     ]
-    if nv <= 4096:
+    exact_rung = nv <= 4096
+    if exact_rung:
         fix_sets.append((np.zeros(nv, bool), np.zeros(nv, bool)))
     best = None
-    for fl, fu in fix_sets:
+    best_obj = obj0 + 1e-9 * max(1.0, abs(obj0))
+    for k, (fl, fu) in enumerate(fix_sets):
+        is_exact = exact_rung and k == len(fix_sets) - 1
+        if best is not None and not is_exact:
+            continue              # restricted rungs: first accepted wins
         fu = fu & ~fl
         lb_r = np.where(fu, ub, lb)
         ub_r = np.where(fl, lb, ub)
@@ -417,9 +428,9 @@ def _crossover_ef(batch, xs, q2_any, masks=None):
         # 0): an iteration-limited incumbent must not be installed as exact
         if not res.feasible or res.status != "0":
             continue
-        if res.obj <= obj0 + 1e-9 * max(1.0, abs(obj0)):
+        if res.obj <= best_obj:
             best = res.x
-            break
+            best_obj = res.obj
     return None if best is None else ef.split_solution(best)
 
 
@@ -545,7 +556,11 @@ def _solve_sc(batch, st, dt):
         # unrestricted exact rung, so any accepted point IS optimal;
         # bigger EFs only cross over from a converged interior point.
         interior_ok = bool(res_f < 100 * st.tol)
-        small_ef = (batch.num_scenarios * batch.num_rows) <= 200_000
+        # "small" must mean the EXACT unrestricted rung exists (EF column
+        # count <= 4096), not just a small row count
+        K_c = batch.tree.nonant_indices.shape[0]
+        nv_est = K_c + batch.num_scenarios * (batch.num_vars - K_c)
+        small_ef = nv_est <= 4096
         x_cross = None
         if interior_ok or small_ef:
             x_cross = _crossover_ef(batch, xs, q2_any, masks=masks)

@@ -66,13 +66,19 @@ def milp_lift(batch, q, base_perscen, *, budget_s=30.0, mip_rel_gap=1e-4,
     const = np.broadcast_to(np.asarray(batch.const), (S,))
     deadline = time.monotonic() + float(budget_s)
     workers = workers or min(8, os.cpu_count() or 1)
+    # shared-A families: one csr conversion for the whole lift round
+    import scipy.sparse as _sp
+
+    A_sh = getattr(batch, "A_shared", None)
+    A_csr = _sp.csr_matrix(np.asarray(A_sh)) if A_sh is not None else None
 
     def solve(s):
         rem = deadline - time.monotonic()
         if rem <= 0.05:
             return s, None
         res = scipy_backend.solve_lp(
-            q[s], batch.A[s], batch.cl[s], batch.cu[s],
+            q[s], A_csr if A_csr is not None else batch.A[s],
+            batch.cl[s], batch.cu[s],
             batch.lb[s], batch.ub[s], is_int=batch.is_int,
             mip_rel_gap=mip_rel_gap,
             time_limit=min(float(time_limit), rem))

@@ -39,7 +39,9 @@ def solve_lp(c, A, cl, cu, lb, ub, is_int=None, q2=None, const=0.0,
     if q2 is not None and np.any(q2 != 0):
         raise NotImplementedError("HiGHS backend is LP/MILP only; use admm for QP")
     m, n = A.shape
-    constraints = sopt.LinearConstraint(sp.csr_matrix(A), cl, cu) if m else ()
+    if not sp.issparse(A):
+        A = sp.csr_matrix(np.asarray(A))
+    constraints = sopt.LinearConstraint(A, cl, cu) if m else ()
     integrality = None
     if is_int is not None and np.any(is_int):
         integrality = np.where(is_int, 1, 0)
@@ -76,7 +78,8 @@ def solve_lp_with_duals(c, A, cl, cu, lb, ub, const=0.0) -> SolveResult:
     UC-scale matrices are ~0.3% dense, and linprog's dense input path
     both copies and scans the full (m, n) array per call."""
     # linprog wants A_ub x <= b_ub and A_eq x = b_eq; split rows.
-    A = sp.csr_matrix(np.asarray(A))
+    if not sp.issparse(A):
+        A = sp.csr_matrix(np.asarray(A))
     eq = np.isfinite(cl) & np.isfinite(cu) & (cl == cu)
     ub_rows = np.isfinite(cu) & ~eq
     lb_rows = np.isfinite(cl) & ~eq

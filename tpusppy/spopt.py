@@ -440,9 +440,18 @@ class SPOpt(SPBase):
             # accuracy (bounds stay certified via weak duality regardless)
             worst = np.argsort(-np.maximum(pri[lp_bad], dua[lp_bad]))
             lp_bad = lp_bad[worst[:max_lp]]
+        # shared-A families: ONE csr conversion per rescue round (the
+        # (m, n) dense scan per scenario was the hot cost at WECC scale) —
+        # built only when there is LP work, so QP-only rounds skip it
+        import scipy.sparse as _sp
+
+        A_csr = (_sp.csr_matrix(np.asarray(b.A_shared))
+                 if lp_bad.size
+                 and getattr(b, "A_shared", None) is not None else None)
         for s in lp_bad:
             res = scipy_backend.solve_lp_with_duals(
-                q[s], b.A[s], b.cl[s], b.cu[s], lb[s], ub[s])
+                q[s], A_csr if A_csr is not None else b.A[s],
+                b.cl[s], b.cu[s], lb[s], ub[s])
             if not res.feasible or res.duals is None:
                 continue        # genuine infeasibility: leave residuals
             xs = res.x
