@@ -557,9 +557,16 @@ def _solve_sc(batch, st, dt):
         # bigger EFs only cross over from a converged interior point.
         interior_ok = bool(res_f < 100 * st.tol)
         # "small" must mean the EXACT unrestricted rung exists (EF column
-        # count <= 4096), not just a small row count
+        # count <= 4096), not just a small row count.  Count columns the
+        # way build_ef does: one merged column per distinct (node, nonant
+        # slot) pair — a two-stage shortcut (K + S*(n-K)) undercounts
+        # multistage EFs, which allocate per-node columns.
         K_c = batch.tree.nonant_indices.shape[0]
-        nv_est = K_c + batch.num_scenarios * (batch.num_vars - K_c)
+        nid_sk = batch.tree.nid_sk()                     # (S, K) node ids
+        merged_cols = np.unique(
+            nid_sk.astype(np.int64) * max(K_c, 1)
+            + np.arange(K_c, dtype=np.int64)[None, :]).size
+        nv_est = merged_cols + batch.num_scenarios * (batch.num_vars - K_c)
         small_ef = nv_est <= 4096
         x_cross = None
         if interior_ok or small_ef:

@@ -375,7 +375,8 @@ class SPOpt(SPBase):
         q2_np = np.asarray(q2)
         is_qp = np.any(q2_np != 0.0, axis=-1)
         tol_s = np.where(is_qp, tol_qp, tol_lp)
-        bad = np.flatnonzero((pri > tol_s) | (dua > tol_s))
+        # negated <= so NaN residuals (diverged solves) are selected too
+        bad = np.flatnonzero(~(pri <= tol_s) | ~(dua <= tol_s))
         if bad.size == 0:
             return sol
         from .solvers import scipy_backend
