@@ -16,6 +16,7 @@ the same compiled step runs on one device with a trivial mesh.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import NamedTuple
 
@@ -26,6 +27,54 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..solvers import admm, shared_admm
 from ..solvers.admm import ADMMSettings
+
+# ---------------------------------------------------------------------------
+# Dispatch segmentation: the remote TPU worker kills any single program
+# execution around ~60 s (measured: a synthetic 110 s matmul loop dies at
+# 62 s with "TPU worker process crashed or restarted").  Reference-scale UC
+# (S=1000, n=16008) needs minutes of ADMM sweeps per PH iteration, so one
+# monolithic dispatch is structurally impossible — the sweep loop is split
+# into bounded-length segments re-entered from the host (the frozen-factor
+# path makes continuation free: factors are computed once, segments warm-
+# start from the previous raw iterate).  Shapes small enough for one
+# dispatch keep the original single-program path (and its pipelining).
+# ---------------------------------------------------------------------------
+_DISPATCH_TARGET_SECS = 18.0
+# conservative effective sweep throughput under matmul precision "highest"
+# (bf16x6 passes); measured ~7.7e12 flop/s at UC shapes on one v5e chip
+_DISPATCH_EFF_FLOPS = 4e12
+
+
+def _dispatch_segments(S, n, m, st: ADMMSettings, factor_batch=1):
+    """(seg_refresh, seg_frozen): per-dispatch sweep caps for these shapes.
+
+    ``S`` is the PER-DEVICE scenario count (callers divide by the mesh
+    size); ``factor_batch`` is how many factorizations one refresh performs
+    per restart (the per-device scenario count for dense per-scenario A,
+    1 for the shared-A engine).  Returns (max_iter, max_iter) — i.e.
+    "don't segment" — when the whole solve fits one dispatch under the
+    worker watchdog.
+    """
+    ce = max(1, st.check_every)
+    t_sweep = S * (n * float(n) + 2.0 * n * m) * 2.0 / _DISPATCH_EFF_FLOPS
+    t_factor = factor_batch * (m * float(n) * n + 3.0 * float(n) ** 3) \
+        * 2.0 / _DISPATCH_EFF_FLOPS
+    rst = max(1, st.restarts)
+
+    def _cap(budget_secs, floor):
+        raw = budget_secs / max(t_sweep, 1e-12)
+        return int(max(min(floor, st.max_iter),
+                       min(st.max_iter, ce * int(raw / ce))))
+
+    # The refresh program runs `restarts` factorizations + sweep rounds.
+    # Floors: rho adaptation on fewer than ~32 sweeps of residual evidence
+    # misadapts (restart ratios are meaningless at cold residuals), and a
+    # frozen segment must exceed one check interval or a converged batch
+    # (which always burns its first check_every sweeps) is indistinguishable
+    # from an unconverged one.
+    seg_r = _cap(_DISPATCH_TARGET_SECS / rst - t_factor, 32)
+    seg_f = _cap(_DISPATCH_TARGET_SECS, 2 * ce)
+    return seg_r, seg_f
 
 
 class PHArrays(NamedTuple):
@@ -132,45 +181,51 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
     """
     idx = jnp.asarray(nonant_idx)
 
-    def shared_refresh(q, q2, A, cl, cu, lb, ub, x, z, y, yx):
-        with jax.default_matmul_precision("highest"):
-            return shared_admm._solve_shared_impl(
-                q, q2, A, cl, cu, lb, ub, settings, (x, z, y, yx),
-                want_factors=True)
+    def _solver_fns(st: ADMMSettings):
+        """(shared_refresh, shared_frozen, dense_refresh, dense_frozen) for
+        one settings variant; dense fns are shard_mapped when on a mesh."""
 
-    def shared_frozen(q, q2, A, cl, cu, lb, ub, x, z, y, yx, factors):
-        with jax.default_matmul_precision("highest"):
-            return shared_admm._solve_shared_frozen_impl(
-                q, q2, A, cl, cu, lb, ub, factors, (x, z, y, yx),
-                settings)
+        def shared_refresh(q, q2, A, cl, cu, lb, ub, x, z, y, yx):
+            with jax.default_matmul_precision("highest"):
+                return shared_admm._solve_shared_impl(
+                    q, q2, A, cl, cu, lb, ub, st, (x, z, y, yx),
+                    want_factors=True)
 
-    def local_refresh(q, q2, A, cl, cu, lb, ub, x, z, y, yx):
-        with jax.default_matmul_precision("highest"):
-            return admm._solve_impl(
-                q, q2, A, cl, cu, lb, ub, settings, (x, z, y, yx),
-                want_factors=True)
+        def shared_frozen(q, q2, A, cl, cu, lb, ub, x, z, y, yx, factors):
+            with jax.default_matmul_precision("highest"):
+                return shared_admm._solve_shared_frozen_impl(
+                    q, q2, A, cl, cu, lb, ub, factors, (x, z, y, yx), st)
 
-    def local_frozen(q, q2, A, cl, cu, lb, ub, x, z, y, yx, factors):
-        with jax.default_matmul_precision("highest"):
-            return admm._solve_frozen_impl(
-                q, q2, A, cl, cu, lb, ub, factors, (x, z, y, yx),
-                settings)
+        def local_refresh(q, q2, A, cl, cu, lb, ub, x, z, y, yx):
+            with jax.default_matmul_precision("highest"):
+                return admm._solve_impl(
+                    q, q2, A, cl, cu, lb, ub, st, (x, z, y, yx),
+                    want_factors=True)
 
-    if mesh is not None:
-        sp = jax.sharding.PartitionSpec(axis)
-        sol_spec = admm.BatchSolution(*([sp] * 7), raw=(sp, sp, sp, sp))
-        fac_spec = admm.Factors(*([sp] * 7))
-        refresh_solve = jax.shard_map(
-            local_refresh, mesh=mesh, in_specs=(sp,) * 11,
-            out_specs=(sol_spec, fac_spec), check_vma=False,
-        )
-        frozen_solve = jax.shard_map(
-            local_frozen, mesh=mesh,
-            in_specs=(sp,) * 11 + (fac_spec,),
-            out_specs=sol_spec, check_vma=False,
-        )
-    else:
-        refresh_solve, frozen_solve = local_refresh, local_frozen
+        def local_frozen(q, q2, A, cl, cu, lb, ub, x, z, y, yx, factors):
+            with jax.default_matmul_precision("highest"):
+                return admm._solve_frozen_impl(
+                    q, q2, A, cl, cu, lb, ub, factors, (x, z, y, yx), st)
+
+        if mesh is not None:
+            sp = jax.sharding.PartitionSpec(axis)
+            sol_spec = admm.BatchSolution(*([sp] * 7), raw=(sp, sp, sp, sp))
+            fac_spec = admm.Factors(*([sp] * 7))
+            refresh_solve = jax.shard_map(
+                local_refresh, mesh=mesh, in_specs=(sp,) * 11,
+                out_specs=(sol_spec, fac_spec), check_vma=False,
+            )
+            frozen_solve = jax.shard_map(
+                local_frozen, mesh=mesh,
+                in_specs=(sp,) * 11 + (fac_spec,),
+                out_specs=sol_spec, check_vma=False,
+            )
+        else:
+            refresh_solve, frozen_solve = local_refresh, local_frozen
+        return shared_refresh, shared_frozen, refresh_solve, frozen_solve
+
+    shared_refresh, shared_frozen, refresh_solve, frozen_solve = \
+        _solver_fns(settings)
 
     def _objective(arr, state, prox_on):
         dt = settings.jdtype()
@@ -198,7 +253,7 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
         return new_state, PHStepOut(conv, eobj, sol.pri_res, sol.dua_res)
 
     @jax.jit
-    def refresh_step(state: PHState, arr: PHArrays, prox_on):
+    def refresh_step_1(state: PHState, arr: PHArrays, prox_on):
         q, q2, W, rho = _objective(arr, state, prox_on)
         solve = shared_refresh if arr.A.ndim == 2 else refresh_solve
         sol, factors = solve(
@@ -209,7 +264,7 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
         return new_state, out, factors
 
     @jax.jit
-    def frozen_step(state: PHState, arr: PHArrays, prox_on, factors):
+    def frozen_step_1(state: PHState, arr: PHArrays, prox_on, factors):
         q, q2, W, rho = _objective(arr, state, prox_on)
         solve = shared_frozen if arr.A.ndim == 2 else frozen_solve
         sol = solve(
@@ -217,6 +272,131 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
             state.x, state.z, state.y, state.yx, factors,
         )
         new_state, out = _finish(arr, state, sol, W, rho)
+        return new_state, out
+
+    # ---- segmented dispatch (shapes too big for one program execution) ----
+
+    @jax.jit
+    def _prep_jit(state: PHState, arr: PHArrays, prox_on):
+        return _objective(arr, state, prox_on)
+
+    @jax.jit
+    def _finish_jit(state: PHState, arr: PHArrays, sol, W, rho):
+        return _finish(arr, state, sol, W, rho)
+
+    seg_cache: dict = {}
+
+    def _seg_programs(seg_r, seg_f):
+        key = (seg_r, seg_f)
+        if key not in seg_cache:
+            st_r = dataclasses.replace(settings, max_iter=seg_r)
+            st_f = dataclasses.replace(settings, max_iter=seg_f)
+            sr, _, lr, _ = _solver_fns(st_r)
+            _, sf, _, lf = _solver_fns(st_f)
+
+            @jax.jit
+            def refresh_solve_seg(q, q2, arr: PHArrays, warm):
+                solve = sr if arr.A.ndim == 2 else lr
+                x, z, y, yx = warm
+                return solve(q, q2, arr.A, arr.cl, arr.cu, arr.lb, arr.ub,
+                             x, z, y, yx)
+
+            @jax.jit
+            def frozen_solve_seg(q, q2, arr: PHArrays, warm, factors):
+                solve = sf if arr.A.ndim == 2 else lf
+                x, z, y, yx = warm
+                return solve(q, q2, arr.A, arr.cl, arr.cu, arr.lb, arr.ub,
+                             x, z, y, yx, factors)
+
+            # short polishing finale for the dense path (single-dispatch
+            # refresh polishes; frozen continuations don't — this restores
+            # parity from the converged iterate without re-factorizing)
+            ce = max(1, settings.check_every)
+            st_p = dataclasses.replace(settings, max_iter=2 * ce)
+
+            def local_polish(q, q2, A, cl, cu, lb, ub, x, z, y, yx,
+                             factors):
+                with jax.default_matmul_precision("highest"):
+                    return admm._solve_frozen_impl(
+                        q, q2, A, cl, cu, lb, ub, factors, (x, z, y, yx),
+                        st_p, polish=True)
+
+            if mesh is not None:
+                sp = jax.sharding.PartitionSpec(axis)
+                sol_spec = admm.BatchSolution(
+                    *([sp] * 7), raw=(sp, sp, sp, sp))
+                fac_spec = admm.Factors(*([sp] * 7))
+                local_polish = jax.shard_map(
+                    local_polish, mesh=mesh,
+                    in_specs=(sp,) * 11 + (fac_spec,),
+                    out_specs=sol_spec, check_vma=False,
+                )
+
+            @jax.jit
+            def polish_solve_seg(q, q2, arr: PHArrays, warm, factors):
+                x, z, y, yx = warm
+                return local_polish(q, q2, arr.A, arr.cl, arr.cu, arr.lb,
+                                    arr.ub, x, z, y, yx, factors)
+
+            seg_cache[key] = (refresh_solve_seg, frozen_solve_seg,
+                              polish_solve_seg)
+        return seg_cache[key]
+
+    def _segments_for(arr):
+        S, n = arr.c.shape
+        m = arr.cl.shape[1]
+        ndev = 1 if mesh is None else len(mesh.devices.flat)
+        S_dev = -(-S // ndev)          # per-device shard does the sweeping
+        dense = arr.A.ndim == 3
+        return _dispatch_segments(S_dev, n, m, settings,
+                                  factor_batch=S_dev if dense else 1)
+
+    def _all_done(sol, seg_f):
+        """True iff every shard's while_loop exited before its sweep cap
+        (iters is per-shard under shard_map: take the max, ~KB fetch)."""
+        return int(np.asarray(sol.iters).max()) < seg_f
+
+    def _continue_frozen(q, q2, arr, sol, factors, seg_f, budget, fsolve):
+        """Host loop: frozen continuation segments until converged (every
+        shard's while_loop exits before its sweep cap) or the sweep budget
+        is spent."""
+        while budget > 0:
+            sol = fsolve(q, q2, arr, sol.raw, factors)
+            budget -= seg_f
+            if _all_done(sol, seg_f):
+                break
+        return sol
+
+    def refresh_step(state: PHState, arr: PHArrays, prox_on):
+        seg_r, seg_f = _segments_for(arr)
+        if seg_r >= settings.max_iter and seg_f >= settings.max_iter:
+            return refresh_step_1(state, arr, prox_on)
+        rsolve, fsolve, psolve = _seg_programs(seg_r, seg_f)
+        q, q2, W, rho = _prep_jit(state, arr, prox_on)
+        warm = (state.x, state.z, state.y, state.yx)
+        sol, factors = rsolve(q, q2, arr, warm)
+        rst = max(1, settings.restarts)
+        budget = rst * settings.max_iter - rst * seg_r
+        sol = _continue_frozen(q, q2, arr, sol, factors, seg_f, budget,
+                               fsolve)
+        if arr.A.ndim == 3 and settings.polish and settings.polish_passes:
+            sol = psolve(q, q2, arr, sol.raw, factors)
+        new_state, out = _finish_jit(state, arr, sol, W, rho)
+        return new_state, out, factors
+
+    def frozen_step(state: PHState, arr: PHArrays, prox_on, factors):
+        seg_r, seg_f = _segments_for(arr)
+        if seg_r >= settings.max_iter and seg_f >= settings.max_iter:
+            return frozen_step_1(state, arr, prox_on, factors)
+        _, fsolve, _ = _seg_programs(seg_r, seg_f)
+        q, q2, W, rho = _prep_jit(state, arr, prox_on)
+        warm = (state.x, state.z, state.y, state.yx)
+        sol = fsolve(q, q2, arr, warm, factors)
+        budget = settings.max_iter - seg_f
+        if not _all_done(sol, seg_f):
+            sol = _continue_frozen(q, q2, arr, sol, factors, seg_f, budget,
+                                   fsolve)
+        new_state, out = _finish_jit(state, arr, sol, W, rho)
         return new_state, out
 
     return refresh_step, frozen_step
