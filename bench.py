@@ -118,6 +118,12 @@ def _probe_tpu(timeout):
 
 
 def main():
+    # persistent XLA compile cache: reference-shape UC programs cost minutes
+    # of (remote) compile; cacheing them makes re-runs and the driver's
+    # round-end run start warm
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "tpusppy_xla_tpu"))
     force_cpu = (os.environ.get("BENCH_FORCE_CPU")
                  or os.environ.get("JAX_PLATFORMS") == "cpu")
     attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", "3"))

@@ -95,7 +95,12 @@ def uc_metrics():
         "BENCH_UC_HORIZON",
         str(min(12, default_horizon) if degraded
             else min(24, default_horizon))))
-    iters = int(os.environ.get("BENCH_UC_ITERS", "4" if degraded else "30"))
+    # rate-metric iteration count: the real-data family runs ~40 s per PH
+    # iteration at S=1000 (n=16008) — 8 iterations measure the steady rate
+    # without blowing the parent's workload timeout
+    iters = int(os.environ.get(
+        "BENCH_UC_ITERS",
+        "4" if degraded else ("8" if model_name == "data" else "30")))
     refresh_every = max(1, int(os.environ.get("BENCH_REFRESH", "16")))
     gap_target = float(os.environ.get("BENCH_UC_GAP", "0.01"))
     dtype = "float32" if platform != "cpu" else "float64"

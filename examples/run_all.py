@@ -59,6 +59,12 @@ RUNS = [
      ["--num-scens", "6", "--battery-lam", "0.1", "--battery-use-lp",
       "--max-iterations", "8", "--default-rho", "0.5",
       "--rel-gap", "0.02", "--lagrangian", "--xhatshuffle"]),
+    ("usar/usar_ef.py",
+     ["--num-scens", "3", "--output-dir", "/tmp/tpusppy_usar_out"]),
+    ("usar/usar_cylinders.py",
+     ["--num-scens", "3", "--max-iterations", "20", "--default-rho", "1.0",
+      "--rel-gap", "0.05", "--lagrangian", "--xhatrestrictedef",
+      "--xhat-ef-every", "1", "--output-dir", "/tmp/tpusppy_usar_out"]),
 ]
 
 

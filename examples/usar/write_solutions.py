@@ -1,0 +1,100 @@
+"""Solution writers for the USAR example (reference:
+examples/usar/write_solutions.py + plot.py, Pyomo/matplotlib based).
+
+The reference plots rescue-team walks (geographic) and Gantt charts from a
+solved Pyomo model.  Here the same figures are drawn from the flat solution
+vector of one scenario (`tpusppy.models.usar` variable layout); writers
+degrade to CSV when matplotlib is unavailable.
+"""
+
+import csv
+import os
+
+import numpy as np
+
+from tpusppy.models import usar
+
+
+def _var_index(kw):
+    """(a, dd, sd, st, ita) index arrays for the flat layout."""
+    T, D, N = kw["time_horizon"], kw["num_depots"], kw["num_households"]
+    i = 0
+    a = np.arange(i, i + D); i += D
+    dd = np.arange(i, i + T * D * N).reshape(T, D, N); i += T * D * N
+    sd = np.arange(i, i + T * N * N).reshape(T, N, N); i += T * N * N
+    st = np.arange(i, i + T * N).reshape(T, N); i += T * N
+    ita = np.arange(i, i + T * T * N).reshape(T, T, N); i += T * T * N
+    return a, dd, sd, st, ita
+
+
+def walks_writer(walks_dir, scen_name, x, kw):
+    """Geographic plot of team movements for one scenario solution ``x``
+    (reference plot.plot_walks); CSV of arcs when matplotlib is missing."""
+    os.makedirs(walks_dir, exist_ok=True)
+    a, dd, sd, _, _ = _var_index(kw)
+    depot_coords, site_coords = usar.generate_coords(**kw)
+    x = np.asarray(x)
+    arcs = []
+    for (t, d, s) in zip(*np.nonzero(np.round(x[dd]) > 0)):
+        arcs.append(("depot", int(d), int(s), int(t)))
+    for (t, s1, s2) in zip(*np.nonzero(np.round(x[sd]) > 0)):
+        arcs.append(("site", int(s1), int(s2), int(t)))
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except Exception:
+        with open(os.path.join(walks_dir, scen_name + ".csv"), "w",
+                  newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["kind", "from", "to", "time"])
+            w.writerows(arcs)
+        return
+    fig, ax = plt.subplots()
+    dx, dy = (np.array([c[i] for c in depot_coords]) for i in (0, 1)) \
+        if depot_coords else (np.array([]), np.array([]))
+    sx, sy = (np.array([c[i] for c in site_coords]) for i in (0, 1)) \
+        if site_coords else (np.array([]), np.array([]))
+    ax.scatter(dx, dy, marker="s", label="depots")
+    ax.scatter(sx, sy, marker="o", label="sites")
+    for kind, frm, to, t in arcs:
+        p0 = depot_coords[frm] if kind == "depot" else site_coords[frm]
+        p1 = site_coords[to]
+        ax.annotate("", xy=p1, xytext=p0,
+                    arrowprops={"arrowstyle": "->", "alpha": 0.6})
+    ax.set_title(f"USAR walks — {scen_name}")
+    ax.legend()
+    fig.savefig(os.path.join(walks_dir, scen_name + ".pdf"))
+    plt.close(fig)
+
+
+def gantt_writer(gantt_dir, scen_name, x, kw):
+    """Gantt chart of rescues (reference plot.plot_gantt): for each site,
+    the interval [arrival, arrival + rescue_time)."""
+    os.makedirs(gantt_dir, exist_ok=True)
+    _, _, _, _, ita = _var_index(kw)
+    T = kw["time_horizon"]
+    rescue = kw["constant_rescue_time"]
+    x = np.asarray(x)
+    bars = [(int(s), int(t), rescue)
+            for (t, s) in zip(*np.nonzero(np.round(x[ita][:, 0, :]) > 0))]
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except Exception:
+        with open(os.path.join(gantt_dir, scen_name + ".csv"), "w",
+                  newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["site", "arrival", "duration"])
+            w.writerows(bars)
+        return
+    fig, ax = plt.subplots()
+    for s, t, dur in bars:
+        ax.barh(s, dur, left=t)
+    ax.set_xlim(0, T)
+    ax.set_xlabel("time step")
+    ax.set_ylabel("site")
+    ax.set_title(f"USAR rescues — {scen_name}")
+    fig.savefig(os.path.join(gantt_dir, scen_name + ".pdf"))
+    plt.close(fig)

@@ -1,0 +1,102 @@
+"""USAR (urban search and rescue) model family.
+
+Mirrors the reference's examples/usar (abstract.py MILP + generate_data.py
+sampling): data generation must be draw-for-draw identical, the EF must be
+integer-feasible and respect the depot cardinality row, and the wheel must
+certify through the restricted-EF incumbent spoke (naive rounding of the
+symmetric fractional consensus violates sum(active_depots) == K).
+"""
+
+import numpy as np
+import pytest
+
+from tpusppy.ef import solve_ef
+from tpusppy.ir import ScenarioBatch
+from tpusppy.models import usar
+
+
+def make_batch(n, **over):
+    kw = usar.kw_creator(num_scens=n, **over)
+    names = usar.scenario_names_creator(n)
+    return ScenarioBatch.from_problems(
+        [usar.scenario_creator(nm, **kw) for nm in names]), kw
+
+
+def test_ppf_parity_with_scipy():
+    """The manual Poisson/Pareto inverse CDFs must match scipy's (the
+    reference's exact distributions, generate_data.py:19-20)."""
+    import scipy.stats
+
+    for u in (0.01, 0.25, 0.5, 0.77, 0.93, 0.999):
+        assert usar._poisson2_ppf(u) == float(scipy.stats.poisson(2).ppf(u))
+        assert usar._pareto1_ppf(u) == pytest.approx(
+            float(scipy.stats.pareto(1).ppf(u)), rel=1e-12)
+
+
+def test_ef_golden_seed0():
+    batch, kw = make_batch(3)
+    assert batch.tree.num_nonants == kw["num_depots"]
+    obj, xs = solve_ef(batch, solver="highs")
+    # lives saved = -obj; per-scenario optima are 12, 9, 10 at seed 0
+    assert obj == pytest.approx(-31.0 / 3.0, abs=1e-6)
+    x = np.asarray(xs)
+    assert np.abs(x - np.round(x)).max() < 1e-6          # integral
+    a = x[:, :kw["num_depots"]]
+    np.testing.assert_allclose(a.sum(axis=1), kw["num_active_depots"])
+    # nonanticipativity: all scenarios share the depot choice
+    assert np.abs(a - a[0]).max() < 1e-9
+
+
+def test_ef_respects_depot_cardinality_binding():
+    """With only one active depot allowed, fewer lives are saved."""
+    batch3, _ = make_batch(3)
+    obj2, _ = solve_ef(batch3, solver="highs")
+    batch1, _ = make_batch(3, num_active_depots=1)
+    obj1, _ = solve_ef(batch1, solver="highs")
+    assert obj1 >= obj2 - 1e-9          # minimization: fewer depots is worse
+
+
+@pytest.mark.slow
+def test_usar_wheel_certifies_with_restricted_ef():
+    """PH + Lagrangian + XhatRestrictedEF reaches the EF optimum: the hub
+    consensus is fractional-symmetric, so only the relax-and-fix MILP spoke
+    can produce a cardinality-feasible incumbent."""
+    from tpusppy.cylinders import LagrangianOuterBound, PHHub, XhatRestrictedEF
+    from tpusppy.opt.ph import PH
+    from tpusppy.phbase import PHBase
+    from tpusppy.spin_the_wheel import WheelSpinner
+    from tpusppy.xhat_eval import Xhat_Eval
+
+    n = 3
+    kw = usar.kw_creator(num_scens=n)
+    names = usar.scenario_names_creator(n)
+    batch, _ = make_batch(n)
+    ef_obj, _ = solve_ef(batch, solver="highs")
+
+    def okw():
+        return {
+            "options": {"defaultPHrho": 1.0, "PHIterLimit": 20,
+                        "convthresh": -1.0,
+                        "xhat_integer_strategy": "milp",
+                        "xhat_ef_options": {"every": 1, "ksub": 3,
+                                            "time_limit": 30.0}},
+            "all_scenario_names": names,
+            "scenario_creator": usar.scenario_creator,
+            "scenario_creator_kwargs": kw,
+        }
+
+    hub = {"hub_class": PHHub,
+           "hub_kwargs": {"options": {"rel_gap": 0.05}},
+           "opt_class": PH, "opt_kwargs": okw()}
+    spokes = [
+        {"spoke_class": LagrangianOuterBound, "opt_class": PHBase,
+         "opt_kwargs": okw()},
+        {"spoke_class": XhatRestrictedEF, "opt_class": Xhat_Eval,
+         "opt_kwargs": okw()},
+    ]
+    ws = WheelSpinner(hub, spokes).spin()
+    assert np.isfinite(ws.BestInnerBound)
+    assert ws.BestInnerBound == pytest.approx(ef_obj, abs=1e-4)
+    # dual-side solver tolerance: the certified bound may exceed the
+    # incumbent by ADMM eps-level noise at a 0% gap
+    assert ws.BestOuterBound <= ws.BestInnerBound + 1e-6

@@ -26,55 +26,24 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..solvers import admm, shared_admm
+from ..solvers import segmented as segmented_solvers
 from ..solvers.admm import ADMMSettings
 
 # ---------------------------------------------------------------------------
 # Dispatch segmentation: the remote TPU worker kills any single program
-# execution around ~60 s (measured: a synthetic 110 s matmul loop dies at
-# 62 s with "TPU worker process crashed or restarted").  Reference-scale UC
-# (S=1000, n=16008) needs minutes of ADMM sweeps per PH iteration, so one
-# monolithic dispatch is structurally impossible — the sweep loop is split
-# into bounded-length segments re-entered from the host (the frozen-factor
-# path makes continuation free: factors are computed once, segments warm-
-# start from the previous raw iterate).  Shapes small enough for one
-# dispatch keep the original single-program path (and its pipelining).
+# execution around ~60 s, so reference-scale UC (S=1000, n=16008) can never
+# run one monolithic PH step — see tpusppy/solvers/segmented.py for the
+# shared mechanism.  The constants live here too so tests can monkeypatch
+# this module's copies; _dispatch_segments forwards them explicitly.
 # ---------------------------------------------------------------------------
-_DISPATCH_TARGET_SECS = 18.0
-# conservative effective sweep throughput under matmul precision "highest"
-# (bf16x6 passes); measured ~7.7e12 flop/s at UC shapes on one v5e chip
-_DISPATCH_EFF_FLOPS = 4e12
+_DISPATCH_TARGET_SECS = segmented_solvers._DISPATCH_TARGET_SECS
+_DISPATCH_EFF_FLOPS = segmented_solvers._DISPATCH_EFF_FLOPS
 
 
 def _dispatch_segments(S, n, m, st: ADMMSettings, factor_batch=1):
-    """(seg_refresh, seg_frozen): per-dispatch sweep caps for these shapes.
-
-    ``S`` is the PER-DEVICE scenario count (callers divide by the mesh
-    size); ``factor_batch`` is how many factorizations one refresh performs
-    per restart (the per-device scenario count for dense per-scenario A,
-    1 for the shared-A engine).  Returns (max_iter, max_iter) — i.e.
-    "don't segment" — when the whole solve fits one dispatch under the
-    worker watchdog.
-    """
-    ce = max(1, st.check_every)
-    t_sweep = S * (n * float(n) + 2.0 * n * m) * 2.0 / _DISPATCH_EFF_FLOPS
-    t_factor = factor_batch * (m * float(n) * n + 3.0 * float(n) ** 3) \
-        * 2.0 / _DISPATCH_EFF_FLOPS
-    rst = max(1, st.restarts)
-
-    def _cap(budget_secs, floor):
-        raw = budget_secs / max(t_sweep, 1e-12)
-        return int(max(min(floor, st.max_iter),
-                       min(st.max_iter, ce * int(raw / ce))))
-
-    # The refresh program runs `restarts` factorizations + sweep rounds.
-    # Floors: rho adaptation on fewer than ~32 sweeps of residual evidence
-    # misadapts (restart ratios are meaningless at cold residuals), and a
-    # frozen segment must exceed one check interval or a converged batch
-    # (which always burns its first check_every sweeps) is indistinguishable
-    # from an unconverged one.
-    seg_r = _cap(_DISPATCH_TARGET_SECS / rst - t_factor, 32)
-    seg_f = _cap(_DISPATCH_TARGET_SECS, 2 * ce)
-    return seg_r, seg_f
+    return segmented_solvers.dispatch_segments(
+        S, n, m, st, factor_batch=factor_batch,
+        eff_flops=_DISPATCH_EFF_FLOPS, target_secs=_DISPATCH_TARGET_SECS)
 
 
 class PHArrays(NamedTuple):
@@ -351,21 +320,18 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
         return _dispatch_segments(S_dev, n, m, settings,
                                   factor_batch=S_dev if dense else 1)
 
-    def _all_done(sol, seg_f):
-        """True iff every shard's while_loop exited before its sweep cap
-        (iters is per-shard under shard_map: take the max, ~KB fetch)."""
-        return int(np.asarray(sol.iters).max()) < seg_f
+    # A mesh spanning several processes cannot make data-dependent host
+    # decisions: sol.iters' shards are non-addressable (fetch raises), and
+    # even local-shard votes could disagree across processes — different
+    # dispatch counts would deadlock the collectives.  Run the full budget
+    # deterministically there; single-process meshes early-exit normally.
+    multiproc = mesh is not None and len(
+        {d.process_index for d in mesh.devices.flat}) > 1
 
-    def _continue_frozen(q, q2, arr, sol, factors, seg_f, budget, fsolve):
-        """Host loop: frozen continuation segments until converged (every
-        shard's while_loop exits before its sweep cap) or the sweep budget
-        is spent."""
-        while budget > 0:
-            sol = fsolve(q, q2, arr, sol.raw, factors)
-            budget -= seg_f
-            if _all_done(sol, seg_f):
-                break
-        return sol
+    def _all_done_fn(seg_f):
+        if multiproc:
+            return lambda sol: False
+        return lambda sol: int(np.asarray(sol.iters).max()) < seg_f
 
     def refresh_step(state: PHState, arr: PHArrays, prox_on):
         seg_r, seg_f = _segments_for(arr)
@@ -375,10 +341,10 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
         q, q2, W, rho = _prep_jit(state, arr, prox_on)
         warm = (state.x, state.z, state.y, state.yx)
         sol, factors = rsolve(q, q2, arr, warm)
-        rst = max(1, settings.restarts)
-        budget = rst * settings.max_iter - rst * seg_r
-        sol = _continue_frozen(q, q2, arr, sol, factors, seg_f, budget,
-                               fsolve)
+        sol = segmented_solvers.continue_frozen(
+            lambda w: fsolve(q, q2, arr, w, factors), sol, seg_f,
+            segmented_solvers.refresh_budget(settings, seg_r),
+            all_done=_all_done_fn(seg_f))
         if arr.A.ndim == 3 and settings.polish and settings.polish_passes:
             sol = psolve(q, q2, arr, sol.raw, factors)
         new_state, out = _finish_jit(state, arr, sol, W, rho)
@@ -392,10 +358,11 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
         q, q2, W, rho = _prep_jit(state, arr, prox_on)
         warm = (state.x, state.z, state.y, state.yx)
         sol = fsolve(q, q2, arr, warm, factors)
-        budget = settings.max_iter - seg_f
-        if not _all_done(sol, seg_f):
-            sol = _continue_frozen(q, q2, arr, sol, factors, seg_f, budget,
-                                   fsolve)
+        all_done = _all_done_fn(seg_f)
+        if not all_done(sol):
+            sol = segmented_solvers.continue_frozen(
+                lambda w: fsolve(q, q2, arr, w, factors), sol, seg_f,
+                settings.max_iter - seg_f, all_done=all_done)
         new_state, out = _finish_jit(state, arr, sol, W, rho)
         return new_state, out
 

@@ -183,15 +183,17 @@ def _factor(q2, A, rho_a, rho_x, sigma, P=None):
     return _explicit_inverse(K), K
 
 
-# Above this leaf size, XLA:TPU's TriangularSolve lowering is avoided
-# entirely: one (16008, 16008) \ (16008, 2048) solve compiles to 9.2 GB of
-# HLO temps (chunked substitution keeps ~n/128 O(n*rhs) accumulator copies
-# live), which OOMed the headline UC refresh program at 62 GB demand on a
-# 16 GB chip.  Large matrices instead go through a recursive 2x2-block
-# Schur-complement inversion — pure MXU matmuls, measured at n=16008:
-# 1.2 GB temps, 1.6 s steady-state (8x faster than the triangular path),
+# Matrices larger than 2 * this go through the recursive Schur inversion,
+# avoiding XLA:TPU's TriangularSolve lowering at big n: one
+# (16008, 16008) \ (16008, 2048) solve compiles to 9.2 GB of HLO temps
+# (chunked substitution keeps ~n/128 O(n*rhs) accumulator copies live),
+# which OOMed the headline UC refresh program at 62 GB demand on a 16 GB
+# chip.  The recursion is pure MXU matmuls — measured at n=16008: 1.2 GB
+# temps, 1.6 s steady-state (8x faster than the triangular path),
 # comparable f32 accuracy (iterative refinement against the exact K in
-# _chol_solve covers the rest).
+# _chol_solve covers the rest).  Base cases — up to 2x the leaf size, i.e.
+# n <= 4096 — still use Cholesky + triangular solves, where the lowering
+# is cheap.
 _EXPLICIT_INV_LEAF_N = 2048
 
 
@@ -200,9 +202,10 @@ def _explicit_inverse(K):
 
     inv([[A, B], [B', C]]) = [[Ai + W Si W', -W Si], [-Si W', Si]] with
     Ai = inv(A), W = Ai B, Si = inv(C - B' Ai B); Schur complements of SPD
-    are SPD, so the recursion is well posed.  Leaves (n <= 2048) use
-    Cholesky + triangular solves against I, where XLA's lowering is cheap.
-    Split points are multiples of the leaf size for tidy MXU tiling.
+    are SPD, so the recursion is well posed.  Base cases (n <= 2 * leaf =
+    4096) use Cholesky + triangular solves against I, where XLA's lowering
+    is cheap.  Split points are multiples of the leaf size for tidy MXU
+    tiling.
     """
     n = K.shape[-1]
     leaf = _EXPLICIT_INV_LEAF_N
@@ -811,14 +814,14 @@ def _solve_frozen_impl(c, q2, A, cl, cu, lb, ub, factors: Factors, warm,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("settings",))
+@functools.partial(jax.jit, static_argnames=("settings", "polish"))
 def solve_batch_frozen(c, q2, A, cl, cu, lb, ub, factors: Factors,
                        settings: ADMMSettings = ADMMSettings(),
-                       warm=None, P=None) -> BatchSolution:
+                       warm=None, P=None, polish=False) -> BatchSolution:
     """Jitted frozen-factor solve; see :func:`_solve_frozen_impl`."""
     with jax.default_matmul_precision("highest"):
         return _solve_frozen_impl(c, q2, A, cl, cu, lb, ub, factors, warm,
-                                  settings, P)
+                                  settings, P, polish=polish)
 
 
 def _Aty(A, y):

@@ -1,0 +1,164 @@
+"""Watchdog-safe segmented solve wrappers.
+
+The remote TPU worker kills any single program execution around ~60 s
+(measured: a synthetic 110 s matmul loop dies at 62 s with "TPU worker
+process crashed or restarted"), so solves whose sweep loops would run
+longer must be split into bounded segments re-entered from the host.  The
+frozen-factor protocol makes continuation free: factors are computed once,
+segments warm-start from the previous raw iterate.
+
+Two consumers share this module: the scenario-sharded jitted PH step
+(:mod:`tpusppy.parallel.sharded`) and the host solve loop
+(:meth:`tpusppy.spopt.SPOpt._solve_amortized` — the path every cylinder in
+a wheel runs).  Shapes that fit one dispatch pass through unchanged.
+
+Reference context: the reference's per-rank Gurobi solves
+(``mpisppy/spopt.py:85-223``) have no analogue of this constraint — the
+solver runs on the host.  On TPU the solve IS a device program, so dispatch
+length becomes a correctness concern, not a tuning knob.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+_DISPATCH_TARGET_SECS = 18.0
+# conservative effective sweep throughput under matmul precision "highest"
+# (bf16x6 passes); measured ~7.7e12 flop/s at reference-UC shapes on v5e
+_DISPATCH_EFF_FLOPS = 4e12
+
+
+def dispatch_segments(S, n, m, st, factor_batch=1,
+                      eff_flops=None, target_secs=None):
+    """(seg_refresh, seg_frozen): per-dispatch sweep caps for these shapes.
+
+    ``S`` is the PER-DEVICE scenario count (mesh callers divide by the mesh
+    size); ``factor_batch`` is how many factorizations one adaptive solve
+    performs per restart (the scenario count for dense per-scenario A, 1
+    for the shared-A engine).  Returns (max_iter, max_iter) — i.e. "don't
+    segment" — when the whole solve fits one dispatch under the worker
+    watchdog.
+
+    Floors: rho adaptation on fewer than ~32 sweeps of residual evidence
+    misadapts (restart ratios are meaningless at cold residuals), and a
+    frozen segment must exceed one check interval or a converged batch
+    (which always burns its first ``check_every`` sweeps) is
+    indistinguishable from an unconverged one.
+    """
+    eff = _DISPATCH_EFF_FLOPS if eff_flops is None else eff_flops
+    target = _DISPATCH_TARGET_SECS if target_secs is None else target_secs
+    ce = max(1, st.check_every)
+    t_sweep = S * (n * float(n) + 2.0 * n * m) * 2.0 / eff
+    t_factor = factor_batch * (m * float(n) * n + 3.0 * float(n) ** 3) \
+        * 2.0 / eff
+    rst = max(1, st.restarts)
+
+    def _cap(budget_secs, floor):
+        raw = budget_secs / max(t_sweep, 1e-12)
+        return int(max(min(floor, st.max_iter),
+                       min(st.max_iter, ce * int(raw / ce))))
+
+    seg_r = _cap(target / rst - t_factor, 32)
+    seg_f = _cap(target, 2 * ce)
+    return seg_r, seg_f
+
+
+def _shapes(args, shared):
+    q, q2, A = args[0], args[1], args[2]
+    S, n = np.shape(q)
+    m = np.shape(A)[0] if shared else np.shape(A)[1]
+    return S, n, m
+
+
+def refresh_budget(settings, seg_r):
+    """Sweep budget left for frozen continuations after a segmented
+    adaptive dispatch (which ran ``restarts`` rounds of ``seg_r``)."""
+    rst = max(1, settings.restarts)
+    return rst * settings.max_iter - rst * seg_r
+
+
+def continue_frozen(run_segment, sol, seg_f, budget, all_done=None):
+    """Generic frozen-continuation loop shared by the host solve path and
+    the jitted sharded PH step: re-dispatch ``run_segment(warm)`` until
+    converged or the sweep budget is spent.
+
+    ``all_done(sol)`` decides early exit; the default reads the iteration
+    counter (the while_loop exits before its cap iff every scenario met
+    eps).  Multi-controller callers MUST pass a deterministic ``all_done``
+    (e.g. ``lambda sol: False``): the default fetches a scenario-sharded
+    array, which is impossible for non-addressable shards — and even a
+    local-shard check would let processes disagree on the loop count and
+    deadlock the collective dispatches.
+    """
+    if all_done is None:
+        def all_done(s):
+            return int(np.asarray(s.iters).max()) < seg_f
+    while budget > 0:
+        sol = run_segment(sol.raw)
+        budget -= seg_f
+        if all_done(sol):
+            break
+    return sol
+
+
+def _continue_frozen(frozen_fn, args, factors, sol, st_f, seg_f, budget,
+                     **kw):
+    """Host-path adapter for :func:`continue_frozen`."""
+    return continue_frozen(
+        lambda warm: frozen_fn(*args, factors, settings=st_f, warm=warm,
+                               **kw),
+        sol, seg_f, budget)
+
+
+def solve_factored_segmented(frozen_fn, factored_fn, args, settings,
+                             warm=None, shared=False):
+    """Adaptive solve + factors, segmented when the shapes demand it.
+
+    Equivalent to ``factored_fn(*args, settings=settings, warm=warm)`` for
+    shapes that fit one dispatch.  Returns (sol, factors, converged).
+    """
+    S, n, m = _shapes(args, shared)
+    seg_r, seg_f = dispatch_segments(S, n, m, settings,
+                                     factor_batch=1 if shared else S)
+    if seg_r >= settings.max_iter and seg_f >= settings.max_iter:
+        sol, factors = factored_fn(*args, settings=settings, warm=warm)
+        return sol, factors, True
+    st_r = dataclasses.replace(settings, max_iter=seg_r)
+    st_f = dataclasses.replace(settings, max_iter=seg_f)
+    sol, factors = factored_fn(*args, settings=st_r, warm=warm)
+    sol = _continue_frozen(frozen_fn, args, factors, sol, st_f, seg_f,
+                           refresh_budget(settings, seg_r))
+    converged = int(np.asarray(sol.iters).max()) < seg_f
+    if not shared and settings.polish and settings.polish_passes:
+        # dense-path parity with the one-dispatch adaptive solve, which
+        # polishes its final iterate; frozen continuations don't
+        ce = max(1, settings.check_every)
+        st_p = dataclasses.replace(settings, max_iter=2 * ce)
+        sol = frozen_fn(*args, factors, settings=st_p, warm=sol.raw,
+                        polish=True)
+    return sol, factors, converged
+
+
+def solve_frozen_segmented(frozen_fn, args, factors, settings, warm=None):
+    """Frozen solve, segmented when the shapes demand it.
+
+    Returns (sol, converged) — callers must use ``converged`` instead of
+    comparing ``sol.iters`` against ``settings.max_iter`` (iters reflects
+    only the LAST segment's counter).
+    """
+    shared = np.ndim(args[2]) == 2
+    S, n, m = _shapes(args, shared)
+    seg_r, seg_f = dispatch_segments(S, n, m, settings,
+                                     factor_batch=1 if shared else S)
+    if seg_f >= settings.max_iter:
+        sol = frozen_fn(*args, factors, settings=settings, warm=warm)
+        converged = int(np.asarray(sol.iters).max()) < settings.max_iter
+        return sol, converged
+    st_f = dataclasses.replace(settings, max_iter=seg_f)
+    sol = frozen_fn(*args, factors, settings=st_f, warm=warm)
+    if int(np.asarray(sol.iters).max()) >= seg_f:
+        sol = _continue_frozen(frozen_fn, args, factors, sol, st_f, seg_f,
+                               settings.max_iter - seg_f)
+    return sol, int(np.asarray(sol.iters).max()) < seg_f

@@ -262,12 +262,16 @@ class SPOpt(SPBase):
         sig = (self._solve_sig(args[1], args[5], args[6])
                if refresh_every > 1 else None)
         sol = None
+        from .solvers import segmented
+
         if (refresh_every > 1 and warm and slot.get("warm") is not None
                 and slot.get("factors") is not None
                 and slot.get("sig") == sig
                 and slot.get("age", 0) < refresh_every):
-            cand = frozen_fn(
-                *args, slot["factors"], settings=self.admm_settings,
+            # segmented: oversized sweep loops are split into bounded
+            # dispatches (the remote TPU worker kills ~60s+ executions)
+            cand, fro_conv = segmented.solve_frozen_segmented(
+                frozen_fn, args, slot["factors"], self.admm_settings,
                 warm=slot["warm"])
             # accept when the sweep budget sufficed (converged to eps) OR
             # every scenario already sits inside the rescue-tolerance
@@ -280,14 +284,14 @@ class SPOpt(SPBase):
                 np.any(np.asarray(args[1]) != 0.0, axis=-1), tol_qp, tol_lp)
             pri_c = np.asarray(cand.pri_res)
             dua_c = np.asarray(cand.dua_res)
-            if (int(np.asarray(cand.iters)[0]) < self.admm_settings.max_iter
+            if (fro_conv
                     or bool(np.all((pri_c <= tol_s) & (dua_c <= tol_s)))):
                 sol = cand
                 slot["age"] = slot.get("age", 0) + 1
         if sol is None:
-            sol, factors = factored_fn(
-                *args, settings=self.admm_settings,
-                warm=slot.get("warm") if warm else None)
+            sol, factors, _ = segmented.solve_factored_segmented(
+                frozen_fn, factored_fn, args, self.admm_settings,
+                warm=slot.get("warm") if warm else None, shared=shared)
             slot["factors"] = factors
             slot["sig"] = sig
             slot["age"] = 1

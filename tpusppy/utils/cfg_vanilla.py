@@ -20,6 +20,7 @@ from ..cylinders import (
     SlamMaxHeuristic,
     SlamMinHeuristic,
     XhatLooperInnerBound,
+    XhatRestrictedEF,
     XhatShuffleInnerBound,
     XhatSpecificInnerBound,
     XhatXbarInnerBound,
@@ -393,6 +394,30 @@ def xhatshuffle_spoke(
             "scen_limit": cfg.get("xhat_scen_limit", 3),
             "reverse": cfg.get("add_reversed_shuffle", False),
             "iter_step": cfg.get("xhatshuffle_iter_step"),
+        }},
+    )
+
+
+def xhatrestrictedef_spoke(
+    cfg,
+    scenario_creator,
+    scenario_denouement=None,
+    all_scenario_names=None,
+    scenario_creator_kwargs=None,
+    all_nodenames=None,
+):
+    """tpusppy addition (no reference analogue): relax-and-fix restricted-EF
+    incumbents — consensus-confident integers fixed, contested ones MILPed
+    over a scenario subsample, result evaluated on the full batch.  The
+    incumbent mechanism of choice when naive rounding of the hub consensus
+    violates coupling rows (e.g. cardinality constraints)."""
+    return _xhat_spoke(
+        cfg, XhatRestrictedEF, scenario_creator, all_scenario_names,
+        scenario_creator_kwargs, all_nodenames,
+        {"xhat_ef_options": {
+            "every": cfg.get("xhat_ef_every", 4),
+            "ksub": cfg.get("xhat_ef_ksub", 6),
+            "time_limit": cfg.get("xhat_ef_time_limit", 60.0),
         }},
     )
 

@@ -306,6 +306,20 @@ class Config:
             "step in shuffled list between 2 scenarios to try (default None)",
             int, None)
 
+    def xhatrestrictedef_args(self):
+        """tpusppy addition (no reference analogue): restricted-EF
+        incumbent spoke — relax-and-fix host MILP over a scenario
+        subsample at the hub's consensus."""
+        add = self.add_to_config
+        add("xhatrestrictedef", "have an xhat restricted-EF spoke",
+            bool, False)
+        add("xhat_ef_every", "hub iterations between restricted-EF tries",
+            int, 4)
+        add("xhat_ef_ksub", "scenario subsample size for the restricted EF",
+            int, 6)
+        add("xhat_ef_time_limit", "MILP time limit per restricted EF (sec)",
+            float, 60.0)
+
     def mult_rho_args(self):
         add = self.add_to_config
         add("mult_rho", "have mult_rho extension (default False)", bool, False)

@@ -301,7 +301,16 @@ class Xhat_Eval(SPOpt):
             leftover_ints = b.is_int.any() and bool(
                 (b.is_int[None, :] & (self._fixed_ub > self._fixed_lb)).any()
             )
-            if leftover_ints:
+            if leftover_ints and self.options.get(
+                    "xhat_integer_strategy", "dive") == "milp":
+                # exact per-scenario host MILPs instead of device dives:
+                # the right tool for families whose SECOND stage is mostly
+                # binary scheduling (e.g. USAR), where rounding dives wedge
+                # on hundreds of coupled binaries but each scenario MILP is
+                # solver-trivial — the reference's posture for every
+                # incumbent evaluation (extensions/xhatbase.py:38-230)
+                x = self._host_milp(self._fixed_lb, self._fixed_ub)
+            elif leftover_ints:
                 x = self._integer_dive(self._fixed_lb, self._fixed_ub)
                 tol = max(self.options.get("feas_tol", 1e-3),
                           10.0 * self.admm_settings.eps_rel)
