@@ -1,0 +1,60 @@
+"""CCOPF (contingency-constrained OPF, DC) cylinders driver.
+
+Behavioral analogue of the reference's ``examples/acopf3/ccopf2wood.py`` /
+``fourstage.py``: multistage PH hub over the line-failure tree with
+lagrangian / xhatshuffle spokes.  The AC physics is DC-linearized (see
+``tpusppy/models/ccopf.py`` docstring for the honest scope note).
+
+    python ccopf_cylinders.py --branching-factors "2 2" --max-iterations 20 \
+        --default-rho 1.0 --rel-gap 0.01 --lagrangian --xhatshuffle
+"""
+
+import numpy as np
+
+from tpusppy.models import ccopf
+from tpusppy.spin_the_wheel import WheelSpinner
+from tpusppy.utils import cfg_vanilla as vanilla
+from tpusppy.utils import config
+
+
+def _parse_args():
+    cfg = config.Config()
+    cfg.multistage()   # includes popular_args
+    cfg.two_sided_args()
+    cfg.ph_args()
+    cfg.lagrangian_args()
+    cfg.xhatshuffle_args()
+    ccopf.inparser_adder(cfg)
+    cfg.parse_command_line("ccopf_cylinders")
+    return cfg
+
+
+def main():
+    cfg = _parse_args()
+    if cfg.default_rho is None:
+        raise RuntimeError("specify --default-rho")
+    bf = [int(f) for f in (cfg.branching_factors or [2, 2])]
+    num_scens = int(np.prod(bf))
+    names = ccopf.scenario_names_creator(num_scens)
+    kw = ccopf.kw_creator(cfg)
+    kw["branching_factors"] = bf
+    beans = dict(
+        cfg=cfg, scenario_creator=ccopf.scenario_creator,
+        scenario_denouement=ccopf.scenario_denouement,
+        all_scenario_names=names,
+        scenario_creator_kwargs=kw,
+    )
+    hub_dict = vanilla.ph_hub(**beans)
+    spokes = []
+    if cfg.lagrangian:
+        spokes.append(vanilla.lagrangian_spoke(**beans))
+    if cfg.xhatshuffle:
+        spokes.append(vanilla.xhatshuffle_spoke(**beans))
+    ws = WheelSpinner(hub_dict, spokes).spin()
+    print(f"BestInnerBound={ws.BestInnerBound:.4f} "
+          f"BestOuterBound={ws.BestOuterBound:.4f}")
+    return ws
+
+
+if __name__ == "__main__":
+    main()

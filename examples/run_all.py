@@ -59,6 +59,10 @@ RUNS = [
      ["--num-scens", "6", "--battery-lam", "0.1", "--battery-use-lp",
       "--max-iterations", "8", "--default-rho", "0.5",
       "--rel-gap", "0.02", "--lagrangian", "--xhatshuffle"]),
+    ("acopf3/ccopf_cylinders.py",
+     ["--branching-factors", "2 2", "--max-iterations", "20",
+      "--default-rho", "0.1", "--rel-gap", "0.01", "--lagrangian",
+      "--xhatshuffle"]),
     ("usar/usar_ef.py",
      ["--num-scens", "3", "--output-dir", "/tmp/tpusppy_usar_out"]),
     ("usar/usar_cylinders.py",

@@ -190,12 +190,12 @@ class TestFarmerADMM:
 
 
 class TestBlockedExplicitInverse:
-    """The large-n blocked K^-1 path (admm._explicit_inverse).
+    """The large-n recursive Schur-inversion path (admm._explicit_inverse).
 
-    One-shot triangular solves against a full identity RHS OOM XLA:TPU around
-    n~16k (chunked substitution keeps ~n/128 O(n^2) temps live); the blocked
-    path must agree with the one-shot path bit-for-bit-ish and handle batch
-    dims and non-divisor tail blocks.
+    XLA:TPU's TriangularSolve lowering OOMs around n~16k (9.2 GB of temps for
+    a single full-height solve), so large SPD inverses recurse on 2x2 Schur
+    blocks instead; the recursive path must agree with the Cholesky leaf
+    path and handle batch dims and odd (non-multiple-of-leaf) sizes.
     """
 
     def test_blocked_matches_oneshot_and_numpy(self, monkeypatch):
@@ -204,25 +204,23 @@ class TestBlockedExplicitInverse:
         from tpusppy.solvers import admm
 
         rng = np.random.default_rng(7)
-        n = 97  # prime: exercises the tail block
+        n = 97  # odd, prime: exercises uneven split points
         M = rng.standard_normal((3, n, n))
         K = jnp.asarray(M @ M.transpose(0, 2, 1) + n * np.eye(n))
         ref = admm._explicit_inverse(K)
-        monkeypatch.setattr(admm, "_EXPLICIT_INV_BLOCK_N", 16)
-        monkeypatch.setattr(admm, "_EXPLICIT_INV_BLOCK", 24)
+        monkeypatch.setattr(admm, "_EXPLICIT_INV_LEAF_N", 16)
         blocked = admm._explicit_inverse(K)
         np.testing.assert_allclose(
-            np.asarray(blocked), np.asarray(ref), rtol=0, atol=1e-10)
+            np.asarray(blocked), np.asarray(ref), rtol=0, atol=1e-9)
         np.testing.assert_allclose(
             np.asarray(blocked), np.linalg.inv(np.asarray(K)),
-            rtol=0, atol=1e-10)
+            rtol=0, atol=1e-9)
 
     def test_solve_batch_through_blocked_path(self, monkeypatch):
-        """End-to-end LP solve with the factorization forced blocked."""
+        """End-to-end LP solve with the factorization forced recursive."""
         from tpusppy.solvers import admm
 
-        monkeypatch.setattr(admm, "_EXPLICIT_INV_BLOCK_N", 4)
-        monkeypatch.setattr(admm, "_EXPLICIT_INV_BLOCK", 8)
+        monkeypatch.setattr(admm, "_EXPLICIT_INV_LEAF_N", 4)
         rng = np.random.default_rng(3)
         c, A, cl, cu, lb, ub = random_feasible_lp(rng, n=11, m=9)
         ref = scipy_backend.solve_lp(c, A, cl, cu, lb, ub)

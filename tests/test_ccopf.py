@@ -1,0 +1,125 @@
+"""CCOPF (DC contingency-constrained OPF) family — the acopf3 analogue.
+
+Mirrors the reference's examples/acopf3 structure (ACtree failure/repair
+tree + per-stage OPF with mismatch slack + ramp coupling + per-node pg
+nonanticipativity) on the DC linearization; see models/ccopf.py for scope.
+"""
+
+import numpy as np
+import pytest
+
+from tpusppy.ef import solve_ef
+from tpusppy.ir import ScenarioBatch
+from tpusppy.models import ccopf
+
+
+def make_batch(bfs=(2, 2), **over):
+    kw = ccopf.kw_creator(branching_factors=list(bfs), **over)
+    n = int(np.prod(bfs))
+    names = ccopf.scenario_names_creator(n)
+    return ScenarioBatch.from_problems(
+        [ccopf.scenario_creator(nm, **kw) for nm in names]), kw
+
+
+def test_tree_semantics():
+    """FixFast repairs every failed line one stage later; failures draw
+    per in-service line (ACtree.py:118-140 semantics)."""
+    t = ccopf.ContingencyTree(3, [2, 2], 1134, 0.2, [5, 15, 45],
+                              ccopf.FixFast, list(range(6)))
+    assert t.num_scens == 4
+    assert t.root.up == list(range(6)) and t.root.failed == []
+    for kid in t.root.kids:
+        assert sorted(kid.up + [l for l, _ in kid.failed]) == list(range(6))
+        for grandkid in kid.kids:
+            # FixFast: everything failed at the kid is back up unless it
+            # failed again fresh at the grandkid
+            for line, mo in grandkid.failed:
+                assert mo == 45  # fresh failure carries this stage's minutes
+    # FixNever accumulates minutes instead
+    t2 = ccopf.ContingencyTree(3, [2, 2], 1134, 0.2, [5, 15, 45],
+                               ccopf.FixNever, list(range(6)))
+    for kid in t2.root.kids:
+        for grandkid in kid.kids:
+            for line, mo in grandkid.failed:
+                assert mo in (45, 15 + 45)
+
+    # node paths are stage-ordered and consistent
+    for s in range(1, 5):
+        path = t.nodes_for_scenario(s)
+        assert [n.stage for n in path] == [1, 2, 3]
+        assert path[0].name == "ROOT"
+
+
+def test_ef_golden_and_outage_physics():
+    batch, kw = make_batch()
+    assert batch.tree.num_stages == 3
+    obj, xs = solve_ef(batch, solver="highs")
+    assert obj == pytest.approx(318122.02, abs=0.1)
+    # nonanticipativity: pg of stage 1 (first 5 vars) equal across scenarios
+    x = np.asarray(xs)
+    assert np.abs(x[:, :5] - x[0, :5]).max() < 1e-6
+
+    # no failures => pure dispatch cost, far below the outage expectation
+    batch0, _ = make_batch(fail_prob=0.0)
+    obj0, xs0 = solve_ef(batch0, solver="highs")
+    assert obj0 < obj * 0.5
+    # and identical scenarios agree everywhere (degenerate tree)
+    assert np.abs(np.asarray(xs0) - np.asarray(xs0)[0]).max() < 1e-6
+
+
+def test_ramping_penalty_limits_swings():
+    """A large ramp coefficient forces flatter pg trajectories."""
+    batch_lo, kw = make_batch(ramp_coeff=0.0)
+    batch_hi, _ = make_batch(ramp_coeff=10000.0)
+    _, xs_lo = solve_ef(batch_lo, solver="highs")
+    _, xs_hi = solve_ef(batch_hi, solver="highs")
+    T, G = 3, 5
+    vn = batch_lo.var_names
+    pg_idx = np.array([[vn.index(f"pg[{t},{g}]") for g in range(G)]
+                       for t in range(T)])
+
+    def swing(xs):
+        return sum(
+            np.abs(np.diff(np.asarray(xs)[s][pg_idx], axis=0)).sum()
+            for s in range(np.asarray(xs).shape[0]))
+
+    assert swing(xs_hi) <= swing(xs_lo) + 1e-6
+
+
+@pytest.mark.slow
+def test_ccopf_wheel_certifies():
+    from tpusppy.cylinders import LagrangianOuterBound, PHHub, \
+        XhatShuffleInnerBound
+    from tpusppy.opt.ph import PH
+    from tpusppy.phbase import PHBase
+    from tpusppy.spin_the_wheel import WheelSpinner
+    from tpusppy.xhat_eval import Xhat_Eval
+
+    batch, kw = make_batch()
+    ef_obj, _ = solve_ef(batch, solver="highs")
+    names = ccopf.scenario_names_creator(4)
+
+    def okw():
+        return {
+            "options": {"defaultPHrho": 0.1, "PHIterLimit": 20,
+                        "convthresh": -1.0,
+                        "xhat_looper_options": {"scen_limit": 3}},
+            "all_scenario_names": names,
+            "scenario_creator": ccopf.scenario_creator,
+            "scenario_creator_kwargs": kw,
+        }
+
+    hub = {"hub_class": PHHub,
+           "hub_kwargs": {"options": {"rel_gap": 0.01}},
+           "opt_class": PH, "opt_kwargs": okw()}
+    spokes = [
+        {"spoke_class": LagrangianOuterBound, "opt_class": PHBase,
+         "opt_kwargs": okw()},
+        {"spoke_class": XhatShuffleInnerBound, "opt_class": Xhat_Eval,
+         "opt_kwargs": okw()},
+    ]
+    ws = WheelSpinner(hub, spokes).spin()
+    gap = (ws.BestInnerBound - ws.BestOuterBound) / abs(ws.BestInnerBound)
+    assert np.isfinite(ws.BestInnerBound)
+    assert gap <= 0.01 + 1e-9
+    assert ws.BestInnerBound == pytest.approx(ef_obj, rel=0.01)

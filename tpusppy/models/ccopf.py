@@ -1,0 +1,306 @@
+"""Contingency-constrained OPF (CCOPF) in the tpusppy IR — DC approximation.
+
+Mirrors the reference's acopf3 example family (`examples/acopf3/ACtree.py`,
+`examples/acopf3/ccopf_multistage.py:67-241`): a multistage stochastic OPF
+where transmission lines randomly fail and get repaired along a scenario
+tree, each stage solves an OPF with load-mismatch slack, stages couple
+through generator ramping, and per-stage generation is nonanticipative at
+each tree node.
+
+Honest scope note: the reference builds egret's rectangular-IV ACOPF (or
+its SOC relaxation) per stage.  egret is unavailable here and nonconvex AC
+physics is outside the LP/convex-QP IR, so this family implements the
+classic **DC (B-theta) linearization**: real-power flow f = b*(theta_i -
+theta_j) on in-service lines, f = 0 on failed lines, bus balance with
+load-mismatch slack at ``load_mismatch_cost`` (the reference's
+include_feasibility_slack), and **L1 ramping** r >= |pg[t+1] - pg[t]| at
+``ramp_coeff`` (the reference penalizes the squared difference in the
+objective, ccopf_multistage.py:190-201; the IR's quadratic term is
+diagonal, so the cross-stage square is linearized).  The failure/repair
+tree reproduces ACTree's semantics: per-line failure probability per
+stage, minutes-out bookkeeping, and a pluggable repair rule (FixFast /
+FixNever / probabilistic).
+
+Default grid: the 5-bus PJM test system (gens/loads/lines as in the
+public case5 data) — small enough for EF goldens, structured enough for
+line outages to matter.
+"""
+
+import numpy as np
+
+from ..ir import LinearModelBuilder
+from ..scenario_tree import ScenarioNode, extract_num
+
+# --- repair rules (ccopf_multistage.py:32-49) -----------------------------
+
+
+def FixFast(minutes):
+    return True
+
+
+def FixNever(minutes):
+    return False
+
+
+# --- default grid: PJM 5-bus ----------------------------------------------
+# buses 0..4; loads (MW); generators (bus, pmax, cost $/MWh); lines
+# (from, to, susceptance b [p.u. scaled], capacity MW)
+CASE5_LOADS = {1: 300.0, 2: 300.0, 3: 400.0}
+CASE5_GENS = [
+    (0, 110.0, 14.0),     # Alta
+    (0, 100.0, 15.0),     # Park City
+    (2, 520.0, 30.0),     # Solitude
+    (3, 200.0, 40.0),     # Sundance
+    (4, 600.0, 10.0),     # Brighton
+]
+CASE5_LINES = [
+    (0, 1, 1.0 / 0.0281, 400.0),
+    (0, 3, 1.0 / 0.0304, 1000.0),
+    (0, 4, 1.0 / 0.0064, 1000.0),
+    (1, 2, 1.0 / 0.0108, 1000.0),
+    (2, 3, 1.0 / 0.0297, 1000.0),
+    (3, 4, 1.0 / 0.0297, 240.0),
+]
+NUM_BUSES = 5
+
+
+class _TreeNode:
+    """ACtree.py:89-162 semantics: failed lines carry minutes-out, repairs
+    happen first, then fresh failures are drawn per in-service line."""
+
+    def __init__(self, parent, tree, scen_list, name, cond_prob, stream):
+        self.name = name
+        self.cond_prob = cond_prob
+        self.scen_list = scen_list
+        self.parent = parent
+        if parent is None:
+            self.stage = 1
+            self.failed = []                     # [(line, minutes_out)]
+            self.up = list(tree.line_list)
+        else:
+            self.stage = parent.stage + 1
+            self.failed = list(parent.failed)
+            self.up = list(parent.up)
+            dur = tree.stage_durations[self.stage - 1]
+            still_failed = []
+            for line, mo in self.failed:
+                if tree.repairer(mo):
+                    self.up.append(line)
+                else:
+                    still_failed.append((line, mo + dur))
+            self.failed = still_failed
+            # fresh failures (reference iterates while mutating LinesUp,
+            # which skips the element after each removal; we draw once per
+            # in-service line — same distribution, no iteration quirk)
+            survivors = []
+            for line in self.up:
+                if stream.rand() < tree.fail_prob:
+                    self.failed.append((line, dur))
+                else:
+                    survivors.append(line)
+            self.up = survivors
+        self.kids = []
+        if self.stage < tree.num_stages:
+            bf = tree.bfs[self.stage - 1]
+            for k in range(bf):
+                first = k * len(scen_list) // bf
+                last = (k + 1) * len(scen_list) // bf
+                self.kids.append(_TreeNode(
+                    self, tree, scen_list[first:last],
+                    f"{name}_{k}", 1.0 / bf, stream))
+
+
+class ContingencyTree:
+    """ACTree analogue: failure/repair scenario tree over the line set."""
+
+    def __init__(self, num_stages, bfs, seed, fail_prob, stage_durations,
+                 repairer, line_list):
+        self.num_stages = num_stages
+        self.bfs = list(bfs)
+        self.fail_prob = fail_prob
+        self.stage_durations = list(stage_durations)
+        self.repairer = repairer
+        self.line_list = list(line_list)
+        self.num_scens = int(np.prod(bfs))
+        stream = np.random.RandomState(seed)
+        self.root = _TreeNode(None, self,
+                              list(range(1, self.num_scens + 1)),
+                              "ROOT", 1.0, stream)
+
+    def nodes_for_scenario(self, snum):
+        """Stage-ordered node path for 1-based scenario ``snum``
+        (ACtree.py:60-72)."""
+        if not 1 <= snum <= self.num_scens:
+            raise ValueError(
+                f"scenario {snum} outside 1..{self.num_scens} (the tree has "
+                f"prod(branching_factors) = {self.num_scens} scenarios)")
+        path = [self.root]
+        while path[-1].kids:
+            for kid in path[-1].kids:
+                if snum in kid.scen_list:
+                    path.append(kid)
+                    break
+            else:
+                raise RuntimeError(
+                    f"scenario {snum} missing from every child of "
+                    f"{path[-1].name}")
+        return path
+
+    def all_nodenames(self):
+        out = []
+
+        def walk(node):
+            out.append(node.name)
+            for kid in node.kids:
+                walk(kid)
+
+        walk(self.root)
+        return out
+
+
+_TREE_CACHE = {}
+
+
+def _tree(branching_factors, seed, fail_prob, repair):
+    key = (tuple(branching_factors), seed, fail_prob, repair)
+    if key not in _TREE_CACHE:
+        repairer = {"fast": FixFast, "never": FixNever}[repair]
+        num_stages = len(branching_factors) + 1
+        durations = [5 * 3 ** t for t in range(num_stages)]
+        _TREE_CACHE[key] = ContingencyTree(
+            num_stages, branching_factors, seed, fail_prob, durations,
+            repairer, list(range(len(CASE5_LINES))))
+    return _TREE_CACHE[key]
+
+
+def scenario_names_creator(num_scens, start=0):
+    return [f"Scen{i + 1}" for i in range(start, start + num_scens)]
+
+
+def kw_creator(cfg=None, **kwargs):
+    cfg = cfg or {}
+    get = (cfg.get if hasattr(cfg, "get")
+           else lambda k, d=None: getattr(cfg, k, d))
+
+    def pick(name, default):
+        v = kwargs.get(name, get(name, default))
+        return default if v is None else v
+
+    return {
+        "branching_factors": pick("branching_factors", [2, 2]),
+        "seed": pick("seed", 1134),
+        "fail_prob": pick("fail_prob", 0.2),
+        "repair": pick("repair", "fast"),
+        "ramp_coeff": pick("ramp_coeff", 100.0),
+        "load_mismatch_cost": pick("load_mismatch_cost", 1000.0),
+    }
+
+
+def inparser_adder(cfg):
+    if "branching_factors" not in cfg:
+        cfg.add_branching_factors()
+    if "num_scens" not in cfg:
+        cfg.num_scens_optional() if hasattr(cfg, "num_scens_optional") \
+            else None
+    for name, domain, default, desc in (
+        ("fail_prob", float, 0.2, "per-line failure probability per stage"),
+        ("repair", str, "fast", "repair rule: fast | never"),
+        ("ramp_coeff", float, 100.0, "L1 ramping cost coefficient"),
+        ("load_mismatch_cost", float, 1000.0,
+         "cost per MW of unserved/spilled load"),
+    ):
+        if name not in cfg:
+            cfg.add_to_config(name, description=desc, domain=domain,
+                              default=default)
+    if "seed" not in cfg:
+        cfg.add_to_config("seed", description="tree seed", domain=int,
+                          default=1134)
+
+
+def scenario_denouement(rank, scenario_name, scenario):
+    pass
+
+
+def all_nodenames(branching_factors=None, seed=1134, fail_prob=0.2,
+                  repair="fast", **_):
+    return _tree(branching_factors or [2, 2], seed, fail_prob,
+                 repair).all_nodenames()
+
+
+def scenario_creator(scenario_name, branching_factors=None, seed=1134,
+                     fail_prob=0.2, repair="fast", ramp_coeff=100.0,
+                     load_mismatch_cost=1000.0):
+    """One CCOPF scenario: a DC-OPF block per stage along the line-outage
+    tree path, ramp-coupled, pg nonanticipative per nonleaf node
+    (ccopf_multistage.py:211-226 attaches [pg, qg]; DC has no qg)."""
+    branching_factors = branching_factors or [2, 2]
+    tree = _tree(branching_factors, seed, fail_prob, repair)
+    snum = extract_num(scenario_name)
+    path = tree.nodes_for_scenario(snum)
+    T = tree.num_stages
+    G = len(CASE5_GENS)
+    B = NUM_BUSES
+    L = len(CASE5_LINES)
+
+    b = LinearModelBuilder(scenario_name)
+    pg = np.empty((T, G), dtype=np.int64)
+    th = np.empty((T, B), dtype=np.int64)
+    fl = np.empty((T, L), dtype=np.int64)
+    sp = np.empty((T, B), dtype=np.int64)
+    sn = np.empty((T, B), dtype=np.int64)
+    for t in range(T):
+        up = set(path[t].up)
+        for g, (bus, pmax, cost) in enumerate(CASE5_GENS):
+            pg[t, g] = b.add_var(f"pg[{t},{g}]", lb=0.0, ub=pmax, cost=cost)
+        for i in range(B):
+            # reference bus 0 pinned; others free
+            lim = 0.0 if i == 0 else np.pi
+            th[t, i] = b.add_var(f"th[{t},{i}]", lb=-lim, ub=lim)
+        for l, (fi, ti, susc, cap) in enumerate(CASE5_LINES):
+            c = cap if l in up else 0.0
+            fl[t, l] = b.add_var(f"f[{t},{l}]", lb=-c, ub=c)
+        for i in range(B):
+            sp[t, i] = b.add_var(f"s+[{t},{i}]", lb=0.0,
+                                 cost=load_mismatch_cost)
+            sn[t, i] = b.add_var(f"s-[{t},{i}]", lb=0.0,
+                                 cost=load_mismatch_cost)
+        # flow definition on in-service lines: f - b*(th_i - th_j) = 0;
+        # failed lines keep f = 0 (same row count in every scenario)
+        for l, (fi, ti, susc, cap) in enumerate(CASE5_LINES):
+            if l in up:
+                b.add_eq({int(fl[t, l]): 1.0, int(th[t, fi]): -susc,
+                          int(th[t, ti]): susc}, 0.0)
+            else:
+                b.add_eq({int(fl[t, l]): 1.0}, 0.0)
+        # bus balance: gen - outflow + inflow + s+ - s- = load
+        for i in range(B):
+            coeffs = {int(sp[t, i]): 1.0, int(sn[t, i]): -1.0}
+            for g, (bus, _, _) in enumerate(CASE5_GENS):
+                if bus == i:
+                    coeffs[int(pg[t, g])] = 1.0
+            for l, (fi, ti, _, _) in enumerate(CASE5_LINES):
+                if fi == i:
+                    coeffs[int(fl[t, l])] = \
+                        coeffs.get(int(fl[t, l]), 0.0) - 1.0
+                if ti == i:
+                    coeffs[int(fl[t, l])] = \
+                        coeffs.get(int(fl[t, l]), 0.0) + 1.0
+            b.add_eq(coeffs, CASE5_LOADS.get(i, 0.0))
+    # L1 ramping between consecutive stages (linearized analogue of the
+    # reference's squared ramping expression)
+    for t in range(T - 1):
+        for g in range(G):
+            r = b.add_var(f"ramp[{t},{g}]", lb=0.0, cost=ramp_coeff)
+            b.add_ge({r: 1.0, int(pg[t + 1, g]): -1.0, int(pg[t, g]): 1.0},
+                     0.0)
+            b.add_ge({r: 1.0, int(pg[t + 1, g]): 1.0, int(pg[t, g]): -1.0},
+                     0.0)
+
+    p = b.build()
+    p.prob = 1.0 / tree.num_scens
+    p.nodes = [
+        ScenarioNode(path[t].name, path[t].cond_prob, t + 1,
+                     pg[t].astype(np.int32))
+        for t in range(T - 1)       # nonleaf stages only
+    ]
+    return p

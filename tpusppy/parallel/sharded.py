@@ -155,24 +155,24 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
         one settings variant; dense fns are shard_mapped when on a mesh."""
 
         def shared_refresh(q, q2, A, cl, cu, lb, ub, x, z, y, yx):
-            with jax.default_matmul_precision("highest"):
+            with jax.default_matmul_precision(st.matmul_precision):
                 return shared_admm._solve_shared_impl(
                     q, q2, A, cl, cu, lb, ub, st, (x, z, y, yx),
                     want_factors=True)
 
         def shared_frozen(q, q2, A, cl, cu, lb, ub, x, z, y, yx, factors):
-            with jax.default_matmul_precision("highest"):
+            with jax.default_matmul_precision(st.matmul_precision):
                 return shared_admm._solve_shared_frozen_impl(
                     q, q2, A, cl, cu, lb, ub, factors, (x, z, y, yx), st)
 
         def local_refresh(q, q2, A, cl, cu, lb, ub, x, z, y, yx):
-            with jax.default_matmul_precision("highest"):
+            with jax.default_matmul_precision(st.matmul_precision):
                 return admm._solve_impl(
                     q, q2, A, cl, cu, lb, ub, st, (x, z, y, yx),
                     want_factors=True)
 
         def local_frozen(q, q2, A, cl, cu, lb, ub, x, z, y, yx, factors):
-            with jax.default_matmul_precision("highest"):
+            with jax.default_matmul_precision(st.matmul_precision):
                 return admm._solve_frozen_impl(
                     q, q2, A, cl, cu, lb, ub, factors, (x, z, y, yx), st)
 
@@ -285,7 +285,7 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
 
             def local_polish(q, q2, A, cl, cu, lb, ub, x, z, y, yx,
                              factors):
-                with jax.default_matmul_precision("highest"):
+                with jax.default_matmul_precision(st_p.matmul_precision):
                     return admm._solve_frozen_impl(
                         q, q2, A, cl, cu, lb, ub, factors, (x, z, y, yx),
                         st_p, polish=True)

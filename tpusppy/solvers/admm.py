@@ -69,6 +69,12 @@ class ADMMSettings:
     rho_row_boost: float = 10.0
     rho_row_max: float = 1e6
     dtype: str = "float64"
+    # Matmul precision for the solve programs.  "highest" = full f32
+    # (bf16x6 passes on TPU MXU — ~6x the flops of plain bf16); "high" =
+    # bf16x3; "default" = bf16.  Lower precisions trade residual floor for
+    # sweep throughput; certified-bound programs (dual_objective/dual_cut)
+    # always run "highest" regardless.
+    matmul_precision: str = "highest"
 
     def jdtype(self):
         return jnp.dtype(self.dtype)
@@ -676,10 +682,11 @@ def solve_batch(c, q2, A, cl, cu, lb, ub, settings: ADMMSettings = ADMMSettings(
     FWPH's simplex QPs; omit for the separable scenario subproblems.
 
     On TPU, float32 matmuls default to bf16 MXU accumulation, which stalls ADMM
-    below ~1e-3 residuals; trace everything at highest available precision
-    (f32 full-precision passes on the MXU — still fast at these sizes).
+    below ~1e-3 residuals; the solve traces at ``settings.matmul_precision``
+    (default "highest": f32 full-precision passes on the MXU).  Lowering it
+    trades residual floor for sweep throughput.
     """
-    with jax.default_matmul_precision("highest"):
+    with jax.default_matmul_precision(settings.matmul_precision):
         return _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm, P)
 
 
@@ -819,7 +826,7 @@ def solve_batch_frozen(c, q2, A, cl, cu, lb, ub, factors: Factors,
                        settings: ADMMSettings = ADMMSettings(),
                        warm=None, P=None, polish=False) -> BatchSolution:
     """Jitted frozen-factor solve; see :func:`_solve_frozen_impl`."""
-    with jax.default_matmul_precision("highest"):
+    with jax.default_matmul_precision(settings.matmul_precision):
         return _solve_frozen_impl(c, q2, A, cl, cu, lb, ub, factors, warm,
                                   settings, P, polish=polish)
 
@@ -829,6 +836,21 @@ def _Aty(A, y):
     return y @ A if A.ndim == 2 else jnp.einsum("smn,sm->sn", A, y)
 
 
+
+
+def _highest_precision(fn):
+    """Pin a jitted certified-bound program to full-f32 matmuls regardless
+    of ambient or settings precision (the bound's validity is numerical)."""
+
+    @functools.wraps(fn)
+    def wrapped(*a, **k):
+        with jax.default_matmul_precision("highest"):
+            return fn(*a, **k)
+
+    return wrapped
+
+
+@_highest_precision
 @jax.jit
 def dual_objective(c, q2, A, cl, cu, lb, ub, y, x_hint, margin_scale=100.0):
     """(S,) LOWER bounds on each scenario optimum from row duals ``y``.
@@ -857,6 +879,7 @@ def dual_objective(c, q2, A, cl, cu, lb, ub, y, x_hint, margin_scale=100.0):
     return base
 
 
+@_highest_precision
 @jax.jit
 def dual_objective_margin(c, q2, A, cl, cu, lb, ub, y, x_hint,
                           margin_scale=100.0, widen=10.0):
@@ -892,6 +915,7 @@ def dual_objective_margin(c, q2, A, cl, cu, lb, ub, y, x_hint,
     return jnp.sum(per, axis=1)
 
 
+@_highest_precision
 @jax.jit
 def dual_cut(c, q2, A, cl, cu, lb, ub, y, x_hint, clamp_mask,
              margin_scale=100.0):
@@ -943,7 +967,7 @@ def solve_batch_factored(c, q2, A, cl, cu, lb, ub,
                          warm=None, P=None):
     """Adaptive solve that ALSO returns the reusable :class:`Factors` for
     subsequent :func:`solve_batch_frozen` calls."""
-    with jax.default_matmul_precision("highest"):
+    with jax.default_matmul_precision(settings.matmul_precision):
         return _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm, P,
                            want_factors=True)
 

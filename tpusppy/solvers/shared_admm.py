@@ -439,7 +439,7 @@ def solve_shared(c, q2, A, cl, cu, lb, ub,
                  settings: ADMMSettings = ADMMSettings(),
                  warm=None) -> BatchSolution:
     """Solve a shared-A batch: A is (m, n); everything else (S, ...)."""
-    with jax.default_matmul_precision("highest"):
+    with jax.default_matmul_precision(settings.matmul_precision):
         return _solve_shared_impl(c, q2, A, cl, cu, lb, ub, settings, warm)
 
 
@@ -448,7 +448,7 @@ def solve_shared_factored(c, q2, A, cl, cu, lb, ub,
                           settings: ADMMSettings = ADMMSettings(),
                           warm=None):
     """Adaptive shared-A solve that also returns :class:`SharedFactors`."""
-    with jax.default_matmul_precision("highest"):
+    with jax.default_matmul_precision(settings.matmul_precision):
         return _solve_shared_impl(c, q2, A, cl, cu, lb, ub, settings, warm,
                                   want_factors=True)
 
@@ -458,6 +458,6 @@ def solve_shared_frozen(c, q2, A, cl, cu, lb, ub, factors: SharedFactors,
                         settings: ADMMSettings = ADMMSettings(),
                         warm=None) -> BatchSolution:
     """Jitted frozen-factor shared-A solve."""
-    with jax.default_matmul_precision("highest"):
+    with jax.default_matmul_precision(settings.matmul_precision):
         return _solve_shared_frozen_impl(c, q2, A, cl, cu, lb, ub, factors,
                                          warm, settings)
