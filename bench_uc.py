@@ -183,6 +183,18 @@ def uc_metrics():
         f"=> {base_ips:.4f} iters/sec serial, {base32:.4f} at ideal "
         f"{RANKS}-rank scaling")
 
+    # free the rate-metric's device residency before the wheel: the S=1000
+    # arrays + factors (~6 GB at reference shape) plus the compiled S=1000
+    # executables (~0.5 GB code each) otherwise coexist with the wheel's
+    # per-cylinder factors and OOM the chip
+    del arr, state, out, factors, refresh, frozen
+    import gc
+
+    from tpusppy import spopt as _spopt
+    _spopt.clear_device_caches()
+    gc.collect()
+    jax.clear_caches()
+
     # ---- metric 2: wall-clock to certified MIP gap (full wheel) ----------
     from tpusppy.cylinders import (
         LagrangianOuterBound, PHHub, SlamMaxHeuristic, XhatRestrictedEF,

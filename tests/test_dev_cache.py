@@ -1,0 +1,42 @@
+"""Content-keyed device cache for big constraint matrices (spopt._device_A):
+wheel cylinders build identical shared-A batches in separate threads and
+must end up sharing ONE device buffer."""
+
+import threading
+
+import numpy as np
+
+from tpusppy import spopt
+
+
+def test_content_dedup_and_thread_safety(monkeypatch):
+    monkeypatch.setattr(spopt, "_DEV_A_CACHE", type(spopt._DEV_A_CACHE)())
+    A = np.random.default_rng(0).standard_normal((2048, 2048))  # 32 MB
+    copies = [A.copy() for _ in range(4)]
+    out = [None] * 4
+
+    def worker(i):
+        out[i] = spopt._device_A(copies[i], "float64")
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # identical content => one cache entry, one shared buffer
+    assert len(spopt._DEV_A_CACHE) == 1
+    assert all(o is out[0] for o in out[1:])
+    np.testing.assert_array_equal(np.asarray(out[0]), A)
+
+    # different content => new entry; LRU stays bounded
+    for k in range(6):
+        spopt._device_A(A + k + 1, "float64")
+    assert len(spopt._DEV_A_CACHE) <= 4
+
+    spopt.clear_device_caches()
+    assert len(spopt._DEV_A_CACHE) == 0
+
+    # small matrices bypass the cache entirely
+    small = np.ones((8, 8))
+    spopt._device_A(small, "float64")
+    assert len(spopt._DEV_A_CACHE) == 0

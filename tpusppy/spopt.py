@@ -11,9 +11,11 @@ psums on the scenario axis.
 
 from __future__ import annotations
 
-import numpy as np
-
+import collections
 import itertools
+import threading
+
+import numpy as np
 
 from . import global_toc
 from .spbase import SPBase
@@ -32,6 +34,48 @@ def _batch_token(b):
         tok = next(_BATCH_TOKENS)
         b._sig_token = tok
     return tok
+
+
+# Content-keyed device cache for big constraint matrices.  Every cylinder in
+# a wheel builds its own ScenarioBatch from the same scenario_creator, so
+# without content sharing each one uploads (and keeps) its own device copy
+# of the identical shared (m, n) A — ~800 MB x n_cylinders at reference UC
+# shapes, a large slice of one chip's HBM.  Keyed by sha1 of the bytes;
+# tiny LRU since distinct big matrices rarely coexist.  The lock matters:
+# wheel cylinders are threads that reach their first solve near-
+# simultaneously, and both the hash and the host->device upload release
+# the GIL — unlocked, every thread would miss and upload its own copy.
+_DEV_A_CACHE: dict = collections.OrderedDict()
+_DEV_A_LOCK = threading.Lock()
+
+
+def _device_A(A_src, dt):
+    import hashlib
+
+    import jax.numpy as jnp
+
+    A_np = np.asarray(A_src)
+    if A_np.nbytes < 16 << 20:          # small matrices: not worth hashing
+        return jnp.asarray(A_np, dt)
+    with _DEV_A_LOCK:
+        digest = hashlib.sha1(
+            memoryview(np.ascontiguousarray(A_np))).hexdigest()
+        key = (digest, A_np.shape, str(dt))
+        dev = _DEV_A_CACHE.pop(key, None)
+        if dev is None:
+            dev = jnp.asarray(A_np, dt)
+        _DEV_A_CACHE[key] = dev         # re-insert = LRU touch
+        while len(_DEV_A_CACHE) > 4:
+            _DEV_A_CACHE.popitem(last=False)
+        return dev
+
+
+def clear_device_caches():
+    """Release the content-keyed device-A cache (e.g. between benchmark
+    phases that need the HBM back; ``jax.clear_caches()`` doesn't reach
+    module-level array references)."""
+    with _DEV_A_LOCK:
+        _DEV_A_CACHE.clear()
 
 
 def _np_dual_objective(q, A, cl, cu, lb, ub, y, x_hint, margin_scale=100.0):
@@ -153,8 +197,8 @@ class SPOpt(SPBase):
     def _device_consts(self, dt):
         """Device-resident (A, cl, cu) cached on batch.version: the (S, m, n)
         constraint tensor dominates host->device traffic and never changes
-        between bound evaluations (spoke hot loops call Edualbound per
-        iteration)."""
+        between solves (both the solve_loop hot path and the spokes'
+        Edualbound calls go through here)."""
         import jax.numpy as jnp
 
         b = self.batch
@@ -167,7 +211,7 @@ class SPOpt(SPBase):
             # shared-A batches upload the single (m, n) matrix, not the
             # (S, m, n) broadcast view (which would materialize S copies)
             A_src = b.A if getattr(b, "A_shared", None) is None else b.A_shared
-            cached = (key, (jnp.asarray(A_src, dt), jnp.asarray(b.cl, dt),
+            cached = (key, (_device_A(A_src, dt), jnp.asarray(b.cl, dt),
                             jnp.asarray(b.cu, dt)))
             self._dev_consts = cached
         return cached[1]
@@ -223,11 +267,14 @@ class SPOpt(SPBase):
             return x
 
         shared = getattr(b, "A_shared", None) is not None
-        A_arg = b.A_shared if shared else b.A
+        # device-resident (A, cl, cu): avoids re-uploading the constraint
+        # tensor (up to ~GB for shared-A UC) on EVERY solve call, and shares
+        # one device copy of identical A across wheel cylinders
+        A_d, cl_d, cu_d = self._device_consts(self.admm_settings.jdtype())
         slot = {"warm": self._warm, "factors": self._factors,
                 "sig": self._factors_sig, "age": self._factors_age}
         sol = self._solve_amortized(
-            (q, q2, A_arg, b.cl, b.cu, lb, ub), slot, warm, None,
+            (q, q2, A_d, cl_d, cu_d, lb, ub), slot, warm, None,
             shared=shared)
         self._warm = slot["warm"]
         self._factors = slot["factors"]
