@@ -69,6 +69,12 @@ class ADMMSettings:
     rho_row_boost: float = 10.0
     rho_row_max: float = 1e6
     dtype: str = "float64"
+    # Carry the exact K inside SharedFactors for dense refinement ("True",
+    # fastest sweeps) or drop it and refine matrix-free through the shared A
+    # ("False", ~1 GB less HBM per factors at reference UC shapes — the host
+    # wheel path defaults this off via SPBase since several cylinders'
+    # factors coexist on one chip).
+    factors_keep_K: bool = True
     # Matmul precision for the solve programs.  "highest" = full f32
     # (bf16x6 passes on TPU MXU — ~6x the flops of plain bf16); "high" =
     # bf16x3; "default" = bf16.  Lower precisions trade residual floor for

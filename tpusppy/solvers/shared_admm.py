@@ -56,7 +56,9 @@ class SharedFactors(NamedTuple):
     rho_x: jax.Array   # (n,) variable-box penalties actually used last
     gamma: jax.Array   # (S,) per-scenario penalty scales actually used last
     Kinv: jax.Array    # (n, n) explicit inverse of the shared x-update system
-    K: jax.Array       # (n, n) exact shared K for refinement
+    K: jax.Array       # (n, n) exact shared K for dense refinement, or None
+                       # (factors_keep_K=False): refinement then runs
+                       # matrix-free through the scaled shared A
     q2ref: jax.Array   # (n,) scaled q2 the K was built with
 
 
@@ -100,9 +102,11 @@ def _factor_shared(q2ref, A, rho_a, rho_x, sigma):
     return _explicit_inverse(K[None])[0], K
 
 
-def _solve_shared_K(Kinv, K, dq2, gamma, b, refine, extra_if_dq2=2):
+def _solve_shared_K(Kinv, Kmul, dq2, gamma, b, refine, extra_if_dq2=2):
     """x s.t. (gamma_s K + diag(dq2_s)) x_s = b_s per scenario, via the shared
-    inverse + matrix-free refinement against the exact per-scenario system.
+    inverse + refinement against the exact per-scenario system; ``Kmul``
+    applies the exact K (dense row-vector product, or matrix-free via the
+    scaled A when the factors don't carry K — see ``factors_keep_K``).
 
     ``gamma`` (S, 1) is the per-scenario penalty scale: rho_a, rho_x and
     sigma are all free ADMM parameters, so scaling the WHOLE penalty profile
@@ -115,7 +119,7 @@ def _solve_shared_K(Kinv, K, dq2, gamma, b, refine, extra_if_dq2=2):
     actually present (LP batches skip them at runtime via lax.cond)."""
     def steps(x, k):
         for _ in range(k):
-            r = b - (gamma * (x @ K) + dq2 * x)
+            r = b - (gamma * Kmul(x) + dq2 * x)
             x = x + (r / gamma) @ Kinv
         return x
 
@@ -154,6 +158,16 @@ def _core(q, q2s, q2ref, A, cl, cu, lb, ub, state, Kinv, K, rho_a, rho_x,
     ``glo``/``ghi`` bound gamma: wide for LP batches (dq2 = 0, exact at any
     gamma), clamped near 1 for QP (keeps the dq2 refinement contractive).
     """
+    # exact-K application for refinement: dense when K is carried, else
+    # matrix-free through the (scaled) shared A — identical product, two
+    # (S,m)/(S,n) matmuls instead of one (S,n)x(n,n), and no (n,n) K in
+    # the factors (memory matters when several wheel cylinders coexist
+    # on one chip)
+    if K is not None:
+        Kmul = lambda x: x @ K
+    else:
+        diagK = q2ref + rho_x + st.sigma
+        Kmul = lambda x: x * diagK[None, :] + ((x @ A.T) * rho_a[None, :]) @ A
     alpha = st.alpha
     AT = A.T
 
@@ -167,7 +181,7 @@ def _core(q, q2s, q2ref, A, cl, cu, lb, ub, state, Kinv, K, rho_a, rho_x,
         for _ in range(max(1, st.check_every)):
             rhs = (sigma_s * x - q + (rho_a_s * z - y) @ A
                    + (rho_x_s * zx - yx))
-            xt = _solve_shared_K(Kinv, K, dq2, g, rhs, st.solve_refine)
+            xt = _solve_shared_K(Kinv, Kmul, dq2, g, rhs, st.solve_refine)
             Axt = xt @ AT
             x_new = alpha * xt + (1 - alpha) * x
             Ax_new = alpha * Axt + (1 - alpha) * Ax
@@ -386,7 +400,8 @@ def _solve_shared_impl(c, q2, A, cl, cu, lb, ub, settings, warm,
     )
     if want_factors:
         return sol, SharedFactors(D=D, E=E, cost=cost, rho_a=rho_a,
-                                  rho_x=rho_x, gamma=gamma, Kinv=Kinv, K=K,
+                                  rho_x=rho_x, gamma=gamma, Kinv=Kinv,
+                                  K=K if st.factors_keep_K else None,
                                   q2ref=q2ref)
     return sol
 
