@@ -288,7 +288,11 @@ def uc_metrics():
     # bounded join; on timeout the farmer metric still prints)
     import threading
 
-    budget = float(os.environ.get("BENCH_UC_WHEEL_TIMEOUT", "900"))
+    # measured on chip: the real-data S=64 wheel certifies ~0.15% around
+    # 610 s (includes in-wheel compiles + the restricted-EF MILP cadence);
+    # 1500 s gives that trajectory headroom for compile/rescue variance
+    # while staying inside the parent's workload timeout
+    budget = float(os.environ.get("BENCH_UC_WHEEL_TIMEOUT", "1500"))
     result = {}
 
     def _spin():

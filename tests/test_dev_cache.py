@@ -28,12 +28,17 @@ def test_content_dedup_and_thread_safety(monkeypatch):
     assert all(o is out[0] for o in out[1:])
     np.testing.assert_array_equal(np.asarray(out[0]), A)
 
-    # a new digest at the same (shape, dtype) evicts the stale version (the
-    # in-place-mutation pattern of cross-scenario cut rounds), so dead
-    # versions never accumulate in HBM
+    # a new digest at the same (shape, dtype) keeps only the newest prior
+    # version (cylinders at cut-round k and k-1 coexist and alternate; older
+    # versions are dead and dropped), so the cache holds at most 2 per shape
     for k in range(6):
         spopt._device_A(A + k + 1, "float64")
-    assert len(spopt._DEV_A_CACHE) == 1
+    assert len(spopt._DEV_A_CACHE) == 2
+    # and the two newest alternate without thrashing
+    d5 = spopt._device_A(A + 6, "float64")
+    d4 = spopt._device_A(A + 5, "float64")
+    assert spopt._device_A(A + 6, "float64") is d5
+    assert spopt._device_A(A + 5, "float64") is d4
 
     spopt.clear_device_caches()
     assert len(spopt._DEV_A_CACHE) == 0

@@ -113,10 +113,6 @@ class SPBase:
     def _make_admm_settings(self) -> ADMMSettings:
         so = dict(self.options.get("solver_options") or {})
         allowed = {f.name for f in ADMMSettings.__dataclass_fields__.values()}
-        # host-path opts (wheel cylinders) drop the exact K from shared-A
-        # factors: several cylinders' factors coexist on one chip, and the
-        # refinement runs matrix-free through the one shared device A
-        so.setdefault("factors_keep_K", False)
         # bundles are fewer but larger/harder subproblems; spend more solver
         # budget per problem unless the user pinned it (same trade as giving
         # the external solver more time per bundle EF in the reference)

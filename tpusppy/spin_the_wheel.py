@@ -37,12 +37,27 @@ class WheelSpinner:
         """comm_world accepted for reference API parity; unused in-process."""
         return self.run()
 
+    @staticmethod
+    def _cylinder_opt_kwargs(opt_kwargs):
+        """Wheel-context solver defaults: several cylinders' factors coexist
+        on one chip, so shared-A factors drop the exact K and refine
+        matrix-free (factors_keep_K) unless the caller pinned it.
+        Deep-copies only the dicts it touches."""
+        opt_kwargs = dict(opt_kwargs)
+        options = dict(opt_kwargs.get("options") or {})
+        so = dict(options.get("solver_options") or {})
+        so.setdefault("factors_keep_K", False)
+        options["solver_options"] = so
+        opt_kwargs["options"] = options
+        return opt_kwargs
+
     def run(self):
         fabric = WindowFabric()
 
         # Hub opt + communicator (spin_the_wheel.py:92-116)
         hub = self.hub_dict
-        hub_opt = hub["opt_class"](**hub["opt_kwargs"])
+        hub_opt = hub["opt_class"](
+            **self._cylinder_opt_kwargs(hub["opt_kwargs"]))
         hub_comm = hub["hub_class"](
             hub_opt, 0, fabric, spokes=self.list_of_spoke_dict,
             **hub.get("hub_kwargs", {}),
@@ -51,7 +66,7 @@ class WheelSpinner:
         # Spoke opts + communicators; negotiate mailbox lengths
         spoke_comms = []
         for i, sd in enumerate(self.list_of_spoke_dict):
-            opt = sd["opt_class"](**sd["opt_kwargs"])
+            opt = sd["opt_class"](**self._cylinder_opt_kwargs(sd["opt_kwargs"]))
             comm = sd["spoke_class"](
                 opt, i + 1, fabric, **sd.get("spoke_kwargs", {}),
             )

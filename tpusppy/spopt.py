@@ -63,11 +63,15 @@ def _device_A(A_src, dt):
         key = (digest, A_np.shape, str(dt))
         dev = _DEV_A_CACHE.pop(key, None)
         if dev is None:
-            # a new digest at an existing (shape, dtype) is almost always a
+            # A new digest at an existing (shape, dtype) is almost always a
             # mutated version of the same family (e.g. cross-scenario cut
-            # rounds writing into the shared A): drop the stale entries so
-            # dead versions don't sit in HBM until count-based eviction
-            for k in [k for k in _DEV_A_CACHE if k[1:] == key[1:]]:
+            # rounds writing into the shared A).  Keep the single newest
+            # prior version and drop older ones: cylinders update at
+            # different times (round k vs k-1 coexist and alternate), so
+            # evicting ALL same-shape entries would thrash — but unbounded
+            # retention strands dead ~800 MB copies in HBM.
+            same = [k for k in _DEV_A_CACHE if k[1:] == key[1:]]
+            for k in same[:-1]:
                 del _DEV_A_CACHE[k]
             dev = jnp.asarray(A_np, dt)
         _DEV_A_CACHE[key] = dev         # re-insert = LRU touch
