@@ -55,11 +55,14 @@ class ADMMSettings:
     polish: bool = True           # active-set KKT polish (OSQP-style)
     polish_passes: int = 4        # active-set correction passes
     polish_delta: float = 1e-8
-    # Opt-in fused Pallas sweep kernel (scenario-on-lanes layout).  Off by
-    # default: at the benchmark shapes XLA's batched MXU einsums beat the
-    # VPU multiply-accumulate kernel; flip on for bandwidth-bound regimes
-    # (very large S with small n) where VMEM residency wins.
-    use_pallas: bool = False
+    # Fused Pallas sweep kernel (scenario-on-lanes layout).  "auto"
+    # (default) enables it in its MEASURED win regime on TPU — dense
+    # batches whose block partition is fine-grained (n big enough that a
+    # block is <=512 scenarios: 2.0x at S=1000 n=44, 6.5x at S=10000
+    # n=44) or single-block (1.14x at S=1000 n=11) — and stays off where
+    # it measured slower (many coarse blocks: 0.68x at S=10000 n=11).
+    # True forces it wherever usable; False disables.
+    use_pallas: bool | str = "auto"
     # Per-ROW rho adaptation between restarts: rows (and variable boxes) with
     # persistent primal violation get their penalty boosted.  Cures ADMM
     # stalls on strongly-coupled LPs (UC's ramp/genlim rows) that global rho
@@ -222,10 +225,40 @@ def _explicit_inverse(K):
     n = K.shape[-1]
     leaf = _EXPLICIT_INV_LEAF_N
     if n <= 2 * leaf:
-        L = jnp.linalg.cholesky(K)
-        eye = jnp.broadcast_to(jnp.eye(n, dtype=K.dtype), K.shape)
-        t = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
-        return jax.scipy.linalg.solve_triangular(L, t, lower=True, trans=1)
+        # XLA:TPU's blocked TriangularSolve lowering has a broken window
+        # when the diagonal block IS the (sub-128) matrix: 64 < n < 128
+        # allocates a fixed 18.95 MB of scoped VMEM (> the 16 MB limit)
+        # in InvertDiagBlocksLowerTriangular regardless of batch size —
+        # observed at n=88 for batches 139/190/1000 alike, while n=44
+        # (unblocked path) and n>=128 (128-wide diag blocks) compile fine.
+        # Embed K into a 128x128 identity-extended SPD and slice back.
+        # TPU-only (trace-time check): other backends' lowerings are fine
+        # and would just pay ~3x the flops for the padding.
+        if 64 < n < 128 and jax.default_backend() == "tpu":
+            pad = 128 - n
+            eye_pad = jnp.eye(128, dtype=K.dtype)[n:, :]
+            Kp = jnp.concatenate([
+                jnp.concatenate(
+                    [K, jnp.zeros(K.shape[:-1] + (pad,), K.dtype)], axis=-1),
+                jnp.broadcast_to(eye_pad, K.shape[:-2] + (pad, 128)),
+            ], axis=-2)
+            return _explicit_inverse_oneshot(Kp)[..., :n, :n]
+        return _explicit_inverse_oneshot(K)
+    return _explicit_inverse_schur(K)
+
+
+def _explicit_inverse_oneshot(K):
+    """Cholesky + two triangular solves against I (small/medium n)."""
+    n = K.shape[-1]
+    L = jnp.linalg.cholesky(K)
+    eye = jnp.broadcast_to(jnp.eye(n, dtype=K.dtype), K.shape)
+    t = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+    return jax.scipy.linalg.solve_triangular(L, t, lower=True, trans=1)
+
+
+def _explicit_inverse_schur(K):
+    n = K.shape[-1]
+    leaf = _EXPLICIT_INV_LEAF_N
     h = ((n // 2 + leaf - 1) // leaf) * leaf
     A = K[..., :h, :h]
     B = K[..., :h, h:]
@@ -331,7 +364,14 @@ def _admm_core(q, q2, A, cl, cu, lb, ub, state, LK, rho_a, rho_x,
     from . import pallas_kernels
 
     S, m, n = A.shape
-    bs = (pallas_kernels.usable(S, m, n, P=P) if st.use_pallas else None)
+    if st.use_pallas == "auto":
+        bs = pallas_kernels.usable(S, m, n, P=P)
+        if bs is not None and bs < S and bs > 512:
+            bs = None          # measured-loss regime (many coarse blocks)
+    elif st.use_pallas:
+        bs = pallas_kernels.usable(S, m, n, P=P)
+    else:
+        bs = None
     if bs is not None:
         Kinv, K = LK
         tT = lambda a: jnp.transpose(a, (1, 2, 0))

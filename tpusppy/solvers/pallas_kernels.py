@@ -30,9 +30,13 @@ except Exception:  # pragma: no cover
     HAVE_PALLAS = False
 
 # VMEM budget for one scenario block's matrices (bytes).  v5e has ~16 MB of
-# scoped VMEM per core; Mosaic double-buffers in/out blocks for the grid
-# pipeline, so keep the single-block working set near a quarter of that.
-_VMEM_BUDGET = 4 * 1024 * 1024
+# scoped VMEM per core, and the measured end-to-end footprint is ~5x the
+# naive single-block byte count (Mosaic double-buffers inputs AND outputs
+# for the grid pipeline, plus scratch): a block sized to 4.15 MB of
+# operands compiled to a 20.7 MB scoped allocation (S=10000, n=11).  3 MB
+# keeps the real footprint ~14-15 MB worst case while preserving bs=128 at
+# the farmer bench shape (n=44), where the kernel measures 2.0x XLA.
+_VMEM_BUDGET = 3 * 1024 * 1024
 
 
 def sweep_block_size(S, m, n, itemsize=4) -> int:
