@@ -128,7 +128,10 @@ def main():
                  or os.environ.get("JAX_PLATFORMS") == "cpu")
     attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", "3"))
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
-    run_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", "3200"))
+    # headroom accounting: farmer ~250s + UC batch/iter0 ~210s + rate loop
+    # ~360s + MIP baseline ~100s + wheel watchdog 1500s + spoke teardown
+    # (lingering final passes) ~300s ≈ 2700s typical, plus compile variance
+    run_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", "4000"))
     cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", "2400"))
     backoff = float(os.environ.get("BENCH_BACKOFF", "30"))
 

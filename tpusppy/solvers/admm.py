@@ -364,6 +364,11 @@ def _admm_core(q, q2, A, cl, cu, lb, ub, state, LK, rho_a, rho_x,
     from . import pallas_kernels
 
     S, m, n = A.shape
+    if isinstance(st.use_pallas, str) and st.use_pallas != "auto":
+        raise ValueError(
+            f"use_pallas must be True, False, or 'auto'; got "
+            f"{st.use_pallas!r} (strings other than 'auto' would silently "
+            f"force the kernel on)")
     if st.use_pallas == "auto":
         bs = pallas_kernels.usable(S, m, n, P=P)
         if bs is not None and bs < S and bs > 512:
