@@ -275,6 +275,15 @@ def uc_metrics():
          "opt_kwargs": okw()},
         {"spoke_class": XhatRestrictedEF, "opt_class": Xhat_Eval,
          "opt_kwargs": okw()},
+        # donor-MILP shuffle: exact scenario-MIP first stages as candidates
+        # (the reference's donor semantics) — lands integer-feasible
+        # incumbents within the first hub iterations instead of waiting for
+        # consensus to crystallize for the restricted EF
+        {"spoke_class": XhatShuffleInnerBound, "opt_class": Xhat_Eval,
+         "opt_kwargs": okw() | {"options": dict(
+             okw()["options"],
+             xhat_looper_options={"scen_limit": 2, "donor_milp": True,
+                                  "donor_milp_time": 60.0})}},
     ]
     if degraded:
         # the small CPU family benefits from donor cycling + slam too
@@ -289,10 +298,20 @@ def uc_metrics():
     import threading
 
     # measured on chip: the real-data S=64 wheel certifies ~0.15% around
-    # 610 s (includes in-wheel compiles + the restricted-EF MILP cadence);
-    # 1500 s gives that trajectory headroom for compile/rescue variance
-    # while staying inside the parent's workload timeout
+    # 610-1370 s (in-wheel compiles + when the restricted-EF incumbent
+    # lands are both high-variance), so the watchdog stretches to whatever
+    # budget remains before the parent's deadline (minus teardown margin)
+    # rather than a fixed number.
+    explicit = "BENCH_UC_WHEEL_TIMEOUT" in os.environ
     budget = float(os.environ.get("BENCH_UC_WHEEL_TIMEOUT", "1500"))
+    deadline = float(os.environ.get("BENCH_CHILD_DEADLINE", "0") or 0)
+    if deadline:
+        # grow OR shrink to what actually remains (the parent SIGKILLs the
+        # child at its deadline, losing the whole JSON line); an explicit
+        # BENCH_UC_WHEEL_TIMEOUT is only ever shrunk, never overridden up
+        remaining = max(600.0, deadline - time.time() - 300.0)
+        budget = min(budget, remaining) if explicit else remaining
+        log(f"uc wheel watchdog: {budget:.0f}s (deadline-derived)")
     result = {}
 
     def _spin():
@@ -302,7 +321,12 @@ def uc_metrics():
         except Exception as e:       # error != timeout; surface which
             result["error"] = repr(e)
             return
-        result["wall"] = time.time() - t0
+        total = time.time() - t0
+        # wall to the hub's gap-based termination (construction + hub
+        # loop); the extra teardown minutes (final spoke passes) are
+        # reported separately as wall_s_total
+        result["wall"] = float(getattr(ws, "gap_wall_secs", total))
+        result["wall_total"] = total
         result["ib"] = ws.BestInnerBound
         result["ob"] = ws.BestOuterBound
 
@@ -328,6 +352,7 @@ def uc_metrics():
             out["wheel_timeout_s"] = budget
         return out
     wall, ib, ob = result["wall"], result["ib"], result["ob"]
+    wall_total = result.get("wall_total", wall)
     gap = (ib - ob) / max(abs(ib), 1e-9) if np.isfinite(ib) else float("inf")
     log(f"uc wheel: {wall:.1f}s inner={ib:.2f} outer={ob:.2f} "
         f"gap={gap*100:.2f}%")
@@ -340,6 +365,7 @@ def uc_metrics():
         "vs_baseline_32rank": round(iters_per_sec / base32, 2),
         "S": S, "degraded_cpu_run": degraded,
         "wall_s_to_gap": round(wall, 1),
+        "wall_s_total": round(wall_total, 1),
         "gap_pct": round(gap * 100, 3),
         "gap_target_pct": gap_target * 100,
         "certified": bool(np.isfinite(ib) and np.isfinite(ob)

@@ -244,3 +244,41 @@ def test_integer_sizes_wheel_certified_gap():
     # reference golden: integer optimum ~224k-226k; LP bound ~220k+
     assert 218000.0 <= ws.BestOuterBound <= 230000.0
     assert 220000.0 <= ws.BestInnerBound <= 240000.0
+
+
+def test_donor_milp_shuffle_candidates():
+    """Donor-MILP mode: the shuffle spoke's candidates come from exact host
+    scenario MILPs (the reference's donor semantics — solved MIP instances)
+    instead of LP-relaxation rows, so they are integer-feasible by
+    construction and evaluate to finite incumbents on integer UC."""
+    from tpusppy.cylinders.xhatshufflelooper_bounder import (
+        XhatShuffleInnerBound)
+    from tpusppy.models import uc_lite
+
+    n = 6
+    kw = uc_lite.kw_creator(num_scens=n)
+    names = uc_lite.scenario_names_creator(n)
+    ev = Xhat_Eval(
+        {"xhat_looper_options": {"donor_milp": True, "scen_limit": 3}},
+        names, uc_lite.scenario_creator, scenario_creator_kwargs=kw)
+    spoke = XhatShuffleInnerBound.__new__(XhatShuffleInnerBound)
+    spoke.opt = ev
+    spoke.xhatbase_prep()
+    assert spoke.donor_milp
+    seen = []
+    for donor in range(3):
+        cand = spoke._donor_milp_candidate(donor)
+        assert cand is not None
+        ints = ev.batch.is_int[ev.tree.nonant_indices]
+        assert np.abs(cand[ints] - np.round(cand[ints])).max() < 1e-6
+        obj = ev.evaluate(cand)
+        seen.append(obj)
+    assert np.isfinite(seen).any()
+    # cache: second ask returns the same array without re-solving
+    again = spoke._donor_milp_candidate(0)
+    assert again is spoke._milp_donor_cache[0]
+
+    batch = ScenarioBatch.from_problems(
+        [uc_lite.scenario_creator(nm, **kw) for nm in names])
+    ef_obj, _ = solve_ef(batch, solver="highs")
+    assert min(seen) >= ef_obj - 1e-6      # valid upper bounds

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import csv
 import threading
+import time
 
 import numpy as np
 
@@ -52,6 +53,7 @@ class WheelSpinner:
         return opt_kwargs
 
     def run(self):
+        t_build0 = time.monotonic()
         fabric = WindowFabric()
 
         # Hub opt + communicator (spin_the_wheel.py:92-116)
@@ -99,6 +101,11 @@ class WheelSpinner:
             hub_comm.main()
         finally:
             hub_comm.send_terminate()
+            # construction + hub loop: gap-based termination happened HERE;
+            # the spoke teardown below (final bound-tightening passes,
+            # lingering MILPs) can add minutes that are bookkeeping, not
+            # time-to-certified-gap — benchmarks report this figure
+            self.gap_wall_secs = time.monotonic() - t_build0
         for t in threads:
             t.join(timeout=300)
         hung = [t.name for t in threads if t.is_alive()]
