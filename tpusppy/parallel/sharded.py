@@ -333,6 +333,9 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
             return lambda sol: False
         return lambda sol: int(np.asarray(sol.iters).max()) < seg_f
 
+    # plateau stop is data-dependent => multi-process meshes must not use it
+    plateau = None if multiproc else settings.segment_plateau_rtol
+
     def refresh_step(state: PHState, arr: PHArrays, prox_on):
         seg_r, seg_f = _segments_for(arr)
         if seg_r >= settings.max_iter and seg_f >= settings.max_iter:
@@ -344,7 +347,7 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
         sol = segmented_solvers.continue_frozen(
             lambda w: fsolve(q, q2, arr, w, factors), sol, seg_f,
             segmented_solvers.refresh_budget(settings, seg_r),
-            all_done=_all_done_fn(seg_f))
+            all_done=_all_done_fn(seg_f), plateau_rtol=plateau)
         if arr.A.ndim == 3 and settings.polish and settings.polish_passes:
             sol = psolve(q, q2, arr, sol.raw, factors)
         new_state, out = _finish_jit(state, arr, sol, W, rho)
@@ -362,7 +365,8 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
         if not all_done(sol):
             sol = segmented_solvers.continue_frozen(
                 lambda w: fsolve(q, q2, arr, w, factors), sol, seg_f,
-                settings.max_iter - seg_f, all_done=all_done)
+                settings.max_iter - seg_f, all_done=all_done,
+                plateau_rtol=plateau)
         new_state, out = _finish_jit(state, arr, sol, W, rho)
         return new_state, out
 

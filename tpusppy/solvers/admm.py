@@ -78,6 +78,12 @@ class ADMMSettings:
     # wheel path defaults this off via SPBase since several cylinders'
     # factors coexist on one chip).
     factors_keep_K: bool = True
+    # Segmented continuations stop when one whole extra segment improves
+    # the worst scaled residual by less than this fraction (plateau):
+    # first-order batches on hard LP families park at a residual floor
+    # regardless of budget, and further dispatches are pure waste.  0
+    # disables (always run the full sweep budget).
+    segment_plateau_rtol: float = 0.05
     # Matmul precision for the solve programs.  "highest" = full f32
     # (bf16x6 passes on TPU MXU — ~6x the flops of plain bf16); "high" =
     # bf16x3; "default" = bf16.  Lower precisions trade residual floor for

@@ -79,27 +79,53 @@ def refresh_budget(settings, seg_r):
     return rst * settings.max_iter - rst * seg_r
 
 
-def continue_frozen(run_segment, sol, seg_f, budget, all_done=None):
+def continue_frozen(run_segment, sol, seg_f, budget, all_done=None,
+                    plateau_rtol=None):
     """Generic frozen-continuation loop shared by the host solve path and
     the jitted sharded PH step: re-dispatch ``run_segment(warm)`` until
-    converged or the sweep budget is spent.
+    converged, plateaued, or the sweep budget is spent.
 
     ``all_done(sol)`` decides early exit; the default reads the iteration
     counter (the while_loop exits before its cap iff every scenario met
     eps).  Multi-controller callers MUST pass a deterministic ``all_done``
-    (e.g. ``lambda sol: False``): the default fetches a scenario-sharded
-    array, which is impossible for non-addressable shards — and even a
-    local-shard check would let processes disagree on the loop count and
-    deadlock the collective dispatches.
+    (e.g. ``lambda sol: False``) and ``plateau_rtol=None``: both defaults
+    fetch scenario-sharded data, which is impossible for non-addressable
+    shards — and even a local-shard check would let processes disagree on
+    the loop count and deadlock the collective dispatches.
+
+    ``plateau_rtol``: stop when a whole extra segment improved the worst
+    scaled residual by less than this fraction — further sweeps are futile
+    (first-order UC batches park around 5e-2 at ANY budget; the host
+    path's rescue-tolerance ladder already embraces exactly this).
     """
     if all_done is None:
         def all_done(s):
             return int(np.asarray(s.iters).max()) < seg_f
+
+    def _worst(s):
+        return max(float(np.asarray(s.pri_res).max()),
+                   float(np.asarray(s.dua_res).max()))
+
+    # seeded from the INCOMING iterate so an already-parked batch exits
+    # quickly; two consecutive non-improving segments are required so a
+    # transient residual uptick (ADMM is not monotone segment-to-segment)
+    # cannot abort a budget that was still making progress
+    best = _worst(sol) if plateau_rtol else None
+    stall = 0
     while budget > 0:
         sol = run_segment(sol.raw)
         budget -= seg_f
         if all_done(sol):
             break
+        if plateau_rtol:
+            worst = _worst(sol)
+            if worst > (1.0 - plateau_rtol) * best:
+                stall += 1
+                if stall >= 2:
+                    break
+            else:
+                stall = 0
+            best = min(best, worst)
     return sol
 
 
@@ -109,7 +135,8 @@ def _continue_frozen(frozen_fn, args, factors, sol, st_f, seg_f, budget,
     return continue_frozen(
         lambda warm: frozen_fn(*args, factors, settings=st_f, warm=warm,
                                **kw),
-        sol, seg_f, budget)
+        sol, seg_f, budget,
+        plateau_rtol=st_f.segment_plateau_rtol)
 
 
 def solve_factored_segmented(frozen_fn, factored_fn, args, settings,
