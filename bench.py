@@ -30,8 +30,10 @@ wall-clock to gap on scenario ladders up to 1000 scenarios).  Baselines:
 
 PH iterations run on the factorization-amortized path (periodic adaptive
 refresh + sweep-only frozen steps, `sharded.make_ph_step_pair`); subproblems
-are solved to 1e-5 scaled residuals each iteration — comparable to external
-solver default feasibility/optimality tolerances.
+are swept to 1e-5 scaled residuals or to their residual plateau (hard LP
+families park around 5e-2 at ANY budget; the certified bounds never depend
+on prox exactness, and the host tolerance ladder + rescue covers the tail
+— see ADMMSettings.segment_plateau_rtol).
 
 Timing note: on the axon TPU plugin ``jax.block_until_ready`` returns before
 execution completes, so all timing fences are host fetches (``np.asarray``).
