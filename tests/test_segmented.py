@@ -1,0 +1,81 @@
+"""Unit tests for the watchdog-safe segmented continuation loop
+(tpusppy/solvers/segmented.py) with scripted fake segments — the on-chip
+behavior (budget, early exit, plateau grace) without device dependence."""
+
+import numpy as np
+
+from tpusppy.solvers import segmented
+
+
+class FakeSol:
+    def __init__(self, pri, dua=0.0, iters=52, raw=None):
+        self.pri_res = np.asarray([pri])
+        self.dua_res = np.asarray([dua])
+        self.iters = np.asarray([iters])
+        self.raw = raw or ("x",)
+
+
+def run_with(script, seg_f=52, budget=520, plateau=0.05, sol0=None):
+    """script: list of FakeSol returned by successive segments."""
+    calls = []
+
+    def run_segment(warm):
+        calls.append(warm)
+        return script[min(len(calls) - 1, len(script) - 1)]
+
+    sol = segmented.continue_frozen(
+        run_segment, sol0 or FakeSol(1.0), seg_f, budget,
+        plateau_rtol=plateau)
+    return sol, len(calls)
+
+
+def test_budget_exhaustion():
+    sols = [FakeSol(1.0 / (k + 2)) for k in range(20)]  # keeps improving
+    _, n = run_with(sols, seg_f=52, budget=520, plateau=0.05)
+    assert n == 10          # 520 / 52 — no early exit while improving >=5%
+
+
+def test_converged_early_exit():
+    # second segment's while_loop exits before its cap => all done
+    sols = [FakeSol(0.5), FakeSol(1e-9, iters=4)]
+    _, n = run_with(sols)
+    assert n == 2
+
+
+def test_plateau_two_strike_grace():
+    # parked at the floor from the start: seeded best + two non-improving
+    # segments => exactly two dispatches
+    sols = [FakeSol(0.05)] * 20
+    _, n = run_with(sols, sol0=FakeSol(0.05))
+    assert n == 2
+
+
+def test_transient_uptick_does_not_abort():
+    # improving trend with one wobble: the single strike is forgiven
+    sols = [FakeSol(0.5), FakeSol(0.51), FakeSol(0.3), FakeSol(0.1),
+            FakeSol(0.1), FakeSol(0.1)]
+    # budget for 10 segments so n == 6 can only come from the plateau
+    # break, not budget exhaustion: wobble at segment 2 (strike 1),
+    # improvement resets the strikes, two consecutive non-improving
+    # segments at the end fire the break
+    _, n = run_with(sols, budget=52 * 10)
+    assert n == 6
+
+
+def test_plateau_disabled_runs_full_budget():
+    sols = [FakeSol(0.05)] * 10
+    _, n = run_with(sols, plateau=None, budget=52 * 7)
+    assert n == 7
+
+
+def test_dispatch_segments_no_segmentation_for_small():
+    from tpusppy.solvers.admm import ADMMSettings
+
+    st = ADMMSettings(max_iter=300, restarts=3)
+    seg_r, seg_f = segmented.dispatch_segments(1000, 44, 28, st)
+    assert (seg_r, seg_f) == (300, 300)      # farmer: single dispatch
+    seg_r, seg_f = segmented.dispatch_segments(
+        1000, 16008, 12408, ADMMSettings(max_iter=200, restarts=2,
+                                         check_every=4))
+    assert seg_f < 200 and seg_r < 200       # reference UC: segmented
+    assert seg_r >= 32 and seg_f >= 8        # floors
