@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import enum
 import math
+import threading
 import os
 import time
 
@@ -197,6 +198,9 @@ class InnerBoundNonantSpoke(_BoundNonantSpoke):
         self.is_minimizing = self.opt.is_minimizing
         self.best_inner_bound = math.inf if self.is_minimizing else -math.inf
         self.best_solution_cache = None   # (S, n) full solutions
+        # (bound, cache) are written as a pair; teardown may read them from
+        # another thread while a hung spoke is still mid-update
+        self._best_lock = threading.Lock()
 
     def update_if_improving(self, candidate_inner_bound) -> bool:
         if candidate_inner_bound is None or not np.isfinite(
@@ -207,10 +211,17 @@ class InnerBoundNonantSpoke(_BoundNonantSpoke):
                   else candidate_inner_bound > self.best_inner_bound)
         if not better:
             return False
-        self.best_inner_bound = float(candidate_inner_bound)
-        self.bound = self.best_inner_bound
-        self._cache_best_solution()
+        with self._best_lock:
+            self.best_inner_bound = float(candidate_inner_bound)
+            self.bound = self.best_inner_bound
+            self._cache_best_solution()
         return True
+
+    def best_snapshot(self):
+        """(bound, cache) read atomically w.r.t. update_if_improving —
+        safe even while the spoke's main loop is still running."""
+        with self._best_lock:
+            return self.best_inner_bound, self.best_solution_cache
 
     def _cache_best_solution(self):
         if self.opt.local_x is not None:
