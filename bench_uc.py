@@ -107,9 +107,16 @@ def uc_metrics():
     if dtype == "float64":
         jax.config.update("jax_enable_x64", True)
     eps = 1e-5 if dtype == "float32" else 1e-8
+    # sweep_plateau: reference-scale UC batches park at a ~1e-1 worst /
+    # 1e-2 median scaled residual regardless of budget (measured at S=256,
+    # n=16008: the frozen 200-sweep loop never reaches eps and every sweep
+    # past ~100 is waste); the in-loop plateau exit stops the while_loop as
+    # soon as 2 consecutive 32-sweep windows improve the batch-worst
+    # residual <5% — same accuracy, ~2x the PH iteration rate
     settings = ADMMSettings(
         dtype=dtype, eps_abs=eps, eps_rel=eps, max_iter=200, restarts=2,
         scaling_iters=6, polish_passes=1,
+        sweep_plateau_rtol=0.05, sweep_plateau_window=32,
     )
 
     if model_name == "data":
@@ -228,7 +235,8 @@ def uc_metrics():
     else:
         so = {"dtype": dtype, "eps_abs": eps, "eps_rel": eps,
               "max_iter": 100, "restarts": 2, "scaling_iters": 6,
-              "polish_passes": 1}
+              "polish_passes": 1,
+              "sweep_plateau_rtol": 0.05, "sweep_plateau_window": 32}
 
     # host-MILP budgets scale with problem size: the degraded CPU shape
     # solves scenario MIPs in ~0.5-2 s (full lifts + dual ascent are

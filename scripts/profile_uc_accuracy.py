@@ -1,0 +1,120 @@
+"""Accuracy A/B for candidate UC sweep-cost reductions.
+
+For each settings variant, run the SAME frozen PH prox solve (identical q,
+warm start, factors refreshed under that variant's precision) and report:
+
+- worst / median scaled residuals (the floor)
+- prob-weighted expected objective (PH trajectory quality proxy)
+- NaN presence (the bf16x3 divergence mode from the session-2 A/B)
+
+Usage:  python scripts/profile_uc_accuracy.py [S] [horizon]
+"""
+
+import dataclasses
+import sys
+import time
+
+import numpy as np
+
+S = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+horizon = int(sys.argv[2]) if len(sys.argv) > 2 else 24
+
+import jax
+import jax.numpy as jnp
+
+import tpusppy
+tpusppy.disable_tictoc_output()
+from tpusppy.ir import ScenarioBatch
+from tpusppy.models import uc_data
+from tpusppy.parallel import sharded
+from tpusppy.solvers import shared_admm
+from tpusppy.solvers.admm import ADMMSettings
+
+DATA = "/root/reference/paperruns/larger_uc/1000scenarios_wind"
+
+names = uc_data.scenario_names_creator(data_dir=DATA)[:S]
+kw = {"data_dir": DATA, "horizon": horizon, "relax_integers": False,
+      "num_scens": S}
+batch = ScenarioBatch.from_problems(
+    [uc_data.scenario_creator(nm, **kw) for nm in names])
+print(f"batch: {batch.num_scenarios} x ({batch.num_rows} rows, "
+      f"{batch.num_vars} vars) platform={jax.devices()[0].platform}",
+      flush=True)
+
+base = ADMMSettings(dtype="float32", eps_abs=1e-5, eps_rel=1e-5,
+                    max_iter=200, restarts=2, scaling_iters=6,
+                    polish_passes=1)
+
+mesh = sharded.make_mesh()
+arr = sharded.shard_batch(batch, mesh)
+
+# advance a couple of PH iterations at baseline settings to get a
+# REPRESENTATIVE prox state (W, xbars, warm start) — then all variants
+# solve that same subproblem
+refresh, frozen = sharded.make_ph_step_pair(
+    batch.tree.nonant_indices, base, mesh)
+state = sharded.init_state(arr, 1.0, base)
+state, out, _ = refresh(state, arr, 0.0)
+state, out, factors0 = refresh(state, arr, 1.0)
+state, out = frozen(state, arr, 1.0, factors0)
+np.asarray(out.conv)
+print("warmup done", flush=True)
+
+idx = jnp.asarray(batch.tree.nonant_indices)
+dt = base.jdtype()
+q = arr.c.astype(dt).at[:, idx].add(
+    jnp.asarray(np.asarray(state.W), dt)
+    - jnp.asarray(np.asarray(state.rho), dt)
+    * jnp.asarray(np.asarray(state.xbars), dt))
+q2 = arr.q2.astype(dt).at[:, idx].add(jnp.asarray(np.asarray(state.rho), dt))
+warm = (state.x, state.z, state.y, state.yx)
+probs = np.asarray(arr.probs)
+
+
+def report(tag, st, reuse_factors=None):
+    t0 = time.time()
+    if reuse_factors is None:
+        sol, fac = shared_admm.solve_shared_factored(
+            q, q2, arr.A, arr.cl, arr.cu, arr.lb, arr.ub,
+            settings=st, warm=warm)
+    else:
+        fac = reuse_factors
+        sol = shared_admm.solve_shared_frozen(
+            q, q2, arr.A, arr.cl, arr.cu, arr.lb, arr.ub, fac,
+            settings=st, warm=warm)
+    jax.block_until_ready(sol.x)
+    wall = time.time() - t0
+    x = np.asarray(sol.x)
+    pri = np.asarray(sol.pri_res)
+    dua = np.asarray(sol.dua_res)
+    lin = np.einsum("sn,sn->s", np.asarray(q), x)
+    quad = 0.5 * np.einsum("sn,sn->s", np.asarray(q2), x * x)
+    eobj = float(probs @ (lin + quad))
+    # true constraint violation in UNSCALED space
+    A = np.asarray(arr.A)
+    Ax = x @ A.T
+    viol = np.maximum(np.asarray(arr.cl) - Ax, Ax - np.asarray(arr.cu))
+    viol = np.maximum(viol, 0).max()
+    print(f"  {tag:34s} wall={wall:6.1f}s floor: worst={max(pri.max(), dua.max()):.2e} "
+          f"med={np.median(np.maximum(pri, dua)):.2e} "
+          f"true_viol={viol:.2e} eobj={eobj:.6e} "
+          f"nan={int(np.isnan(x).any())}", flush=True)
+    return fac
+
+
+print("\nvariants (fresh adaptive factors each):", flush=True)
+report("baseline (highest, refine=2)", base)
+report("refine=1", dataclasses.replace(base, solve_refine=1))
+report("high (bf16x3)", dataclasses.replace(base, matmul_precision="high"))
+report("high + refine=1",
+       dataclasses.replace(base, matmul_precision="high", solve_refine=1))
+report("high + refine=3",
+       dataclasses.replace(base, matmul_precision="high", solve_refine=3))
+print("\nfrozen-only on baseline factors:", flush=True)
+report("frozen high + refine=2",
+       dataclasses.replace(base, matmul_precision="high"),
+       reuse_factors=factors0)
+report("frozen high + refine=1",
+       dataclasses.replace(base, matmul_precision="high", solve_refine=1),
+       reuse_factors=factors0)
+report("frozen baseline", base, reuse_factors=factors0)

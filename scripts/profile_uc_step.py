@@ -1,0 +1,164 @@
+"""Profile the UC frozen PH step on TPU: segment counts, sweep time, knobs.
+
+Quantifies where the ~40 s/PH-iteration goes at reference shape
+(WECC-240 horizon 24, shared-A, n=16008 m=12408):
+
+- how many segment dispatches `continue_frozen` issues per frozen step and
+  what each costs (is the plateau detector's 2-stall rule the bottleneck?)
+- per-sweep device time at the current settings vs candidate knobs
+  (solve_refine, extra dq2 passes, check_every)
+
+Usage:  python scripts/profile_uc_step.py [S] [horizon] [iters]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+S = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+horizon = int(sys.argv[2]) if len(sys.argv) > 2 else 24
+iters = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+
+import jax
+
+import tpusppy
+tpusppy.disable_tictoc_output()
+from tpusppy.ir import ScenarioBatch
+from tpusppy.models import uc_data
+from tpusppy.parallel import sharded
+from tpusppy.solvers import segmented
+from tpusppy.solvers.admm import ADMMSettings
+
+DATA = "/root/reference/paperruns/larger_uc/1000scenarios_wind"
+
+names = uc_data.scenario_names_creator(data_dir=DATA)[:S]
+kw = {"data_dir": DATA, "horizon": horizon, "relax_integers": False,
+      "num_scens": S}
+batch = ScenarioBatch.from_problems(
+    [uc_data.scenario_creator(nm, **kw) for nm in names])
+print(f"batch: {batch.num_scenarios} x ({batch.num_rows} rows, "
+      f"{batch.num_vars} vars) platform={jax.devices()[0].platform}",
+      flush=True)
+
+import os
+plateau = float(os.environ.get("PROFILE_PLATEAU", "0"))
+settings = ADMMSettings(dtype="float32", eps_abs=1e-5, eps_rel=1e-5,
+                        max_iter=200, restarts=2, scaling_iters=6,
+                        polish_passes=1, sweep_plateau_rtol=plateau,
+                        sweep_plateau_window=32)
+
+# --- instrument segment dispatches --------------------------------------
+orig_continue = segmented.continue_frozen
+seg_log = []
+
+
+def logged_continue(run_segment, sol, seg_f, budget, all_done=None,
+                    plateau_rtol=None):
+    def timed_segment(warm):
+        t0 = time.time()
+        out = run_segment(warm)
+        jax.block_until_ready(out.x)
+        seg_log.append(time.time() - t0)
+        return out
+
+    return orig_continue(timed_segment, sol, seg_f, budget,
+                         all_done=all_done, plateau_rtol=plateau_rtol)
+
+
+segmented.continue_frozen = logged_continue
+sharded.segmented_solvers = segmented  # already same module; belt+braces
+
+mesh = sharded.make_mesh()
+arr = sharded.shard_batch(batch, mesh)
+S_dev = arr.c.shape[0]
+n = arr.c.shape[1]
+m = arr.cl.shape[1]
+seg_r, seg_f = sharded._dispatch_segments(S_dev, n, m, settings,
+                                          factor_batch=1)
+print(f"dispatch segments: refresh={seg_r} frozen={seg_f} sweeps "
+      f"(max_iter={settings.max_iter} restarts={settings.restarts})",
+      flush=True)
+
+refresh, frozen = sharded.make_ph_step_pair(
+    batch.tree.nonant_indices, settings, mesh)
+state = sharded.init_state(arr, 1.0, settings)
+
+t0 = time.time()
+state, out, _ = refresh(state, arr, 0.0)
+np.asarray(out.conv)
+print(f"compile+iter0: {time.time() - t0:.1f}s "
+      f"(segments: {[f'{t:.1f}' for t in seg_log]})", flush=True)
+seg_log.clear()
+
+t0 = time.time()
+state, out, factors = refresh(state, arr, 1.0)
+np.asarray(out.conv)
+print(f"refresh iter: {time.time() - t0:.1f}s "
+      f"segments={len(seg_log)} {[f'{t:.1f}' for t in seg_log]}",
+      flush=True)
+
+for i in range(iters):
+    seg_log.clear()
+    t0 = time.time()
+    state, out = frozen(state, arr, 1.0, factors)
+    np.asarray(out.conv)
+    worst = max(float(np.asarray(out.pri_res).max()),
+                float(np.asarray(out.dua_res).max()))
+    print(f"frozen iter {i}: {time.time() - t0:.1f}s "
+          f"segments={len(seg_log)} {[f'{t:.1f}' for t in seg_log]} "
+          f"last_iters={int(np.asarray(out.pri_res).shape[0])}S "
+          f"worst_res={worst:.2e}", flush=True)
+
+# --- raw sweep throughput: time the frozen solver at fixed sweep counts --
+print("\nsweep-cost A/B (frozen solver, one dispatch, no continuation):",
+      flush=True)
+import dataclasses
+
+from tpusppy.solvers import shared_admm
+
+
+def time_sweeps(tag, st, k_sweeps, **kw_solver):
+    st1 = dataclasses.replace(st, max_iter=k_sweeps)
+    q, q2, W, rho = None, None, None, None
+
+    import jax.numpy as jnp
+    dt = st1.jdtype()
+    idx = jnp.asarray(batch.tree.nonant_indices)
+    q = arr.c.astype(dt).at[:, idx].add(
+        jnp.asarray(np.asarray(state.W), dt)
+        - jnp.asarray(np.asarray(state.rho), dt)
+        * jnp.asarray(np.asarray(state.xbars), dt))
+    q2 = arr.q2.astype(dt).at[:, idx].add(
+        jnp.asarray(np.asarray(state.rho), dt))
+
+    def run():
+        return shared_admm.solve_shared_frozen(
+            q, q2, arr.A, arr.cl, arr.cu, arr.lb, arr.ub, factors,
+            settings=st1, warm=(state.x, state.z, state.y, state.yx))
+
+    sol = run()
+    jax.block_until_ready(sol.x)   # compile
+    t0 = time.time()
+    sol = run()
+    jax.block_until_ready(sol.x)
+    dt_s = time.time() - t0
+    it = int(np.asarray(sol.iters).max())
+    print(f"  {tag:42s} {dt_s:6.2f}s for {it} sweeps "
+          f"=> {dt_s / max(it, 1) * 1e3:7.1f} ms/sweep", flush=True)
+    return dt_s / max(it, 1)
+
+
+base = time_sweeps("baseline (refine=2, ce=4)", settings, seg_f)
+t_r1 = time_sweeps("solve_refine=1",
+                   dataclasses.replace(settings, solve_refine=1), seg_f)
+t_r0 = time_sweeps("solve_refine=0",
+                   dataclasses.replace(settings, solve_refine=0), seg_f)
+t_ce8 = time_sweeps("check_every=8",
+                    dataclasses.replace(settings, check_every=8), seg_f)
+t_hi = time_sweeps("matmul high (bf16x3)",
+                   dataclasses.replace(settings, matmul_precision="high"),
+                   seg_f)
+print(f"\nspeedups vs baseline: refine1={base/t_r1:.2f}x "
+      f"refine0={base/t_r0:.2f}x ce8={base/t_ce8:.2f}x "
+      f"high={base/t_hi:.2f}x", flush=True)

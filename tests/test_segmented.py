@@ -79,3 +79,77 @@ def test_dispatch_segments_no_segmentation_for_small():
                                          check_every=4))
     assert seg_f < 200 and seg_r < 200       # reference UC: segmented
     assert seg_r >= 32 and seg_f >= 8        # floors
+
+
+# ---- in-loop plateau exit (ADMMSettings.sweep_plateau_rtol) -------------
+
+def _toy_lp(S=3, n=6, m=4, seed=0):
+    rng = np.random.default_rng(seed)
+    A = rng.normal(size=(S, m, n))
+    x0 = rng.normal(size=(S, n))
+    b = np.einsum("smn,sn->sm", A, x0)
+    c = rng.normal(size=(S, n))
+    q2 = np.zeros((S, n))
+    cl, cu = b - 1.0, b + 1.0
+    lb, ub = np.full((S, n), -10.0), np.full((S, n), 10.0)
+    return c, q2, A, cl, cu, lb, ub
+
+
+def test_inloop_plateau_well_conditioned_still_converges():
+    from tpusppy.solvers import admm
+
+    args = _toy_lp()
+    st = admm.ADMMSettings(max_iter=2000, restarts=3,
+                           sweep_plateau_rtol=0.05,
+                           sweep_plateau_window=32, polish=False)
+    sol = admm.solve_batch(*args, settings=st)
+    assert bool(np.asarray(sol.done).all())
+    assert float(np.asarray(sol.pri_res).max()) < 1e-6
+
+
+def test_inloop_plateau_exits_early_on_parked_batch():
+    """A near-contradictory LP parks far above eps: with the plateau exit
+    the sweep loop must stop long before max_iter, and ``done`` must stay
+    False (a plateau exit is not convergence)."""
+    from tpusppy.solvers import admm
+
+    S, n = 2, 4
+    # x >= 1 (row) fighting x <= -1 (bounds) => infeasible, residual parks
+    A = np.tile(np.eye(n)[None], (S, 1, 1))
+    c = np.ones((S, n))
+    q2 = np.zeros((S, n))
+    cl = np.full((S, n), 1.0)
+    cu = np.full((S, n), np.inf)
+    lb = np.full((S, n), -2.0)
+    ub = np.full((S, n), -1.0)
+    st = admm.ADMMSettings(max_iter=100000, restarts=1, polish=False,
+                           rho_row_adapt=False,
+                           sweep_plateau_rtol=0.05,
+                           sweep_plateau_window=32)
+    sol = admm.solve_batch(c, q2, A, cl, cu, lb, ub, settings=st)
+    assert not bool(np.asarray(sol.done).any())
+    assert int(np.asarray(sol.iters).max()) < 100000
+
+
+def test_inloop_plateau_shared_engine():
+    """Strongly convex shared-A QP (guaranteed linear ADMM convergence):
+    the plateau exit must not fire before eps, and done must be all-True.
+    (A pure random LP is a bad subject here — degenerate instances park
+    above eps even with the full budget and no plateau exit at all.)"""
+    from tpusppy.solvers import admm, shared_admm
+
+    rng = np.random.default_rng(1)
+    S, m, n = 4, 5, 7
+    A = rng.normal(size=(m, n))
+    x0 = rng.normal(size=(S, n))
+    b = x0 @ A.T
+    c = rng.normal(size=(S, n))
+    q2 = np.ones((S, n))
+    cl, cu = b - 1.0, b + 1.0
+    lb, ub = np.full((S, n), -10.0), np.full((S, n), 10.0)
+    st = admm.ADMMSettings(max_iter=4000, restarts=3,
+                           sweep_plateau_rtol=0.05,
+                           sweep_plateau_window=32, polish=False)
+    sol = shared_admm.solve_shared(c, q2, A, cl, cu, lb, ub, settings=st)
+    assert bool(np.asarray(sol.done).all())
+    assert float(np.asarray(sol.pri_res).max()) < 1e-6

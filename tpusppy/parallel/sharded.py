@@ -178,7 +178,7 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
 
         if mesh is not None:
             sp = jax.sharding.PartitionSpec(axis)
-            sol_spec = admm.BatchSolution(*([sp] * 7), raw=(sp, sp, sp, sp))
+            sol_spec = admm.BatchSolution(*([sp] * 8), raw=(sp, sp, sp, sp))
             fac_spec = admm.Factors(*([sp] * 7))
             refresh_solve = jax.shard_map(
                 local_refresh, mesh=mesh, in_specs=(sp,) * 11,
@@ -293,7 +293,7 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
             if mesh is not None:
                 sp = jax.sharding.PartitionSpec(axis)
                 sol_spec = admm.BatchSolution(
-                    *([sp] * 7), raw=(sp, sp, sp, sp))
+                    *([sp] * 8), raw=(sp, sp, sp, sp))
                 fac_spec = admm.Factors(*([sp] * 7))
                 local_polish = jax.shard_map(
                     local_polish, mesh=mesh,
@@ -329,6 +329,9 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
         {d.process_index for d in mesh.devices.flat}) > 1
 
     def _all_done_fn(seg_f):
+        # stop-dispatching signal (NOT convergence — see BatchSolution.done):
+        # an early while_loop exit means eps met or plateau-exited; both end
+        # the continuation
         if multiproc:
             return lambda sol: False
         return lambda sol: int(np.asarray(sol.iters).max()) < seg_f

@@ -90,6 +90,17 @@ class ADMMSettings:
     # sweep throughput; certified-bound programs (dual_objective/dual_cut)
     # always run "highest" regardless.
     matmul_precision: str = "highest"
+    # In-loop plateau exit: leave the sweep while_loop when the batch-worst
+    # eps-normalized residual improved by less than this fraction over each
+    # of 2 consecutive windows of ``sweep_plateau_window`` sweeps.  Hard LP
+    # families (reference-scale UC) park at a residual floor far above eps,
+    # and every further sweep is waste — the segment-level host detector
+    # (``segmented.continue_frozen``) catches the same condition only at
+    # whole-dispatch granularity and burns 2 extra dispatches proving it.
+    # 0 disables.  ``BatchSolution.done`` reports true eps-convergence, so
+    # a plateau exit is never mistaken for convergence by callers.
+    sweep_plateau_rtol: float = 0.0
+    sweep_plateau_window: int = 32
 
     def jdtype(self):
         return jnp.dtype(self.dtype)
@@ -103,6 +114,9 @@ class BatchSolution(NamedTuple):
     pri_res: jax.Array  # (S,)
     dua_res: jax.Array  # (S,)
     iters: jax.Array   # (S,) total inner iterations used (same for all)
+    done: jax.Array    # (S,) met the eps tolerances (False = budget spent or
+    # plateau exit) — callers must use this, never an iters-vs-cap compare,
+    # to decide convergence (the plateau exit leaves the loop early)
     raw: tuple         # pre-polish (x, z, y, yx) — the ONLY valid warm start
     # (polished states are exact-KKT candidates, not consistent ADMM
     # iterates; feeding them back as warm starts destabilizes later solves)
@@ -299,6 +313,56 @@ class _IterState(NamedTuple):
     prinorm: jax.Array
     duanorm: jax.Array
     k: jax.Array
+    best: jax.Array   # scalar: best batch-worst eps-normalized residual
+    stall: jax.Array  # scalar int32: consecutive non-improving windows
+
+
+def _done_mask(pri, dua, prinorm, duanorm, st: ADMMSettings):
+    """Per-scenario eps-convergence (the while_loop's own OSQP test)."""
+    eps_pri = st.eps_abs + st.eps_rel * jnp.maximum(prinorm, 1.0)
+    eps_dua = st.eps_abs + st.eps_rel * jnp.maximum(duanorm, 1.0)
+    return (pri < eps_pri) & (dua < eps_dua)
+
+
+def _plateau_update(s, pri, dua, prinorm, duanorm, st: ADMMSettings,
+                    min_k=0):
+    """(best, stall) update at a residual checkpoint; evaluated every
+    ``sweep_plateau_window`` sweeps.
+
+    The progress metric is the GEOMETRIC MEAN of per-scenario
+    eps-normalized residual excesses (clipped to [1, 1e6]): converged
+    scenarios contribute a neutral 1 (so scenarios crossing eps register
+    as progress), a NaN/diverged scenario contributes the constant cap
+    instead of poisoning the whole batch, and — unlike a batch-max — one
+    parked scenario cannot stall the detector while the rest are still
+    descending (stopping is all-or-nothing for the batched loop, so the
+    exit must wait for COLLECTIVE stagnation; the host rescue ladder owns
+    the per-scenario stragglers afterwards).
+
+    ``min_k``: stall counting starts only at checkpoints past this sweep
+    index — the shared engine's ADAPTIVE solve passes its in-loop gamma
+    cadence so the exit cannot preempt the first adaptation opportunity
+    (a batch that stalls precisely until gamma moves would otherwise be
+    abandoned at 3 windows); its frozen solves, whose gamma is already
+    adapted, pass 0 and keep the earliest exit."""
+    eps_pri = st.eps_abs + st.eps_rel * jnp.maximum(prinorm, 1.0)
+    eps_dua = st.eps_abs + st.eps_rel * jnp.maximum(duanorm, 1.0)
+    excess = jnp.maximum(pri / eps_pri, dua / eps_dua)
+    excess = jnp.clip(jnp.nan_to_num(excess, nan=1e6, posinf=1e6), 1.0, 1e6)
+    gmean = jnp.exp(jnp.mean(jnp.log(excess)))
+    ck = max(1, st.check_every)
+    period = max(1, st.sweep_plateau_window // ck)
+    due = (((s.k // ck) + 1) % period == 0) & (s.k >= min_k)
+    # near-eps grace: once the batch gmean sits within rtol of eps the
+    # >=1 floor makes fractional improvement unmeasurable, so a batch 2
+    # windows from crossing eps would be force-exited — treat that zone
+    # as improving and let it finish (a batch PARKED there runs out its
+    # budget instead, which is bounded and effectively converged anyway)
+    improved = (gmean < (1.0 - st.sweep_plateau_rtol) * s.best) | (
+        gmean <= 1.0 + st.sweep_plateau_rtol)
+    stall = jnp.where(due, jnp.where(improved, 0, s.stall + 1), s.stall)
+    best = jnp.where(due, jnp.minimum(s.best, gmean), s.best)
+    return best, stall
 
 
 def _admm_core(q, q2, A, cl, cu, lb, ub, state, LK, rho_a, rho_x,
@@ -359,10 +423,11 @@ def _admm_core(q, q2, A, cl, cu, lb, ub, state, LK, rho_a, rho_x,
     def cont(carry):
         s, Ax = carry
         # OSQP termination: eps_abs + eps_rel * residual-scale norms
-        eps_pri = st.eps_abs + st.eps_rel * jnp.maximum(s.prinorm, 1.0)
-        eps_dua = st.eps_abs + st.eps_rel * jnp.maximum(s.duanorm, 1.0)
-        done = (s.pri < eps_pri) & (s.dua < eps_dua)
-        return (s.k < st.max_iter) & ~jnp.all(done)
+        done = _done_mask(s.pri, s.dua, s.prinorm, s.duanorm, st)
+        go = (s.k < st.max_iter) & ~jnp.all(done)
+        if st.sweep_plateau_rtol > 0:
+            go = go & (s.stall < 2)
+        return go
 
     # fused Pallas sweep block on TPU: all matrices stay in VMEM across the
     # check_every sweeps instead of re-streaming from HBM every sweep, in
@@ -414,8 +479,12 @@ def _admm_core(q, q2, A, cl, cu, lb, ub, state, LK, rho_a, rho_x,
         # sweeps, so one true matvec per checkpoint resets the drift
         Ax = jnp.einsum("smn,sn->sm", A, x)
         pri, dua, prinorm, duanorm = residuals(x, z, zx, y, yx, Ax)
+        if st.sweep_plateau_rtol > 0:
+            best, stall = _plateau_update(s, pri, dua, prinorm, duanorm, st)
+        else:
+            best, stall = s.best, s.stall
         return (_IterState(x, z, zx, y, yx, pri, dua, prinorm, duanorm,
-                           s.k + max(1, st.check_every)), Ax)
+                           s.k + max(1, st.check_every), best, stall), Ax)
 
     Ax0 = jnp.einsum("smn,sn->sm", A, state.x)
     state, _ = jax.lax.while_loop(cont, multi_step, (state, Ax0))
@@ -458,7 +527,8 @@ def _solve_scaled(q, q2, A, cl, cu, lb, ub, warm, masks, st: ADMMSettings,
     inf = jnp.full((S,), jnp.inf, dt)
     one = jnp.ones((S,), dt)
     state0 = _IterState(x0, z0, zx0, y0, yx0, inf, inf, one, one,
-                        jnp.zeros((), jnp.int32))
+                        jnp.zeros((), jnp.int32),
+                        jnp.asarray(jnp.inf, dt), jnp.zeros((), jnp.int32))
 
     # Restart loop as a lax.scan with the factorization in the CARRY, so
     # the LAST rho vectors + factorization survive to become the reusable
@@ -475,7 +545,9 @@ def _solve_scaled(q, q2, A, cl, cu, lb, ub, warm, masks, st: ADMMSettings,
         LK = _factor(q2, A, rho_a, rho_x, st.sigma, P)
         state = _admm_core(
             q, q2, A, cl, cu, lb, ub,
-            state._replace(k=jnp.zeros((), jnp.int32)),
+            state._replace(k=jnp.zeros((), jnp.int32),
+                           best=jnp.asarray(jnp.inf, dt),
+                           stall=jnp.zeros((), jnp.int32)),
             LK, rho_a, rho_x, st, P,
         )
         total = total + state.k
@@ -485,9 +557,9 @@ def _solve_scaled(q, q2, A, cl, cu, lb, ub, warm, masks, st: ADMMSettings,
         # adapting on the stale residual ratio would compound x10 per
         # remaining restart into a runaway rho that only ever reaches the
         # Factors (and wrecks the frozen path's dual convergence).
+        done = _done_mask(state.pri, state.dua, state.prinorm,
+                          state.duanorm, st)
         eps_pri = st.eps_abs + st.eps_rel * jnp.maximum(state.prinorm, 1.0)
-        eps_dua = st.eps_abs + st.eps_rel * jnp.maximum(state.duanorm, 1.0)
-        done = (state.pri < eps_pri) & (state.dua < eps_dua)
         pri_rel = state.pri / jnp.maximum(state.prinorm, 1e-10)
         dua_rel = state.dua / jnp.maximum(state.duanorm, 1e-10)
         ratio = jnp.sqrt(
@@ -813,6 +885,8 @@ def _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm, P=None,
         x=x, z=z, y=y, yx=yx,
         pri_res=state.pri, dua_res=state.dua,
         iters=jnp.broadcast_to(total, (S,)),
+        done=_done_mask(state.pri, state.dua, state.prinorm,
+                        state.duanorm, settings),
         raw=raw,
     )
     if want_factors:
@@ -855,7 +929,8 @@ def _solve_frozen_impl(c, q2, A, cl, cu, lb, ub, factors: Factors, warm,
     inf = jnp.full((S,), jnp.inf, dt)
     one = jnp.ones((S,), dt)
     state0 = _IterState(x0, z0, zx0, y0, yx0, inf, inf, one, one,
-                        jnp.zeros((), jnp.int32))
+                        jnp.zeros((), jnp.int32),
+                        jnp.asarray(jnp.inf, dt), jnp.zeros((), jnp.int32))
 
     state = _admm_core(qs, q2s, As, cls, cus, lbs, ubs, state0,
                        (factors.Kinv, factors.K), factors.rho_a,
@@ -874,6 +949,8 @@ def _solve_frozen_impl(c, q2, A, cl, cu, lb, ub, factors: Factors, warm,
         x=x, z=z, y=y, yx=yx,
         pri_res=state.pri, dua_res=state.dua,
         iters=jnp.broadcast_to(state.k, (S,)),
+        done=_done_mask(state.pri, state.dua, state.prinorm,
+                        state.duanorm, settings),
         raw=raw,
     )
 

@@ -24,10 +24,20 @@ import dataclasses
 
 import numpy as np
 
-_DISPATCH_TARGET_SECS = 18.0
-# conservative effective sweep throughput under matmul precision "highest"
-# (bf16x6 passes); measured ~7.7e12 flop/s at reference-UC shapes on v5e
-_DISPATCH_EFF_FLOPS = 4e12
+# Per-dispatch budget: must stay well under the remote worker's ~60 s
+# execution kill, but long enough that the solver's IN-LOOP plateau exit
+# (earliest at 3 x sweep_plateau_window = 96 sweeps) can fire inside one
+# dispatch — at 18 s the reference-UC S=1000 segments capped at 52 sweeps
+# and the in-loop exit could never trigger, wasting 2 whole continuation
+# dispatches proving the plateau at host granularity.  30 s x the model's
+# built-in overestimate (~1.5x vs measured sweep times) lands actual
+# dispatches around 20-30 s: 2x margin under the watchdog.
+_DISPATCH_TARGET_SECS = 30.0
+# effective sweep throughput on the model's (n^2 + 2nm) flop accounting
+# under matmul precision "highest" (bf16x6): measured 6.9-7.7e12 flop/s at
+# reference-UC shapes on v5e (48.8 ms/sweep at S=256, n=16008, m=12408,
+# solve_refine=2); 6e12 keeps ~15% conservatism
+_DISPATCH_EFF_FLOPS = 6e12
 
 
 def dispatch_segments(S, n, m, st, factor_batch=1,
@@ -85,10 +95,14 @@ def continue_frozen(run_segment, sol, seg_f, budget, all_done=None,
     the jitted sharded PH step: re-dispatch ``run_segment(warm)`` until
     converged, plateaued, or the sweep budget is spent.
 
-    ``all_done(sol)`` decides early exit; the default reads the iteration
-    counter (the while_loop exits before its cap iff every scenario met
-    eps).  Multi-controller callers MUST pass a deterministic ``all_done``
-    (e.g. ``lambda sol: False``) and ``plateau_rtol=None``: both defaults
+    ``all_done(sol)`` decides whether to STOP DISPATCHING; the default
+    reads the iteration counter — the while_loop leaves before its cap
+    when every scenario met eps OR the in-loop plateau exit fired
+    (``sweep_plateau_rtol``), and in both cases further dispatches are
+    pointless.  It is a stop signal, NOT a convergence signal: use
+    ``BatchSolution.done`` for convergence.  Multi-controller callers
+    MUST pass a deterministic ``all_done`` (e.g. ``lambda sol: False``)
+    and ``plateau_rtol=None``: both defaults
     fetch scenario-sharded data, which is impossible for non-addressable
     shards — and even a local-shard check would let processes disagree on
     the loop count and deadlock the collective dispatches.
@@ -151,13 +165,12 @@ def solve_factored_segmented(frozen_fn, factored_fn, args, settings,
                                      factor_batch=1 if shared else S)
     if seg_r >= settings.max_iter and seg_f >= settings.max_iter:
         sol, factors = factored_fn(*args, settings=settings, warm=warm)
-        return sol, factors, True
+        return sol, factors, bool(np.asarray(sol.done).all())
     st_r = dataclasses.replace(settings, max_iter=seg_r)
     st_f = dataclasses.replace(settings, max_iter=seg_f)
     sol, factors = factored_fn(*args, settings=st_r, warm=warm)
     sol = _continue_frozen(frozen_fn, args, factors, sol, st_f, seg_f,
                            refresh_budget(settings, seg_r))
-    converged = int(np.asarray(sol.iters).max()) < seg_f
     if not shared and settings.polish and settings.polish_passes:
         # dense-path parity with the one-dispatch adaptive solve, which
         # polishes its final iterate; frozen continuations don't
@@ -165,15 +178,19 @@ def solve_factored_segmented(frozen_fn, factored_fn, args, settings,
         st_p = dataclasses.replace(settings, max_iter=2 * ce)
         sol = frozen_fn(*args, factors, settings=st_p, warm=sol.raw,
                         polish=True)
-    return sol, factors, converged
+    # convergence from the RETURNED sol (post-polish), so the flag and
+    # sol.done can never disagree
+    return sol, factors, bool(np.asarray(sol.done).all())
 
 
 def solve_frozen_segmented(frozen_fn, args, factors, settings, warm=None):
     """Frozen solve, segmented when the shapes demand it.
 
-    Returns (sol, converged) — callers must use ``converged`` instead of
-    comparing ``sol.iters`` against ``settings.max_iter`` (iters reflects
-    only the LAST segment's counter).
+    Returns (sol, converged) — callers must use ``converged`` (computed
+    from ``BatchSolution.done``, the solver's own eps test) instead of any
+    iters-vs-cap compare: iters reflects only the LAST segment's counter,
+    and the in-loop plateau exit (``sweep_plateau_rtol``) leaves the sweep
+    loop early without convergence.
     """
     shared = np.ndim(args[2]) == 2
     S, n, m = _shapes(args, shared)
@@ -181,11 +198,10 @@ def solve_frozen_segmented(frozen_fn, args, factors, settings, warm=None):
                                      factor_batch=1 if shared else S)
     if seg_f >= settings.max_iter:
         sol = frozen_fn(*args, factors, settings=settings, warm=warm)
-        converged = int(np.asarray(sol.iters).max()) < settings.max_iter
-        return sol, converged
+        return sol, bool(np.asarray(sol.done).all())
     st_f = dataclasses.replace(settings, max_iter=seg_f)
     sol = frozen_fn(*args, factors, settings=st_f, warm=warm)
     if int(np.asarray(sol.iters).max()) >= seg_f:
         sol = _continue_frozen(frozen_fn, args, factors, sol, st_f, seg_f,
                                settings.max_iter - seg_f)
-    return sol, int(np.asarray(sol.iters).max()) < seg_f
+    return sol, bool(np.asarray(sol.done).all())

@@ -42,7 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from .admm import (ADMMSettings, BatchSolution, BIG, _clean_bounds,
-                   _explicit_inverse)
+                   _done_mask, _explicit_inverse, _plateau_update)
 
 
 class SharedFactors(NamedTuple):
@@ -142,10 +142,12 @@ class _IterState(NamedTuple):
     prinorm: jax.Array
     duanorm: jax.Array
     k: jax.Array
+    best: jax.Array    # scalar: best batch-worst eps-normalized residual
+    stall: jax.Array   # scalar int32: consecutive non-improving windows
 
 
 def _core(q, q2s, q2ref, A, cl, cu, lb, ub, state, Kinv, K, rho_a, rho_x,
-          glo, ghi, st: ADMMSettings):
+          glo, ghi, st: ADMMSettings, adaptive=False):
     """Inner ADMM sweep at a fixed shared rho profile with IN-LOOP
     per-scenario gamma adaptation.
 
@@ -214,10 +216,11 @@ def _core(q, q2s, q2ref, A, cl, cu, lb, ub, state, Kinv, K, rho_a, rho_x,
 
     def cont(carry):
         s, _ = carry
-        eps_pri = st.eps_abs + st.eps_rel * jnp.maximum(s.prinorm, 1.0)
-        eps_dua = st.eps_abs + st.eps_rel * jnp.maximum(s.duanorm, 1.0)
-        done = (s.pri < eps_pri) & (s.dua < eps_dua)
-        return (s.k < st.max_iter) & ~jnp.all(done)
+        done = _done_mask(s.pri, s.dua, s.prinorm, s.duanorm, st)
+        go = (s.k < st.max_iter) & ~jnp.all(done)
+        if st.sweep_plateau_rtol > 0:
+            go = go & (s.stall < 2)
+        return go
 
     def multi_step(carry):
         s, Ax = carry
@@ -228,10 +231,13 @@ def _core(q, q2s, q2ref, A, cl, cu, lb, ub, state, Kinv, K, rho_a, rho_x,
         # Cadence matters: adapting every checkpoint thrashes (early ratios
         # are always imbalanced and rho oscillates); every ~128 sweeps
         # matches the restart cadence that converges, at zero
-        # refactorization cost.
-        eps_pri = st.eps_abs + st.eps_rel * jnp.maximum(prinorm, 1.0)
-        eps_dua = st.eps_abs + st.eps_rel * jnp.maximum(duanorm, 1.0)
-        done = (pri < eps_pri) & (dua < eps_dua)
+        # refactorization cost.  (A faster cadence to beat the in-loop
+        # plateau exit was tried and thrashes LP batches, whose free gamma
+        # oscillates.  Instead, ADAPTIVE solves delay plateau-stall
+        # counting past the first gamma opportunity via min_k below;
+        # frozen solves, whose gamma was already adapted at refresh,
+        # keep the earliest exit.)
+        done = _done_mask(pri, dua, prinorm, duanorm, st)
         pri_rel = pri / jnp.maximum(prinorm, 1e-10)
         dua_rel = dua / jnp.maximum(duanorm, 1e-10)
         ratio = jnp.sqrt(
@@ -243,8 +249,23 @@ def _core(q, q2s, q2ref, A, cl, cu, lb, ub, state, Kinv, K, rho_a, rho_x,
         move = due & ((ratio > 5.0) | (ratio < 0.2))
         gnew = jnp.clip(s.gamma * jnp.clip(ratio, 0.1, 10.0), glo, ghi)
         gamma = jnp.where(done | ~move, s.gamma, gnew)
+        if st.sweep_plateau_rtol > 0:
+            best, stall = _plateau_update(s, pri, dua, prinorm, duanorm,
+                                          st, min_k=128 if adaptive else 0)
+            # an ACTUAL gamma move changes the iteration itself: give the
+            # new penalties a fresh plateau grace instead of exiting on
+            # residuals produced by the OLD gamma.  (gnew clipped back to
+            # its old value is a no-op and must NOT reset the grace — a
+            # pinned gamma at the clip bound would otherwise defeat the
+            # plateau exit forever.)
+            moved = jnp.any(move & ~done & (gnew != s.gamma))
+            stall = jnp.where(moved, 0, stall)
+            best = jnp.where(moved, jnp.asarray(jnp.inf, best.dtype), best)
+        else:
+            best, stall = s.best, s.stall
         return (_IterState(x, z, zx, y, yx, gamma, pri, dua, prinorm,
-                           duanorm, s.k + max(1, st.check_every)), Ax)
+                           duanorm, s.k + max(1, st.check_every),
+                           best, stall), Ax)
 
     Ax0 = state.x @ AT
     state, _ = jax.lax.while_loop(cont, multi_step, (state, Ax0))
@@ -328,7 +349,9 @@ def _solve_shared_impl(c, q2, A, cl, cu, lb, ub, settings, warm,
     inf = jnp.full((S,), jnp.inf, dt)
     one = jnp.ones((S,), dt)
     state0 = _IterState(x0, z0, zx0, y0, yx0, jnp.ones((S,), dt),
-                        inf, inf, one, one, jnp.zeros((), jnp.int32))
+                        inf, inf, one, one, jnp.zeros((), jnp.int32),
+                        jnp.asarray(jnp.inf, dt),
+                        jnp.zeros((), jnp.int32))
 
     # Per-scenario gamma runs FREE for (near-)LP batches: dq2 = 0 there, so
     # the shared inverse solves every scenario's x-update exactly at any
@@ -348,12 +371,14 @@ def _solve_shared_impl(c, q2, A, cl, cu, lb, ub, settings, warm,
             rho_x = jnp.minimum(rho_x * multx, st.rho_row_max)
         Kinv, K = _factor_shared(q2ref, As, rho_a, rho_x, st.sigma)
         state = _core(qs, q2s, q2ref, As, cls, cus, lbs, ubs,
-                      state._replace(k=jnp.zeros((), jnp.int32)),
-                      Kinv, K, rho_a, rho_x, glo, ghi, st)
+                      state._replace(k=jnp.zeros((), jnp.int32),
+                                     best=jnp.asarray(jnp.inf, dt),
+                                     stall=jnp.zeros((), jnp.int32)),
+                      Kinv, K, rho_a, rho_x, glo, ghi, st, adaptive=True)
         total = total + state.k
+        done = _done_mask(state.pri, state.dua, state.prinorm,
+                          state.duanorm, st)
         eps_pri = st.eps_abs + st.eps_rel * jnp.maximum(state.prinorm, 1.0)
-        eps_dua = st.eps_abs + st.eps_rel * jnp.maximum(state.duanorm, 1.0)
-        done = (state.pri < eps_pri) & (state.dua < eps_dua)
         pri_rel = state.pri / jnp.maximum(state.prinorm, 1e-10)
         dua_rel = state.dua / jnp.maximum(state.duanorm, 1e-10)
         ratio = jnp.sqrt(
@@ -396,6 +421,8 @@ def _solve_shared_impl(c, q2, A, cl, cu, lb, ub, settings, warm,
         x=x, z=z, y=y, yx=yx,
         pri_res=state.pri, dua_res=state.dua,
         iters=jnp.broadcast_to(total, (S,)),
+        done=_done_mask(state.pri, state.dua, state.prinorm,
+                        state.duanorm, st),
         raw=(x, z, y, yx),
     )
     if want_factors:
@@ -430,7 +457,9 @@ def _solve_shared_frozen_impl(c, q2, A, cl, cu, lb, ub,
     inf = jnp.full((S,), jnp.inf, dt)
     one = jnp.ones((S,), dt)
     state0 = _IterState(x0, z0, zx0, y0, yx0, factors.gamma,
-                        inf, inf, one, one, jnp.zeros((), jnp.int32))
+                        inf, inf, one, one, jnp.zeros((), jnp.int32),
+                        jnp.asarray(jnp.inf, dt),
+                        jnp.zeros((), jnp.int32))
 
     lp_like = jnp.max(jnp.abs(q2s)) < 1e-12
     glo = jnp.where(lp_like, 1e-4, 0.6)
@@ -445,6 +474,8 @@ def _solve_shared_frozen_impl(c, q2, A, cl, cu, lb, ub,
         x=x, z=z, y=y, yx=yx,
         pri_res=state.pri, dua_res=state.dua,
         iters=jnp.broadcast_to(state.k, (S,)),
+        done=_done_mask(state.pri, state.dua, state.prinorm,
+                        state.duanorm, settings),
         raw=(x, z, y, yx),
     )
 

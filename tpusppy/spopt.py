@@ -451,6 +451,7 @@ class SPOpt(SPBase):
                        for a in (sol.x, sol.z, sol.y, sol.yx))
         pri = pri.copy()
         dua = dua.copy()
+        done = np.array(np.asarray(sol.done), copy=True)
         n_resc = 0
         qp_bad = bad[is_qp[bad]]
         if qp_bad.size:
@@ -492,6 +493,7 @@ class SPOpt(SPBase):
                     z[s] = b.A[s] @ xs
                     pri[s] = 0.0
                     dua[s] = 0.0
+                    done[s] = True
                     n_resc += 1
         lp_bad = bad[~is_qp[bad]]
         max_lp = int(self.options.get("straggler_lp_max", 64))
@@ -526,13 +528,14 @@ class SPOpt(SPBase):
             z[s] = b.A[s] @ xs
             pri[s] = 0.0
             dua[s] = 0.0
+            done[s] = True
             n_resc += 1
         if n_resc:
             global_toc(
                 f"straggler rescue: {n_resc}/{b.num_scenarios} scenarios "
                 "re-solved host-exact", self.options.get("verbose", False))
         return sol._replace(x=x, z=z, y=y, yx=yx, pri_res=pri, dua_res=dua,
-                            raw=(x, z, y, yx))
+                            done=done, raw=(x, z, y, yx))
 
     # ---- expectations (Allreduce analogues) ---------------------------------
     def Eobjective(self, x=None) -> float:
