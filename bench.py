@@ -309,6 +309,13 @@ def workload():
             log(f"uc benchmark failed: {e!r}")
             line["uc"] = {"error": repr(e)}
     print(json.dumps(line))
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # hard-exit: a wheel watchdog timeout leaves a daemon spoke thread
+    # mid-device-call, and normal interpreter teardown then aborts the
+    # whole process (exit 134, "FATAL: exception not rethrown") AFTER the
+    # artifact line was printed — losing the rc=0 the driver records.
+    os._exit(0)
 
 
 if __name__ == "__main__":

@@ -166,6 +166,36 @@ def uc_metrics():
     iters_per_sec = iters / (time.time() - t0)
     log(f"uc PH: {iters_per_sec:.3f} iters/sec (conv={conv:.3e})")
 
+    # FULL-reference-horizon submetric (horizon 48, n=32016 at S=1000):
+    # the shape the dense engine could never fit on one chip (4.1 GB
+    # Kinv + 3.2 GB dense A); the sparse/block-Woodbury engine runs it —
+    # record the rate as capability evidence.  TPU real-data runs only.
+    h48_rate = None
+    if (model_name == "data" and platform != "cpu"
+            and horizon < 48 and not os.environ.get("BENCH_UC_NO_H48")):
+        try:
+            kw48 = dict(kw, horizon=48)
+            b48 = ScenarioBatch.from_problems(
+                [uc_model.scenario_creator(nm, **kw48) for nm in names])
+            arr48 = sharded.shard_batch(b48, mesh)
+            r48, f48 = sharded.make_ph_step_pair(
+                b48.tree.nonant_indices, settings, mesh)
+            st48 = sharded.init_state(arr48, 1.0, settings)
+            st48, o48, _ = r48(st48, arr48, 0.0)
+            np.asarray(o48.conv)
+            st48, o48, fac48 = r48(st48, arr48, 1.0)
+            np.asarray(o48.conv)
+            t0 = time.time()
+            n48 = 3
+            for _ in range(n48):
+                st48, o48 = f48(st48, arr48, 1.0, fac48)
+            np.asarray(o48.conv)
+            h48_rate = n48 / (time.time() - t0)
+            log(f"uc h48 (n={b48.num_vars}): {h48_rate:.4f} iters/sec")
+            del arr48, st48, o48, fac48, r48, f48, b48
+        except Exception as e:          # capability metric is additive
+            log(f"uc h48 probe failed: {e!r}")
+
     # baseline: serial per-scenario HiGHS MIP loop (reference architecture),
     # sampled ADAPTIVELY — reference-scale UC MIPs cost tens of seconds each
     # on this host, so the sample stops once ~90s of baseline evidence is in
@@ -348,6 +378,8 @@ def uc_metrics():
             "model": model_name,
             "wheel_S": S_wheel,
             "ph_iters_per_sec": round(iters_per_sec, 4),
+            "h48_ph_iters_per_sec": (round(h48_rate, 4)
+                                     if h48_rate else None),
             "vs_baseline": round(iters_per_sec / base_ips, 2),
             "vs_baseline_32rank": round(iters_per_sec / base32, 2),
             "S": S, "degraded_cpu_run": degraded,
@@ -369,6 +401,8 @@ def uc_metrics():
         "model": model_name,
         "wheel_S": S_wheel,
         "ph_iters_per_sec": round(iters_per_sec, 4),
+        "h48_ph_iters_per_sec": (round(h48_rate, 4)
+                                 if h48_rate else None),
         "vs_baseline": round(iters_per_sec / base_ips, 2),
         "vs_baseline_32rank": round(iters_per_sec / base32, 2),
         "S": S, "degraded_cpu_run": degraded,
@@ -390,6 +424,9 @@ def main():
         "vs_baseline": m["vs_baseline"],
         "uc": m,
     }))
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)      # see bench.py: daemon wheel threads abort teardown
 
 
 if __name__ == "__main__":

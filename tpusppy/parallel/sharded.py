@@ -28,6 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..solvers import admm, shared_admm
 from ..solvers import segmented as segmented_solvers
 from ..solvers.admm import ADMMSettings
+from ..solvers.sparse import SparseA
 
 # ---------------------------------------------------------------------------
 # Dispatch segmentation: the remote TPU worker kills any single program
@@ -40,10 +41,12 @@ _DISPATCH_TARGET_SECS = segmented_solvers._DISPATCH_TARGET_SECS
 _DISPATCH_EFF_FLOPS = segmented_solvers._DISPATCH_EFF_FLOPS
 
 
-def _dispatch_segments(S, n, m, st: ADMMSettings, factor_batch=1):
+def _dispatch_segments(S, n, m, st: ADMMSettings, factor_batch=1,
+                       sparse_factor=1.0):
     return segmented_solvers.dispatch_segments(
         S, n, m, st, factor_batch=factor_batch,
-        eff_flops=_DISPATCH_EFF_FLOPS, target_secs=_DISPATCH_TARGET_SECS)
+        eff_flops=_DISPATCH_EFF_FLOPS, target_secs=_DISPATCH_TARGET_SECS,
+        sparse_factor=sparse_factor)
 
 
 class PHArrays(NamedTuple):
@@ -317,8 +320,11 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
         ndev = 1 if mesh is None else len(mesh.devices.flat)
         S_dev = -(-S // ndev)          # per-device shard does the sweeping
         dense = arr.A.ndim == 3
+        sf = (segmented_solvers.SPARSE_DISPATCH_FACTOR
+              if isinstance(arr.A, SparseA) else 1.0)
         return _dispatch_segments(S_dev, n, m, settings,
-                                  factor_batch=S_dev if dense else 1)
+                                  factor_batch=S_dev if dense else 1,
+                                  sparse_factor=sf)
 
     # A mesh spanning several processes cannot make data-dependent host
     # decisions: sol.iters' shards are non-addressable (fetch raises), and
@@ -415,7 +421,8 @@ def make_mesh_2d(n_scen: int, n_row: int, scen_axis: str = "scen",
                 (scen_axis, row_axis))
 
 
-def shard_batch(batch, mesh: Mesh, axis: str = "scen") -> PHArrays:
+def shard_batch(batch, mesh: Mesh, axis: str = "scen",
+                sparse: bool | str = "auto") -> PHArrays:
     """Place a :class:`~tpusppy.ir.ScenarioBatch` on the mesh, scenario-sharded.
 
     Pads S up to a multiple of the mesh axis size with zero-probability copies
@@ -423,6 +430,14 @@ def shard_batch(batch, mesh: Mesh, axis: str = "scen") -> PHArrays:
     scenario-to-rank maps, sputils.py:807-812).  On a 2-D mesh
     (:func:`make_mesh_2d`) with a shared-A batch, the row dimension
     additionally shards over the "row" axis (m padded to a multiple of it).
+
+    ``sparse``: upload a shared A as a :class:`~tpusppy.solvers.sparse.SparseA`
+    (gather/segment-sum matvecs + block/Woodbury structured KKT when the
+    family has the structure) instead of the dense (m, n) matrix.  "auto"
+    enables it for large very-sparse families (reference-scale UC: 0.03%
+    dense) on a 1-D mesh; dense stays the default elsewhere (small
+    matrices ride the MXU better dense, and the 2-D row-sharded mesh
+    needs the dense layout).
     """
     S = batch.num_scenarios
     nsh = mesh.shape[axis]
@@ -469,9 +484,16 @@ def shard_batch(batch, mesh: Mesh, axis: str = "scen") -> PHArrays:
         return np.pad(a, widths)
 
     if A_shared is not None:
+        An = np.asarray(A_shared)
+        from ..solvers.sparse import should_sparsify
+        use_sparse = (sparse is True) or (
+            sparse == "auto" and row_axis is None and should_sparsify(An))
         if row_axis is not None:
-            A_dev = put(pad_rows(np.asarray(A_shared), 0),
+            A_dev = put(pad_rows(An, 0),
                         NamedSharding(mesh, P(row_axis, None)))
+        elif use_sparse:
+            sp = SparseA.from_dense(An, structure=True)
+            A_dev = jax.device_put(sp, NamedSharding(mesh, P()))
         else:
             A_dev = put(A_shared, NamedSharding(mesh, P()))
         row_spec = NamedSharding(mesh, P(axis, row_axis))

@@ -966,7 +966,12 @@ def solve_batch_frozen(c, q2, A, cl, cu, lb, ub, factors: Factors,
 
 
 def _Aty(A, y):
-    """A'y per scenario; A may be (S, m, n) or a shared (m, n)."""
+    """A'y per scenario; A may be (S, m, n), a shared (m, n), or a
+    :class:`~tpusppy.solvers.sparse.SparseA` (certified-bound programs
+    then ride the exact sparse transpose matvec)."""
+    from .sparse import SparseA
+    if isinstance(A, SparseA):
+        return A.rmatvec(y)
     return y @ A if A.ndim == 2 else jnp.einsum("smn,sm->sn", A, y)
 
 

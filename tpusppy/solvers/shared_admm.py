@@ -43,6 +43,19 @@ import jax.numpy as jnp
 
 from .admm import (ADMMSettings, BatchSolution, BIG, _clean_bounds,
                    _done_mask, _explicit_inverse, _plateau_update)
+from .sparse import SparseA
+from .structured_kkt import (apply_kinv_like, factor_structured,
+                             zero_factors)
+
+
+def _mv(A, x):
+    """A x: (S, n) -> (S, m) for dense (m, n) or :class:`SparseA`."""
+    return A.matvec(x) if isinstance(A, SparseA) else x @ A.T
+
+
+def _rmv(A, y):
+    """A' y: (S, m) -> (S, n) for dense (m, n) or :class:`SparseA`."""
+    return A.rmatvec(y) if isinstance(A, SparseA) else y @ A
 
 
 class SharedFactors(NamedTuple):
@@ -55,7 +68,9 @@ class SharedFactors(NamedTuple):
     rho_a: jax.Array   # (m,) row penalties actually used last
     rho_x: jax.Array   # (n,) variable-box penalties actually used last
     gamma: jax.Array   # (S,) per-scenario penalty scales actually used last
-    Kinv: jax.Array    # (n, n) explicit inverse of the shared x-update system
+    Kinv: jax.Array    # (n, n) explicit inverse of the shared x-update
+                       # system, or a structured_kkt.BlockWoodbury operator
+                       # (sparse-A families with block/Woodbury structure)
     K: jax.Array       # (n, n) exact shared K for dense refinement, or None
                        # (factors_keep_K=False): refinement then runs
                        # matrix-free through the scaled shared A
@@ -73,17 +88,24 @@ class _Masks(NamedTuple):
 
 
 def _ruiz_shared(A, q2ref, iters):
-    """Ruiz equilibration of the single shared A; returns (D (n,), E (m,))."""
+    """Ruiz equilibration of the single shared A (dense or sparse);
+    returns (D (n,), E (m,))."""
     m, n = A.shape
     D = jnp.ones((n,), A.dtype)
     E = jnp.ones((m,), A.dtype)
+    sparse = isinstance(A, SparseA)
 
     def body(_, DE):
         D, E = DE
-        As = A * E[:, None] * D[None, :]
         Ps = q2ref * D * D
-        col = jnp.maximum(jnp.max(jnp.abs(As), axis=0), jnp.abs(Ps))
-        row = jnp.max(jnp.abs(As), axis=1)
+        if sparse:
+            As = A.scale(E, D)
+            col = jnp.maximum(As.col_absmax(), jnp.abs(Ps))
+            row = As.row_absmax()
+        else:
+            As = A * E[:, None] * D[None, :]
+            col = jnp.maximum(jnp.max(jnp.abs(As), axis=0), jnp.abs(Ps))
+            row = jnp.max(jnp.abs(As), axis=1)
         col = jnp.where(col < 1e-12, 1.0, col)
         row = jnp.where(row < 1e-12, 1.0, row)
         return D / jnp.sqrt(col), E / jnp.sqrt(row)
@@ -94,8 +116,27 @@ def _ruiz_shared(A, q2ref, iters):
 
 def _factor_shared(q2ref, A, rho_a, rho_x, sigma):
     """(Kinv, K) of the SHARED K = diag(q2ref + rho_x) + sigma I + A'RA —
-    one (n, n) system for the whole scenario batch."""
+    one (n, n) system for the whole scenario batch.
+
+    Three regimes by matrix type:
+    - dense (m, n) array: dense K + explicit inverse (unchanged);
+    - :class:`SparseA` WITH attached block/Woodbury structure: the
+      structured factorization (no (n, n) object at all; K is None and
+      refinement runs matrix-free through the sparse A);
+    - SparseA without structure: K assembled via a transient dense
+      scatter, explicit inverse kept, K dropped (matrix-free refinement
+      keeps the factors small)."""
     n = A.shape[1]
+    if isinstance(A, SparseA):
+        if A.structure is not None:
+            bw = factor_structured(A, A.structure, q2ref + rho_x, rho_a,
+                                   sigma)
+            return bw, None
+        Ad = A.todense()
+        K = jnp.einsum("mn,m,mk->nk", Ad, rho_a, Ad)
+        K = K + jnp.eye(n, dtype=Ad.dtype) * sigma
+        K = K + jnp.diag(q2ref + rho_x)
+        return _explicit_inverse(K[None])[0], None
     K = jnp.einsum("mn,m,mk->nk", A, rho_a, A)
     K = K + jnp.eye(n, dtype=A.dtype) * sigma
     K = K + jnp.diag(q2ref + rho_x)
@@ -120,10 +161,10 @@ def _solve_shared_K(Kinv, Kmul, dq2, gamma, b, refine, extra_if_dq2=2):
     def steps(x, k):
         for _ in range(k):
             r = b - (gamma * Kmul(x) + dq2 * x)
-            x = x + (r / gamma) @ Kinv
+            x = x + apply_kinv_like(Kinv, r / gamma)
         return x
 
-    x = steps((b / gamma) @ Kinv, refine)
+    x = steps(apply_kinv_like(Kinv, b / gamma), refine)
     if extra_if_dq2 > 0:
         x = jax.lax.cond(jnp.any(dq2 != 0),
                          lambda v: steps(v, extra_if_dq2), lambda v: v, x)
@@ -169,9 +210,9 @@ def _core(q, q2s, q2ref, A, cl, cu, lb, ub, state, Kinv, K, rho_a, rho_x,
         Kmul = lambda x: x @ K
     else:
         diagK = q2ref + rho_x + st.sigma
-        Kmul = lambda x: x * diagK[None, :] + ((x @ A.T) * rho_a[None, :]) @ A
+        Kmul = lambda x: (x * diagK[None, :]
+                          + _rmv(A, _mv(A, x) * rho_a[None, :]))
     alpha = st.alpha
-    AT = A.T
 
     def block(x, z, zx, y, yx, Ax, gamma):
         g = gamma[:, None]
@@ -181,10 +222,10 @@ def _core(q, q2s, q2ref, A, cl, cu, lb, ub, state, Kinv, K, rho_a, rho_x,
         dq2 = q2s - g * q2ref[None, :]
 
         for _ in range(max(1, st.check_every)):
-            rhs = (sigma_s * x - q + (rho_a_s * z - y) @ A
+            rhs = (sigma_s * x - q + _rmv(A, rho_a_s * z - y)
                    + (rho_x_s * zx - yx))
             xt = _solve_shared_K(Kinv, Kmul, dq2, g, rhs, st.solve_refine)
-            Axt = xt @ AT
+            Axt = _mv(A, xt)
             x_new = alpha * xt + (1 - alpha) * x
             Ax_new = alpha * Axt + (1 - alpha) * Ax
 
@@ -203,7 +244,7 @@ def _core(q, q2s, q2ref, A, cl, cu, lb, ub, state, Kinv, K, rho_a, rho_x,
             jnp.max(jnp.abs(Ax - z), axis=1),
             jnp.max(jnp.abs(x - zx), axis=1),
         )
-        Aty = y @ A
+        Aty = _rmv(A, y)
         Pxv = q2s * x
         dua = jnp.max(jnp.abs(Pxv + q + Aty + yx), axis=1)
         prinorm = jnp.maximum(
@@ -225,7 +266,7 @@ def _core(q, q2s, q2ref, A, cl, cu, lb, ub, state, Kinv, K, rho_a, rho_x,
     def multi_step(carry):
         s, Ax = carry
         x, z, zx, y, yx, Ax = block(s.x, s.z, s.zx, s.y, s.yx, Ax, s.gamma)
-        Ax = x @ AT    # re-anchor carried Ax (see admm._admm_core)
+        Ax = _mv(A, x)   # re-anchor carried Ax (see admm._admm_core)
         pri, dua, prinorm, duanorm = residuals(x, z, zx, y, yx, Ax)
         # OSQP-style per-scenario adaptation on normalized residual ratios.
         # Cadence matters: adapting every checkpoint thrashes (early ratios
@@ -267,14 +308,15 @@ def _core(q, q2s, q2ref, A, cl, cu, lb, ub, state, Kinv, K, rho_a, rho_x,
                            duanorm, s.k + max(1, st.check_every),
                            best, stall), Ax)
 
-    Ax0 = state.x @ AT
+    Ax0 = _mv(A, state.x)
     state, _ = jax.lax.while_loop(cont, multi_step, (state, Ax0))
     return state
 
 
 def _prep_shared(c, q2, A, cl, cu, lb, ub, settings):
     dt = settings.jdtype()
-    c, q2, A = jnp.asarray(c, dt), jnp.asarray(q2, dt), jnp.asarray(A, dt)
+    c, q2 = jnp.asarray(c, dt), jnp.asarray(q2, dt)
+    A = A.astype(dt) if isinstance(A, SparseA) else jnp.asarray(A, dt)
     cl, cu = _clean_bounds(jnp.asarray(cl, dt), jnp.asarray(cu, dt))
     lb, ub = _clean_bounds(jnp.asarray(lb, dt), jnp.asarray(ub, dt))
     masks = _Masks(
@@ -292,7 +334,8 @@ def _prep_shared(c, q2, A, cl, cu, lb, ub, settings):
 
 
 def _scale_shared(c, q2, A, cl, cu, lb, ub, D, E, cost, warm, dt):
-    As = A * E[:, None] * D[None, :]
+    As = A.scale(E, D) if isinstance(A, SparseA) else (
+        A * E[:, None] * D[None, :])
     q2s = q2 * (D * D)[None, :] * cost
     qs = c * D[None, :] * cost
     cls, cus = cl * E[None, :], cu * E[None, :]
@@ -394,7 +437,7 @@ def _solve_shared_impl(c, q2, A, cl, cu, lb, ub, settings, warm,
         if st.rho_row_adapt:
             stuck = (state.pri > 100.0 * eps_pri)[:, None]
             gate = jnp.maximum(0.3 * state.pri, 10.0 * eps_pri)[:, None]
-            Ax = state.x @ As.T
+            Ax = _mv(As, state.x)
             viol = jnp.maximum(cls - Ax, Ax - cus)
             hit = jnp.any(stuck & (viol > gate), axis=0)       # max over S
             mult = jnp.where(hit, mult * st.rho_row_boost, mult)
@@ -404,10 +447,22 @@ def _solve_shared_impl(c, q2, A, cl, cu, lb, ub, settings, warm,
         return (state, base, total, mult, multx,
                 rho_a, rho_x, Kinv, K), None
 
-    zK = jnp.zeros((n, n), dt)
+    # (Kinv, K) carry placeholders must match the factorization regime's
+    # pytree structure (lax.scan carries are structure-invariant): dense
+    # (n, n) pair for a dense A, (dense, None) for unstructured sparse,
+    # (BlockWoodbury, None) for the structured path
+    if isinstance(As, SparseA):
+        if As.structure is not None:
+            zKinv = zero_factors(As.structure, n, dt)
+        else:
+            zKinv = jnp.zeros((n, n), dt)
+        zK = None
+    else:
+        zKinv = jnp.zeros((n, n), dt)
+        zK = zKinv
     carry0 = (state0, jnp.asarray(st.rho, dt), jnp.zeros((), jnp.int32),
               jnp.ones((m,), dt), jnp.ones((n,), dt),
-              jnp.zeros((m,), dt), jnp.zeros((n,), dt), zK, zK)
+              jnp.zeros((m,), dt), jnp.zeros((n,), dt), zKinv, zK)
     (state, _, total, _, _, rho_a, rho_x, Kinv, K), _ = jax.lax.scan(
         restart, carry0, None, length=st.restarts)
     gamma = state.gamma

@@ -1,0 +1,285 @@
+"""Sparse shared constraint matrices + block/Woodbury KKT structure.
+
+Reference-scale stochastic-programming families have EXTREMELY sparse
+shared constraint matrices (the WECC-240 UC at horizon 24 is (12408,
+16008) with 64k nonzeros — 0.03% dense), yet the shared-A ADMM engine
+(:mod:`tpusppy.solvers.shared_admm`) streams the dense (m, n) matrix
+through every sweep and applies a dense (n, n) explicit KKT inverse.
+This module provides the two structure-exploiting pieces:
+
+- :class:`SparseA` — a COO/CSR-ordered jit-compatible pytree with batched
+  matvecs via gather + ``segment_sum``.  Measured on v5e at UC shapes
+  (S=1000): 6.0 ms forward / 7.4 ms transpose in exact f32 versus ~42 ms
+  for the dense matmul at matmul precision "highest" (the solver's
+  setting) — and it removes the 795 MB (3.2 GB at horizon 48) dense A
+  from the sweep path entirely.
+
+- :func:`detect_structure` + :class:`BlockWoodbury` — the KKT system
+  K = diag(d) + A' R A separates, for these families, into
+  ``B + U R_w U'`` where B is BLOCK-DIAGONAL over variable components
+  (generators: vars coupled only by their own ramp/min-up/segment rows)
+  and U collects the few hundred WIDE rows (power balance, reserves)
+  that couple everything.  The x-update solve then costs
+  O(S*(sum_b bs^2 + 2 n r)) instead of O(S n^2) — ~6x fewer flops at UC
+  shape, and no (n, n) dense inverse in HBM at all (the 4.1 GB Kinv at
+  horizon 48 was the single-chip memory wall).
+
+Reference analogue: none — the reference hands subproblems to Gurobi,
+whose presolve/LU exploits sparsity internally (spopt.py:85-223).  This
+is the TPU-native equivalent of that internal structure exploitation.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+class SparseA:
+    """Shared (m, n) sparse matrix, batched-matvec ready, jit-compatible.
+
+    Arrays (pytree children): COO triplets sorted in CSR order plus a
+    CSC-order permutation for the transpose matvec.  ``shape`` is static
+    aux data (participates in the jit cache key, never traced).
+    """
+
+    def __init__(self, rows, cols, vals, perm_csc, shape, structure=None):
+        self.rows = rows
+        self.cols = cols
+        self.vals = vals
+        self.perm_csc = perm_csc
+        self.shape = tuple(shape)
+        # optional StructureArrays (tpusppy.solvers.structured_kkt): the
+        # block/Woodbury split of this matrix's KKT system, attached at
+        # build time so jitted factor programs can use it
+        self.structure = structure
+
+    # -- pytree protocol --------------------------------------------------
+    def tree_flatten(self):
+        return ((self.rows, self.cols, self.vals, self.perm_csc,
+                 self.structure), self.shape)
+
+    @classmethod
+    def tree_unflatten(cls, shape, children):
+        rows, cols, vals, perm_csc, structure = children
+        return cls(rows, cols, vals, perm_csc, shape, structure)
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def from_dense(cls, A, dtype=None, structure: bool = False,
+                   **detect_kw):
+        """Build from a dense ndarray; ``structure=True`` additionally
+        runs :func:`detect_structure` and attaches the device-side index
+        arrays when a usable block/Woodbury split exists."""
+        A = np.asarray(A)
+        m, n = A.shape
+        rows, cols = np.nonzero(A)
+        vals = A[rows, cols]
+        order = np.lexsort((cols, rows))          # CSR order
+        rows, cols, vals = rows[order], cols[order], vals[order]
+        perm_csc = np.lexsort((rows, cols)).astype(np.int32)
+        struct_arrays = None
+        if structure:
+            st = detect_structure(A, **detect_kw)
+            if st is not None:
+                from .structured_kkt import StructureArrays
+                struct_arrays = StructureArrays.from_structure(st)
+        return cls(jnp.asarray(rows, jnp.int32),
+                   jnp.asarray(cols, jnp.int32),
+                   # no explicit dtype when unspecified: jnp.asarray then
+                   # applies the default f64->f32 demotion silently instead
+                   # of warning on every upload in non-x64 processes
+                   jnp.asarray(vals, dtype) if dtype is not None
+                   else jnp.asarray(vals),
+                   jnp.asarray(perm_csc), (m, n), struct_arrays)
+
+    @property
+    def nnz(self):
+        return self.vals.shape[0]
+
+    @property
+    def ndim(self):
+        """2 — shared-matrix rank, so ``A.ndim == 2`` dispatch sites
+        treat a SparseA exactly like a shared dense (m, n) matrix."""
+        return 2
+
+    @property
+    def dtype(self):
+        return self.vals.dtype
+
+    def astype(self, dt):
+        return SparseA(self.rows, self.cols, self.vals.astype(dt),
+                       self.perm_csc, self.shape, self.structure)
+
+    def scale(self, E, D):
+        """diag(E) @ A @ diag(D) — the Ruiz application; zero-copy on the
+        index arrays (the attached structure is sparsity-pattern-only and
+        survives scaling)."""
+        vals = self.vals * E[self.rows] * D[self.cols]
+        return SparseA(self.rows, self.cols, vals, self.perm_csc,
+                       self.shape, self.structure)
+
+    # -- matvecs ----------------------------------------------------------
+    def matvec(self, x):
+        """A x for x (S, n) -> (S, m).  Gather + sorted segment_sum."""
+        g = x[:, self.cols] * self.vals[None, :]
+        return jax.ops.segment_sum(
+            g.T, self.rows, num_segments=self.shape[0],
+            indices_are_sorted=True).T
+
+    def rmatvec(self, y):
+        """A' y for y (S, m) -> (S, n)."""
+        rows = self.rows[self.perm_csc]
+        cols = self.cols[self.perm_csc]
+        vals = self.vals[self.perm_csc]
+        g = y[:, rows] * vals[None, :]
+        return jax.ops.segment_sum(
+            g.T, cols, num_segments=self.shape[1],
+            indices_are_sorted=True).T
+
+    def row_absmax(self):
+        """(m,) per-row max |a_ij| (Ruiz row norms); empty rows give 0
+        (segment_max alone yields -inf there)."""
+        out = jax.ops.segment_max(
+            jnp.abs(self.vals), self.rows, num_segments=self.shape[0],
+            indices_are_sorted=True)
+        return jnp.maximum(out, 0.0)
+
+    def col_absmax(self):
+        """(n,) per-column max |a_ij|; empty columns give 0."""
+        vals = jnp.abs(self.vals[self.perm_csc])
+        out = jax.ops.segment_max(
+            vals, self.cols[self.perm_csc], num_segments=self.shape[1],
+            indices_are_sorted=True)
+        return jnp.maximum(out, 0.0)
+
+    def todense(self):
+        """Dense (m, n) materialization (for factorization programs and
+        consumers that need the full matrix; transient inside jit)."""
+        return jnp.zeros(self.shape, self.vals.dtype).at[
+            self.rows, self.cols].add(self.vals)
+
+
+def should_sparsify(A_np) -> bool:
+    """The shared enablement policy for uploading a shared A as SparseA
+    (used by both parallel.sharded.shard_batch and spopt._device_A so the
+    rate path and the wheel path always classify a family identically):
+    large AND very sparse — small matrices ride the MXU better dense."""
+    return A_np.size >= 4e6 and (A_np != 0).mean() < 0.01
+
+
+def _as_numpy_coo(A):
+    """(rows, cols, vals, m, n) from dense ndarray or SparseA."""
+    if isinstance(A, SparseA):
+        return (np.asarray(A.rows), np.asarray(A.cols),
+                np.asarray(A.vals), A.shape[0], A.shape[1])
+    A = np.asarray(A)
+    rows, cols = np.nonzero(A)
+    return rows, cols, A[rows, cols], A.shape[0], A.shape[1]
+
+
+class KKTStructure(NamedTuple):
+    """Host-side (static) description of the block/Woodbury split of
+    K = diag + A' R A.  All members are numpy; shipped to the device by
+    :func:`tpusppy.solvers.structured_kkt.factor_structured`.
+
+    Variables are grouped into components connected by NARROW rows; wide
+    rows form the low-rank coupling.  Components are padded into size
+    buckets so each bucket factors as one batched (nb, bs, bs) program.
+    """
+
+    narrow_rows: np.ndarray   # (mn,) row ids whose support stays in-block
+    wide_rows: np.ndarray     # (r,) row ids in the coupling term
+    # per bucket: (block_vars (nb, bs) padded with n [dummy var],
+    #             block_rows (nb, mb) padded with m [dummy row])
+    buckets: tuple
+    n: int
+    m: int
+
+    @property
+    def r(self):
+        return int(self.wide_rows.size)
+
+
+def detect_structure(A, narrow_k: int = 8, max_block: int = 1024,
+                     max_coupling: int = 4096,
+                     min_blocks: int = 4) -> KKTStructure | None:
+    """Find the block/Woodbury split, or None when the family has no
+    usable structure (falls back to the dense explicit inverse).
+
+    ``narrow_k``: rows with more nonzeros than this are coupling rows
+    (their quadratic contribution is rank-1 each, handled via Woodbury).
+    Union-find over narrow-row supports yields variable components; the
+    split is usable when the largest component stays small (batched
+    block factorization) and the coupling rank r is moderate (dense
+    (r, r) cap solve).
+    """
+    rows, cols, vals, m, n = _as_numpy_coo(A)
+    if rows.size == 0:
+        return None
+    counts = np.bincount(rows, minlength=m)
+    wide_mask = counts > narrow_k
+    wide_rows = np.flatnonzero(wide_mask)
+    if wide_rows.size > max_coupling:
+        return None
+    narrow_sel = ~wide_mask[rows]
+    nr, nc = rows[narrow_sel], cols[narrow_sel]
+
+    # union-find over narrow-row supports
+    parent = np.arange(n)
+
+    def find(a):
+        root = a
+        while parent[root] != root:
+            root = parent[root]
+        while parent[a] != root:
+            parent[a], a = root, parent[a]
+        return root
+
+    # link all columns of a narrow row to its first column
+    order = np.argsort(nr, kind="stable")
+    nr_s, nc_s = nr[order], nc[order]
+    starts = np.searchsorted(nr_s, np.unique(nr_s))
+    bounds = np.append(starts, nr_s.size)
+    for i in range(len(starts)):
+        seg = nc_s[bounds[i]:bounds[i + 1]]
+        r0 = find(seg[0])
+        for c in seg[1:]:
+            rc = find(c)
+            if rc != r0:
+                parent[rc] = r0
+    roots = np.array([find(v) for v in range(n)])
+    _, comp = np.unique(roots, return_inverse=True)
+    n_comp = comp.max() + 1
+    sizes = np.bincount(comp, minlength=n_comp)
+    if sizes.max() > max_block or n_comp < min_blocks:
+        return None
+
+    # narrow-row -> component (all its columns share one, by construction)
+    row_comp = np.full(m, -1)
+    row_comp[nr] = comp[nc]
+    narrow_rows = np.flatnonzero(row_comp >= 0)
+
+    # bucket components by padded size (next power of two, min 8)
+    pad = np.maximum(8, 2 ** np.ceil(np.log2(np.maximum(sizes, 1))).astype(int))
+    buckets = []
+    for bs in np.unique(pad):
+        comp_ids = np.flatnonzero(pad == bs)
+        nb = comp_ids.size
+        bvars = np.full((nb, bs), n, np.int32)        # n = dummy var slot
+        rows_per = []
+        for j, cid in enumerate(comp_ids):
+            vs = np.flatnonzero(comp == cid)
+            bvars[j, :vs.size] = vs
+            rows_per.append(np.flatnonzero(row_comp == cid))
+        mb = max(1, max(r.size for r in rows_per))
+        brows = np.full((nb, mb), m, np.int32)        # m = dummy row slot
+        for j, rws in enumerate(rows_per):
+            brows[j, :rws.size] = rws
+        buckets.append((bvars, brows))
+    return KKTStructure(narrow_rows=narrow_rows, wide_rows=wide_rows,
+                        buckets=tuple(buckets), n=n, m=m)

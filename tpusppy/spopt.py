@@ -49,35 +49,51 @@ _DEV_A_CACHE: dict = collections.OrderedDict()
 _DEV_A_LOCK = threading.Lock()
 
 
-def _device_A(A_src, dt):
+def _cached_dev_A(A_np, tag_key, build):
+    """Content-keyed device-A cache insert/lookup with the shared eviction
+    policy: keep the single newest prior same-(shape, dtype, kind) entry
+    (cut rounds mutate the shared A; round k and k-1 coexist) and a
+    4-entry LRU cap — stale versions must never strand HBM, on the dense
+    OR the sparse path."""
     import hashlib
 
-    import jax.numpy as jnp
-
-    A_np = np.asarray(A_src)
-    if A_np.nbytes < 16 << 20:          # small matrices: not worth hashing
-        return jnp.asarray(A_np, dt)
     with _DEV_A_LOCK:
         digest = hashlib.sha1(
             memoryview(np.ascontiguousarray(A_np))).hexdigest()
-        key = (digest, A_np.shape, str(dt))
+        key = (digest,) + tag_key
         dev = _DEV_A_CACHE.pop(key, None)
         if dev is None:
-            # A new digest at an existing (shape, dtype) is almost always a
-            # mutated version of the same family (e.g. cross-scenario cut
-            # rounds writing into the shared A).  Keep the single newest
-            # prior version and drop older ones: cylinders update at
-            # different times (round k vs k-1 coexist and alternate), so
-            # evicting ALL same-shape entries would thrash — but unbounded
-            # retention strands dead ~800 MB copies in HBM.
             same = [k for k in _DEV_A_CACHE if k[1:] == key[1:]]
             for k in same[:-1]:
                 del _DEV_A_CACHE[k]
-            dev = jnp.asarray(A_np, dt)
+            dev = build()
         _DEV_A_CACHE[key] = dev         # re-insert = LRU touch
         while len(_DEV_A_CACHE) > 4:
             _DEV_A_CACHE.popitem(last=False)
         return dev
+
+
+def _device_A(A_src, dt, sparse="auto"):
+    import jax.numpy as jnp
+
+    from .solvers.sparse import SparseA, should_sparsify
+
+    A_np = np.asarray(A_src)
+    # large very-sparse SHARED matrices upload as SparseA: gather/
+    # segment-sum matvecs + block/Woodbury structured KKT (see
+    # tpusppy/solvers/sparse.py) — the same policy the sharded rate path
+    # applies in parallel/sharded.shard_batch.  (Checked before the
+    # small-matrix early return so tests can force sparse=True on small
+    # families.)
+    if A_np.ndim == 2 and (sparse is True or
+                           (sparse == "auto" and should_sparsify(A_np))):
+        return _cached_dev_A(
+            A_np, (A_np.shape, str(dt), "sparse"),
+            lambda: SparseA.from_dense(A_np, jnp.dtype(dt), structure=True))
+    if A_np.nbytes < 16 << 20:          # small matrices: not worth hashing
+        return jnp.asarray(A_np, dt)
+    return _cached_dev_A(A_np, (A_np.shape, str(dt)),
+                         lambda: jnp.asarray(A_np, dt))
 
 
 def clear_device_caches():
@@ -221,7 +237,11 @@ class SPOpt(SPBase):
             # shared-A batches upload the single (m, n) matrix, not the
             # (S, m, n) broadcast view (which would materialize S copies)
             A_src = b.A if getattr(b, "A_shared", None) is None else b.A_shared
-            cached = (key, (_device_A(A_src, dt), jnp.asarray(b.cl, dt),
+            sparse = self.options.get("sparse_device_A", "auto")
+            if getattr(b, "A_shared", None) is None:
+                sparse = False            # per-scenario A: dense batched path
+            cached = (key, (_device_A(A_src, dt, sparse=sparse),
+                            jnp.asarray(b.cl, dt),
                             jnp.asarray(b.cu, dt)))
             self._dev_consts = cached
         return cached[1]
