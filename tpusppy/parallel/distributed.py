@@ -138,7 +138,20 @@ def _global_scen_arrays(batch_local, S_global, owned_rows, mesh, axis,
 
     A_shared = getattr(b, "A_shared", None)
     if A_shared is not None:
-        A_arr = jnp.asarray(np.asarray(A_shared), dt)   # replicated
+        from ..solvers.sparse import SparseA, should_sparsify
+
+        An = np.asarray(A_shared)
+        if should_sparsify(An):
+            # every process builds the identical SparseA (+ structure)
+            # deterministically from the identical A, so the jitted
+            # step's pytree structure is globally consistent; the
+            # in-loop plateau exit stays multi-process-safe because its
+            # stall decision is computed INSIDE the program via
+            # collectives (unlike the host-side segment detectors,
+            # which multi-process meshes already disable)
+            A_arr = SparseA.from_dense(An, jnp.dtype(dt), structure=True)
+        else:
+            A_arr = jnp.asarray(An, dt)                 # replicated
     else:
         A_arr = mk(lambda i: np.asarray(b.A[i]), dt, (m, n))
 
