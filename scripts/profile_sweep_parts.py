@@ -1,0 +1,96 @@
+"""Decompose the sparse/structured sweep cost at UC shape on TPU.
+
+Times each component of one ADMM sweep in isolation (jitted, fetch-timed):
+block/Woodbury Kinv apply, sparse matvec + transpose, the elementwise
+z/y updates, and the full refine-k x-update — to show where the next
+speedup lives.  Pass the refine count as the third arg to match the
+configuration under study (bench_uc runs solve_refine=1).
+
+Usage: python scripts/profile_sweep_parts.py [S] [horizon]
+"""
+
+import sys
+import time
+
+import numpy as np
+
+S = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+horizon = int(sys.argv[2]) if len(sys.argv) > 2 else 24
+refine = int(sys.argv[3]) if len(sys.argv) > 3 else 1
+
+import jax
+import jax.numpy as jnp
+
+import tpusppy
+tpusppy.disable_tictoc_output()
+from tpusppy.ir import ScenarioBatch
+from tpusppy.models import uc_data
+from tpusppy.solvers import structured_kkt as sk
+from tpusppy.solvers.sparse import SparseA
+
+DATA = "/root/reference/paperruns/larger_uc/1000scenarios_wind"
+names = uc_data.scenario_names_creator(data_dir=DATA)[:4]
+kw = {"data_dir": DATA, "horizon": horizon, "relax_integers": False,
+      "num_scens": 4}
+batch = ScenarioBatch.from_problems(
+    [uc_data.scenario_creator(nm, **kw) for nm in names])
+A = np.asarray(batch.A_shared)
+m, n = A.shape
+sp = SparseA.from_dense(A, jnp.float32, structure=True)
+assert sp.structure is not None
+print(f"({m}, {n}) nnz={sp.nnz} r={sp.structure.wide_rows.shape[0]} "
+      f"S={S}", flush=True)
+
+rng = np.random.default_rng(0)
+d = jnp.asarray(rng.random(n) + 0.5, jnp.float32)
+rho = jnp.asarray(rng.random(m) + 0.5, jnp.float32)
+bw = sk.factor_structured(sp, sp.structure, d, rho, 1e-6)
+x = jnp.asarray(rng.normal(size=(S, n)), jnp.float32)
+y = jnp.asarray(rng.normal(size=(S, m)), jnp.float32)
+
+
+def bench(tag, fn, *args):
+    f = jax.jit(fn)
+    out = f(*args)
+    np.asarray(jnp.sum(out if isinstance(out, jax.Array) else out[0]))
+    reps = 20
+    t0 = time.time()
+    for _ in range(reps):
+        out = f(*args)
+    np.asarray(jnp.sum(out if isinstance(out, jax.Array) else out[0]))
+    ms = (time.time() - t0) / reps * 1e3
+    print(f"  {tag:34s} {ms:8.2f} ms", flush=True)
+    return ms
+
+
+with jax.default_matmul_precision("highest"):
+    t_kinv = bench("block/Woodbury Kinv apply", sk.kinv_apply, bw, x)
+    t_mv = bench("sparse matvec A x", lambda a, xx: a.matvec(xx), sp, x)
+    t_rmv = bench("sparse rmatvec A' y", lambda a, yy: a.rmatvec(yy), sp, y)
+
+    def elementwise(xx, yy):
+        z = jnp.clip(yy * 1.3 + 0.1, -1.0, 1.0)
+        return yy + 0.7 * (z - yy)
+
+    t_el = bench("one (S, m) clip+axpy pair", elementwise, x, y)
+
+    def kmul_free(a, xx, dd, rr):
+        return xx * dd[None, :] + a.rmatvec(a.matvec(xx) * rr[None, :])
+
+    t_kmul = bench("matrix-free Kmul (refine term)", kmul_free, sp, x, d,
+                   rho)
+
+    def full_refine_solve(a, b_, dd, rr):
+        # x-update as in _solve_shared_K (dq2 path skipped)
+        xx = sk.kinv_apply(bw, b_)
+        for _ in range(refine):
+            r_ = b_ - kmul_free(a, xx, dd, rr)
+            xx = xx + sk.kinv_apply(bw, r_)
+        return xx
+
+    t_xupd = bench(f"full x-update (refine={refine})", full_refine_solve,
+                   sp, x, d, rho)
+
+print(f"\nper-sweep estimate: x-update {t_xupd:.1f} + Axt {t_mv:.1f} + "
+      f"rhs rmv {t_rmv:.1f} + elementwise ~{4*t_el:.1f} "
+      f"= {t_xupd + t_mv + t_rmv + 4*t_el:.1f} ms", flush=True)
