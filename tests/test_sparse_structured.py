@@ -179,3 +179,36 @@ def test_spopt_wheel_path_sparse_parity():
     eobj_s, bound_s = run(True)
     assert abs(eobj_s - eobj_d) / max(1.0, abs(eobj_d)) < 1e-6
     assert abs(bound_s - bound_d) / max(1.0, abs(bound_d)) < 1e-6
+
+
+def test_structure_redetect_after_cut_augmentation():
+    """Cross-scenario cut rounds append DENSE rows to the shared A
+    (extensions/cross_scen_extension.py): the sparse upload must rebuild
+    with the cut rows classified as wide coupling rows and keep solving
+    in parity with the dense engine."""
+    A, c, cl, cu, lb, ub = _block_lp()
+    S, n = c.shape
+    rng = np.random.default_rng(7)
+    # augment: 3 dense eta-style cut rows, loose bounds
+    cuts = rng.normal(size=(3, n))
+    A2 = np.vstack([A, cuts])
+    cl2 = np.hstack([cl, np.full((S, 3), -1e3)])
+    cu2 = np.hstack([cu, np.full((S, 3), 1e3)])
+    q2 = np.zeros((S, n))
+    st = admm.ADMMSettings(max_iter=2000, restarts=3, polish=False)
+
+    sp = SparseA.from_dense(A2, jnp.float64, structure=True, min_blocks=2)
+    assert sp.structure is not None
+    # all 6 original wide + 3 cut rows must be coupling rows
+    assert sp.structure.wide_rows.shape[0] == 3 + 3
+    sol_s = shared_admm.solve_shared(c, q2, sp, cl2, cu2, lb, ub,
+                                     settings=st)
+    sol_d = shared_admm.solve_shared(c, q2, jnp.asarray(A2), cl2, cu2,
+                                     lb, ub, settings=st)
+
+    def obj(sol):
+        return np.einsum("sn,sn->s", c, np.asarray(sol.x))
+
+    rel = np.abs(obj(sol_s) - obj(sol_d)).max() / max(
+        1.0, np.abs(obj(sol_d)).max())
+    assert rel < 1e-8
