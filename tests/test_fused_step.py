@@ -1,0 +1,108 @@
+"""Fused multi-iteration PH step: trajectory parity with the step pair.
+
+The fused program (``sharded.make_ph_fused_step``) exists to make the
+headline rate latency-proof — k PH iterations per device dispatch instead
+of one (VERDICT r4: the driver capture collapsed 25x on a slow tunnel).
+It must be a pure re-packaging: same refresh cadence, bit-comparable
+trajectory to driving the (refresh, frozen) pair from the host.
+"""
+
+import numpy as np
+import pytest
+
+from tpusppy.ir import ScenarioBatch
+from tpusppy.models import farmer
+from tpusppy.parallel import sharded
+from tpusppy.solvers.admm import ADMMSettings
+
+
+def make_batch(n, **kw):
+    names = farmer.scenario_names_creator(n)
+    return ScenarioBatch.from_problems(
+        [farmer.scenario_creator(nm, num_scens=n, **kw) for nm in names]
+    )
+
+
+def _host_loop(refresh, frozen, state, arr, iters, refresh_every):
+    factors = None
+    for i in range(iters):
+        if i % refresh_every == 0:
+            state, out, factors = refresh(state, arr, 1.0)
+        else:
+            state, out = frozen(state, arr, 1.0, factors)
+    return state, out
+
+
+@pytest.mark.parametrize("shared", [False, True])
+def test_fused_matches_step_pair(shared):
+    if shared:
+        # uc_lite's uncertainty enters the rhs only -> A_shared engine
+        from tpusppy.models import uc_lite
+        names = uc_lite.scenario_names_creator(6)
+        batch = ScenarioBatch.from_problems([
+            uc_lite.scenario_creator(nm, num_scens=6, relax_integers=True)
+            for nm in names])
+        assert batch.A_shared is not None
+    else:
+        batch = make_batch(6)
+    mesh = sharded.make_mesh()
+    settings = ADMMSettings(max_iter=120, restarts=2)
+    arr = sharded.shard_batch(batch, mesh)
+    idx = batch.tree.nonant_indices
+    refresh, frozen = sharded.make_ph_step_pair(idx, settings, mesh)
+    state0 = sharded.init_state(arr, 1.0, settings)
+    state0, _, _ = refresh(state0, arr, 0.0)  # Iter0
+
+    s_ref, out_ref = _host_loop(refresh, frozen, state0, arr, 8, 4)
+
+    fused = sharded.make_ph_fused_step(idx, settings, mesh,
+                                       chunk=8, refresh_every=4)
+    s_f, out_f = fused(state0, arr, 1.0)
+
+    np.testing.assert_allclose(np.asarray(out_f.conv),
+                               np.asarray(out_ref.conv), rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(out_f.eobj),
+                               np.asarray(out_ref.eobj), rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(s_f.W), np.asarray(s_ref.W),
+                               rtol=1e-8, atol=1e-10)
+    np.testing.assert_allclose(np.asarray(s_f.xbars), np.asarray(s_ref.xbars),
+                               rtol=1e-8, atol=1e-10)
+
+
+def test_fused_single_refresh_block():
+    """chunk == refresh_every: one refresh then frozen sweeps, one program."""
+    batch = make_batch(4)
+    mesh = sharded.make_mesh()
+    settings = ADMMSettings(max_iter=120, restarts=2)
+    arr = sharded.shard_batch(batch, mesh)
+    idx = batch.tree.nonant_indices
+    refresh, frozen = sharded.make_ph_step_pair(idx, settings, mesh)
+    state0 = sharded.init_state(arr, 1.0, settings)
+    state0, _, _ = refresh(state0, arr, 0.0)
+
+    s_ref, out_ref = _host_loop(refresh, frozen, state0, arr, 5, 5)
+    fused = sharded.make_ph_fused_step(idx, settings, mesh, chunk=5)
+    s_f, out_f = fused(state0, arr, 1.0)
+    np.testing.assert_allclose(np.asarray(out_f.eobj),
+                               np.asarray(out_ref.eobj), rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(s_f.W), np.asarray(s_ref.W),
+                               rtol=1e-8, atol=1e-10)
+
+
+def test_fused_chunk_must_divide():
+    with pytest.raises(ValueError):
+        sharded.make_ph_fused_step(np.arange(3), ADMMSettings(),
+                                   chunk=10, refresh_every=4)
+
+
+def test_fused_iteration_cap_regimes():
+    mesh = sharded.make_mesh()
+    settings = ADMMSettings(max_iter=200, restarts=2)
+    small = sharded.shard_batch(make_batch(8), mesh)
+    cap = sharded.fused_iteration_cap(small, settings, mesh, refresh_every=16)
+    assert cap >= 16 and cap % 16 == 0
+    # reference-UC-scale shapes must refuse to fuse (worker watchdog)
+    huge = int(
+        sharded.segmented_solvers.fused_iteration_budget(
+            1000, 16008, 12408, settings, 16, factor_batch=1))
+    assert huge == 0

@@ -49,6 +49,19 @@ def _dispatch_segments(S, n, m, st: ADMMSettings, factor_batch=1,
         sparse_factor=sparse_factor)
 
 
+def _dispatch_model_params(arr, mesh):
+    """(S_dev, n, m, factor_batch, sparse_factor) for the dispatch flop
+    model — single source for _segments_for and fused_iteration_cap."""
+    S, n = arr.c.shape
+    m = arr.cl.shape[1]
+    ndev = 1 if mesh is None else len(mesh.devices.flat)
+    S_dev = -(-S // ndev)          # per-device shard does the sweeping
+    dense = arr.A.ndim == 3
+    sf = (segmented_solvers.SPARSE_DISPATCH_FACTOR
+          if isinstance(arr.A, SparseA) else 1.0)
+    return S_dev, n, m, (S_dev if dense else 1), sf
+
+
 class PHArrays(NamedTuple):
     """Device-resident, scenario-sharded problem data + tree indexing.
 
@@ -114,6 +127,77 @@ def _gather_per_scenario(xbar_nk, nid_sk):
     return xbar_nk[nid_sk, kidx]
 
 
+def _solver_fns_for(st: ADMMSettings, mesh, axis):
+    """(shared_refresh, shared_frozen, dense_refresh, dense_frozen) for one
+    settings variant; dense fns are shard_mapped when on a mesh."""
+
+    def shared_refresh(q, q2, A, cl, cu, lb, ub, x, z, y, yx):
+        with jax.default_matmul_precision(st.matmul_precision):
+            return shared_admm._solve_shared_impl(
+                q, q2, A, cl, cu, lb, ub, st, (x, z, y, yx),
+                want_factors=True)
+
+    def shared_frozen(q, q2, A, cl, cu, lb, ub, x, z, y, yx, factors):
+        with jax.default_matmul_precision(st.matmul_precision):
+            return shared_admm._solve_shared_frozen_impl(
+                q, q2, A, cl, cu, lb, ub, factors, (x, z, y, yx), st)
+
+    def local_refresh(q, q2, A, cl, cu, lb, ub, x, z, y, yx):
+        with jax.default_matmul_precision(st.matmul_precision):
+            return admm._solve_impl(
+                q, q2, A, cl, cu, lb, ub, st, (x, z, y, yx),
+                want_factors=True)
+
+    def local_frozen(q, q2, A, cl, cu, lb, ub, x, z, y, yx, factors):
+        with jax.default_matmul_precision(st.matmul_precision):
+            return admm._solve_frozen_impl(
+                q, q2, A, cl, cu, lb, ub, factors, (x, z, y, yx), st)
+
+    if mesh is not None:
+        sp = jax.sharding.PartitionSpec(axis)
+        sol_spec = admm.BatchSolution(*([sp] * 8), raw=(sp, sp, sp, sp))
+        fac_spec = admm.Factors(*([sp] * 7))
+        refresh_solve = jax.shard_map(
+            local_refresh, mesh=mesh, in_specs=(sp,) * 11,
+            out_specs=(sol_spec, fac_spec), check_vma=False,
+        )
+        frozen_solve = jax.shard_map(
+            local_frozen, mesh=mesh,
+            in_specs=(sp,) * 11 + (fac_spec,),
+            out_specs=sol_spec, check_vma=False,
+        )
+    else:
+        refresh_solve, frozen_solve = local_refresh, local_frozen
+    return shared_refresh, shared_frozen, refresh_solve, frozen_solve
+
+
+def _ph_objective(arr, state, prox_on, idx, settings):
+    dt = settings.jdtype()
+    W, xbars, rho = (state.W.astype(dt), state.xbars.astype(dt),
+                     state.rho.astype(dt))
+    prox_on = jnp.asarray(prox_on, dt)
+    q = arr.c.astype(dt).at[:, idx].add(W - prox_on * rho * xbars)
+    q2 = arr.q2.astype(dt).at[:, idx].add(prox_on * rho)
+    return q, q2, W, rho
+
+
+def _ph_finish(arr, state, sol, W, rho, idx):
+    xk = sol.x[:, idx]
+    xbar_nk, _ = _node_xbar(arr.onehot, arr.probs, xk)
+    new_xbars = _gather_per_scenario(xbar_nk, arr.nid_sk)
+    new_W = W + rho * (xk - new_xbars)
+    dev = jnp.abs(xk - new_xbars).mean(axis=1)
+    conv = arr.probs @ dev
+    lin = jnp.einsum("sn,sn->s", arr.c, sol.x)
+    quad = 0.5 * jnp.einsum("sn,sn->s", arr.q2, sol.x * sol.x)
+    eobj = arr.probs @ (lin + quad + arr.const)
+    new_state = PHState(
+        W=new_W, xbars=new_xbars, rho=rho,
+        x=sol.x, z=sol.z, y=sol.y, yx=sol.yx,
+    )
+    return new_state, PHStepOut(conv, eobj, sol.pri_res, sol.dua_res)
+
+
 def make_ph_step(nonant_idx: np.ndarray, settings: ADMMSettings,
                  mesh: Mesh | None = None, axis: str = "scen"):
     """Back-compat single-step API: the adaptive (refresh) step of
@@ -154,75 +238,16 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
     idx = jnp.asarray(nonant_idx)
 
     def _solver_fns(st: ADMMSettings):
-        """(shared_refresh, shared_frozen, dense_refresh, dense_frozen) for
-        one settings variant; dense fns are shard_mapped when on a mesh."""
-
-        def shared_refresh(q, q2, A, cl, cu, lb, ub, x, z, y, yx):
-            with jax.default_matmul_precision(st.matmul_precision):
-                return shared_admm._solve_shared_impl(
-                    q, q2, A, cl, cu, lb, ub, st, (x, z, y, yx),
-                    want_factors=True)
-
-        def shared_frozen(q, q2, A, cl, cu, lb, ub, x, z, y, yx, factors):
-            with jax.default_matmul_precision(st.matmul_precision):
-                return shared_admm._solve_shared_frozen_impl(
-                    q, q2, A, cl, cu, lb, ub, factors, (x, z, y, yx), st)
-
-        def local_refresh(q, q2, A, cl, cu, lb, ub, x, z, y, yx):
-            with jax.default_matmul_precision(st.matmul_precision):
-                return admm._solve_impl(
-                    q, q2, A, cl, cu, lb, ub, st, (x, z, y, yx),
-                    want_factors=True)
-
-        def local_frozen(q, q2, A, cl, cu, lb, ub, x, z, y, yx, factors):
-            with jax.default_matmul_precision(st.matmul_precision):
-                return admm._solve_frozen_impl(
-                    q, q2, A, cl, cu, lb, ub, factors, (x, z, y, yx), st)
-
-        if mesh is not None:
-            sp = jax.sharding.PartitionSpec(axis)
-            sol_spec = admm.BatchSolution(*([sp] * 8), raw=(sp, sp, sp, sp))
-            fac_spec = admm.Factors(*([sp] * 7))
-            refresh_solve = jax.shard_map(
-                local_refresh, mesh=mesh, in_specs=(sp,) * 11,
-                out_specs=(sol_spec, fac_spec), check_vma=False,
-            )
-            frozen_solve = jax.shard_map(
-                local_frozen, mesh=mesh,
-                in_specs=(sp,) * 11 + (fac_spec,),
-                out_specs=sol_spec, check_vma=False,
-            )
-        else:
-            refresh_solve, frozen_solve = local_refresh, local_frozen
-        return shared_refresh, shared_frozen, refresh_solve, frozen_solve
+        return _solver_fns_for(st, mesh, axis)
 
     shared_refresh, shared_frozen, refresh_solve, frozen_solve = \
         _solver_fns(settings)
 
     def _objective(arr, state, prox_on):
-        dt = settings.jdtype()
-        W, xbars, rho = (state.W.astype(dt), state.xbars.astype(dt),
-                         state.rho.astype(dt))
-        prox_on = jnp.asarray(prox_on, dt)
-        q = arr.c.astype(dt).at[:, idx].add(W - prox_on * rho * xbars)
-        q2 = arr.q2.astype(dt).at[:, idx].add(prox_on * rho)
-        return q, q2, W, rho
+        return _ph_objective(arr, state, prox_on, idx, settings)
 
     def _finish(arr, state, sol, W, rho):
-        xk = sol.x[:, idx]
-        xbar_nk, _ = _node_xbar(arr.onehot, arr.probs, xk)
-        new_xbars = _gather_per_scenario(xbar_nk, arr.nid_sk)
-        new_W = W + rho * (xk - new_xbars)
-        dev = jnp.abs(xk - new_xbars).mean(axis=1)
-        conv = arr.probs @ dev
-        lin = jnp.einsum("sn,sn->s", arr.c, sol.x)
-        quad = 0.5 * jnp.einsum("sn,sn->s", arr.q2, sol.x * sol.x)
-        eobj = arr.probs @ (lin + quad + arr.const)
-        new_state = PHState(
-            W=new_W, xbars=new_xbars, rho=rho,
-            x=sol.x, z=sol.z, y=sol.y, yx=sol.yx,
-        )
-        return new_state, PHStepOut(conv, eobj, sol.pri_res, sol.dua_res)
+        return _ph_finish(arr, state, sol, W, rho, idx)
 
     @jax.jit
     def refresh_step_1(state: PHState, arr: PHArrays, prox_on):
@@ -315,15 +340,9 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
         return seg_cache[key]
 
     def _segments_for(arr):
-        S, n = arr.c.shape
-        m = arr.cl.shape[1]
-        ndev = 1 if mesh is None else len(mesh.devices.flat)
-        S_dev = -(-S // ndev)          # per-device shard does the sweeping
-        dense = arr.A.ndim == 3
-        sf = (segmented_solvers.SPARSE_DISPATCH_FACTOR
-              if isinstance(arr.A, SparseA) else 1.0)
+        S_dev, n, m, factor_batch, sf = _dispatch_model_params(arr, mesh)
         return _dispatch_segments(S_dev, n, m, settings,
-                                  factor_batch=S_dev if dense else 1,
+                                  factor_batch=factor_batch,
                                   sparse_factor=sf)
 
     # A mesh spanning several processes cannot make data-dependent host
@@ -380,6 +399,95 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
         return new_state, out
 
     return refresh_step, frozen_step
+
+
+def fused_iteration_cap(arr: PHArrays, settings: ADMMSettings,
+                        mesh: Mesh | None = None,
+                        refresh_every: int = 16) -> int:
+    """Max PH iterations safely fusable into ONE device program for these
+    shapes (a multiple of ``refresh_every``; 0 = do not fuse).
+
+    Sized with the same flop model as :func:`dispatch_segments` against the
+    remote worker's ~60 s execution kill; shapes that need segmentation get
+    0 and must use the step pair.
+    """
+    S_dev, n, m, factor_batch, sf = _dispatch_model_params(arr, mesh)
+    return segmented_solvers.fused_iteration_budget(
+        S_dev, n, m, settings, refresh_every,
+        factor_batch=factor_batch,
+        eff_flops=_DISPATCH_EFF_FLOPS, target_secs=_DISPATCH_TARGET_SECS,
+        sparse_factor=sf)
+
+
+def make_ph_fused_step(nonant_idx: np.ndarray, settings: ADMMSettings,
+                       mesh: Mesh | None = None, axis: str = "scen",
+                       chunk: int = 16, refresh_every: int | None = None):
+    """ONE jitted program running ``chunk`` PH iterations — the latency-proof
+    headline path.
+
+    The step pair (:func:`make_ph_step_pair`) pays one device dispatch per PH
+    iteration; over a remote tunnel each dispatch is a serial RPC, and for
+    small programs (farmer: S=1000, n=44) the RPC dominates — the measured
+    rate collapses ~25x when the tunnel is slow.  This factory fuses the
+    whole refresh cadence into one program: an adaptive refresh (Ruiz + rho
+    adaptation + factorization) at iteration 0 and every ``refresh_every``
+    after it, frozen factor-reusing sweeps in between, all inside nested
+    ``lax.scan`` — so ``chunk`` PH iterations cost ONE dispatch.  Identical
+    trajectory to driving the step pair from the host with the same cadence
+    (tests assert this).
+
+    This replaces the reference's per-iteration solve round-trip
+    (``mpisppy/spopt.py:226-307``: one ``solve()`` per rank per iteration,
+    every iteration a fresh host<->solver exchange) with a single compiled
+    multi-iteration program — the XLA-native amortization.
+
+    ``refresh_every`` defaults to ``chunk`` (one refresh at the top).
+    ``chunk`` must be a multiple of ``refresh_every``.  Callers must size
+    ``chunk`` within :func:`fused_iteration_cap` — a fused program past the
+    worker watchdog is killed mid-flight, which the host cannot recover.
+
+    Returns ``fused(state, arr, prox_on) -> (state, out)`` where ``out`` is
+    the LAST iteration's :class:`PHStepOut`.
+    """
+    if refresh_every is None:
+        refresh_every = chunk
+    if chunk % refresh_every != 0:
+        raise ValueError(
+            f"chunk ({chunk}) must be a multiple of refresh_every "
+            f"({refresh_every})")
+    n_blocks = chunk // refresh_every
+    idx = jnp.asarray(nonant_idx)
+    shared_refresh, shared_frozen, refresh_solve, frozen_solve = \
+        _solver_fns_for(settings, mesh, axis)
+
+    @jax.jit
+    def fused(state: PHState, arr: PHArrays, prox_on):
+        def block(state, _):
+            q, q2, W, rho = _ph_objective(arr, state, prox_on, idx, settings)
+            rsolve = shared_refresh if arr.A.ndim == 2 else refresh_solve
+            sol, factors = rsolve(
+                q, q2, arr.A, arr.cl, arr.cu, arr.lb, arr.ub,
+                state.x, state.z, state.y, state.yx)
+            state, out = _ph_finish(arr, state, sol, W, rho, idx)
+
+            def frozen_iter(st, _):
+                q, q2, W, rho = _ph_objective(arr, st, prox_on, idx,
+                                              settings)
+                fsolve = shared_frozen if arr.A.ndim == 2 else frozen_solve
+                sol = fsolve(q, q2, arr.A, arr.cl, arr.cu, arr.lb, arr.ub,
+                             st.x, st.z, st.y, st.yx, factors)
+                return _ph_finish(arr, st, sol, W, rho, idx)
+
+            if refresh_every > 1:
+                state, outs = jax.lax.scan(
+                    frozen_iter, state, None, length=refresh_every - 1)
+                out = jax.tree.map(lambda a: a[-1], outs)
+            return state, out
+
+        state, outs = jax.lax.scan(block, state, None, length=n_blocks)
+        return state, jax.tree.map(lambda a: a[-1], outs)
+
+    return fused
 
 
 def dispatch_window(mesh: Mesh) -> int:

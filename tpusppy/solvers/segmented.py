@@ -81,6 +81,34 @@ def dispatch_segments(S, n, m, st, factor_batch=1,
     return seg_r, seg_f
 
 
+def fused_iteration_budget(S, n, m, st, refresh_every, factor_batch=1,
+                           eff_flops=None, target_secs=None,
+                           sparse_factor=1.0):
+    """Max PH iterations fusable into ONE device program (multiple of
+    ``refresh_every``; 0 = don't fuse — the shape needs segmentation).
+
+    Worst-case accounting on the :func:`dispatch_segments` flop model: every
+    frozen iteration burns its full ``max_iter`` sweep budget (the
+    while_loop usually exits earlier — this is the safety bound, not the
+    expectation), every refresh runs ``restarts`` adaptation rounds plus the
+    factorizations.  One block = 1 refresh + (refresh_every-1) frozen
+    iterations; as many whole blocks as fit ``target_secs``.
+    """
+    eff = _DISPATCH_EFF_FLOPS if eff_flops is None else eff_flops
+    target = _DISPATCH_TARGET_SECS if target_secs is None else target_secs
+    t_sweep = S * (n * float(n) + 2.0 * n * m) * 2.0 / eff * sparse_factor
+    t_factor = factor_batch * (m * float(n) * n + 3.0 * float(n) ** 3) \
+        * 2.0 / eff * sparse_factor
+    rst = max(1, st.restarts)
+    t_frozen_iter = st.max_iter * t_sweep
+    # the adaptive solve factorizes once PER RESTART (admm._solve_scaled's
+    # restart scan calls _factor each round), matching dispatch_segments'
+    # per-restart budget accounting
+    t_refresh_iter = rst * (st.max_iter * t_sweep + t_factor)
+    t_block = t_refresh_iter + (refresh_every - 1) * t_frozen_iter
+    return int(target / max(t_block, 1e-12)) * refresh_every
+
+
 # measured 2-4x cheaper sweeps on the SparseA/block-Woodbury path vs the
 # dense flop accounting at reference-UC shapes; 0.25 keeps worst-case
 # dispatches inside the worker watchdog with the same 2x margin (see
