@@ -419,7 +419,7 @@ def uc_metrics():
         "model": model_name,
         "wheel_S": S_wheel,
         "ph_iters_per_sec": round(iters_per_sec, 4),
-            "plateau_window": plateau_window,
+        "plateau_window": plateau_window,
         "h48_ph_iters_per_sec": (round(h48_rate, 4)
                                  if h48_rate else None),
         "vs_baseline": round(iters_per_sec / base_ips, 2),

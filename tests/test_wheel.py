@@ -22,6 +22,31 @@ from tpusppy.spin_the_wheel import WheelSpinner
 from tpusppy.xhat_eval import Xhat_Eval
 
 
+def test_rel_gap_terminates_with_zero_outer_bound():
+    """A legitimately-zero outer bound must still terminate on rel_gap
+    (ref hub.py:125-161); the old 0.0-exclusion returned inf forever."""
+    from tpusppy.cylinders.hub import Hub
+
+    h = Hub.__new__(Hub)
+    h.options = {"rel_gap": 1e-4}
+
+    class _Opt:
+        is_minimizing = True
+
+    h.opt = _Opt()
+    h.BestInnerBound = 5e-6
+    h.BestOuterBound = 0.0
+    h.last_gap = np.inf
+    h.stalled_iter_cnt = 0
+    abs_gap, rel_gap = h.compute_gaps()
+    assert abs_gap == pytest.approx(5e-6)
+    assert np.isfinite(rel_gap)
+    assert h.determine_termination()
+    # and a genuinely-open gap at a zero bound must NOT terminate
+    h.BestInnerBound = 1.0
+    assert not h.determine_termination()
+
+
 def test_mailbox_write_id_protocol():
     mb = Mailbox(3)
     data, wid = mb.get()

@@ -81,9 +81,13 @@ class Hub(SPCommunicator):
             abs_gap = self.BestInnerBound - self.BestOuterBound
         else:
             abs_gap = self.BestOuterBound - self.BestInnerBound
-        if np.isfinite(abs_gap) and self.BestOuterBound not in (0.0,) \
-                and np.isfinite(self.BestOuterBound):
-            rel_gap = abs_gap / abs(self.BestOuterBound)
+        if np.isfinite(abs_gap) and np.isfinite(self.BestOuterBound):
+            # a legitimately-zero outer bound (optimum at 0) falls back to
+            # the absolute gap as the "relative" gap so rel_gap termination
+            # still fires; the reference (hub.py:88-97) returns inf there
+            # and can never terminate on rel_gap.  Nonzero bounds keep the
+            # reference's convention exactly.
+            rel_gap = abs_gap / (abs(self.BestOuterBound) or 1.0)
         else:
             rel_gap = inf
         return abs_gap, rel_gap

@@ -37,8 +37,11 @@ from ..solvers.sparse import SparseA
 # shared mechanism.  The constants live here too so tests can monkeypatch
 # this module's copies; _dispatch_segments forwards them explicitly.
 # ---------------------------------------------------------------------------
-_DISPATCH_TARGET_SECS = segmented_solvers._DISPATCH_TARGET_SECS
-_DISPATCH_EFF_FLOPS = segmented_solvers._DISPATCH_EFF_FLOPS
+# None = defer to segmented's defaults (including its per-scenario-dense
+# throughput clamp); tests monkeypatch these with explicit numbers to force
+# dispatch regimes — explicit values are authoritative, never clamped
+_DISPATCH_TARGET_SECS = None
+_DISPATCH_EFF_FLOPS = None
 
 
 def _dispatch_segments(S, n, m, st: ADMMSettings, factor_batch=1,
@@ -596,6 +599,11 @@ def shard_batch(batch, mesh: Mesh, axis: str = "scen",
         from ..solvers.sparse import should_sparsify
         use_sparse = (sparse is True) or (
             sparse == "auto" and row_axis is None and should_sparsify(An))
+        if sparse is True and row_axis is not None:
+            raise ValueError(
+                "sparse=True is incompatible with a 2-D row-sharded mesh: "
+                "the row axis needs the dense (m, n) layout — use the 1-D "
+                "mesh for the SparseA engine or sparse='auto'")
         if row_axis is not None:
             A_dev = put(pad_rows(An, 0),
                         NamedSharding(mesh, P(row_axis, None)))

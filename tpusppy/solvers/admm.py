@@ -351,7 +351,10 @@ def _plateau_update(s, pri, dua, prinorm, duanorm, st: ADMMSettings,
     excess = jnp.clip(jnp.nan_to_num(excess, nan=1e6, posinf=1e6), 1.0, 1e6)
     gmean = jnp.exp(jnp.mean(jnp.log(excess)))
     ck = max(1, st.check_every)
-    period = max(1, st.sweep_plateau_window // ck)
+    # ceil-divide: a window below (or not a multiple of) check_every must
+    # round UP to the next checkpoint, not silently shrink the effective
+    # window and fire the exit earlier than configured
+    period = max(1, -(-st.sweep_plateau_window // ck))
     due = (((s.k // ck) + 1) % period == 0) & (s.k >= min_k)
     # near-eps grace: once the batch gmean sits within rtol of eps the
     # >=1 floor makes fractional improvement unmeasurable, so a batch 2

@@ -38,6 +38,23 @@ _DISPATCH_TARGET_SECS = 30.0
 # reference-UC shapes on v5e (48.8 ms/sweep at S=256, n=16008, m=12408,
 # solve_refine=2); 6e12 keeps ~15% conservatism
 _DISPATCH_EFF_FLOPS = 6e12
+# the 6.9-7.7e12 evidence is all SHARED-A shapes; the per-scenario dense
+# path (batched small factorizations, factor_batch=S) has no measured
+# sweep times at watchdog-relevant scale, so it keeps the pre-raise
+# conservative constant — dispatch_segments clamps to this when
+# factor_batch > 1
+_DISPATCH_EFF_FLOPS_DENSE = 4e12
+
+
+def _dense_clamped_eff(eff_flops, factor_batch):
+    """Default throughput, dense-clamped.  An EXPLICIT eff_flops stays
+    authoritative (callers/tests monkeypatch the module constants to force
+    dispatch regimes); only the defaults get the per-scenario-dense clamp."""
+    if eff_flops is not None:
+        return eff_flops
+    if factor_batch > 1:
+        return min(_DISPATCH_EFF_FLOPS, _DISPATCH_EFF_FLOPS_DENSE)
+    return _DISPATCH_EFF_FLOPS
 
 
 def dispatch_segments(S, n, m, st, factor_batch=1,
@@ -58,7 +75,7 @@ def dispatch_segments(S, n, m, st, factor_batch=1,
     (which always burns its first ``check_every`` sweeps) is
     indistinguishable from an unconverged one.
     """
-    eff = _DISPATCH_EFF_FLOPS if eff_flops is None else eff_flops
+    eff = _dense_clamped_eff(eff_flops, factor_batch)
     target = _DISPATCH_TARGET_SECS if target_secs is None else target_secs
     ce = max(1, st.check_every)
     # ``sparse_factor``: scale applied by SparseA callers — sweeps there
@@ -94,7 +111,7 @@ def fused_iteration_budget(S, n, m, st, refresh_every, factor_batch=1,
     factorizations.  One block = 1 refresh + (refresh_every-1) frozen
     iterations; as many whole blocks as fit ``target_secs``.
     """
-    eff = _DISPATCH_EFF_FLOPS if eff_flops is None else eff_flops
+    eff = _dense_clamped_eff(eff_flops, factor_batch)
     target = _DISPATCH_TARGET_SECS if target_secs is None else target_secs
     t_sweep = S * (n * float(n) + 2.0 * n * m) * 2.0 / eff * sparse_factor
     t_factor = factor_batch * (m * float(n) * n + 3.0 * float(n) ** 3) \
@@ -208,6 +225,13 @@ def solve_factored_segmented(frozen_fn, factored_fn, args, settings,
 
     Equivalent to ``factored_fn(*args, settings=settings, warm=warm)`` for
     shapes that fit one dispatch.  Returns (sol, factors, converged).
+
+    SINGLE-CONTROLLER ONLY: the ``converged`` flag (and the continuation's
+    defaults) fetch scenario-sharded device data, which raises on a
+    multi-controller mesh with non-addressable shards — and even local-shard
+    votes could disagree across processes and deadlock the collectives.
+    Multi-controller callers drive the jitted sharded step with a
+    deterministic schedule instead (see :func:`continue_frozen`).
     """
     S, n, m = _shapes(args, shared)
     seg_r, seg_f = dispatch_segments(S, n, m, settings,
@@ -241,6 +265,10 @@ def solve_frozen_segmented(frozen_fn, args, factors, settings, warm=None):
     iters-vs-cap compare: iters reflects only the LAST segment's counter,
     and the in-loop plateau exit (``sweep_plateau_rtol``) leaves the sweep
     loop early without convergence.
+
+    SINGLE-CONTROLLER ONLY — same contract as
+    :func:`solve_factored_segmented`: the convergence fetch and the
+    data-dependent continuation need addressable shards.
     """
     shared = getattr(args[2], "ndim", None) == 2
     S, n, m = _shapes(args, shared)
