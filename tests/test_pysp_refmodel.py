@@ -99,3 +99,64 @@ def test_load_reference_model_restores_modules():
     before = sys.modules.get("pyomo")
     load_reference_model(os.path.join(SHIM_DIR, "ReferenceModel.py"))
     assert sys.modules.get("pyomo") is before
+
+
+def test_mutable_param_post_assignment():
+    """Pyomo semantics: mutable params assigned AFTER create_instance are
+    seen by the solve (rules re-evaluate at to_problem)."""
+    from tpusppy.utils.pysp_model.abstract_model import (
+        AbstractModel, Constraint, Objective, Param, Var)
+
+    m = AbstractModel()
+    m.x = Var()
+    m.p = Param(mutable=True, initialize=1.0)
+    m.c = Constraint(rule=lambda mm: mm.x >= mm.p)
+    m.o = Objective(rule=lambda mm: mm.x)
+    inst = m.create_instance()
+    assert inst.p.value == 1.0
+    inst.p.value = 7.5
+    prob = inst.to_problem("s")
+    # constraint lower bound must reflect the POST-assignment value
+    from tpusppy.ir import ScenarioBatch
+
+    batch = ScenarioBatch.from_problems([_with_root(prob)])
+    obj, x = solve_ef(batch, solver="highs")
+    assert obj == pytest.approx(7.5, abs=1e-9)
+
+
+def _with_root(prob):
+    """Attach a trivial root node over all variables (EF plumbing)."""
+    from tpusppy.scenario_tree import ScenarioNode
+
+    prob.nodes = [ScenarioNode("ROOT", 1.0, 1,
+                               np.arange(len(prob.var_names or [0]),
+                                         dtype=np.int32))]
+    prob.prob = 1.0
+    return prob
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_DIR),
+                    reason="reference checkout not present")
+def test_reference_callback_fixture():
+    """The reference's pysp_instance_creation_callback fixture
+    (instance_factory.py:200-360 discovery): mutable param set per
+    scenario AFTER create_instance; EF = max_s p_s = 3.0."""
+    m, batch = _pysp_batch(
+        os.path.join(REF_DIR, "reference_test_model_with_callback.py"),
+        os.path.join(REF_DIR, "reference_test_scenario_tree.dat"))
+    assert m.all_scenario_names == ["s1", "s2", "s3"]
+    obj, _ = solve_ef(batch, solver="highs")
+    assert obj == pytest.approx(3.0, abs=1e-6)
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_DIR),
+                    reason="reference checkout not present")
+def test_reference_both_callbacks_fixture():
+    """both_callbacks.py: the scenario TREE also comes from a callback (a
+    networkx DiGraph) — no ScenarioStructure.dat at all."""
+    m = PySPModel(os.path.join(REF_DIR, "both_callbacks.py"))
+    assert sorted(m.all_scenario_names) == ["s1", "s2", "s3"]
+    batch = ScenarioBatch.from_problems(
+        [m.scenario_creator(nm) for nm in m.all_scenario_names])
+    obj, _ = solve_ef(batch, solver="highs")
+    assert obj == pytest.approx(3.0, abs=1e-6)

@@ -130,6 +130,67 @@ def value(v):
     return float(v)
 
 
+class _MutableParam:
+    """Scalar ``Param(mutable=True)``: an object with a ``.value`` slot, so
+    the PySP callback idiom ``instance.p.value = 2.0`` works
+    (instance_factory fixtures set mutable params AFTER create_instance and
+    before the solve).  Honored because rule lowering re-reads values at
+    ``to_problem`` time (:meth:`_Instance._rebuild_rules`)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = float(value)
+
+    def __float__(self):
+        return float(self.value)
+
+    def __int__(self):
+        return int(self.value)
+
+    def __repr__(self):
+        return f"_MutableParam({self.value})"
+
+    def __add__(self, o):
+        return float(self) + o if isinstance(o, numbers.Number) \
+            else NotImplemented
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return float(self) - o if isinstance(o, numbers.Number) \
+            else NotImplemented
+
+    def __rsub__(self, o):
+        return o - float(self)
+
+    def __mul__(self, o):
+        return float(self) * o if isinstance(o, numbers.Number) \
+            else NotImplemented
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return float(self) / o
+
+    def __rtruediv__(self, o):
+        return o / float(self)
+
+    def __neg__(self):
+        return -float(self)
+
+    def __le__(self, o):
+        return LinExpr({}, float(self)).__le__(o)
+
+    def __ge__(self, o):
+        return LinExpr({}, float(self)).__ge__(o)
+
+
+# LinExpr.of / the linearity checks accept any numbers.Number; a mutable
+# param IS a number that happens to be settable
+numbers.Number.register(_MutableParam)
+
+
 # ---------------------------------------------------------------------------
 # domains
 # ---------------------------------------------------------------------------
@@ -319,6 +380,8 @@ class _Instance:
         self._vars = {}      # name -> (keys, lb, ub, integer) per flat key
         self._var_order = []
         self._cons = []      # (name, Relation)
+        self._rule_decls = []
+        self._has_mutable = False
         self._objective = None
         self._obj_sense = minimize
         get = data.get if hasattr(data, "get") else lambda k, d=None: d
@@ -347,16 +410,35 @@ class _Instance:
                 setattr(self, comp.name, vals)
             elif isinstance(comp, Param):
                 self._build_param(comp, data)
-            elif isinstance(comp, Var):
+            elif isinstance(comp, (Var, Expression, Constraint, Objective)):
+                # value-consuming components are REBUILDABLE: mutable
+                # params may be assigned between create_instance and the
+                # solve (the PySP callback idiom), so to_problem
+                # re-evaluates vars (bounds rules!) and every rule against
+                # current values (_rebuild_rules)
+                self._rule_decls.append(comp)
+            else:
+                raise TypeError(f"unsupported component {comp!r}")
+        self._rebuild_rules()
+
+    def _rebuild_rules(self):
+        """(Re-)evaluate var bounds, expressions, constraints and the
+        objective in declaration order against the CURRENT param values —
+        Pyomo semantics for ``mutable=True`` params updated after
+        ``create_instance`` (bounds included: Pyomo resolves them at
+        solve time)."""
+        self._cons = []
+        self._var_order = []
+        self._objective = None
+        for comp in self._rule_decls:
+            if isinstance(comp, Var):
                 self._build_var(comp)
             elif isinstance(comp, Expression):
                 self._build_expression(comp)
             elif isinstance(comp, Constraint):
                 self._build_constraint(comp)
-            elif isinstance(comp, Objective):
-                self._build_objective(comp)
             else:
-                raise TypeError(f"unsupported component {comp!r}")
+                self._build_objective(comp)
 
     # ---- components -----------------------------------------------------
     def _build_param(self, comp, data):
@@ -374,6 +456,9 @@ class _Instance:
                 v = default
             else:
                 raise ValueError(f"no value for scalar Param {comp.name}")
+            if kw.get("mutable"):
+                v = _MutableParam(float(v))
+                self._has_mutable = True
             setattr(self, comp.name, v)
             return
         keys = _index_product(sets)
@@ -395,6 +480,11 @@ class _Instance:
                 items[k] = default
             else:
                 raise ValueError(f"no value for Param {comp.name}[{k}]")
+        if kw.get("mutable"):
+            # the _ParamView dict is LIVE — `inst.d[k] = v` updates it in
+            # place and rules re-read it — so post-assignment honoring only
+            # needs the rebuild flag
+            self._has_mutable = True
         setattr(self, comp.name, _ParamView(items, default))
 
     def _build_var(self, comp):
@@ -461,7 +551,17 @@ class _Instance:
 
     # ---- lowering -------------------------------------------------------
     def to_problem(self, name=None):
-        """Lower to a :class:`tpusppy.ir.ScenarioProblem`."""
+        """Lower to a :class:`tpusppy.ir.ScenarioProblem`.
+
+        Rules are re-evaluated first so mutable-param assignments made
+        after ``create_instance`` (``instance.p.value = ...``, the PySP
+        callback idiom) are reflected — matching Pyomo, where expressions
+        hold the param OBJECT and see its current value at solve time.
+        Models without mutable params skip the rebuild (rule evaluation
+        over index products dominates build time at family scale).
+        """
+        if self._has_mutable:
+            self._rebuild_rules()
         from ...ir import LinearModelBuilder
 
         b = LinearModelBuilder(name or self.name)
@@ -493,13 +593,12 @@ def _val(inst, v):
 # model-file loading (the instance_factory entry)
 # ---------------------------------------------------------------------------
 
-def load_reference_model(path):
+def load_reference_module(path):
     """Execute a PySP ``ReferenceModel.py`` with ``pyomo.environ`` mapped to
-    this shim; returns the declared AbstractModel (conventionally named
-    ``model``, else the unique AbstractModel global).
-
-    Reference analogue: instance_factory.py:1-120 (which imports the real
-    Pyomo); only the linear PySP modeling subset is honored here.
+    this shim; returns the module NAMESPACE (model + any PySP callbacks:
+    ``pysp_instance_creation_callback``,
+    ``pysp_scenario_tree_model_callback`` — instance_factory.py:200-360
+    discovers the same names).
     """
     import sys
     import types
@@ -530,21 +629,37 @@ def load_reference_model(path):
                 sys.modules.pop(k, None)
             else:
                 sys.modules[k] = v
+    return ns
+
+
+def load_reference_model(path):
+    """The declared AbstractModel of a ReferenceModel.py (conventionally
+    named ``model``, else the unique AbstractModel global)."""
+    return _model_from_ns(load_reference_module(path), path)
+
+
+def _model_from_ns(ns, where):
     mdl = ns.get("model")
     if not isinstance(mdl, AbstractModel):
         cands = [v for v in ns.values() if isinstance(v, AbstractModel)]
         if len(cands) != 1:
             raise ValueError(
-                f"{path} must declare exactly one AbstractModel "
+                f"{where} must declare exactly one AbstractModel "
                 "(conventionally named 'model')")
         mdl = cands[0]
     return mdl
 
 
-def reference_model_creator(path):
+def reference_model_creator(path_or_model):
     """``instance_creator(data, scenario_name)`` for a ReferenceModel.py —
-    plugs straight into :class:`~tpusppy.utils.pysp_model.PySPModel`."""
-    mdl = load_reference_model(path)
+    plugs straight into :class:`~tpusppy.utils.pysp_model.PySPModel`.
+    Accepts a path OR an already-loaded AbstractModel (so callers that ran
+    ``load_reference_module`` for callback discovery don't execute the
+    user's module — and its side effects — twice)."""
+    if isinstance(path_or_model, AbstractModel):
+        mdl = path_or_model
+    else:
+        mdl = load_reference_model(path_or_model)
 
     def creator(data, scenario_name):
         return mdl.create_instance(data, scenario_name).to_problem(

@@ -44,19 +44,54 @@ class PySPModel:
       file's directory).
     """
 
-    def __init__(self, instance_creator, scenario_structure, data_dir=None,
-                 param_arity=None):
+    def __init__(self, instance_creator, scenario_structure=None,
+                 data_dir=None, param_arity=None):
+        self._callback = None
         if isinstance(instance_creator, (str, os.PathLike)):
             instance_creator = os.fspath(instance_creator)
             # a path to an actual Pyomo ReferenceModel.py: ingest it through
             # the restricted AbstractModel shim (abstract_model.py) — old
             # PySP models run unchanged, like the reference's
-            # instance_factory.py does with real Pyomo
-            from .abstract_model import reference_model_creator
+            # instance_factory.py does with real Pyomo.  The module's PySP
+            # callbacks are discovered by name, exactly like
+            # instance_factory.py:200-360:
+            #   pysp_instance_creation_callback(tree, name, node_names)
+            #     builds instances (mutable-param updates honored);
+            #   pysp_scenario_tree_model_callback() may supply the tree
+            #     itself (networkx DiGraph form), replacing
+            #     ScenarioStructure.dat entirely.
+            from .abstract_model import (load_reference_module,
+                                         reference_model_creator)
 
-            instance_creator = reference_model_creator(instance_creator)
+            model_path = instance_creator
+            ns = load_reference_module(model_path)
+            self._callback = ns.get("pysp_instance_creation_callback")
+            if self._callback is None:
+                # hand the ALREADY-loaded model over: re-executing the
+                # user's module would double its side effects + build time
+                from .abstract_model import _model_from_ns
+
+                instance_creator = reference_model_creator(
+                    _model_from_ns(ns, model_path))
+            if scenario_structure is None:
+                tree_cb = ns.get("pysp_scenario_tree_model_callback")
+                if tree_cb is None:
+                    raise ValueError(
+                        "no scenario_structure given and the model module "
+                        "has no pysp_scenario_tree_model_callback")
+                scenario_structure = ScenarioStructure.from_networkx(
+                    tree_cb())
+                data_dir = data_dir or os.path.dirname(
+                    os.path.abspath(model_path))
         elif hasattr(instance_creator, "pysp_instance_creator"):
             instance_creator = instance_creator.pysp_instance_creator
+        if scenario_structure is None:
+            # only path-based modules can supply the tree via callback;
+            # fail HERE rather than deep inside the .dat parser
+            raise ValueError(
+                "scenario_structure is required for callable instance "
+                "creators (tree callbacks come from ReferenceModel.py "
+                "paths)")
         self._creator = instance_creator
         if isinstance(scenario_structure, ScenarioStructure):
             self.structure = scenario_structure
@@ -65,7 +100,7 @@ class PySPModel:
             self.structure = ScenarioStructure.from_file(scenario_structure)
             self._dir = data_dir or os.path.dirname(
                 os.path.abspath(scenario_structure))
-        if self._dir is None:
+        if self._dir is None and self._callback is None:
             raise ValueError("data_dir required with a parsed structure")
         self._arity = param_arity
 
@@ -132,7 +167,16 @@ class PySPModel:
     def scenario_creator(self, scenario_name, **kwargs):
         st = self.structure
         prob = st.scenario_probability(scenario_name)
-        mdl = self._creator(self.scenario_data(scenario_name), scenario_name)
+        if self._callback is not None:
+            # instance_factory.py:200-360: the callback builds the instance
+            # itself (its own data, typically mutable-param assignments);
+            # .dat scenario data is not consulted
+            inst = self._callback(st, scenario_name,
+                                  st.node_path(scenario_name))
+            mdl = inst.to_problem(scenario_name)
+        else:
+            mdl = self._creator(self.scenario_data(scenario_name),
+                                scenario_name)
         if mdl.var_names is None:
             raise ValueError(
                 "pysp instance creators must build via LinearModelBuilder "

@@ -49,6 +49,66 @@ class ScenarioStructure:
     def from_file(cls, path: str) -> "ScenarioStructure":
         return cls(parse_dat_file(path))
 
+    @classmethod
+    def from_networkx(cls, G) -> "ScenarioStructure":
+        """PySP's networkx scenario-tree form (the
+        ``pysp_scenario_tree_model_callback`` returning a ``DiGraph`` —
+        ref ``instance_factory.py`` / ``tree_structure_model.py``): nodes
+        carry ``variables``/``cost`` attributes, edges carry ``weight``
+        conditional probabilities, leaves are the scenarios (scenario name
+        = leaf name, PySP's default naming).
+        """
+        roots = [n for n in G.nodes if G.in_degree(n) == 0]
+        if len(roots) != 1:
+            raise ValueError(f"scenario tree must have one root: {roots}")
+        root = roots[0]
+        depth = {root: 0}
+        order = [root]
+        for nd in order:
+            for c in G.successors(nd):
+                depth[c] = depth[nd] + 1
+                order.append(c)
+        nstages = max(depth.values()) + 1
+        stages = [f"Stage{d + 1}" for d in range(nstages)]
+        data = {
+            "Stages": stages,
+            "Nodes": order,
+            "NodeStage": {nd: stages[depth[nd]] for nd in order},
+            "ConditionalProbability": {root: 1.0, **{
+                c: float(G.edges[p, c].get("weight", 1.0))
+                for p, c in G.edges}},
+        }
+        leaves = [nd for nd in order if G.out_degree(nd) == 0]
+        data["Scenarios"] = list(leaves)
+        data["ScenarioLeafNode"] = {nd: nd for nd in leaves}
+        for nd in order:
+            kids = list(G.successors(nd))
+            if kids:
+                data[f"Children[{nd}]"] = kids
+        # node-attached variables/cost roll up to their stage (PySP keeps
+        # them per-node but requires stage-consistency; enforce it)
+        cost = {}
+        for d in range(nstages):
+            vs: list = []
+            for nd in order:
+                if depth[nd] != d:
+                    continue
+                for v in G.nodes[nd].get("variables", ()):
+                    if v not in vs:
+                        vs.append(v)
+                c = G.nodes[nd].get("cost")
+                if c is not None:
+                    prev = cost.setdefault(stages[d], str(c))
+                    if prev != str(c):
+                        raise ValueError(
+                            f"nodes of {stages[d]} disagree on cost: "
+                            f"{prev} vs {c}")
+            if vs:
+                data[f"StageVariables[{stages[d]}]"] = vs
+        if cost:
+            data["StageCost"] = cost
+        return cls(data)
+
     # ---- validation (tree_structure.py checks) --------------------------
     def _validate(self):
         parents = {}
