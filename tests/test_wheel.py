@@ -227,3 +227,28 @@ def test_wheel_multistage_hydro():
         # O(100) flow values, not to machine epsilon
         np.testing.assert_allclose(grp, np.broadcast_to(grp[:1], grp.shape),
                                    atol=1e-3)
+
+
+def test_batch_cache_shares_across_cylinders():
+    """options["batch_cache"]: identical (creator, names, kwargs) builds
+    share ONE ScenarioBatch — a 5-cylinder reference-scale wheel otherwise
+    pays minutes of duplicate host construction before the hub starts."""
+    from tpusppy.spbase import SPBase, clear_batch_cache
+
+    clear_batch_cache()
+    names = farmer.scenario_names_creator(3)
+    kw = {"num_scens": 3}
+    a = SPBase({"batch_cache": True}, names, farmer.scenario_creator,
+               scenario_creator_kwargs=kw)
+    b = SPBase({"batch_cache": True}, names, farmer.scenario_creator,
+               scenario_creator_kwargs=kw)
+    assert a.batch is b.batch
+    c = SPBase({}, names, farmer.scenario_creator,
+               scenario_creator_kwargs=kw)
+    assert c.batch is not a.batch
+    # different kwargs must miss
+    d = SPBase({"batch_cache": True}, names, farmer.scenario_creator,
+               scenario_creator_kwargs={"num_scens": 3,
+                                        "crops_multiplier": 2})
+    assert d.batch is not a.batch
+    clear_batch_cache()

@@ -102,6 +102,9 @@ class CrossScenarioExtension(Extension):
         opt.batch = b.augment(
             S, self.max_cut_rounds * S, col_lb=0.0, col_ub=np.inf,
             col_names=[f"_cs_eta[{s}]" for s in range(S)])
+        # augment is functional: opt.batch is now a private copy whatever
+        # the cache says, and the slot writes below touch only its arrays
+        opt._batch_shared = False
         # every scenario model carries the full eta vector with the same
         # certified lower bounds (the reference's valid_eta_bound)
         opt.batch.lb[:, self._eta0:self._eta0 + S] = eta_lb[None, :]

@@ -59,6 +59,7 @@ class Fixer(Extension):
         values = np.where(ints, np.round(values), values)
         # respect original bounds
         values = np.clip(values, opt.batch.lb[:, idx], opt.batch.ub[:, idx])
+        opt._ensure_private_batch()   # never write through a cache-shared batch
         opt.batch.lb[:, idx] = values
         opt.batch.ub[:, idx] = values
         self.fixed[slots] = True

@@ -18,6 +18,30 @@ from .ir import ScenarioBatch
 from .solvers.admm import ADMMSettings
 
 
+_BATCH_CACHE: dict = {}
+
+
+def clear_batch_cache():
+    _BATCH_CACHE.clear()
+
+
+def _kwargs_key(kwargs: dict) -> tuple:
+    """Exact, collision-safe cache key for scenario_creator_kwargs: numpy
+    arrays hash by (shape, dtype, content bytes) — their repr truncates
+    past 1000 elements and would alias distinct families."""
+    import hashlib
+
+    parts = []
+    for k in sorted(kwargs):
+        v = kwargs[k]
+        if isinstance(v, np.ndarray):
+            h = hashlib.sha1(np.ascontiguousarray(v).tobytes()).hexdigest()
+            parts.append((k, "ndarray", v.shape, str(v.dtype), h))
+        else:
+            parts.append((k, repr(v)))
+    return tuple(parts)
+
+
 class SPBase:
     """Base class for scenario-programming objects.
 
@@ -56,6 +80,45 @@ class SPBase:
         # denouement protocol); signature (rank, scenario_name, scenario)
         self.scenario_denouement = scenario_denouement
         self.spcomm = None  # attached by an SPCommunicator when in a wheel
+
+        # ---- batch cache (options["batch_cache"]) ---------------------------
+        # Every cylinder of a wheel builds the SAME family: at reference
+        # scale (S=1000 WECC-240) one build costs minutes of the single host
+        # core, and a 5-cylinder wheel pays it five times BEFORE the hub
+        # loop starts — a third of the certification budget.  Identical
+        # (creator, names, kwargs, bundling) requests share one object.
+        # Normal solve paths only READ the batch (fixing copies bounds,
+        # ``augment`` is functional); the known in-place writers (Fixer,
+        # cross-scenario cuts, sample trees) call ``_ensure_private_batch``
+        # first, which copies a shared batch before the write.
+        cache_key = None
+        self._batch_shared = False
+        if self.options.get("batch_cache"):
+            cache_key = (
+                # the creator OBJECT, not its name: distinct instances'
+                # bound methods share a qualname but build different
+                # families (the key also keeps the object alive, so id
+                # reuse can't alias)
+                scenario_creator,
+                tuple(self.all_scenario_names),
+                _kwargs_key(self.scenario_creator_kwargs),
+                int(self.options.get("bundles_per_rank", 0) or 0),
+                int(self.options.get("shape_bucket_quantum", 16)),
+                bool(self.options.get("shape_buckets", False)),
+            )
+            hit = _BATCH_CACHE.get(cache_key)
+            if hit is not None:
+                self.batch, self.bundling = hit
+                self._batch_shared = True
+                if self.bundling:
+                    self.all_scenario_names = list(self.batch.names)
+                self.tree = self.batch.tree
+                global_toc(
+                    f"Scenario batch from cache: "
+                    f"{self.batch.num_scenarios} scenarios", self.verbose)
+                self.nid_sk = self.tree.nid_sk()
+                self.admm_settings = self._make_admm_settings()
+                return
 
         problems = [
             scenario_creator(name, **self.scenario_creator_kwargs)
@@ -103,11 +166,32 @@ class SPBase:
             self.verbose,
         )
 
+        if cache_key is not None:
+            _BATCH_CACHE[cache_key] = (self.batch, self.bundling)
+            self._batch_shared = True
+
         # Node-grouping arrays (replace per-node comm.Split, spbase.py:333-375):
         # nid_sk[s, k] = node-id owning nonant slot k in scenario s.
         self.nid_sk = self.tree.nid_sk()
 
         self.admm_settings = self._make_admm_settings()
+
+    def _ensure_private_batch(self):
+        """In-place batch writers (Fixer, cross-scenario cut slots, sample
+        trees) MUST call this before mutating batch arrays: a cache-shared
+        batch (``options["batch_cache"]``) is copied first so siblings —
+        e.g. the Lagrangian spoke whose outer bound must stay a bound on
+        the UNrestricted problem — never see the writes."""
+        if not getattr(self, "_batch_shared", False):
+            return
+        import dataclasses
+
+        b = self.batch
+        self.batch = dataclasses.replace(
+            b, c=b.c.copy(), q2=b.q2.copy(), cl=b.cl.copy(),
+            cu=b.cu.copy(), lb=b.lb.copy(), ub=b.ub.copy())
+        self.tree = self.batch.tree
+        self._batch_shared = False
 
     # ---- options ------------------------------------------------------------
     def _make_admm_settings(self) -> ADMMSettings:
