@@ -1,0 +1,149 @@
+"""UC feasibility repair: the scalable certified-inner-bound mechanism.
+
+models/uc_data attaches ``repair_fn`` (closed-form dispatch repair through
+the family's full recourse: shed at VOLL, reserve shortfall at 0.2 VOLL).
+Xhat_Eval repairs + EXACTLY verifies + prices candidates instead of
+host-LP-rescuing every plateaued scenario (O(seconds) each — the wall that
+kept the S=1000 wheel from ever landing an incumbent).
+
+Runs on the reference's real WECC-240 dataset at a small horizon.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tpusppy.ir import ScenarioBatch
+from tpusppy.solvers import scipy_backend
+
+DD = "/root/reference/paperruns/larger_uc/1000scenarios_wind"
+pytestmark = pytest.mark.skipif(not os.path.isdir(DD),
+                                reason="reference dataset not mounted")
+
+
+def _batch(S=3, H=6):
+    from tpusppy.models import uc_data
+
+    names = uc_data.scenario_names_creator(data_dir=DD)[:S]
+    kw = {"data_dir": DD, "horizon": H, "relax_integers": False,
+          "num_scens": S}
+    return ScenarioBatch.from_problems(
+        [uc_data.scenario_creator(nm, **kw) for nm in names])
+
+
+def _donor_candidate(b, s=0):
+    """Integer-feasible commitments from one scenario's exact MIP."""
+    res = scipy_backend.solve_lp(
+        b.c[s], b.A[s], b.cl[s], b.cu[s], b.lb[s], b.ub[s],
+        is_int=b.is_int, mip_rel_gap=1e-4, time_limit=120)
+    assert res.feasible
+    return res.x[b.tree.nonant_indices]
+
+
+def _verify_exact(b, x, tol=1e-6):
+    """(S,) bool: exact row+bound feasibility of each scenario."""
+    ok = np.ones(b.num_scenarios, bool)
+    for s in range(b.num_scenarios):
+        r = b.A[s] @ x[s]
+        scale = np.maximum(1.0, np.maximum(
+            np.abs(np.where(np.isfinite(b.cl[s]), b.cl[s], 0)),
+            np.abs(np.where(np.isfinite(b.cu[s]), b.cu[s], 0))))
+        rv = np.maximum(np.maximum(b.cl[s] - r, r - b.cu[s]), 0) / scale
+        bv = np.maximum(np.maximum(b.lb[s] - x[s], x[s] - b.ub[s]), 0)
+        ok[s] = rv.max() <= tol and bv.max() <= tol
+    return ok
+
+
+def test_repair_fn_attached():
+    b = _batch()
+    assert b.repair_fn is not None
+
+
+def test_repair_produces_exactly_feasible_points():
+    b = _batch(S=3, H=6)
+    cand = _donor_candidate(b)
+    nid = b.tree.nonant_indices
+    # a sloppy starting point: candidate commitments + garbage dispatch
+    rng = np.random.default_rng(0)
+    x0 = rng.uniform(0.0, 50.0, (b.num_scenarios, b.num_vars))
+    x0[:, nid] = cand[None, :]
+    x = b.repair_fn(x0, b)
+    assert _verify_exact(b, x).all()
+
+
+def test_repaired_objective_is_valid_upper_bound():
+    """Repaired-point expected objective >= EF optimum (a feasible point
+    can never beat the optimum) and within a few percent when starting
+    from per-scenario LP solutions (tightness)."""
+    from tpusppy.ef import solve_ef
+
+    b = _batch(S=3, H=6)
+    ef_obj, _ = solve_ef(b, solver="highs")
+    cand = _donor_candidate(b)
+    nid = b.tree.nonant_indices
+    # start from each scenario's LP-relaxation solution with commitments
+    # clamped to the candidate (what the device eval produces)
+    lb = b.lb.copy()
+    ub = b.ub.copy()
+    lb[:, nid] = cand[None, :]
+    ub[:, nid] = cand[None, :]
+    xs = []
+    for s in range(b.num_scenarios):
+        res = scipy_backend.solve_lp(
+            b.c[s], b.A[s], b.cl[s], b.cu[s], lb[s], ub[s])
+        assert res.feasible
+        xs.append(res.x)
+    exact = np.array([float(b.c[s] @ xs[s]) + float(b.const[s])
+                      for s in range(b.num_scenarios)])
+    x = b.repair_fn(np.stack(xs), b)
+    assert _verify_exact(b, x).all()
+    per = b.objective(x)
+    # repair must NOT degrade already-feasible points: per-scenario
+    # objectives match the exact fixed-candidate LPs to machine precision
+    np.testing.assert_allclose(per, exact, rtol=1e-9)
+    eobj = float(b.tree.scen_prob @ per)
+    # and the result is a valid upper bound on the EF optimum
+    assert eobj >= ef_obj - 1e-6 * abs(ef_obj)
+
+
+def test_xhat_eval_uses_repair_and_certifies():
+    """End-to-end: Xhat_Eval.evaluate returns a FINITE certified bound for
+    a donor candidate (the S=1000 wheel's previously-impossible step)."""
+    from tpusppy.models import uc_data
+    from tpusppy.xhat_eval import Xhat_Eval
+
+    S, H = 3, 6
+    names = uc_data.scenario_names_creator(data_dir=DD)[:S]
+    kw = {"data_dir": DD, "horizon": H, "relax_integers": False,
+          "num_scens": S}
+    # deeper eval budget: the repaired bound prices exactly the slack the
+    # device solve leaves (measured: max_iter 200/2 -> +4.7%, 1000/4 ->
+    # +0.07%, 4000/6 -> +0.0004% over the exact fixed-candidate LPs)
+    ev = Xhat_Eval(
+        {"defaultPHrho": 1.0, "PHIterLimit": 1, "convthresh": -1.0,
+         "solver_options": {"dtype": "float64", "eps_abs": 1e-8,
+                            "eps_rel": 1e-8, "max_iter": 1000,
+                            "restarts": 4}},
+        names, uc_data.scenario_creator, scenario_creator_kwargs=kw)
+    cand = _donor_candidate(ev.batch)
+    obj = ev.evaluate(cand)
+    assert np.isfinite(obj)
+    # agree with the EXACT fixed-candidate evaluation (per-scenario host
+    # LPs) to ~1%: device solves are inexact, repair prices the slack
+    b = ev.batch
+    nid = b.tree.nonant_indices
+    lb = b.lb.copy()
+    ub = b.ub.copy()
+    cr = np.where(b.is_int[nid], np.round(cand), cand)
+    lb[:, nid] = cr[None, :]
+    ub[:, nid] = cr[None, :]
+    exact = []
+    for s in range(b.num_scenarios):
+        res = scipy_backend.solve_lp(
+            b.c[s], b.A[s], b.cl[s], b.cu[s], lb[s], ub[s])
+        assert res.feasible
+        exact.append(float(b.c[s] @ res.x) + float(b.const[s]))
+    eobj_exact = float(b.tree.scen_prob @ np.asarray(exact))
+    assert obj >= eobj_exact - 1e-6 * abs(eobj_exact)  # valid upper bound
+    assert obj <= eobj_exact + 0.005 * abs(eobj_exact)  # and tight

@@ -131,6 +131,13 @@ class ScenarioProblem:
     nodes: list            # list[ScenarioNode], stage order
     var_names: list | None = None
     const: float = 0.0     # objective constant
+    # optional model-declared feasibility repair: callable
+    # ``(x: (S, n), batch) -> (S, n)`` mapping near-feasible solver points
+    # to EXACTLY feasible ones (full-recourse families close violations in
+    # their slack columns in closed form).  The scalable certified-inner-
+    # bound mechanism: Xhat_Eval repairs + verifies + prices exactly
+    # instead of per-scenario host LP rescues (O(S) seconds each).
+    repair_fn: object = None
 
     @property
     def num_vars(self) -> int:
@@ -198,6 +205,8 @@ class ScenarioBatch:
     # (tpusppy.solvers.shared_admm), which keeps ONE (n, n) factorization
     # for the whole batch.
     A_shared: np.ndarray | None = None
+    # model-declared feasibility repair (see ScenarioProblem.repair_fn)
+    repair_fn: object = None
 
     @classmethod
     def from_problems(cls, problems: list[ScenarioProblem]) -> "ScenarioBatch":
@@ -250,6 +259,7 @@ class ScenarioBatch:
             const=np.array([p.const for p in problems]),
             tree=tree,
             var_names=var_names,
+            repair_fn=problems[0].repair_fn,
         )
 
     @property
