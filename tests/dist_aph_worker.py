@@ -1,0 +1,63 @@
+"""Worker for tests/test_dist_aph.py: one process of a 2-process APH job
+whose node reductions ride the cross-host listener (parallel/dist_aph.py).
+Prints one JSON line."""
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    nproc = int(os.environ["DIST_NPROC"])
+    pid = int(os.environ["DIST_PID"])
+    port = int(os.environ["FABRIC_PORT"])
+    secret = int(os.environ["FABRIC_SECRET"])
+    n = int(os.environ["DIST_SCENS"])
+
+    from tpusppy.ir import ScenarioBatch
+    from tpusppy.models import farmer
+    from tpusppy.parallel.dist_aph import APHPartialSync, DistributedAPH
+    from tpusppy.parallel.distributed import scen_to_process
+
+    names = farmer.scenario_names_creator(n)
+    lo, hi = scen_to_process(n, nproc, pid)
+    local = names[lo:hi]
+    share = (hi - lo) / n
+
+    # probe the local tree for the partial-sum length (4*N*K + 1)
+    probe = ScenarioBatch.from_problems([
+        farmer.scenario_creator(nm, num_scens=n) for nm in local[:1]])
+    K = probe.tree.num_nonants
+    N = probe.tree.num_nodes
+    L = 4 * N * K + 1
+
+    sync = APHPartialSync(nproc, pid, L, port=port, secret=secret)
+    if pid == 0:
+        with open(os.environ["FABRIC_READY"], "w") as f:
+            f.write("up")
+
+    options = {
+        "defaultPHrho": 1.0, "PHIterLimit": 60, "convthresh": -1.0,
+        "dispatch_frac": float(os.environ.get("DIST_DISPATCH", "0.67")),
+        "APH_listener_wait_secs": 2.0,
+        "solver_options": {"dtype": "float64", "eps_abs": 1e-8,
+                           "eps_rel": 1e-8, "max_iter": 300, "restarts": 3},
+    }
+    aph = DistributedAPH(options, local, farmer.scenario_creator,
+                         sync=sync, prob_share=share,
+                         scenario_creator_kwargs={"num_scens": n})
+    t0 = time.time()
+    conv, eobj, tbound = aph.APH_main()
+    out = {
+        "pid": pid, "share": share, "conv": conv, "eobj": eobj,
+        "tbound": tbound, "wall": time.time() - t0,
+        "stale": aph._stale_dist_reductions,
+        "xbar_root": np.asarray(aph.xbars[0]).tolist(),
+    }
+    print(json.dumps(out), flush=True)
+    sync.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Spoke process for tests/test_distributed_wheel.py: attach to the hub's
+TCP fabric and run one bound spoke (the multi-host spoke launcher of
+doc/multihost.md, pointed at a MULTI-CONTROLLER hub)."""
+import os
+
+
+def main():
+    from tpusppy.models import farmer
+    from tpusppy.spin_the_wheel import _spoke_worker
+
+    n = int(os.environ["DIST_SCENS"])
+    port = int(os.environ["FABRIC_PORT"])
+    secret = int(os.environ["FABRIC_SECRET"])
+    rank = int(os.environ["SPOKE_RANK"])
+    kind = os.environ["SPOKE_KIND"]
+
+    if kind == "lagrangian":
+        from tpusppy.cylinders import LagrangianOuterBound
+        from tpusppy.phbase import PHBase
+
+        spoke_class, opt_class = LagrangianOuterBound, PHBase
+    else:
+        from tpusppy.cylinders import XhatXbarInnerBound
+        from tpusppy.xhat_eval import Xhat_Eval
+
+        spoke_class, opt_class = XhatXbarInnerBound, Xhat_Eval
+
+    sd = {
+        "spoke_class": spoke_class,
+        "opt_class": opt_class,
+        "opt_kwargs": {
+            "options": {"defaultPHrho": 1.0, "PHIterLimit": 120,
+                        "convthresh": -1.0,
+                        "solver_options": {"dtype": "float64",
+                                           "eps_abs": 1e-8, "eps_rel": 1e-8,
+                                           "max_iter": 300, "restarts": 3}},
+            "all_scenario_names": farmer.scenario_names_creator(n),
+            "scenario_creator": farmer.scenario_creator,
+            "scenario_creator_kwargs": {"num_scens": n},
+        },
+    }
+    _spoke_worker(("tcp", "127.0.0.1", port, f"distwheel{rank}", secret),
+                  sd, rank)
+
+
+if __name__ == "__main__":
+    main()

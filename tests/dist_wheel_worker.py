@@ -1,0 +1,67 @@
+"""Worker for tests/test_distributed_wheel.py: one CONTROLLER process of a
+2-process hub cylinder inside a wheel (CPU, virtual devices).
+
+Controller 0 serves the TCP window fabric; controller 1 connects as a
+client.  Both run the identical sharded PH hub loop and vote on every spoke
+write-id (parallel/dist_wheel.py).  Prints one JSON line.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    coord = os.environ["DIST_COORD"]
+    nproc = int(os.environ["DIST_NPROC"])
+    pid = int(os.environ["DIST_PID"])
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=nproc, process_id=pid)
+    jax.config.update("jax_enable_x64", True)
+
+    from tpusppy.models import farmer
+    from tpusppy.parallel.dist_wheel import distributed_wheel_hub
+    from tpusppy.runtime.tcp_window_service import TcpWindowFabric
+
+    n = int(os.environ["DIST_SCENS"])
+    port = int(os.environ["FABRIC_PORT"])
+    secret = int(os.environ["FABRIC_SECRET"])
+    names = farmer.scenario_names_creator(n)
+
+    # spoke 1: Lagrangian (outer, wants W); spoke 2: XhatXbar (inner, nonants)
+    K = 3  # farmer root nonants (crops) — scendars below use crops_mult=1
+    lengths = [(n * K + 2, 1), (n * K + 2, 1)]
+    if pid == 0:
+        fabric = TcpWindowFabric(spoke_lengths=lengths, port=port,
+                                 secret=secret)
+        # readiness sentinel: the parent spawns spokes only once the box
+        # server accepts connections
+        with open(os.environ["FABRIC_READY"], "w") as f:
+            f.write("up")
+    else:
+        fabric = TcpWindowFabric(connect=("127.0.0.1", port), secret=secret)
+
+    res = distributed_wheel_hub(
+        names, farmer.scenario_creator,
+        scenario_creator_kwargs={"num_scens": n},
+        options={"defaultPHrho": 1.0, "PHIterLimit": 120,
+                 "rel_gap": 1e-3, "linger_secs": 8.0,
+                 "solver_options": {"dtype": "float64", "eps_abs": 1e-8,
+                                    "eps_rel": 1e-8, "max_iter": 300,
+                                    "restarts": 3}},
+        fabric=fabric,
+        spoke_roles=[{"bound": "outer", "wants": "W"},
+                     {"bound": "inner", "wants": "nonants"}])
+    print(json.dumps({
+        "pid": pid, "inner": res.BestInnerBound, "outer": res.BestOuterBound,
+        "rel_gap": res.rel_gap, "iters": res.iters, "conv": res.conv,
+        "vote_retries": res.vote_retries,
+    }), flush=True)
+    fabric.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,114 @@
+"""Distributed APH: cross-host listener reductions (parallel/dist_aph.py).
+
+The reference overlaps MPI Allreduces with solves on a listener thread
+(mpisppy/opt/aph.py:198-330 + listener_util.py:277-327).  Here two OS
+processes each run batched APH on half the farmer scenarios; their node
+averages are reduced across processes by APHPartialSync's listener threads
+over the C++ TCP window service — the DCN path — while workers solve.
+Asserted: both processes converge to ONE consensus (identical root xbar),
+and the probability-recombined expected objective matches the
+single-process APH on the full family.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCENS = 6
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _env(extra):
+    env = {k: v for k, v in os.environ.items()
+           if "AXON" not in k and not k.startswith("TPU_")
+           and k != "PYTHONPATH"}
+    env.update({
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "JAX_ENABLE_X64": "1",
+        "JAX_COMPILATION_CACHE_DIR": os.path.join(
+            os.path.expanduser("~"), ".cache", "tpusppy_xla"),
+    })
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _single_process_reference():
+    from tpusppy.models import farmer
+    from tpusppy.opt.aph import APH
+
+    options = {
+        "defaultPHrho": 1.0, "PHIterLimit": 60, "convthresh": -1.0,
+        "dispatch_frac": 0.67,
+        "solver_options": {"dtype": "float64", "eps_abs": 1e-8,
+                           "eps_rel": 1e-8, "max_iter": 300, "restarts": 3},
+    }
+    aph = APH(options, farmer.scenario_names_creator(SCENS),
+              farmer.scenario_creator,
+              scenario_creator_kwargs={"num_scens": SCENS})
+    conv, eobj, tbound = aph.APH_main()
+    return eobj, np.asarray(aph.xbars[0])
+
+
+@pytest.mark.slow
+def test_two_process_aph_cross_host_reductions():
+    port = _free_port()
+    secret = 0xA9B8C7D6
+    ready = os.path.join(tempfile.gettempdir(),
+                         f"distaph_ready_{os.getpid()}")
+    if os.path.exists(ready):
+        os.remove(ready)
+    common = {
+        "DIST_NPROC": 2, "DIST_SCENS": SCENS,
+        "FABRIC_PORT": port, "FABRIC_SECRET": secret,
+        "FABRIC_READY": ready, "DIST_DISPATCH": 0.67,
+    }
+    script = os.path.join(REPO, "tests", "dist_aph_worker.py")
+    p0 = subprocess.Popen([sys.executable, script],
+                          env=_env(common | {"DIST_PID": 0}),
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                          text=True)
+    procs = [p0]
+    try:
+        t0 = time.time()
+        while not os.path.exists(ready):
+            assert time.time() - t0 < 120, "sync server never came up"
+            assert p0.poll() is None, p0.communicate()
+            time.sleep(0.2)
+        os.remove(ready)
+        procs.append(subprocess.Popen(
+            [sys.executable, script], env=_env(common | {"DIST_PID": 1}),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+        outs = []
+        for p in procs:
+            out, err = p.communicate(timeout=600)
+            assert p.returncode == 0, f"rc={p.returncode}\n{err[-4000:]}"
+            outs.append(json.loads(
+                [ln for ln in out.splitlines() if ln.startswith("{")][-1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    r0, r1 = sorted(outs, key=lambda r: r["pid"])
+    # one consensus: the root xbar derives from the same global sums
+    np.testing.assert_allclose(r0["xbar_root"], r1["xbar_root"],
+                               rtol=1e-6, atol=1e-8)
+    # probability-recombined expectation matches single-process APH
+    eobj_ref, xbar_ref = _single_process_reference()
+    eobj_dist = r0["share"] * r0["eobj"] + r1["share"] * r1["eobj"]
+    assert eobj_dist == pytest.approx(eobj_ref, rel=2e-3)
+    np.testing.assert_allclose(r0["xbar_root"], xbar_ref, rtol=2e-2)

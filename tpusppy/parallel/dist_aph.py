@@ -1,0 +1,263 @@
+"""Distributed APH: cross-host listener reductions under asynchronous solves.
+
+The reference's APH runs a LISTENER THREAD doing background MPI Allreduces
+concurrently with worker solves (``mpisppy/opt/aph.py:198-330`` +
+``utils/listener_util/listener_util.py:277-327``): workers publish local
+contributions, the listener reduces them across ranks while the workers are
+already solving the next dispatch, and workers tolerate one-reduction-stale
+averages.  tpusppy's single-controller APH collapses that to host einsums
+(:mod:`tpusppy.opt.aph`); this module is the MULTI-HOST form, where the
+reduction genuinely crosses a network and overlapping it with solves pays.
+
+Architecture (no ``jax.distributed`` needed — matching the reference, the
+coupling between hosts is ONLY the reduction):
+
+- each process owns a scenario shard and runs the ordinary batched APH on
+  it (its own devices, its own dispatch fraction);
+- node averages decompose into per-node partial sums, so each process
+  publishes ``(num_x, num_xsq, num_y, den, phi)`` partials weighted by its
+  TRUE global probabilities;
+- :class:`APHPartialSync`'s listener thread sums partials over processes
+  through the C++ TCP window service (the DCN path) and broadcasts the
+  global sums back — process 0 serves the boxes, everyone else connects;
+- workers read the latest global reduction with a bounded freshness wait
+  and continue on stale averages when the network is behind — APH's
+  tolerated staleness, verbatim.
+
+Two-stage trees only: every process's local tree must contain the same node
+set (a scenario shard of a deep multistage tree can miss interior nodes);
+multistage stays on the single-controller path.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+import numpy as np
+
+from ..opt.aph import APH
+
+
+class APHPartialSync:
+    """Async cross-process partial-sum reducer over the TCP window fabric.
+
+    Box layout per non-root process p: ``to_hub[p]`` carries p's latest
+    partial ``[payload (L), serial]``; ``to_spoke[p]`` carries the reduced
+    global ``[summed payload (L), min_serial]``.  Process 0 keeps its own
+    partial in memory and its listener thread re-reduces whenever any
+    contribution moved; other processes' listeners poll their global box.
+    Staleness is explicit: ``latest()`` returns the reduction's min serial
+    so callers can decide freshness (aph.py:198-330 semantics).
+    """
+
+    def __init__(self, nproc: int, process_id: int, length: int,
+                 port: int = 0, host: str = "127.0.0.1",
+                 secret: int | None = None, sleep_secs: float = 0.005):
+        from ..runtime.tcp_window_service import TcpWindowFabric
+
+        self.nproc = int(nproc)
+        self.pid = int(process_id)
+        self.L = int(length)
+        self.sleep_secs = float(sleep_secs)
+        boxlen = self.L + 1
+        if self.pid == 0:
+            self.fabric = TcpWindowFabric(
+                spoke_lengths=[(boxlen, boxlen)] * (self.nproc - 1),
+                port=port, secret=secret)
+            self.port = self.fabric.port
+        else:
+            self.fabric = TcpWindowFabric(connect=(host, port),
+                                          secret=secret)
+            self.port = port
+        self._lock = threading.Lock()
+        self._own = None              # this process's latest [payload, serial]
+        self._own_version = 0
+        self._global = None           # latest reduced [payload, min_serial]
+        self.listener_error = None    # first listener exception (diagnostic)
+        self._quit = False
+        self._listener = threading.Thread(
+            target=self._listener_loop, name="APHPartialSync", daemon=True)
+        self._listener.start()
+
+    # ---- worker side -------------------------------------------------------
+    def publish(self, payload: np.ndarray, serial: int):
+        vec = np.concatenate([np.asarray(payload, float).ravel(),
+                              [float(serial)]])
+        if vec.shape != (self.L + 1,):
+            raise ValueError(f"partial length {vec.shape} != {self.L + 1}")
+        if self.pid == 0:
+            with self._lock:
+                self._own = vec
+                self._own_version += 1
+        else:
+            self.fabric.to_hub[self.pid].put(vec)
+
+    def latest(self):
+        """(global payload copy, min_serial) or None if no reduction yet."""
+        with self._lock:
+            if self._global is None:
+                return None
+            return self._global[:-1].copy(), int(self._global[-1])
+
+    # ---- listener side -----------------------------------------------------
+    def _listener_loop(self):
+        last_ids = {}
+        last_version = -1
+        while not self._quit:
+            try:
+                if self.pid == 0:
+                    moved = False
+                    parts = []
+                    with self._lock:
+                        if self._own is not None:
+                            parts.append(self._own)
+                        if self._own_version != last_version:
+                            last_version = self._own_version
+                            moved = True
+                    for p in range(1, self.nproc):
+                        data, wid = self.fabric.to_hub[p].get()
+                        if wid > 0:
+                            parts.append(data)
+                            if wid != last_ids.get(p):
+                                last_ids[p] = wid
+                                moved = True
+                    if moved and len(parts) == self.nproc:
+                        tot = np.sum([q[:-1] for q in parts], axis=0)
+                        serial = min(float(q[-1]) for q in parts)
+                        red = np.concatenate([tot, [serial]])
+                        with self._lock:
+                            self._global = red
+                        for p in range(1, self.nproc):
+                            self.fabric.to_spoke[p].put(red)
+                else:
+                    data, wid = self.fabric.to_spoke[self.pid].get()
+                    if wid > 0:
+                        with self._lock:
+                            self._global = data
+            except Exception as e:
+                # a torn-down fabric mid-poll must not spin a traceback
+                # storm — but a LIVE run degrading to stale/local-only
+                # reductions must be loud: record + print the first error
+                # (workers surface staleness via _stale_dist_reductions)
+                if self._quit:
+                    return
+                if self.listener_error is None:
+                    self.listener_error = repr(e)
+                    print(f"APHPartialSync listener error (reductions may "
+                          f"go stale): {e!r}", file=sys.stderr, flush=True)
+            time.sleep(self.sleep_secs)
+
+    def close(self):
+        self._quit = True
+        self._listener.join(timeout=10)
+        self.fabric.close()
+
+
+class DistributedAPH(APH):
+    """APH over a scenario SHARD whose reductions are global.
+
+    Construct per process with its LOCAL scenario names (probabilities
+    renormalized so the local tree validates); ``prob_share`` is the shard's
+    true global probability mass, so published partials carry the global
+    weighting.  Everything else — fractional dispatch, compact sub-batch
+    solves, theta/z/W updates — is the inherited batched APH, now driven by
+    globally-reduced averages.  Reference: one APH rank group of
+    ``mpisppy/opt/aph.py:46-982`` with listener reductions.
+    """
+
+    def __init__(self, options, local_scenario_names, scenario_creator,
+                 *, sync: APHPartialSync, prob_share: float = 1.0,
+                 **kwargs):
+        super().__init__(options, local_scenario_names, scenario_creator,
+                         **kwargs)
+        self.sync = sync
+        self.prob_share = float(prob_share)
+        self._stale_dist_reductions = 0
+        K = self.nonant_length
+        N = self._onehot.shape[2]
+        expect = 4 * N * K + 1
+        if sync.L != expect:
+            raise ValueError(
+                f"sync length {sync.L} != 4*N*K+1 = {expect} "
+                f"(N={N} nodes, K={K} nonants)")
+
+    def partial_length(self):
+        K = self.nonant_length
+        N = self._onehot.shape[2]
+        return 4 * N * K + 1
+
+    def Compute_Averages(self):
+        """Publish global-prob-weighted partial sums; derive the averages
+        from the listener's cross-process reduction (aph.py:332-453 math,
+        decomposed into per-node sums so it distributes)."""
+        xk = self.nonants_of(self.local_x)
+        K = self.nonant_length
+        N = self._onehot.shape[2]
+        pt = (self.prob_share * self.probs)[:, None]
+        num_x = np.einsum("skn,sk->nk", self._onehot, pt * xk)
+        num_xsq = np.einsum("skn,sk->nk", self._onehot, pt * xk * xk)
+        num_y = np.einsum("skn,sk->nk", self._onehot, pt * self.y)
+        den = np.einsum("skn,sk->nk", self._onehot,
+                        np.broadcast_to(pt, xk.shape))
+        local_phis = (self.prob_share * self.probs) * np.einsum(
+            "sk,sk->s", self.z - xk, self.W - self.y)
+        payload = np.concatenate([
+            num_x.ravel(), num_xsq.ravel(), num_y.ravel(), den.ravel(),
+            [float(local_phis.sum())]])
+        self.sync.publish(payload, self._iter)
+
+        g = self._wait_reduction()
+        if g is None:
+            # no global reduction yet (first publishes in flight): proceed
+            # on own partials — transient, and only possible at startup
+            g = payload
+        NK = N * K
+        g_num_x = g[:NK].reshape(N, K)
+        g_num_xsq = g[NK:2 * NK].reshape(N, K)
+        g_num_y = g[2 * NK:3 * NK].reshape(N, K)
+        g_den = np.maximum(g[3 * NK:4 * NK].reshape(N, K), 1e-300)
+        g_phi = float(g[4 * NK])
+
+        xbar_nk = g_num_x / g_den
+        xsqbar_nk = g_num_xsq / g_den
+        ybar_nk = g_num_y / g_den
+        kidx = np.arange(K)[None, :]
+        xbars = xbar_nk[self.nid_sk, kidx]
+        pusq = float(np.sum(g_num_xsq - g_num_x * g_num_x / g_den))
+        pvsq = float(np.sum(g_num_y * g_num_y / g_den))
+        tau = pusq + pvsq / self.APHgamma
+        self.xbars = xbars
+        self.xsqbars = xsqbar_nk[self.nid_sk, kidx]
+        self.ybars = ybar_nk[self.nid_sk, kidx]
+        self.uk = xk - xbars
+        self.global_pusqnorm = pusq
+        self.global_pvsqnorm = pvsq
+        self.tau_summand = tau
+        self.global_tau = tau
+        self.global_phi = g_phi
+        # dispatch priorities stay LOCAL (each process dispatches within
+        # its own shard, like each reference rank solves its own list)
+        self.phis = local_phis
+
+    def _wait_reduction(self):
+        """Latest global sums, waiting briefly for this iteration's serial;
+        returns the stale reduction (counted) when the network is behind."""
+        wait = self.options.get("APH_listener_wait_secs")
+        if wait is None:
+            wait = float(self.options.get("async_sleep_secs", 0.01)) * 100
+        deadline = time.time() + float(wait)
+        while True:
+            got = self.sync.latest()
+            if got is not None and got[1] >= self._iter:
+                return got[0]
+            if time.time() >= deadline:
+                break
+            time.sleep(0.0005)
+        got = self.sync.latest()
+        if got is None:
+            return None
+        if got[1] < self._iter:
+            self._stale_dist_reductions += 1
+        return got[0]

@@ -1,0 +1,265 @@
+"""Multi-controller hub cylinder inside a wheel + the write-id acceptance vote.
+
+The reference's headline topology puts EVERY cylinder on many MPI ranks:
+``mpisppy/spin_the_wheel.py:219-237`` requires ``n_proc % (n_spokes+1) == 0``
+and splits COMM_WORLD so each cylinder is its own multi-rank communicator.
+Because one-sided RMA reads on different ranks can race a writer mid-Put,
+acceptance is a VOTE: a spoke's ranks all read their local window copy and
+agree on the write-id before acting (``cylinders/spoke.py:99-118``), and the
+hub's ranks do the same for spoke payloads (``cylinders/hub.py:424-436``).
+
+Here the multi-rank cylinder is a multi-controller JAX job: the hub's PH
+state is scenario-sharded over a mesh spanning every controller process
+(:mod:`tpusppy.parallel.distributed`), and the wheel fabric is the C++ TCP
+window service (:mod:`tpusppy.runtime.tcp_window_service`) — controller 0
+serves the boxes, the other controllers connect as clients, spokes attach
+from anywhere.  Each controller reads the spoke mailboxes over its own
+connection, so reads genuinely race spoke Puts — the same hazard the
+reference votes away, solved the same way: :func:`read_voted` re-reads until
+every controller snapshotted the SAME write-id.
+
+Determinism contract: after a voted read, every controller holds identical
+payloads, so bound updates and the termination decision are bit-identical
+across controllers — no controller can leave the PH collective early (which
+would deadlock the psums).  :func:`distributed_wheel_hub` asserts this by
+voting on the termination decision itself.
+"""
+
+from __future__ import annotations
+
+import time
+from math import inf
+from typing import NamedTuple
+
+import numpy as np
+
+from .distributed import _setup_distributed
+
+
+def default_allgather():
+    """Scalar allgather over the processes of the current jax.distributed
+    job (the vote's communication primitive).  Write-ids are < 2^53 so the
+    float64 path is exact."""
+    from jax.experimental import multihost_utils
+
+    def allgather(v):
+        out = multihost_utils.process_allgather(
+            np.asarray([float(v)], np.float64))
+        return [float(x) for x in np.asarray(out).ravel()]
+
+    return allgather
+
+
+def read_voted(mailbox, allgather, max_tries: int = 10000,
+               sleep_s: float = 0.002):
+    """All-controllers-agree mailbox read.
+
+    Every controller snapshots ``(payload, write_id)`` from its own view of
+    the mailbox, then the controllers exchange write-ids; if any pair
+    disagrees (a writer raced between their reads), ALL re-read and vote
+    again.  Mirrors ``mpisppy/cylinders/spoke.py:99-118`` (spoke ranks) and
+    ``hub.py:424-436`` (hub ranks).  The kill sentinel (-1) is terminal and
+    immediately visible on every connection, so a mixed [-1, n] vote
+    converges to agreement on -1 within one re-read.
+
+    Returns ``(payload, write_id, retries)``; raises after ``max_tries``
+    disagreeing rounds (a vote that cannot converge means a broken fabric,
+    not a slow writer).
+    """
+    retries = 0
+    for _ in range(max_tries):
+        data, wid = mailbox.get()
+        ids = allgather(wid)
+        if all(i == ids[0] for i in ids):
+            return data, int(wid), retries
+        retries += 1
+        time.sleep(sleep_s)
+    raise RuntimeError(
+        f"write-id vote failed to converge after {max_tries} rounds "
+        f"(mailbox {getattr(mailbox, 'name', '?')})")
+
+
+class DistWheelResult(NamedTuple):
+    BestInnerBound: float
+    BestOuterBound: float
+    rel_gap: float
+    conv: float
+    eobj: float
+    iters: int
+    vote_retries: int    # total disagreeing vote rounds (the covered path)
+
+
+def distributed_wheel_hub(all_scenario_names, scenario_creator,
+                          scenario_creator_kwargs=None, options=None,
+                          fabric=None, spoke_roles=None, mesh=None,
+                          axis: str = "scen", allgather=None,
+                          is_minimizing: bool = True):
+    """Run the HUB cylinder of a wheel across every process of a
+    jax.distributed job, spokes attached over ``fabric``.
+
+    Call collectively from all controller processes.  ``fabric`` is each
+    process's own view of the TCP window fabric (controller 0: the serving
+    ``TcpWindowFabric(spoke_lengths=...)``; others: a client
+    ``TcpWindowFabric(connect=...)``).  ``spoke_roles[i]`` (for strata rank
+    i+1) is ``{"bound": "outer"|"inner", "wants": "W"|"nonants"}`` — the
+    role vocabulary of the spoke type lattice (cylinders/spoke.py).
+
+    Controller 0 is the single WRITER (payloads are replicated consensus
+    state, identical on every controller); ALL controllers read spoke
+    mailboxes and accept via :func:`read_voted`.  Payload layouts match
+    :class:`tpusppy.cylinders.hub.PHHub`: ``[W.ravel()|xk.ravel(), OB, IB]``.
+
+    Reference: one multi-rank hub cylinder of ``spin_the_wheel.py:219-237``
+    with the acceptance votes of ``hub.py:424-436``.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    options = dict(options or {})
+    spoke_roles = list(spoke_roles or [])
+    if allgather is None:
+        allgather = default_allgather()
+    writer = jax.process_index() == 0
+
+    setup = _setup_distributed(all_scenario_names, scenario_creator,
+                               scenario_creator_kwargs, options, mesh, axis)
+    arr, state = setup.arr, setup.state
+    refresh, frozen = setup.refresh, setup.frozen
+    S = setup.S
+    nonant_idx = setup.batch_local.tree.nonant_indices
+
+    # replicated fetch: consensus state is identical across controllers by
+    # construction (post-psum); reshard-to-replicated makes it addressable
+    # everywhere so controller 0 can Put it and every controller can reason
+    # about it without point-to-point traffic
+    rep = jax.jit(lambda a: a,
+                  out_shardings=NamedSharding(setup.mesh, P()))
+
+    def fetch(a):
+        return np.asarray(rep(a))[:S]
+
+    iters = int(options.get("PHIterLimit", 10))
+    refresh_every = max(1, int(options.get("solver_refresh_every", 16)))
+    rel_gap_target = float(options.get("rel_gap", -1.0))
+    BestInner = inf if is_minimizing else -inf
+    BestOuter = -inf if is_minimizing else inf
+
+    def better_inner(new, old):
+        return new < old if is_minimizing else new > old
+
+    def better_outer(new, old):
+        return new > old if is_minimizing else new < old
+
+    def gap():
+        ag = (BestInner - BestOuter) if is_minimizing \
+            else (BestOuter - BestInner)
+        if np.isfinite(ag) and np.isfinite(BestOuter):
+            return ag / (abs(BestOuter) or 1.0)
+        return inf
+
+    last_ids = {i + 1: 0 for i in range(len(spoke_roles))}
+    total_retries = 0
+
+    def pull_bounds():
+        """Voted read of every spoke bound; freshness by write-id, exactly
+        the hub-side acceptance of hub.py:424-436."""
+        nonlocal BestInner, BestOuter, total_retries
+        for i, role in enumerate(spoke_roles):
+            idx = i + 1
+            data, wid, retries = read_voted(fabric.to_hub[idx], allgather)
+            total_retries += retries
+            if wid > last_ids[idx] or wid < 0:
+                last_ids[idx] = wid
+                b = float(data[0])
+                if np.isfinite(b):
+                    if role["bound"] == "outer" and better_outer(b, BestOuter):
+                        BestOuter = b
+                    elif (role["bound"] == "inner"
+                          and better_inner(b, BestInner)):
+                        BestInner = b
+
+    def push_state():
+        # the replicated fetch is a COLLECTIVE (cross-process all-gather):
+        # every controller must join it, even though only controller 0
+        # writes the result into the spoke boxes — an early non-writer
+        # return here deadlocks the mesh (Gloo rendezvous timeout)
+        W = fetch(state.W).ravel()
+        xk = fetch(state.x)[:, nonant_idx].ravel()
+        if not writer:
+            return
+        for i, role in enumerate(spoke_roles):
+            payload = W if role.get("wants", "W") == "W" else xk
+            fabric.to_spoke[i + 1].put(
+                np.concatenate([payload, [BestOuter, BestInner]]))
+
+    def robust_collective(fn, tries=8, backoff=3.0):
+        """Re-attempt a collective step whose Gloo context init timed out.
+
+        The first cross-process execution races a fixed ~30s rendezvous
+        window; controllers can reach it further apart than that (cold
+        local compiles, loaded hosts).  Re-execution is safe — inputs are
+        immutable jax arrays — and both controllers retry symmetrically
+        until their attempts overlap inside the window.
+        """
+        last = None
+        for i in range(tries):
+            try:
+                return fn()
+            except Exception as e:     # jaxlib surfaces DEADLINE_EXCEEDED
+                msg = repr(e)
+                if "Gloo" not in msg and "DEADLINE" not in msg:
+                    raise
+                last = e
+                time.sleep(backoff)
+        raise last
+
+    # Iter0: plain objective (W=0, prox off) — its eobj is the wait-and-see
+    # bound, the hub's trivial outer bound (phbase.py:758-872 semantics)
+    def _iter0():
+        st, o, f = refresh(state, arr, 0.0)
+        return st, o, f, float(np.asarray(o.eobj))
+
+    state, out, factors, trivial = robust_collective(_iter0)
+    if better_outer(trivial, BestOuter):
+        BestOuter = trivial
+
+    conv = eobj = inf
+    it = 0
+    try:
+        for it in range(1, iters + 1):
+            if (it - 1) % refresh_every == 0:
+                state, out, factors = refresh(state, arr, 1.0)
+            else:
+                state, out = frozen(state, arr, 1.0, factors)
+            conv = float(np.asarray(out.conv))
+            eobj = float(np.asarray(out.eobj))
+            push_state()
+            pull_bounds()
+            # the termination DECISION is itself voted: identical voted
+            # inputs make it deterministic, and the assert turns any
+            # nondeterminism bug into a loud failure instead of a psum
+            # deadlock two iterations later
+            stop = rel_gap_target >= 0 and gap() <= rel_gap_target
+            votes = allgather(1.0 if stop else 0.0)
+            assert all(v == votes[0] for v in votes), \
+                "controllers disagreed on termination — determinism bug"
+            if votes[0]:
+                break
+    finally:
+        if writer:
+            fabric.send_terminate()
+
+    # harvest late spoke bounds posted between our last pull and the kill
+    # (their boxes stay writable after the hub->spoke kill, and finalize
+    # passes may tighten bounds — hub_finalize semantics, hub.py:438-450).
+    # FIXED poll count: a wall-clock-bounded loop could run different
+    # iteration counts on different controllers and deadlock the vote's
+    # collectives — the same reason the segmented dispatch runs a
+    # deterministic schedule multi-process.
+    polls = max(1, int(float(options.get("linger_secs", 10.0)) / 0.25))
+    for _ in range(polls):
+        pull_bounds()
+        time.sleep(0.25)
+
+    return DistWheelResult(BestInner, BestOuter, gap(), conv, eobj, it,
+                           total_retries)

@@ -200,33 +200,35 @@ def _init_state_dist(arr, default_rho, settings):
     return jax.jit(init, out_shardings=like)()
 
 
-def distributed_ph(all_scenario_names, scenario_creator,
-                   scenario_creator_kwargs=None, options=None,
-                   mesh=None, axis: str = "scen"):
-    """Run scenario-sharded PH with scenarios partitioned across PROCESSES.
+class DistPHSetup(NamedTuple):
+    """Everything a multi-controller PH loop needs (``_setup_distributed``)."""
 
-    Call collectively from every process of an initialized
-    ``jax.distributed`` job.  Each process instantiates only its own
-    scenario slice (:func:`scen_to_process`), so the global family never
-    materializes on one host — the reference's rank-local scenario lists
-    (spbase.py:184-216).  Returns a :class:`DistPHResult` (identical on
-    every process; the consensus xbar is fully reduced).
-    """
-    import jax
+    arr: object          # sharded.PHArrays, globally sharded
+    state: object        # sharded.PHState
+    refresh: object
+    frozen: object
+    batch_local: object  # this process's ScenarioBatch slice
+    settings: object
+    mesh: object
+    S: int               # global (unpadded) scenario count
 
+
+def _setup_distributed(all_scenario_names, scenario_creator,
+                       scenario_creator_kwargs=None, options=None,
+                       mesh=None, axis: str = "scen") -> DistPHSetup:
+    """Collective setup for one multi-controller cylinder: local scenario
+    slice -> globally-sharded arrays + compiled step pair + initial state.
+    Shared by :func:`distributed_ph` and the distributed wheel hub
+    (:mod:`tpusppy.parallel.dist_wheel`)."""
     from ..ir import ScenarioBatch
     from ..solvers.admm import ADMMSettings
     from . import sharded
-
-    from ..solvers.admm import ADMMSettings as _AS
 
     options = dict(options or {})
     kwargs = dict(scenario_creator_kwargs or {})
     S = len(all_scenario_names)
     if mesh is None:
-        from . import sharded as _sh
-
-        mesh = _sh.make_mesh(axis=axis)
+        mesh = sharded.make_mesh(axis=axis)
     rows, _ = process_rows(mesh, S, axis)
     local_ids = [int(r) for r in rows if r < S]
     local_names = [all_scenario_names[i] for i in local_ids]
@@ -256,6 +258,29 @@ def distributed_ph(all_scenario_names, scenario_creator,
         batch_local.tree.nonant_indices, settings, mesh, axis)
     state = _init_state_dist(
         arr, float(options.get("defaultPHrho", 1.0)), settings)
+    return DistPHSetup(arr, state, refresh, frozen, batch_local, settings,
+                       mesh, S)
+
+
+def distributed_ph(all_scenario_names, scenario_creator,
+                   scenario_creator_kwargs=None, options=None,
+                   mesh=None, axis: str = "scen"):
+    """Run scenario-sharded PH with scenarios partitioned across PROCESSES.
+
+    Call collectively from every process of an initialized
+    ``jax.distributed`` job.  Each process instantiates only its own
+    scenario slice (:func:`scen_to_process`), so the global family never
+    materializes on one host — the reference's rank-local scenario lists
+    (spbase.py:184-216).  Returns a :class:`DistPHResult` (identical on
+    every process; the consensus xbar is fully reduced).
+    """
+    import jax
+
+    options = dict(options or {})
+    setup = _setup_distributed(all_scenario_names, scenario_creator,
+                               scenario_creator_kwargs, options, mesh, axis)
+    arr, state, refresh, frozen = (setup.arr, setup.state, setup.refresh,
+                                   setup.frozen)
 
     iters = int(options.get("PHIterLimit", 10))
     refresh_every = max(1, int(options.get("solver_refresh_every", 16)))
