@@ -333,6 +333,13 @@ def uc_metrics():
                                                  "every": lift_every,
                                                  "mip_rel_gap": 1e-4,
                                                  "time_limit": 30.0},
+                        # full scale: exact donor duals transferred
+                        # batch-wide (spopt.dual_donor_bounds) — the
+                        # certified outer bound no longer rides S=1000
+                        # plateaued ADMM duals
+                        **({"lagrangian_dual_donors": {
+                            "k": 24, "budget_s": 120.0}}
+                           if full_scale else {}),
                         "lagrangian_milp_ascent": {
                             "steps": 10, "budget_s": ascent_budget,
                             "mip_rel_gap": 1e-3, "time_limit": 30.0,
@@ -448,8 +455,12 @@ def uc_metrics():
     wall, ib, ob = result["wall"], result["ib"], result["ob"]
     wall_total = result.get("wall_total", wall)
     gap = (ib - ob) / max(abs(ib), 1e-9) if np.isfinite(ib) else float("inf")
+    # sanity: certified bounds can cross only by tolerance dust; a materially
+    # negative gap means an INVALID bound slipped in — never report it as a
+    # certification (this caught the primal trivial-bound bug in r5)
+    crossed = np.isfinite(gap) and gap < -1e-6
     log(f"uc wheel: {wall:.1f}s inner={ib:.2f} outer={ob:.2f} "
-        f"gap={gap*100:.2f}%")
+        f"gap={gap*100:.2f}%" + (" CROSSED-BOUNDS" if crossed else ""))
 
     return {
         "model": model_name,
@@ -466,7 +477,8 @@ def uc_metrics():
         "gap_pct": round(gap * 100, 3),
         "gap_target_pct": gap_target * 100,
         "certified": bool(np.isfinite(ib) and np.isfinite(ob)
-                          and gap <= gap_target + 1e-9),
+                          and not crossed and gap <= gap_target + 1e-9),
+        **({"crossed_bounds": True} if crossed else {}),
     }
 
 

@@ -49,17 +49,31 @@ class LagrangianOuterBound(OuterBoundWSpoke):
         # CERTIFIED bound: dual objective of the W-augmented subproblems
         # (weak duality absorbs solver tolerance; an inexact primal objective
         # can overshoot the true bound and falsely certify rel_gap)
+        base = None
+        donor_cfg = opt.options.get("lagrangian_dual_donors")
+        if donor_cfg:
+            # full-scale path: plateaued ADMM duals are orders-of-magnitude
+            # loose and per-scenario host rescue is O(S) seconds — transfer
+            # k host-EXACT donor duals batch-wide instead
+            # (spopt.dual_donor_bounds; any y is valid for any scenario)
+            base = opt.Edualbound_perscen(q=q, q2=q2)
+            donors = opt.dual_donor_bounds(q=q, q2=q2, **dict(donor_cfg))
+            if donors is not None:
+                base = np.maximum(base, donors)
         lift_cfg = opt.options.get("lagrangian_milp_lift")
         if lift_cfg and bool(np.asarray(opt.batch.is_int).any()):
             every = max(1, int(lift_cfg.get("every", 1)))
             if getattr(self, "dk_iter", 1) % every == 0:
                 from ..solvers.milp_bound import milp_lift
 
-                base = opt.Edualbound_perscen(q=q, q2=q2)
+                if base is None:
+                    base = opt.Edualbound_perscen(q=q, q2=q2)
                 kw = {k: v for k, v in lift_cfg.items() if k != "every"}
                 lifted, n = milp_lift(opt.batch, q, base, **kw)
                 self.last_milp_lift_count = n
                 return float(opt.probs @ lifted)
+        if base is not None:
+            return float(opt.probs @ base)
         return opt.Edualbound(q=q, q2=q2)
 
     def _set_weights_and_solve(self) -> float:

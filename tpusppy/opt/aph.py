@@ -322,7 +322,9 @@ class APH(PHBase):
             raise RuntimeError(
                 f"Infeasibility detected at APH iter0; mass {feas:.4f}"
             )
-        self.trivial_bound = self.Ebound()
+        # certified (weak-duality) trivial bound — see phbase.iter0: the
+        # primal Ebound of a plateaued iter0 solve is NOT a valid bound
+        self.trivial_bound = self.Edualbound()
         self.best_bound = self.trivial_bound
         self.extobject.post_iter0()
         if self.spcomm is not None:

@@ -161,7 +161,20 @@ class PHBase(SPOpt):
                 + ("ALL of them" if checked_all
                    else f"the {len(worst)} worst — a sampled check")
                 + ") — continuing", True)
-        self.trivial_bound = self.Ebound()
+        # CERTIFIED trivial bound: weak duality, not the primal objective.
+        # Ebound() of an inexact iter0 solve OVERESTIMATES the wait-and-see
+        # bound by the solver residual — at reference scale (S=1000 WECC,
+        # solves parked at plateau) by double digits, which crossed the
+        # bounds and FALSELY certified a negative gap in the r5 full-scale
+        # wheel.  With converged solves the two coincide to tolerance.
+        self.trivial_bound = self.Edualbound()
+        eb = self.Ebound()
+        if np.isfinite(eb) and abs(eb - self.trivial_bound) > \
+                1e-3 * max(1.0, abs(eb)):
+            global_toc(
+                f"iter0: certified trivial bound {self.trivial_bound:.4e} "
+                f"(primal objective {eb:.4e} is solver-tolerance-loose "
+                "and NOT used as a bound)", True)
         self.best_bound = self.trivial_bound
         self.Compute_Xbar()
         self.Update_W()

@@ -72,11 +72,14 @@ def solve_lp(c, A, cl, cu, lb, ub, is_int=None, q2=None, const=0.0,
                        feasible=feasible, dual_bound=db)
 
 
-def solve_lp_with_duals(c, A, cl, cu, lb, ub, const=0.0) -> SolveResult:
+def solve_lp_with_duals(c, A, cl, cu, lb, ub, const=0.0,
+                        time_limit=None) -> SolveResult:
     """Continuous LP with row duals via linprog (for Benders/Lagrangian
     checks and the straggler rescue).  ``A`` goes through scipy.sparse:
     UC-scale matrices are ~0.3% dense, and linprog's dense input path
-    both copies and scans the full (m, n) array per call."""
+    both copies and scans the full (m, n) array per call.
+    ``time_limit``: HiGHS wall-clock cap in seconds (budgeted callers —
+    e.g. donor-dual rounds — must not hang on one degenerate LP)."""
     # linprog wants A_ub x <= b_ub and A_eq x = b_eq; split rows.
     if not sp.issparse(A):
         A = sp.csr_matrix(np.asarray(A))
@@ -88,8 +91,10 @@ def solve_lp_with_duals(c, A, cl, cu, lb, ub, const=0.0) -> SolveResult:
     b_ub = np.concatenate([cu[ub_rows], -cl[lb_rows]]) if A_ub is not None else None
     A_eq = A[eq] if eq.any() else None
     b_eq = cl[eq] if eq.any() else None
+    options = {"time_limit": float(time_limit)} if time_limit else None
     res = sopt.linprog(c, A_ub=A_ub, b_ub=b_ub, A_eq=A_eq, b_eq=b_eq,
-                       bounds=np.stack([lb, ub], axis=1), method="highs")
+                       bounds=np.stack([lb, ub], axis=1), method="highs",
+                       options=options)
     duals = None
     if res.status == 0:
         duals = np.zeros(A.shape[0])
