@@ -338,12 +338,22 @@ def uc_metrics():
                         # certified outer bound no longer rides S=1000
                         # plateaued ADMM duals
                         **({"lagrangian_dual_donors": {
-                            "k": 24, "budget_s": 120.0}}
+                            "k": 24, "budget_s": 120.0,
+                            "time_limit": 20.0},
+                            # the S=1000 batched solve starves the wheel
+                            # and its plateaued duals lose to donors
+                            # anyway — donors ARE the outer bound here
+                            "lagrangian_skip_solve": True}
                            if full_scale else {}),
-                        "lagrangian_milp_ascent": {
-                            "steps": 10, "budget_s": ascent_budget,
-                            "mip_rel_gap": 1e-3, "time_limit": 30.0,
-                            "skip_if_gap_at": gap_target}},
+                        # full scale: no subgradient ascent at teardown —
+                        # each of its steps is a batched S-solve (the exact
+                        # cost lagrangian_skip_solve removes), and the
+                        # donor pass at the final W is the polish
+                        **({} if full_scale else {
+                            "lagrangian_milp_ascent": {
+                                "steps": 10, "budget_s": ascent_budget,
+                                "mip_rel_gap": 1e-3, "time_limit": 30.0,
+                                "skip_if_gap_at": gap_target}})},
             "all_scenario_names": names,
             "scenario_creator": uc_model.scenario_creator,
             "scenario_creator_kwargs": kw,

@@ -184,3 +184,63 @@ def test_dual_donor_bounds_valid_and_tight():
     # a dense 1000-scenario ladder, where the nearest donor is far closer)
     p = b.tree.scen_prob
     assert float(p @ donors) >= float(p @ exact) - 0.05 * abs(float(p @ exact))
+
+
+def test_full_scale_wheel_recipe_certifies_at_mini_scale():
+    """The S=1000 wheel recipe end-to-end at fixture scale: donor-only
+    Lagrangian (lagrangian_skip_solve — no batched solve in the spoke),
+    repair-based incumbent evaluation, certified gap closes."""
+    from tpusppy.cylinders import (LagrangianOuterBound, PHHub,
+                                   XhatShuffleInnerBound)
+    from tpusppy.models import uc_data
+    from tpusppy.opt.ph import PH
+    from tpusppy.phbase import PHBase
+    from tpusppy.spin_the_wheel import WheelSpinner
+    from tpusppy.xhat_eval import Xhat_Eval
+
+    S, H = 4, 6
+    names = uc_data.scenario_names_creator(data_dir=DD)[:S]
+    kw = {"data_dir": DD, "horizon": H, "relax_integers": False,
+          "num_scens": S}
+
+    def okw(iters=20):
+        return {
+            "options": {"batch_cache": True, "defaultPHrho": 500.0,
+                        "PHIterLimit": iters, "convthresh": -1.0,
+                        "lagrangian_dual_donors": {"k": 4, "budget_s": 60.0,
+                                                   "time_limit": 20.0},
+                        "lagrangian_skip_solve": True,
+                        "xhat_looper_options": {
+                            "scen_limit": 2, "donor_milp": True,
+                            "donor_milp_time": 30.0},
+                        "solver_options": {"dtype": "float64",
+                                           "eps_abs": 1e-8, "eps_rel": 1e-8,
+                                           "max_iter": 400, "restarts": 3}},
+            "all_scenario_names": names,
+            "scenario_creator": uc_data.scenario_creator,
+            "scenario_creator_kwargs": kw,
+        }
+
+    from tpusppy.spbase import clear_batch_cache
+
+    clear_batch_cache()
+    hub_dict = {
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": {"rel_gap": 0.02, "linger_secs": 30.0}},
+        "opt_class": PH, "opt_kwargs": okw(20),
+    }
+    spokes = [
+        {"spoke_class": LagrangianOuterBound, "opt_class": PHBase,
+         "opt_kwargs": okw()},
+        {"spoke_class": XhatShuffleInnerBound, "opt_class": Xhat_Eval,
+         "opt_kwargs": okw()},
+    ]
+    ws = WheelSpinner(hub_dict, spokes).spin()
+    assert np.isfinite(ws.BestInnerBound)
+    assert np.isfinite(ws.BestOuterBound)
+    # bounds must NOT cross (both certified now)
+    assert ws.BestOuterBound <= ws.BestInnerBound + 1e-6
+    gap = (ws.BestInnerBound - ws.BestOuterBound) / abs(ws.BestOuterBound)
+    # donor transfer slack at this sparse 4-scenario ladder is a few %
+    assert gap <= 0.10
+    clear_batch_cache()

@@ -45,21 +45,35 @@ class LagrangianOuterBound(OuterBoundWSpoke):
         """
         opt = self.opt
         q, q2 = opt._augmented_q()
-        opt.solve_loop(q=q, q2=q2)
+        donor_cfg = opt.options.get("lagrangian_dual_donors")
+        # full scale (lagrangian_skip_solve): the batched S-solve exists
+        # only to produce ADMM duals, which plateau orders-of-magnitude
+        # loose at reference scale AND starve the chip for the hub/eval
+        # cylinders (the r5 run-4 trace: the spoke's first pass never
+        # finished inside a 3000s wheel).  Donor transfer needs no solve —
+        # bound from k host-exact donor duals alone.
+        skip_solve = bool(opt.options.get("lagrangian_skip_solve")
+                          and donor_cfg)
+        if not skip_solve:
+            opt.solve_loop(q=q, q2=q2)
         # CERTIFIED bound: dual objective of the W-augmented subproblems
         # (weak duality absorbs solver tolerance; an inexact primal objective
         # can overshoot the true bound and falsely certify rel_gap)
         base = None
-        donor_cfg = opt.options.get("lagrangian_dual_donors")
         if donor_cfg:
-            # full-scale path: plateaued ADMM duals are orders-of-magnitude
-            # loose and per-scenario host rescue is O(S) seconds — transfer
-            # k host-EXACT donor duals batch-wide instead
+            # plateaued ADMM duals are orders-of-magnitude loose and
+            # per-scenario host rescue is O(S) seconds — transfer k
+            # host-EXACT donor duals batch-wide instead
             # (spopt.dual_donor_bounds; any y is valid for any scenario)
-            base = opt.Edualbound_perscen(q=q, q2=q2)
             donors = opt.dual_donor_bounds(q=q, q2=q2, **dict(donor_cfg))
             if donors is not None:
-                base = np.maximum(base, donors)
+                base = donors
+                if not skip_solve:
+                    base = np.maximum(
+                        opt.Edualbound_perscen(q=q, q2=q2), donors)
+            elif skip_solve:
+                # donors failed entirely: fall back to the solve path
+                opt.solve_loop(q=q, q2=q2)
         lift_cfg = opt.options.get("lagrangian_milp_lift")
         if lift_cfg and bool(np.asarray(opt.batch.is_int).any()):
             every = max(1, int(lift_cfg.get("every", 1)))

@@ -662,14 +662,28 @@ class SPOpt(SPBase):
         from .solvers import scipy_backend
 
         b = self.batch
-        if isinstance(b, BucketedBatch) or self._warm is None:
+        if isinstance(b, BucketedBatch):
             return None
         q = np.asarray(b.c if q is None else q, dtype=float)
         q2 = np.asarray(b.q2 if q2 is None else q2, dtype=float)
         lb = np.asarray(b.lb if self._fixed_lb is None else self._fixed_lb)
         ub = np.asarray(b.ub if self._fixed_ub is None else self._fixed_ub)
         S = b.num_scenarios
-        x_hint = np.asarray(self._warm[0])
+        if self._warm is not None:
+            x_hint = np.asarray(self._warm[0])
+        else:
+            # no prior batched solve (the full-scale Lagrangian skips it —
+            # donors ARE the bound): a conservative hint sized from the
+            # finite problem data keeps the X-cap certificate box far
+            # outside any reachable optimizer (exact donor duals leave
+            # ~zero reduced cost on capped coordinates, so the margin
+            # stays ~0 regardless)
+            finite_max = 1.0
+            for arr in (b.cl, b.cu, lb, ub):
+                fa = np.abs(arr[np.isfinite(arr)])
+                if fa.size:
+                    finite_max = max(finite_max, float(fa.max()))
+            x_hint = np.full((S, b.num_vars), finite_max)
         cache = getattr(self, "_donor_dual_cache", None)
         age = getattr(self, "_donor_dual_age", 0)
         if cache is None or age >= max(1, int(refresh_every)):
@@ -696,6 +710,18 @@ class SPOpt(SPBase):
                 cache.append(_pick_dual_sign(
                     q[s_k], b.A[s_k], b.cl[s_k], b.cu[s_k],
                     lb[s_k], ub[s_k], res.duals, res.x, obj_k))
+            if not cache:
+                # refresh produced nothing (every LP timed out): KEEP the
+                # previous duals — still valid certificates — and leave the
+                # cache unset otherwise so the next call retries instead of
+                # serving an empty cache for refresh_every-1 rounds
+                prev = getattr(self, "_donor_dual_cache", None)
+                if prev:
+                    cache = prev
+                else:
+                    self._donor_dual_cache = None
+                    self._donor_dual_age = 0
+                    return None
             self._donor_dual_cache = cache
             age = 0
         self._donor_dual_age = age + 1
