@@ -130,10 +130,12 @@ def main():
                  or os.environ.get("JAX_PLATFORMS") == "cpu")
     attempts = int(os.environ.get("BENCH_TPU_ATTEMPTS", "3"))
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
-    # headroom accounting: farmer ~250s + UC batch/iter0 ~210s + rate loop
-    # ~360s + MIP baseline ~100s + wheel watchdog 1500s + spoke teardown
-    # (lingering final passes) ~300s ≈ 2700s typical, plus compile variance
-    run_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", "4000"))
+    # headroom accounting (full-scale wheel default): farmer ~250s + UC
+    # batch/iter0 ~300s + rate loop ~200s + h48 probe ~250s + MIP baseline
+    # ~100s + S=1000 wheel ~1850s-to-gap + teardown ~900s ≈ 3900s typical,
+    # plus compile variance — the child's deadline-derived watchdog shrinks
+    # the wheel budget to whatever actually remains
+    run_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", "5200"))
     cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", "2400"))
     backoff = float(os.environ.get("BENCH_BACKOFF", "30"))
 
