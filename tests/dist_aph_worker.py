@@ -25,9 +25,19 @@ def main():
     local = names[lo:hi]
     share = (hi - lo) / n
 
+    import dataclasses
+
+    def local_creator(name, **kw):
+        # the local slice's probabilities must sum to 1 for the tree build;
+        # the TRUE global weighting re-enters through prob_share (the same
+        # renormalization _setup_distributed applies)
+        p = farmer.scenario_creator(name, num_scens=n)
+        return dataclasses.replace(p, prob=p.prob / share)
+
     # probe the local tree for the partial-sum length (4*N*K + 1)
-    probe = ScenarioBatch.from_problems([
-        farmer.scenario_creator(nm, num_scens=n) for nm in local[:1]])
+    probe = ScenarioBatch.from_problems(
+        [dataclasses.replace(farmer.scenario_creator(local[0], num_scens=n),
+                             prob=1.0)])
     K = probe.tree.num_nonants
     N = probe.tree.num_nodes
     L = 4 * N * K + 1
@@ -44,9 +54,8 @@ def main():
         "solver_options": {"dtype": "float64", "eps_abs": 1e-8,
                            "eps_rel": 1e-8, "max_iter": 300, "restarts": 3},
     }
-    aph = DistributedAPH(options, local, farmer.scenario_creator,
-                         sync=sync, prob_share=share,
-                         scenario_creator_kwargs={"num_scens": n})
+    aph = DistributedAPH(options, local, local_creator,
+                         sync=sync, prob_share=share)
     t0 = time.time()
     conv, eobj, tbound = aph.APH_main()
     out = {

@@ -48,7 +48,7 @@ def main():
         names, farmer.scenario_creator,
         scenario_creator_kwargs={"num_scens": n},
         options={"defaultPHrho": 1.0, "PHIterLimit": 120,
-                 "rel_gap": 1e-3, "linger_secs": 8.0,
+                 "rel_gap": 1e-3, "linger_secs": 8.0, "harvest_secs": 90.0,
                  "solver_options": {"dtype": "float64", "eps_abs": 1e-8,
                                     "eps_rel": 1e-8, "max_iter": 300,
                                     "restarts": 3}},

@@ -178,13 +178,16 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
                           and better_inner(b, BestInner)):
                         BestInner = b
 
-    def push_state():
+    def fetch_consensus():
         # the replicated fetch is a COLLECTIVE (cross-process all-gather):
         # every controller must join it, even though only controller 0
         # writes the result into the spoke boxes — an early non-writer
         # return here deadlocks the mesh (Gloo rendezvous timeout)
-        W = fetch(state.W).ravel()
-        xk = fetch(state.x)[:, nonant_idx].ravel()
+        return (fetch(state.W).ravel(),
+                fetch(state.x)[:, nonant_idx].ravel())
+
+    def push_state(cached=None):
+        W, xk = fetch_consensus() if cached is None else cached
         if not writer:
             return
         for i, role in enumerate(spoke_roles):
@@ -225,6 +228,18 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
 
     conv = eobj = inf
     it = 0
+
+    def voted_stop():
+        # the termination DECISION is itself voted: identical voted
+        # inputs make it deterministic, and the assert turns any
+        # nondeterminism bug into a loud failure instead of a psum
+        # deadlock two iterations later
+        stop = rel_gap_target >= 0 and gap() <= rel_gap_target
+        votes = allgather(1.0 if stop else 0.0)
+        assert all(v == votes[0] for v in votes), \
+            "controllers disagreed on termination — determinism bug"
+        return bool(votes[0])
+
     try:
         for it in range(1, iters + 1):
             if (it - 1) % refresh_every == 0:
@@ -235,16 +250,29 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
             eobj = float(np.asarray(out.eobj))
             push_state()
             pull_bounds()
-            # the termination DECISION is itself voted: identical voted
-            # inputs make it deterministic, and the assert turns any
-            # nondeterminism bug into a loud failure instead of a psum
-            # deadlock two iterations later
-            stop = rel_gap_target >= 0 and gap() <= rel_gap_target
-            votes = allgather(1.0 if stop else 0.0)
-            assert all(v == votes[0] for v in votes), \
-                "controllers disagreed on termination — determinism bug"
-            if votes[0]:
+            if voted_stop():
                 break
+        else:
+            # PRE-KILL harvest (PHHub._linger semantics): the hub's sharded
+            # iterations are much faster than the spokes' solve rounds, so
+            # at loop end the spokes are still digesting early Ws.  Keep
+            # the final consensus posted and the bound boxes polled until
+            # the gap certifies or the budget runs out — FIXED poll count,
+            # like every other loop here (wall-clock-bounded loops could
+            # desynchronize the controllers' collective calls).  Pointless
+            # without a gap target; the state is frozen, so the consensus
+            # is fetched ONCE and only the bound tail refreshes per poll.
+            if rel_gap_target >= 0:
+                cached = fetch_consensus()
+                polls = max(1, int(float(options.get(
+                    "harvest_secs",
+                    options.get("linger_secs", 10.0))) / 0.5))
+                for _ in range(polls):
+                    push_state(cached)
+                    pull_bounds()
+                    if voted_stop():
+                        break
+                    time.sleep(0.5)
     finally:
         if writer:
             fabric.send_terminate()
