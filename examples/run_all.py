@@ -47,9 +47,11 @@ RUNS = [
       "--rel-gap", "0.05", "--lagrangian", "--xhatshuffle"],
      {"obj": 219842.875, "rel": 2e-2, "gap": 0.10}),
     ("sslp/sslp_cylinders.py",
-     ["--num-scens", "4", "--max-iterations", "20", "--default-rho", "5.0",
-      "--rel-gap", "0.05", "--lagrangian", "--xhatshuffle"],
-     {"obj": -24.0285, "rel": 2e-2, "gap": 0.10}),
+     # rho matters here: 5.0 parks the incumbent 16% off optimum (gap 26%);
+     # 100.0 certifies ~2.4% with a near-optimal incumbent (rho sweep r5)
+     ["--num-scens", "4", "--max-iterations", "40", "--default-rho", "100.0",
+      "--rel-gap", "0.02", "--lagrangian", "--xhatshuffle"],
+     {"obj": -24.0285, "rel": 2e-2, "gap": 0.05}),
     ("netdes/netdes_cylinders.py",
      ["--num-scens", "3", "--max-iterations", "20", "--default-rho", "1.0",
       "--rel-gap", "0.05", "--lagrangian", "--xhatshuffle"],
