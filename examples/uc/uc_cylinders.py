@@ -43,6 +43,14 @@ def _parse_args():
     cfg.add_to_config("uc_wind_frac",
                       "mean wind share of peak thermal capacity (full model)",
                       float, 0.25)
+    # full-scale certified-bound machinery (what the S=1000 bench wheel
+    # runs): donor-dual Lagrangian bounds with the batched solve skipped,
+    # shared batch cache across cylinders
+    cfg.add_to_config("dual_donors",
+                      "Lagrangian outer bounds from k host-exact donor "
+                      "duals transferred batch-wide (0 = off); at full "
+                      "scale also skips the spoke's batched solve",
+                      int, 0)
     cfg.parse_command_line("uc_cylinders")
     if cfg.uc_model not in ("full", "lite", "data"):
         raise ValueError(f"--uc-model must be 'full', 'lite' or 'data', "
@@ -84,6 +92,22 @@ def main():
         spokes.append(vanilla.lagrangian_spoke(**beans))
     if cfg.xhatshuffle:
         spokes.append(vanilla.xhatshuffle_spoke(**beans))
+    if cfg.dual_donors:
+        # the full-scale posture (bench_uc S=1000): one shared batch,
+        # donor-dual Lagrangian with no batched solve in the spoke
+        extra = {"batch_cache": True,
+                 "lagrangian_dual_donors": {"k": int(cfg.dual_donors),
+                                            "budget_s": 120.0,
+                                            "time_limit": 20.0},
+                 "lagrangian_skip_solve": True,
+                 # integer UC candidates need exact donor first stages —
+                 # rounding dives wedge on commitment clocks (bench_uc
+                 # posture); repair-based evaluation prices them
+                 "xhat_looper_options": {"scen_limit": 2,
+                                         "donor_milp": True,
+                                         "donor_milp_time": 60.0}}
+        for d in [hub_dict] + spokes:
+            d["opt_kwargs"]["options"].update(extra)
     ws = WheelSpinner(hub_dict, spokes)
     ws.spin()
     ws.write_first_stage_solution("uc_first_stage.csv")
