@@ -327,6 +327,12 @@ def uc_metrics():
                         "xhat_xbar_options": {
                             "thresholds": [0.5, 0.4, 0.35, 0.3, 0.25]
                             if degraded else [0.5, 0.35]},
+                        # every=2, NOT 1 (A/B'd at full scale): every=1
+                        # lands the FIRST restricted-EF candidate one hub
+                        # iteration earlier but it is a WORSE incumbent —
+                        # the wheel certified 0.899% (thin margin) vs the
+                        # 0.34% the one-iteration-later consensus gives,
+                        # with no wall-clock win on an idle host
                         "xhat_ef_options": {"every": 2, "ksub": 6,
                                             "time_limit": 120.0},
                         "lagrangian_milp_lift": {"budget_s": lift_budget,
