@@ -35,7 +35,12 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def uc_metrics():
+def uc_metrics(progress=None):
+    """UC metrics dict.  ``progress(partial_dict)`` (optional) is called
+    with the rate-metric fields the moment they exist — BEFORE the
+    long-running wheel — so a kill during the wheel still leaves the
+    rate/MFU numbers in the artifact (bench.py relays them as a partial
+    JSON line)."""
     import jax
 
     import tpusppy
@@ -44,8 +49,11 @@ def uc_metrics():
         tpusppy.disable_tictoc_output()
     from tpusppy.ir import ScenarioBatch
     from tpusppy.parallel import sharded
+    from tpusppy.solvers import flops as flops_model
     from tpusppy.solvers import scipy_backend
+    from tpusppy.solvers import segmented as segmented_solvers
     from tpusppy.solvers.admm import ADMMSettings
+    from tpusppy.solvers.sparse import SparseA
 
     # Default: the reference-shape scaled UC (30 gens x 24 h with min-up/
     # down, startup ramps, reserves — models/uc.py, shared-A engine),
@@ -177,7 +185,23 @@ def uc_metrics():
             state, out = frozen(state, arr, 1.0, factors)
     conv = float(np.asarray(out.conv))
     iters_per_sec = iters / (time.time() - t0)
-    log(f"uc PH: {iters_per_sec:.3f} iters/sec (conv={conv:.3e})")
+    sweeps = float(np.asarray(out.iters))
+    log(f"uc PH: {iters_per_sec:.3f} iters/sec (conv={conv:.3e}, "
+        f"sweeps/iter={sweeps:.0f})")
+
+    # FLOP-model MFU for the UC rate segment (solvers/flops.py): shared-A
+    # engine => one factorization per refresh; the SparseA engine's model
+    # flops are the dense accounting scaled by the same measured factor
+    # the dispatch model uses
+    sparse_f = (segmented_solvers.SPARSE_DISPATCH_FACTOR
+                if isinstance(arr.A, SparseA) else 1.0)
+    flops_it = flops_model.ph_iteration_flops(
+        batch.num_scenarios, batch.num_vars, batch.num_rows, sweeps,
+        refresh_every, settings.restarts, factor_batch=1,
+        sparse_factor=sparse_f)
+    mfu, mfu_note = flops_model.mfu_pct(
+        iters_per_sec, flops_it, len(mesh.devices.flat), jax.devices()[0],
+        settings.matmul_precision)
 
     # FULL-reference-horizon submetric (horizon 48, n=32016 at S=1000):
     # the shape the dense engine could never fit on one chip (4.1 GB
@@ -235,6 +259,25 @@ def uc_metrics():
     log(f"uc baseline (serial HiGHS MIP): {t_mip*1e3:.1f} ms/scenario "
         f"=> {base_ips:.4f} iters/sec serial, {base32:.4f} at ideal "
         f"{RANKS}-rank scaling")
+
+    rate_fields = {
+        "model": model_name,
+        "ph_iters_per_sec": round(iters_per_sec, 4),
+        "plateau_window": plateau_window,
+        "sweeps_per_iter": round(sweeps, 1),
+        "mfu_pct": round(mfu, 2) if mfu is not None else None,
+        "mfu_note": mfu_note,
+        "h48_ph_iters_per_sec": (round(h48_rate, 4) if h48_rate else None),
+        "vs_baseline": round(iters_per_sec / base_ips, 2),
+        "vs_baseline_32rank": round(iters_per_sec / base32, 2),
+        "S": S, "degraded_cpu_run": degraded,
+    }
+    if progress is not None:
+        # bank the rate/MFU segment NOW: the wheel below can run for
+        # thousands of seconds and a kill there must not lose these
+        progress(dict(rate_fields, wall_s_to_gap=None, gap_pct=None,
+                      gap_target_pct=gap_target * 100, certified=False,
+                      wheel_pending=True))
 
     # free the rate-metric's device residency before the wheel: the S=1000
     # arrays + factors (~6 GB at reference shape) plus the compiled S=1000
@@ -450,19 +493,11 @@ def uc_metrics():
     if "wall" not in result:
         why = result.get("error", f"timeout after {budget:.0f}s")
         log(f"uc wheel: {why}")
-        out = {
-            "model": model_name,
-            "wheel_S": S_wheel,
-            "ph_iters_per_sec": round(iters_per_sec, 4),
-            "plateau_window": plateau_window,
-            "h48_ph_iters_per_sec": (round(h48_rate, 4)
-                                     if h48_rate else None),
-            "vs_baseline": round(iters_per_sec / base_ips, 2),
-            "vs_baseline_32rank": round(iters_per_sec / base32, 2),
-            "S": S, "degraded_cpu_run": degraded,
-            "wall_s_to_gap": None, "gap_pct": None,
-            "gap_target_pct": gap_target * 100, "certified": False,
-        }
+        out = dict(
+            rate_fields, wheel_S=S_wheel,
+            wall_s_to_gap=None, gap_pct=None,
+            gap_target_pct=gap_target * 100, certified=False,
+        )
         if "error" in result:
             out["wheel_error"] = result["error"]
         else:
@@ -478,24 +513,16 @@ def uc_metrics():
     log(f"uc wheel: {wall:.1f}s inner={ib:.2f} outer={ob:.2f} "
         f"gap={gap*100:.2f}%" + (" CROSSED-BOUNDS" if crossed else ""))
 
-    return {
-        "model": model_name,
-        "wheel_S": S_wheel,
-        "ph_iters_per_sec": round(iters_per_sec, 4),
-        "plateau_window": plateau_window,
-        "h48_ph_iters_per_sec": (round(h48_rate, 4)
-                                 if h48_rate else None),
-        "vs_baseline": round(iters_per_sec / base_ips, 2),
-        "vs_baseline_32rank": round(iters_per_sec / base32, 2),
-        "S": S, "degraded_cpu_run": degraded,
-        "wall_s_to_gap": round(wall, 1),
-        "wall_s_total": round(wall_total, 1),
-        "gap_pct": round(gap * 100, 3),
-        "gap_target_pct": gap_target * 100,
-        "certified": bool(np.isfinite(ib) and np.isfinite(ob)
-                          and not crossed and gap <= gap_target + 1e-9),
+    return dict(
+        rate_fields, wheel_S=S_wheel,
+        wall_s_to_gap=round(wall, 1),
+        wall_s_total=round(wall_total, 1),
+        gap_pct=round(gap * 100, 3),
+        gap_target_pct=gap_target * 100,
+        certified=bool(np.isfinite(ib) and np.isfinite(ob)
+                       and not crossed and gap <= gap_target + 1e-9),
         **({"crossed_bounds": True} if crossed else {}),
-    }
+    )
 
 
 def main():

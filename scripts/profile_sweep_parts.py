@@ -10,7 +10,6 @@ Usage: python scripts/profile_sweep_parts.py [S] [horizon]
 """
 
 import sys
-import time
 
 import numpy as np
 
@@ -23,6 +22,7 @@ import jax.numpy as jnp
 
 import tpusppy
 tpusppy.disable_tictoc_output()
+from tpusppy import tune
 from tpusppy.ir import ScenarioBatch
 from tpusppy.models import uc_data
 from tpusppy.solvers import structured_kkt as sk
@@ -50,15 +50,9 @@ y = jnp.asarray(rng.normal(size=(S, m)), jnp.float32)
 
 
 def bench(tag, fn, *args):
-    f = jax.jit(fn)
-    out = f(*args)
-    np.asarray(jnp.sum(out if isinstance(out, jax.Array) else out[0]))
-    reps = 20
-    t0 = time.time()
-    for _ in range(reps):
-        out = f(*args)
-    np.asarray(jnp.sum(out if isinstance(out, jax.Array) else out[0]))
-    ms = (time.time() - t0) / reps * 1e3
+    # the jit/fetch timing core moved into tpusppy.tune (reusable by the
+    # fused-cadence autotuner); this script keeps the printing shell
+    ms = tune.time_jitted(jax.jit(fn), *args)
     print(f"  {tag:34s} {ms:8.2f} ms", flush=True)
     return ms
 

@@ -1,0 +1,93 @@
+"""Incremental-artifact contract of bench.py (BENCH_SMOKE stub mode).
+
+The round-5 flagship failure mode: the driver's ``timeout`` SIGKILLed
+bench.py mid-run (rc=124) and the artifact had parsed=null — every number
+the run HAD produced was lost because the one JSON line printed only at
+the very end.  bench.py now emits a valid partial parsed-JSON line after
+*each* segment and the parent relays lines the moment they land, so a
+kill at ANY point leaves rc-independent parseable content.
+
+This test injects exactly that kill: it starts ``python bench.py`` in
+smoke mode (tiny S, CPU, pinned cadence), SIGKILLs the whole process
+group the moment the first segment line appears on stdout, and asserts
+what was captured is a valid artifact carrying the new fields
+(mfu_pct / vs_baseline_32rank / autotune cadence).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _smoke_env():
+    env = {
+        k: v for k, v in os.environ.items()
+        if k != "PYTHONPATH" and "AXON" not in k and not k.startswith("TPU_")
+    }
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_SMOKE"] = "1"
+    return env
+
+
+def test_bench_smoke_kill_leaves_parseable_artifact():
+    proc = subprocess.Popen(
+        [sys.executable, BENCH], env=_smoke_env(), cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        start_new_session=True,   # own process group: the kill takes the
+    )                             # workload child down with the parent
+    lines = []
+    got_json = threading.Event()
+
+    def _reader():
+        for raw in proc.stdout:
+            line = raw.decode(errors="replace").strip()
+            lines.append(line)
+            if line.startswith("{"):
+                got_json.set()
+
+    th = threading.Thread(target=_reader, daemon=True)
+    th.start()
+    try:
+        # the injected mid-run kill: SIGKILL (un-catchable, exactly what
+        # the driver's timeout -k sends) as soon as segment 1 lands
+        assert got_json.wait(timeout=420), (
+            "no JSON segment line within 420s; bench stdout so far: "
+            + repr(lines[-5:]))
+    finally:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait(timeout=30)
+    th.join(timeout=10)
+
+    parsed = None
+    for line in lines:
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)   # EVERY emitted line must parse
+            except json.JSONDecodeError as e:
+                pytest.fail(f"unparseable artifact line {line!r}: {e}")
+    assert parsed is not None
+    # rc-independent contract: the process was SIGKILLed, yet the captured
+    # content is a complete artifact for the segments that finished
+    assert parsed.get("partial") is True
+    assert parsed["metric"].startswith("ph_iters_per_sec_farmer")
+    assert parsed["value"] > 0
+    assert parsed["unit"] == "iter/s"
+    assert parsed["vs_baseline"] > 0
+    assert "vs_baseline_32rank" in parsed
+    # the new accounting fields ride every segment line
+    assert "mfu_pct" in parsed and "mfu_note" in parsed
+    assert parsed["chunk"] >= 1 and parsed["refresh_every"] >= 1
+    assert "autotuned" in parsed

@@ -1,0 +1,136 @@
+"""Fused-cadence autotuner (tpusppy/tune.py).
+
+The autotuner replaces the hard-coded BENCH_CHUNK/refresh_every with
+measured (chunk, refresh_every) per shape.  These tests pin its contract:
+probes advance real PH state, the picked cadence is watchdog-bounded and
+autotuner-reachable, the cache returns without re-probing, and the picked
+cadence reproduces the step-pair trajectory (the parity guarantee the
+fused program carries for ANY cadence).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from tpusppy import tune
+from tpusppy.ir import ScenarioBatch
+from tpusppy.models import farmer
+from tpusppy.parallel import sharded
+from tpusppy.solvers.admm import ADMMSettings
+
+
+def _setup(n_scen=4, max_iter=60):
+    names = farmer.scenario_names_creator(n_scen)
+    batch = ScenarioBatch.from_problems(
+        [farmer.scenario_creator(nm, num_scens=n_scen) for nm in names])
+    mesh = sharded.make_mesh(1)
+    settings = ADMMSettings(max_iter=max_iter, restarts=2)
+    arr = sharded.shard_batch(batch, mesh)
+    idx = batch.tree.nonant_indices
+    refresh, frozen = sharded.make_ph_step_pair(idx, settings, mesh)
+    state, _, _ = refresh(sharded.init_state(arr, 1.0, settings), arr, 0.0)
+    return batch, mesh, settings, arr, idx, refresh, frozen, state
+
+
+def test_autotune_picks_and_advances():
+    tune._cache.clear()
+    batch, mesh, settings, arr, idx, refresh, frozen, state = _setup()
+    w_before = np.array(np.asarray(state.W), copy=True)
+    res = tune.autotune_fused(
+        idx, settings, arr, state, mesh, refresh_candidates=(2, 4),
+        max_chunk=8, budget_s=300.0)
+    assert res is not None
+    assert res.refresh_every in (2, 4)
+    assert res.chunk % res.refresh_every == 0
+    assert res.chunk <= 8
+    assert res.iters_per_sec > 0
+    assert res.sweeps_per_iter >= 1
+    # probes are REAL PH iterations: the returned state moved
+    assert not np.allclose(np.asarray(res.state.W), w_before)
+    # the table records every candidate tried
+    assert len(res.table) == 2
+
+
+def test_autotune_cache_returns_callers_state():
+    tune._cache.clear()
+    batch, mesh, settings, arr, idx, refresh, frozen, state = _setup()
+    r1 = tune.autotune_fused(idx, settings, arr, state, mesh,
+                             refresh_candidates=(2,), max_chunk=4,
+                             budget_s=300.0)
+    state2 = r1.state
+    r2 = tune.autotune_fused(idx, settings, arr, state2, mesh,
+                             refresh_candidates=(2,), max_chunk=4,
+                             budget_s=300.0)
+    assert (r2.chunk, r2.refresh_every) == (r1.chunk, r1.refresh_every)
+    # cache hit: no probes ran, the caller's state is handed back as-is
+    assert r2.state is state2
+    assert not state2.W.is_deleted()
+
+
+def test_autotune_segmentation_regime_declines():
+    """Shapes whose one-block probe would already breach the worker
+    watchdog (static cap < refresh_every) must return None — the caller
+    stays on the segmented step pair."""
+    tune._cache.clear()
+    batch, mesh, settings, arr, idx, refresh, frozen, state = _setup()
+    old_t, old_f = sharded._DISPATCH_TARGET_SECS, sharded._DISPATCH_EFF_FLOPS
+    sharded._DISPATCH_TARGET_SECS, sharded._DISPATCH_EFF_FLOPS = 1e-9, 1.0
+    try:
+        res = tune.autotune_fused(idx, settings, arr, state, mesh,
+                                  refresh_candidates=(4,), max_chunk=8)
+    finally:
+        sharded._DISPATCH_TARGET_SECS = old_t
+        sharded._DISPATCH_EFF_FLOPS = old_f
+    assert res is None
+
+
+def test_autotuned_cadence_parity_with_step_pair():
+    """End-to-end: whatever cadence the tuner picks, the fused program at
+    that cadence reproduces the step-pair trajectory at 1e-9 on the
+    1-device mesh (the acceptance guarantee for trusting tuned numbers)."""
+    tune._cache.clear()
+    batch, mesh, settings, arr, idx, refresh, frozen, state = _setup()
+    res = tune.autotune_fused(idx, settings, arr, state, mesh,
+                              refresh_candidates=(3,), max_chunk=6,
+                              budget_s=300.0)
+    assert res is not None
+    state = res.state   # tuned cadence continues from the probed state
+
+    def host_loop(st, iters, re):
+        factors = None
+        for i in range(iters):
+            if i % re == 0:
+                st, out, factors = refresh(st, arr, 1.0)
+            else:
+                st, out = frozen(st, arr, 1.0, factors)
+        return st, out
+
+    s_ref, out_ref = host_loop(state, res.chunk, res.refresh_every)
+    fused = sharded.make_ph_fused_step(
+        idx, settings, mesh, chunk=res.chunk,
+        refresh_every=res.refresh_every, donate=False)
+    s_f, out_f = fused(state, arr, 1.0)
+    np.testing.assert_allclose(np.asarray(out_f.conv),
+                               np.asarray(out_ref.conv),
+                               rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(s_f.W), np.asarray(s_ref.W),
+                               rtol=1e-9, atol=1e-10)
+
+
+def test_flops_model_fields():
+    from tpusppy.solvers import flops as fm
+    sw = fm.sweep_flops(10, 20, 30)
+    assert sw == 10 * (20 * 20.0 + 2 * 20 * 30) * 2.0
+    fa = fm.factor_flops(20, 30, factor_batch=10)
+    assert fa == 10 * (30 * 400.0 + 3 * 8000.0) * 2.0
+    # refresh amortization: refresh_every=1 bills restarts every iteration
+    every = fm.ph_iteration_flops(10, 20, 30, sweeps=50, refresh_every=1,
+                                  restarts=2, factor_batch=10)
+    amort = fm.ph_iteration_flops(10, 20, 30, sweeps=50, refresh_every=16,
+                                  restarts=2, factor_batch=10)
+    assert every > amort
+    mfu, note = fm.mfu_pct(2.0, 1e9, n_devices=1)
+    assert note
+    if mfu is not None:
+        assert mfu > 0

@@ -30,6 +30,18 @@ from ..solvers import segmented as segmented_solvers
 from ..solvers.admm import ADMMSettings
 from ..solvers.sparse import SparseA
 
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """shard_map across jax versions: ``jax.shard_map`` + ``check_vma``
+    (>= 0.6) when present, else ``jax.experimental.shard_map`` with the
+    old ``check_rep`` spelling (0.4.x, the pinned toolchain here)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
 # ---------------------------------------------------------------------------
 # Dispatch segmentation: the remote TPU worker kills any single program
 # execution around ~60 s, so reference-scale UC (S=1000, n=16008) can never
@@ -108,6 +120,8 @@ class PHStepOut(NamedTuple):
     eobj: jax.Array       # scalar: expected objective at current x
     pri_res: jax.Array    # (S,)
     dua_res: jax.Array    # (S,)
+    iters: jax.Array      # scalar: ADMM sweeps the subproblem solve used
+    # (batch max; feeds the FLOP-model MFU accounting — solvers/flops.py)
 
 
 def _node_xbar(onehot, probs, xk):
@@ -160,14 +174,14 @@ def _solver_fns_for(st: ADMMSettings, mesh, axis):
         sp = jax.sharding.PartitionSpec(axis)
         sol_spec = admm.BatchSolution(*([sp] * 8), raw=(sp, sp, sp, sp))
         fac_spec = admm.Factors(*([sp] * 7))
-        refresh_solve = jax.shard_map(
-            local_refresh, mesh=mesh, in_specs=(sp,) * 11,
-            out_specs=(sol_spec, fac_spec), check_vma=False,
+        refresh_solve = _shard_map(
+            local_refresh, mesh, in_specs=(sp,) * 11,
+            out_specs=(sol_spec, fac_spec),
         )
-        frozen_solve = jax.shard_map(
-            local_frozen, mesh=mesh,
+        frozen_solve = _shard_map(
+            local_frozen, mesh,
             in_specs=(sp,) * 11 + (fac_spec,),
-            out_specs=sol_spec, check_vma=False,
+            out_specs=sol_spec,
         )
     else:
         refresh_solve, frozen_solve = local_refresh, local_frozen
@@ -198,7 +212,8 @@ def _ph_finish(arr, state, sol, W, rho, idx):
         W=new_W, xbars=new_xbars, rho=rho,
         x=sol.x, z=sol.z, y=sol.y, yx=sol.yx,
     )
-    return new_state, PHStepOut(conv, eobj, sol.pri_res, sol.dua_res)
+    return new_state, PHStepOut(conv, eobj, sol.pri_res, sol.dua_res,
+                                jnp.max(sol.iters))
 
 
 def make_ph_step(nonant_idx: np.ndarray, settings: ADMMSettings,
@@ -326,10 +341,10 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
                 sol_spec = admm.BatchSolution(
                     *([sp] * 8), raw=(sp, sp, sp, sp))
                 fac_spec = admm.Factors(*([sp] * 7))
-                local_polish = jax.shard_map(
-                    local_polish, mesh=mesh,
+                local_polish = _shard_map(
+                    local_polish, mesh,
                     in_specs=(sp,) * 11 + (fac_spec,),
-                    out_specs=sol_spec, check_vma=False,
+                    out_specs=sol_spec,
                 )
 
             @jax.jit
@@ -424,7 +439,8 @@ def fused_iteration_cap(arr: PHArrays, settings: ADMMSettings,
 
 def make_ph_fused_step(nonant_idx: np.ndarray, settings: ADMMSettings,
                        mesh: Mesh | None = None, axis: str = "scen",
-                       chunk: int = 16, refresh_every: int | None = None):
+                       chunk: int = 16, refresh_every: int | None = None,
+                       donate: bool = True, collect: str = "last"):
     """ONE jitted program running ``chunk`` PH iterations — the latency-proof
     headline path.
 
@@ -445,33 +461,54 @@ def make_ph_fused_step(nonant_idx: np.ndarray, settings: ADMMSettings,
     multi-iteration program — the XLA-native amortization.
 
     ``refresh_every`` defaults to ``chunk`` (one refresh at the top).
-    ``chunk`` must be a multiple of ``refresh_every``.  Callers must size
-    ``chunk`` within :func:`fused_iteration_cap` — a fused program past the
-    worker watchdog is killed mid-flight, which the host cannot recover.
+    ``chunk`` need NOT be a multiple of ``refresh_every``: a trailing
+    partial block (refresh + the leftover frozen iterations) preserves the
+    host cadence — refreshes land exactly at iteration indices that are
+    multiples of ``refresh_every`` within the chunk.  Callers must size
+    ``chunk`` within :func:`fused_iteration_cap` (or a measured cap from
+    :mod:`tpusppy.tune`) — a fused program past the worker watchdog is
+    killed mid-flight, which the host cannot recover.
 
-    Returns ``fused(state, arr, prox_on) -> (state, out)`` where ``out`` is
-    the LAST iteration's :class:`PHStepOut`.
+    ``donate=True`` (default) donates the incoming :class:`PHState` buffers
+    to the program (``jax.jit`` ``donate_argnums``): the state is updated
+    in place on device instead of round-tripping fresh allocations per
+    chunk.  The caller's input state is CONSUMED — rebind it
+    (``state, out = fused(state, arr, p)``); reading the old reference
+    afterwards raises.  Pass ``donate=False`` for call sites that must
+    re-enter the same state object (A/B comparisons).
+
+    ``collect="last"`` returns the LAST iteration's :class:`PHStepOut`;
+    ``collect="trace"`` returns the full per-iteration trace (leaves gain a
+    leading ``chunk`` axis), carried device-side so a measurement window of
+    many chunks needs ONE host fetch at the end instead of per-iteration
+    conv/eobj syncs.
+
+    Returns ``fused(state, arr, prox_on) -> (state, out)``.
     """
     if refresh_every is None:
         refresh_every = chunk
-    if chunk % refresh_every != 0:
+    if chunk < 1 or refresh_every < 1:
         raise ValueError(
-            f"chunk ({chunk}) must be a multiple of refresh_every "
-            f"({refresh_every})")
-    n_blocks = chunk // refresh_every
+            f"chunk ({chunk}) and refresh_every ({refresh_every}) must be "
+            f">= 1")
+    if collect not in ("last", "trace"):
+        raise ValueError(f"collect must be 'last' or 'trace': {collect!r}")
+    n_full, rem = divmod(chunk, refresh_every)
     idx = jnp.asarray(nonant_idx)
     shared_refresh, shared_frozen, refresh_solve, frozen_solve = \
         _solver_fns_for(settings, mesh, axis)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def fused(state: PHState, arr: PHArrays, prox_on):
-        def block(state, _):
+        def block_outs(state, length):
+            """One refresh + (length-1) frozen iterations; outs stacked
+            along a leading ``length`` axis (the device-side trace)."""
             q, q2, W, rho = _ph_objective(arr, state, prox_on, idx, settings)
             rsolve = shared_refresh if arr.A.ndim == 2 else refresh_solve
             sol, factors = rsolve(
                 q, q2, arr.A, arr.cl, arr.cu, arr.lb, arr.ub,
                 state.x, state.z, state.y, state.yx)
-            state, out = _ph_finish(arr, state, sol, W, rho, idx)
+            state, out0 = _ph_finish(arr, state, sol, W, rho, idx)
 
             def frozen_iter(st, _):
                 q, q2, W, rho = _ph_objective(arr, st, prox_on, idx,
@@ -481,14 +518,31 @@ def make_ph_fused_step(nonant_idx: np.ndarray, settings: ADMMSettings,
                              st.x, st.z, st.y, st.yx, factors)
                 return _ph_finish(arr, st, sol, W, rho, idx)
 
-            if refresh_every > 1:
+            if length > 1:
                 state, outs = jax.lax.scan(
-                    frozen_iter, state, None, length=refresh_every - 1)
-                out = jax.tree.map(lambda a: a[-1], outs)
-            return state, out
+                    frozen_iter, state, None, length=length - 1)
+                outs = jax.tree.map(
+                    lambda a0, a: jnp.concatenate([a0[None], a]), out0, outs)
+            else:
+                outs = jax.tree.map(lambda a: a[None], out0)
+            return state, outs
 
-        state, outs = jax.lax.scan(block, state, None, length=n_blocks)
-        return state, jax.tree.map(lambda a: a[-1], outs)
+        traces = []
+        if n_full:
+            state, outs = jax.lax.scan(
+                lambda s, _: block_outs(s, refresh_every), state, None,
+                length=n_full)
+            # (n_full, refresh_every, ...) -> (n_full * refresh_every, ...)
+            traces.append(jax.tree.map(
+                lambda a: a.reshape((-1,) + a.shape[2:]), outs))
+        if rem:
+            state, outs = block_outs(state, rem)
+            traces.append(outs)
+        trace = (traces[0] if len(traces) == 1 else jax.tree.map(
+            lambda *xs: jnp.concatenate(xs), *traces))
+        if collect == "trace":
+            return state, trace
+        return state, jax.tree.map(lambda a: a[-1], trace)
 
     return fused
 
@@ -666,7 +720,8 @@ def init_state(arr: PHArrays, default_rho: float, settings: ADMMSettings) -> PHS
 
 def run_ph(batch, mesh: Mesh, iters: int, default_rho: float = 1.0,
            settings: ADMMSettings | None = None, axis: str = "scen",
-           refresh_every: int = 32):
+           refresh_every: int = 32, fused: bool | str = "auto",
+           chunk: int | None = None):
     """Sharded PH driver: Iter0 (plain objective via rho=W=0 warmup step
     semantics) + ``iters`` PH iterations.  Returns (state, last PHStepOut).
 
@@ -676,6 +731,15 @@ def run_ph(batch, mesh: Mesh, iters: int, default_rho: float = 1.0,
     frozen path).  Used by ``__graft_entry__.dryrun_multichip`` and
     ``bench.py``; the class API (:class:`tpusppy.opt.ph.PH`) remains the
     feature-complete host path.
+
+    ``fused="auto"`` (default) packs the iterations into fused
+    multi-iteration programs (:func:`make_ph_fused_step`, buffer-donated,
+    same cadence hence bit-identical trajectory) whenever the shape fits
+    the fused dispatch cap; segmentation-regime shapes fall back to the
+    per-iteration step pair.  ``fused=False`` forces the pair path;
+    ``chunk`` overrides the fused chunk size (else the cap, rounded down
+    to a refresh multiple).  conv/eobj stay device-side across chunks —
+    the host syncs only once per dispatch window.
     """
     settings = settings or ADMMSettings()
     arr = shard_batch(batch, mesh, axis)
@@ -685,9 +749,39 @@ def run_ph(batch, mesh: Mesh, iters: int, default_rho: float = 1.0,
     window = dispatch_window(mesh)
     # Iter0: W=0, prox off, cf. phbase.py:758-872
     state, out, _ = refresh(state, arr, 0.0)
+
+    refresh_every = max(refresh_every, 1)
+    cap = fused_iteration_cap(arr, settings, mesh, refresh_every)
+    use_fused = iters > 0 and (
+        fused is True or (fused == "auto" and cap >= refresh_every))
+    if use_fused:
+        if chunk is None:
+            chunk = max(refresh_every,
+                        (cap or iters) // refresh_every * refresh_every)
+        chunk = min(chunk, iters)
+        fused_cache: dict[int, object] = {}
+
+        def fused_for(c):
+            if c not in fused_cache:
+                fused_cache[c] = make_ph_fused_step(
+                    batch.tree.nonant_indices, settings, mesh, axis,
+                    chunk=c, refresh_every=min(refresh_every, c))
+            return fused_cache[c]
+
+        done = 0
+        n_call = 0
+        while done < iters:
+            c = min(chunk, iters - done)
+            state, out = fused_for(c)(state, arr, 1.0)
+            done += c
+            n_call += 1
+            if n_call % window == 0:
+                jax.block_until_ready(out.conv)
+        return state, out
+
     factors = None
     for i in range(iters):
-        if factors is None or i % max(refresh_every, 1) == 0:
+        if factors is None or i % refresh_every == 0:
             state, out, factors = refresh(state, arr, 1.0)
         else:
             state, out = frozen(state, arr, 1.0, factors)

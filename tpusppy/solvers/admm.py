@@ -822,21 +822,26 @@ def solve_batch(c, q2, A, cl, cu, lb, ub, settings: ADMMSettings = ADMMSettings(
         return _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm, P)
 
 
-def _prep(c, q2, A, cl, cu, lb, ub, settings, P):
+def _prep(c, q2, A, cl, cu, lb, ub, settings, P, want_masks=True):
     """Dtype casting, bound cleaning, finiteness masks — shared by the
-    adaptive and frozen entry points."""
+    adaptive and frozen entry points.  ``want_masks=False`` skips the mask
+    reductions for callers that never use them (polish-free frozen solves:
+    inside a fused multi-iteration scan those reductions would otherwise
+    run once per PH iteration for nothing)."""
     dt = settings.jdtype()
     c, q2, A = (jnp.asarray(v, dt) for v in (c, q2, A))
     if P is not None:
         P = jnp.asarray(P, dt)
     cl, cu = _clean_bounds(jnp.asarray(cl, dt), jnp.asarray(cu, dt))
     lb, ub = _clean_bounds(jnp.asarray(lb, dt), jnp.asarray(ub, dt))
-    masks = _BoundMasks(
-        fin_cl=cl > -BIG / 2, fin_cu=cu < BIG / 2,
-        fin_lb=lb > -BIG / 2, fin_ub=ub < BIG / 2,
-        eq=jnp.abs(cu - cl) < 1e-10,
-        eqx=jnp.abs(ub - lb) < 1e-10,
-    )
+    masks = None
+    if want_masks:
+        masks = _BoundMasks(
+            fin_cl=cl > -BIG / 2, fin_cu=cu < BIG / 2,
+            fin_lb=lb > -BIG / 2, fin_ub=ub < BIG / 2,
+            eq=jnp.abs(cu - cl) < 1e-10,
+            eqx=jnp.abs(ub - lb) < 1e-10,
+        )
     return c, q2, A, cl, cu, lb, ub, masks, P
 
 
@@ -915,7 +920,8 @@ def _solve_frozen_impl(c, q2, A, cl, cu, lb, ub, factors: Factors, warm,
     """
     dt = settings.jdtype()
     c, q2, A, cl, cu, lb, ub, masks, P = _prep(
-        c, q2, A, cl, cu, lb, ub, settings, P)
+        c, q2, A, cl, cu, lb, ub, settings, P,
+        want_masks=polish and settings.polish)
     D, E, cost = factors.D, factors.E, factors.cost
     qs, q2s, As, cls, cus, lbs, ubs, Ps, warm = _scale(
         c, q2, A, cl, cu, lb, ub, D, E, cost, P, warm, dt)
@@ -966,6 +972,21 @@ def solve_batch_frozen(c, q2, A, cl, cu, lb, ub, factors: Factors,
     with jax.default_matmul_precision(settings.matmul_precision):
         return _solve_frozen_impl(c, q2, A, cl, cu, lb, ub, factors, warm,
                                   settings, P, polish=polish)
+
+
+@jax.jit
+def stop_stats(sol: BatchSolution):
+    """[max iters, max pri_res, max dua_res] as ONE device array.
+
+    Segmented continuations (:mod:`.segmented`) need the iteration counter
+    (stop-dispatch test) and the worst residuals (plateau detector) on the
+    host between segments; fetched separately that is three serial
+    host<->device round-trips per segment — over a remote TPU tunnel each
+    is a full RPC.  This reduces them to one fetch of a 3-vector."""
+    dt = sol.pri_res.dtype
+    return jnp.stack([sol.iters.max().astype(dt),
+                      sol.pri_res.max().astype(dt),
+                      sol.dua_res.max().astype(dt)])
 
 
 def _Aty(A, y):

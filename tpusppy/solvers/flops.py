@@ -1,0 +1,125 @@
+"""FLOP model for the batched ADMM engines + MFU accounting.
+
+Single source for the arithmetic-cost model that previously lived inline in
+:mod:`tpusppy.solvers.segmented` (dispatch sizing) and is now also consumed
+by the fused-step autotuner (:mod:`tpusppy.tune`) and the benchmark's MFU
+reporting (``bench.py``/``bench_uc.py``).
+
+The model counts the dominant matmul work only (multiply-add = 2 flops):
+
+- one ADMM **sweep** per scenario is one (n, n) x-update apply plus an A and
+  an A' matvec: ``(n^2 + 2nm) * 2`` flops, scaled by ``sparse_factor`` for
+  the gather/segment-sum SparseA engine (measured 2-4x cheaper than the
+  dense accounting at reference-UC shapes);
+- one **factorization** is the K assembly plus the blocked inversion:
+  ``(m n^2 + 3 n^3) * 2`` flops, times ``factor_batch`` (S for the dense
+  per-scenario engine, 1 for the shared-A engine).
+
+MFU is *model* flops over *nominal* peak — an accounting convention, not a
+hardware counter: elementwise work, residual bookkeeping and host/dispatch
+gaps all land in the denominator, so the number is conservative.  The peak
+is precision-adjusted: ``matmul_precision="highest"`` on TPU runs bf16x6
+passes (6 MXU passes per f32 multiply-add), so the achievable ceiling is
+the bf16 peak divided by the pass count.  Report ``peak_note`` alongside
+``mfu_pct`` so the assumption is auditable.
+"""
+
+from __future__ import annotations
+
+# bf16 MXU peak per chip, matched by substring against device_kind (first
+# hit wins; order matters for e.g. "v5" vs "v5p").  Sources: public TPU
+# spec sheets.  Unknown kinds fall back to the env override or None.
+_TPU_PEAKS_BF16 = (
+    ("v6e", 918e12),
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5litepod", 197e12),
+    ("v5", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+# MXU passes per multiply-add at each jax matmul precision on TPU:
+# "highest" = bf16x6 f32 emulation, "high" = bf16x3, "default" = plain bf16
+PRECISION_PASSES = {"highest": 6, "high": 3, "default": 1}
+
+# Nominal CPU peak used when nothing better is known (one modern core's
+# order-of-magnitude f64 FMA throughput).  CPU MFU numbers exist so the
+# smoke bench exercises the full reporting path, not as a claim about the
+# host — the artifact carries peak_note for honesty.
+CPU_NOMINAL_PEAK = 5e10
+
+
+def sweep_flops(S, n, m, sparse_factor=1.0):
+    """Model flops of ONE ADMM sweep over an S-scenario batch."""
+    return S * (n * float(n) + 2.0 * n * m) * 2.0 * sparse_factor
+
+
+def factor_flops(n, m, factor_batch=1, sparse_factor=1.0):
+    """Model flops of one batch (re)factorization."""
+    return factor_batch * (m * float(n) * n + 3.0 * float(n) ** 3) \
+        * 2.0 * sparse_factor
+
+
+def ph_iteration_flops(S, n, m, sweeps, refresh_every=16, restarts=1,
+                       factor_batch=1, sparse_factor=1.0):
+    """Model flops of one PH iteration, refresh cost amortized over the
+    cadence.
+
+    ``sweeps`` is the MEASURED (or configured) ADMM sweep count per
+    subproblem solve — use ``PHStepOut.iters`` from the actual run, not
+    ``max_iter``, or the MFU is inflated by sweeps that never ran.  A
+    refresh iteration runs ``restarts`` adaptation rounds (each a sweep
+    budget + a factorization); 1 in ``refresh_every`` iterations is a
+    refresh.
+    """
+    sw = sweep_flops(S, n, m, sparse_factor) * max(float(sweeps), 1.0)
+    fa = factor_flops(n, m, factor_batch, sparse_factor)
+    f = 1.0 / max(1, refresh_every)
+    rst = max(1, restarts)
+    return (1.0 - f) * sw + f * rst * (sw + fa)
+
+
+def device_peak_flops(device=None, matmul_precision="highest"):
+    """(peak_flops_per_device, note) for MFU accounting.
+
+    ``TPUSPPY_PEAK_FLOPS`` (flops/s per device, already precision-adjusted)
+    overrides everything — the escape hatch for unknown hardware.  Returns
+    (None, reason) when no peak is known.
+    """
+    import os
+
+    env = os.environ.get("TPUSPPY_PEAK_FLOPS")
+    if env:
+        return float(env), "TPUSPPY_PEAK_FLOPS override"
+    if device is None:
+        import jax
+        device = jax.devices()[0]
+    platform = getattr(device, "platform", "cpu")
+    if platform == "cpu":
+        return CPU_NOMINAL_PEAK, "cpu nominal (order-of-magnitude)"
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    passes = PRECISION_PASSES.get(matmul_precision, 1)
+    for key, bf16 in _TPU_PEAKS_BF16:
+        if key in kind:
+            return bf16 / passes, (
+                f"{key} {bf16/1e12:.0f}T bf16 / {passes} "
+                f"({matmul_precision})")
+    return None, f"unknown device_kind {kind!r}"
+
+
+def mfu_pct(iters_per_sec, flops_per_iter, n_devices=1, device=None,
+            matmul_precision="highest"):
+    """(mfu_pct, note): model-flop utilization of the whole mesh.
+
+    None when the peak is unknown (note says why).  ``flops_per_iter`` is
+    the TOTAL model flops of one PH iteration (all scenarios), so the
+    denominator scales with ``n_devices``.
+    """
+    peak, note = device_peak_flops(device, matmul_precision)
+    if peak is None or iters_per_sec is None:
+        return None, note
+    achieved = iters_per_sec * flops_per_iter
+    return 100.0 * achieved / (peak * max(1, n_devices)), note

@@ -24,6 +24,8 @@ import dataclasses
 
 import numpy as np
 
+from . import flops as flops_model
+
 # Per-dispatch budget: must stay well under the remote worker's ~60 s
 # execution kill, but long enough that the solver's IN-LOOP plateau exit
 # (earliest at 3 x sweep_plateau_window = 96 sweeps) can fire inside one
@@ -82,10 +84,11 @@ def dispatch_segments(S, n, m, st, factor_batch=1,
     # replace the dense n^2/nm matmuls with gather/segment-sum matvecs and
     # the block/Woodbury x-update (measured 2-4x cheaper than the dense
     # accounting at reference-UC shapes; 0.25 keeps dispatches inside the
-    # watchdog with the same 2x margin)
-    t_sweep = S * (n * float(n) + 2.0 * n * m) * 2.0 / eff * sparse_factor
-    t_factor = factor_batch * (m * float(n) * n + 3.0 * float(n) ** 3) \
-        * 2.0 / eff * sparse_factor
+    # watchdog with the same 2x margin).  Flop accounting lives in
+    # solvers/flops.py (shared with the autotuner + MFU reporting).
+    t_sweep = flops_model.sweep_flops(S, n, m, sparse_factor) / eff
+    t_factor = flops_model.factor_flops(n, m, factor_batch,
+                                        sparse_factor) / eff
     rst = max(1, st.restarts)
 
     def _cap(budget_secs, floor):
@@ -113,9 +116,9 @@ def fused_iteration_budget(S, n, m, st, refresh_every, factor_batch=1,
     """
     eff = _dense_clamped_eff(eff_flops, factor_batch)
     target = _DISPATCH_TARGET_SECS if target_secs is None else target_secs
-    t_sweep = S * (n * float(n) + 2.0 * n * m) * 2.0 / eff * sparse_factor
-    t_factor = factor_batch * (m * float(n) * n + 3.0 * float(n) ** 3) \
-        * 2.0 / eff * sparse_factor
+    t_sweep = flops_model.sweep_flops(S, n, m, sparse_factor) / eff
+    t_factor = flops_model.factor_flops(n, m, factor_batch,
+                                        sparse_factor) / eff
     rst = max(1, st.restarts)
     t_frozen_iter = st.max_iter * t_sweep
     # the adaptive solve factorizes once PER RESTART (admm._solve_scaled's
@@ -177,28 +180,46 @@ def continue_frozen(run_segment, sol, seg_f, budget, all_done=None,
     scaled residual by less than this fraction — further sweeps are futile
     (first-order UC batches park around 5e-2 at ANY budget; the host
     path's rescue-tolerance ladder already embraces exactly this).
-    """
-    if all_done is None:
-        def all_done(s):
-            return int(np.asarray(s.iters).max()) < seg_f
 
+    With the default ``all_done`` (None), the per-segment host decision
+    reads ONE fetched 3-vector (:func:`..admm.stop_stats`: iters + worst
+    residuals) instead of three separate array fetches — per-segment host
+    syncs are serial RPCs over the remote tunnel, and the segmented UC
+    path pays them every dispatch.  A caller-provided ``all_done`` keeps
+    the legacy separate-fetch protocol.
+    """
     def _worst(s):
         return max(float(np.asarray(s.pri_res).max()),
                    float(np.asarray(s.dua_res).max()))
 
-    # seeded from the INCOMING iterate so an already-parked batch exits
-    # quickly; two consecutive non-improving segments are required so a
-    # transient residual uptick (ADMM is not monotone segment-to-segment)
+    if all_done is None:
+        from . import admm as _admm
+
+        def _stats(s):
+            """(stop_dispatching, worst_residual) — ONE device fetch for a
+            real (pytree) BatchSolution; scripted stand-ins (tests) take
+            the plain attribute path."""
+            if isinstance(s, _admm.BatchSolution):
+                st = np.asarray(_admm.stop_stats(s))
+                return int(st[0]) < seg_f, max(float(st[1]), float(st[2]))
+            return int(np.asarray(s.iters).max()) < seg_f, _worst(s)
+    else:
+        def _stats(s):
+            return all_done(s), _worst(s) if plateau_rtol else None
+
+    # best is seeded from the INCOMING iterate so an already-parked batch
+    # exits quickly; two consecutive non-improving segments are required so
+    # a transient residual uptick (ADMM is not monotone segment-to-segment)
     # cannot abort a budget that was still making progress
     best = _worst(sol) if plateau_rtol else None
     stall = 0
     while budget > 0:
         sol = run_segment(sol.raw)
         budget -= seg_f
-        if all_done(sol):
+        done, worst = _stats(sol)
+        if done:
             break
         if plateau_rtol:
-            worst = _worst(sol)
             if worst > (1.0 - plateau_rtol) * best:
                 stall += 1
                 if stall >= 2:

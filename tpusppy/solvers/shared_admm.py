@@ -313,12 +313,17 @@ def _core(q, q2s, q2ref, A, cl, cu, lb, ub, state, Kinv, K, rho_a, rho_x,
     return state
 
 
-def _prep_shared(c, q2, A, cl, cu, lb, ub, settings):
+def _prep_shared(c, q2, A, cl, cu, lb, ub, settings, want_masks=True):
+    """``want_masks=False`` skips the mask reductions (several (S, m)/(S, n)
+    jnp.all's) for the frozen path, which never reads them — inside a fused
+    multi-iteration scan they would otherwise run once per PH iteration."""
     dt = settings.jdtype()
     c, q2 = jnp.asarray(c, dt), jnp.asarray(q2, dt)
     A = A.astype(dt) if isinstance(A, SparseA) else jnp.asarray(A, dt)
     cl, cu = _clean_bounds(jnp.asarray(cl, dt), jnp.asarray(cu, dt))
     lb, ub = _clean_bounds(jnp.asarray(lb, dt), jnp.asarray(ub, dt))
+    if not want_masks:
+        return c, q2, A, cl, cu, lb, ub, None
     masks = _Masks(
         fin_cl=cl > -BIG / 2, fin_cu=cu < BIG / 2,
         fin_lb=lb > -BIG / 2, fin_ub=ub < BIG / 2,
@@ -495,7 +500,7 @@ def _solve_shared_frozen_impl(c, q2, A, cl, cu, lb, ub,
     absorbed by the refinement against K + diag(dq2)."""
     dt = settings.jdtype()
     c, q2, A, cl, cu, lb, ub, _ = _prep_shared(
-        c, q2, A, cl, cu, lb, ub, settings)
+        c, q2, A, cl, cu, lb, ub, settings, want_masks=False)
     D, E, cost = factors.D, factors.E, factors.cost
     qs, q2s, As, cls, cus, lbs, ubs, warm = _scale_shared(
         c, q2, A, cl, cu, lb, ub, D, E, cost, warm, dt)

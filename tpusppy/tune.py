@@ -1,0 +1,192 @@
+"""Warmup autotuner for the fused PH dispatch cadence.
+
+The fused multi-iteration program (:func:`tpusppy.parallel.sharded.
+make_ph_fused_step`) has two knobs: ``refresh_every`` (how many PH
+iterations reuse one factorization — the math/amortization trade) and
+``chunk`` (how many PH iterations one device dispatch carries — the
+latency/watchdog trade).  The benchmark used to hard-code ``chunk=64``/
+``refresh_every=16``; shapes whose sweeps are 16x costlier (farmer
+crops_mult=4 vs 1) then run chunks far below what the worker watchdog
+allows and pay dispatch round-trips they don't have to, while the static
+worst-case cap (:func:`~tpusppy.parallel.sharded.fused_iteration_cap`,
+every frozen iteration billed at its full ``max_iter`` sweep budget) is
+~5-10x more conservative than measured reality.
+
+:func:`autotune_fused` replaces both with measurement at warmup: for each
+``refresh_every`` candidate it times a one-block probe dispatch, converts
+the MEASURED seconds/iteration into a watchdog-safe chunk (``margin`` x
+the dispatch target budget), confirms the rate at that chunk, and picks
+the fastest cadence.  Probes are real PH iterations (the state advances —
+warmup work is not wasted) and each probe is itself sized inside the
+static worst-case cap, so a mistuned model can never push a probe past
+the watchdog.
+
+Grew out of ``scripts/profile_sweep_parts.py`` (whose jit/fetch timing
+helper lives here now as :func:`time_jitted`); results feed ``bench.py``
+and any driver that wants a per-shape cadence instead of a global
+default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+import numpy as np
+
+from .parallel import sharded
+from .solvers import segmented as segmented_solvers
+
+
+@dataclasses.dataclass
+class TuneResult:
+    chunk: int                 # picked dispatch size (PH iters per dispatch)
+    refresh_every: int         # picked factorization cadence
+    iters_per_sec: float       # measured at the picked (chunk, refresh)
+    secs_per_iter: float
+    sweeps_per_iter: float     # mean measured ADMM sweeps per PH iteration
+    table: list                # per-candidate measurement dicts
+    state: Any                 # PH state advanced by the probe iterations
+    out: Any                   # last probe's PHStepOut
+
+
+_cache: dict = {}
+
+
+def _fetch(x):
+    """Host fetch as the timing fence (block_until_ready returns early on
+    the axon TPU plugin — see bench.py's timing note)."""
+    return np.asarray(x)
+
+
+def time_jitted(fn, *args, reps=20):
+    """Milliseconds per call of an already-jitted ``fn`` (fetch-fenced);
+    the sweep-part profiler's timing core (scripts/profile_sweep_parts)."""
+    import jax
+    import jax.numpy as jnp
+
+    out = fn(*args)
+    first = out[0] if isinstance(out, tuple) else out
+    _fetch(jnp.sum(first) if isinstance(first, jax.Array) else first)
+    t0 = time.time()
+    for _ in range(reps):
+        out = fn(*args)
+    first = out[0] if isinstance(out, tuple) else out
+    _fetch(jnp.sum(first) if isinstance(first, jax.Array) else first)
+    return (time.time() - t0) / reps * 1e3
+
+
+def _tune_key(arr, settings, mesh, axis, prox_on, refresh_candidates,
+              max_chunk, target_secs, margin):
+    ndev = 1 if mesh is None else len(mesh.devices.flat)
+    return (arr.c.shape, arr.cl.shape, arr.A.ndim if hasattr(arr.A, "ndim")
+            else "sparse", settings, ndev, axis, float(prox_on),
+            tuple(refresh_candidates), max_chunk, target_secs, margin)
+
+
+def autotune_fused(nonant_idx, settings, arr, state, mesh=None,
+                   axis: str = "scen", prox_on=1.0,
+                   refresh_candidates=(8, 16, 32), max_chunk: int = 256,
+                   target_secs: float | None = None, margin: float = 0.5,
+                   budget_s: float = 120.0, cache: bool = True):
+    """Measure-and-pick (chunk, refresh_every) for these shapes.
+
+    Returns a :class:`TuneResult` (with the probe-advanced ``state``), or
+    ``None`` when no candidate fits even a one-block probe under the
+    static worst-case cap (segmentation regime — use the step pair).
+
+    ``target_secs``: per-dispatch wall budget (defaults to the segmented
+    dispatch target, itself 2x under the worker watchdog); the picked
+    chunk keeps a measured dispatch at ``margin * target_secs``.
+    ``budget_s`` bounds total tuning wall-clock — candidates that don't
+    fit the remaining budget fall back to their probe measurement.
+
+    The cache (keyed on shapes + settings + mesh width + the tuning
+    parameters, budget included) makes repeat calls free but returns the
+    CALLER's state untouched — probe iterations only advance the state on
+    a cache miss.
+    """
+    if target_secs is None:
+        # honor the same override slot the static cap and probes obey
+        # (sharded._DISPATCH_TARGET_SECS, None = the segmented default): a
+        # stricter worker watchdog must also shrink the MEASURED chunk
+        target_secs = (sharded._DISPATCH_TARGET_SECS
+                       if sharded._DISPATCH_TARGET_SECS is not None
+                       else segmented_solvers._DISPATCH_TARGET_SECS)
+    key = _tune_key(arr, settings, mesh, axis, prox_on, refresh_candidates,
+                    max_chunk, target_secs, margin)
+    if cache and key in _cache:
+        hit = _cache[key]
+        return dataclasses.replace(hit, state=state, out=None)
+
+    t_start = time.time()
+    table = []
+    best = None
+    out = None
+    for r in refresh_candidates:
+        r = int(r)
+        if r > max_chunk:
+            # max_chunk is the caller's per-dispatch bound; even the
+            # one-block probe of this candidate would exceed it
+            table.append({"refresh_every": r, "skipped": "max_chunk"})
+            continue
+        cap = sharded.fused_iteration_cap(arr, settings, mesh, r)
+        if cap < r:
+            table.append({"refresh_every": r, "skipped": "static cap"})
+            continue
+        fused_probe = sharded.make_ph_fused_step(
+            nonant_idx, settings, mesh, axis, chunk=r, refresh_every=r,
+            collect="trace")
+        state, trace = fused_probe(state, arr, prox_on)   # compile + run
+        iters_tr = _fetch(trace.iters)
+        t0 = time.time()
+        state, trace = fused_probe(state, arr, prox_on)
+        iters_tr = _fetch(trace.iters)
+        dt = time.time() - t0
+        out = trace
+        spi = dt / r
+        sweeps = float(iters_tr.mean())
+        # measured watchdog-safe chunk: margin * target over the measured
+        # per-iteration cost, whole refresh blocks only
+        c = int(margin * target_secs / max(spi, 1e-9)) // r * r
+        c = max(r, min(c, max_chunk))
+        entry = {"refresh_every": r, "probe_chunk": r,
+                 "probe_secs_per_iter": round(spi, 6),
+                 "sweeps_per_iter": round(sweeps, 1), "chunk": c}
+        rate = 1.0 / spi
+        remaining = budget_s - (time.time() - t_start)
+        if c > r and c * spi * 2.5 < remaining:
+            # confirm at the picked chunk (compile + one timed dispatch):
+            # the dispatch amortization is the whole point, so rank on it
+            fused_c = sharded.make_ph_fused_step(
+                nonant_idx, settings, mesh, axis, chunk=c, refresh_every=r,
+                collect="trace")
+            state, trace = fused_c(state, arr, prox_on)
+            _fetch(trace.conv)
+            t0 = time.time()
+            state, trace = fused_c(state, arr, prox_on)
+            iters_tr = _fetch(trace.iters)
+            dt = time.time() - t0
+            out = trace
+            rate = c / dt
+            sweeps = float(iters_tr.mean())
+            entry["confirmed_iters_per_sec"] = round(rate, 4)
+            entry["sweeps_per_iter"] = round(sweeps, 1)
+        entry["iters_per_sec"] = round(rate, 4)
+        table.append(entry)
+        if best is None or rate > best[0]:
+            best = (rate, c, r, sweeps)
+        if time.time() - t_start > budget_s:
+            break
+    if best is None:
+        return None
+    rate, c, r, sweeps = best
+    last = None if out is None else sharded.PHStepOut(
+        *(a[-1] for a in out))
+    res = TuneResult(chunk=c, refresh_every=r, iters_per_sec=rate,
+                     secs_per_iter=1.0 / rate, sweeps_per_iter=sweeps,
+                     table=table, state=state, out=last)
+    if cache:
+        _cache[key] = dataclasses.replace(res, state=None, out=None)
+    return res

@@ -140,6 +140,20 @@ def ph_hub(
         k: v for k, v in hub_dict["hub_kwargs"]["options"].items()
         if v is not None
     }
+    # adaptive-rho posture (cfg.ph_args): per-slot rho adaptation from
+    # primal/dual residual balance, so families certify without a
+    # hand-tuned --default-rho (sslp needed rho=100 before this).
+    # Posture defaults (vs the reference's conservative updater defaults):
+    # pd_factor 10 — at 100 the update rarely fires and rho never leaves a
+    # bad start (sslp probe: gap 14% at pd=100 vs 4.4% at pd=10, robust
+    # across default_rho 1..5); drivers can override via norm_rho_options.
+    if _hasit(cfg, "adaptive_rho") and cfg.adaptive_rho and not (
+            _hasit(cfg, "no_adaptive_rho") and cfg.no_adaptive_rho):
+        from ..extensions.norm_rho_updater import NormRhoUpdater
+
+        extension_adder(hub_dict, NormRhoUpdater)
+        hub_dict["opt_kwargs"]["options"].setdefault(
+            "norm_rho_options", {"primal_dual_difference_factor": 10.0})
     return hub_dict
 
 

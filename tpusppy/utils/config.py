@@ -202,6 +202,18 @@ class Config:
 
     def ph_args(self):
         add = self.add_to_config
+        # adaptive per-slot rho (NormRhoUpdater, the reference's
+        # adaptive_rho_converger lineage): attached by cfg_vanilla.ph_hub
+        # when adaptive_rho is on.  Drivers that default the posture ON
+        # (examples harness) leave --no-adaptive-rho as the opt-out, since
+        # bool flags here are store_true.
+        add("adaptive_rho",
+            "adapt per-slot rho from primal/dual residual balance "
+            "(NormRhoUpdater) instead of relying on a hand-tuned "
+            "--default-rho", bool, False)
+        add("no_adaptive_rho",
+            "force adaptive rho OFF in drivers that default it on",
+            bool, False)
         add("linearize_binary_proximal_terms",
             "linearize prox for binary nonants (no-op: the ADMM solver is a "
             "native QP solver)", bool, False)
