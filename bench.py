@@ -60,6 +60,7 @@ BENCH_SMOKE=1 shrinks everything (tiny S, pinned cadence, no UC) for the
 CI kill-safety test.
 """
 
+import dataclasses
 import json
 import os
 import subprocess
@@ -85,6 +86,10 @@ def _apply_smoke_defaults():
         "BENCH_SCENS": "8", "BENCH_ITERS": "8", "BENCH_CHUNK": "4",
         "BENCH_REFRESH": "4", "BENCH_AUTOTUNE": "0", "BENCH_SKIP_UC": "1",
         "BENCH_CROPS_MULT": "2",
+        # --ladder smoke: two tiny rate-only rungs on the lite UC family
+        "BENCH_LADDER_SCENS": "2,3", "BENCH_LADDER_RATE_ONLY": "1",
+        "BENCH_UC_GENS": "2", "BENCH_UC_HORIZON": "4",
+        "BENCH_UC_ITERS": "2",
     }.items():
         os.environ.setdefault(k, v)
 
@@ -213,6 +218,12 @@ def main():
     def _remaining(margin=60.0):
         return max(120.0, deadline - time.time() - margin)
 
+    # --ladder: the certified-gap wheel over a scenario ladder (one parsed
+    # entry per rung) instead of the farmer/UC flagship line; the child
+    # reuses the same kill-safe partial-line protocol
+    child_args = ["--workload"] + (
+        ["--ladder"] if "--ladder" in sys.argv[1:] else [])
+
     tpu_error = None
     if not force_cpu:
         for attempt in range(attempts):
@@ -231,7 +242,7 @@ def main():
             # farmer/rate/baseline phases (high-variance compiles)
             child_budget = min(run_timeout, _remaining())
             env["BENCH_CHILD_DEADLINE"] = str(time.time() + child_budget - 60)
-            ok, line, tail = _run_child(["--workload"], env, child_budget)
+            ok, line, tail = _run_child(child_args, env, child_budget)
             if ok and line is not None:
                 line["tpu_unavailable"] = False
                 print(json.dumps(line))
@@ -249,7 +260,7 @@ def main():
     env.setdefault("BENCH_UC_WHEEL_TIMEOUT", "600")
     child_budget = min(cpu_timeout, _remaining())
     env["BENCH_CHILD_DEADLINE"] = str(time.time() + child_budget - 30)
-    ok, line, tail = _run_child(["--workload"], env, child_budget)
+    ok, line, tail = _run_child(child_args, env, child_budget)
     if ok and line is not None:
         line["tpu_unavailable"] = not force_cpu
         if tpu_error and not force_cpu:
@@ -259,7 +270,9 @@ def main():
 
     # Last resort: a structured failure line, rc still 0 (a parseable
     # artifact with an error field beats a dead artifact)
-    if os.environ.get("BENCH_UC"):
+    if "--ladder" in sys.argv[1:]:
+        metric = "uc_certified_ladder"
+    elif os.environ.get("BENCH_UC"):
         metric = f"ph_iters_per_sec_uc{os.environ.get('BENCH_UC_SCENS', '1000')}"
     else:
         metric = f"ph_iters_per_sec_farmer{os.environ.get('BENCH_SCENS', '1000')}"
@@ -286,9 +299,94 @@ def emit_partial(line):
     print(json.dumps(out), flush=True)
 
 
+def ladder_workload():
+    """Certified-gap wheel over a scenario ladder (VERDICT r5 item 5):
+    one :func:`bench_uc.uc_metrics` run per rung S, all inside ONE
+    ``BENCH_DEADLINE``, one parsed-JSON partial line banked per rung —
+    the same kill-safe protocol as the flagship line, so a kill at any
+    rung keeps every rung that finished.
+
+    Budgeting: the remaining deadline is split evenly over the remaining
+    rungs — small rungs finish early and their surplus flows to the big
+    ones.  Rungs that no longer fit are reported as skipped, never
+    silently dropped.  ``BENCH_LADDER_SCENS`` overrides the rung list;
+    ``BENCH_LADDER_RATE_ONLY=1`` skips the wheels (smoke posture).
+    """
+    rungs = [int(s) for s in os.environ.get(
+        "BENCH_LADDER_SCENS", "3,50,100,250,500,1000").split(",")]
+    wheel = os.environ.get("BENCH_LADDER_RATE_ONLY", "0") == "0"
+    deadline = float(os.environ.get("BENCH_CHILD_DEADLINE", "0") or 0)
+    if not deadline:
+        deadline = time.time() + 3600.0
+    entries = []
+    line = {"metric": "uc_certified_ladder", "unit": "rungs", "value": 0,
+            "rungs": entries}
+
+    def _n_ok():
+        """Completed rungs — errored and deadline-skipped ones excluded."""
+        return len([e for e in entries
+                    if "error" not in e and "skipped" not in e])
+
+    import bench_uc
+
+    for i, S in enumerate(rungs):
+        remaining = deadline - time.time()
+        if remaining < 120.0:
+            entries.extend({"S": s, "skipped": "deadline"}
+                           for s in rungs[i:])
+            line["value"] = _n_ok()
+            emit_partial(line)
+            break
+        rung_budget = remaining / (len(rungs) - i)
+        os.environ["BENCH_UC_SCENS"] = str(S)
+        os.environ["BENCH_UC_WHEEL_SCENS"] = str(S)
+        os.environ["BENCH_CHILD_DEADLINE"] = str(
+            time.time() + rung_budget)
+        # the per-rung budget must actually bind: uc_metrics' deadline-
+        # derived wheel watchdog floors at 600s (teardown margin), which
+        # would let one stuck small rung starve the large rungs — an
+        # EXPLICIT wheel timeout is only ever shrunk, never floored.  The
+        # 30s comfort floor applies only within the rung's own budget (a
+        # stuck wheel may never overrun the rung)
+        os.environ["BENCH_UC_WHEEL_TIMEOUT"] = str(
+            min(rung_budget, max(30.0, 0.7 * rung_budget)))
+        log(f"ladder rung S={S}: budget {rung_budget:.0f}s "
+            f"({len(rungs) - i} rungs left)")
+        try:
+            m = bench_uc.uc_metrics(
+                progress=lambda p, S=S: emit_partial(
+                    dict(line, running=dict(p, S=S))),
+                wheel=wheel)
+            # keep uc_metrics' ACTUAL scenario count (dataset-truncated
+            # rungs must not report the requested S as measured)
+            m.setdefault("S", S)
+            if m["S"] != S:
+                m["S_requested"] = S
+        except Exception as e:   # a failed rung never loses earlier rungs
+            log(f"ladder rung S={S} failed: {e!r}")
+            m = {"S": S, "error": repr(e)}
+        entries.append(m)
+        line["value"] = _n_ok()
+        emit_partial(line)
+        # drop the rung's device residency before the next shape compiles
+        import gc
+        import jax
+        from tpusppy import spopt as _spopt
+        _spopt.clear_device_caches()
+        gc.collect()
+        jax.clear_caches()
+    print(json.dumps(line))
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)   # daemon wheel threads abort normal teardown (see below)
+
+
 def workload():
     if _smoke():
         _apply_smoke_defaults()
+    if "--ladder" in sys.argv[1:]:
+        ladder_workload()
+        return
     if os.environ.get("BENCH_UC"):
         import bench_uc
         bench_uc.main()
@@ -342,6 +440,10 @@ def workload():
         path remains as fallback for segmentation-regime shapes.
         """
         refresh_every = max(1, int(refresh_env or "16"))
+        st = settings
+        prec_env = os.environ.get("BENCH_PRECISION")
+        if prec_env:   # operator-pinned sweep precision: no sweep stage
+            st = dataclasses.replace(st, sweep_precision=prec_env)
         log(f"platform={platform} S={S} crops_mult={mult} dtype={dtype}")
         names = farmer.scenario_names_creator(S)
         batch = ScenarioBatch.from_problems([
@@ -354,8 +456,8 @@ def workload():
         mesh = sharded.make_mesh()
         arr = sharded.shard_batch(batch, mesh)
         idx = batch.tree.nonant_indices
-        refresh, frozen = sharded.make_ph_step_pair(idx, settings, mesh)
-        state = sharded.init_state(arr, 1.0, settings)
+        refresh, frozen = sharded.make_ph_step_pair(idx, st, mesh)
+        state = sharded.init_state(arr, 1.0, st)
 
         # warmup/compile + Iter0
         t0 = time.time()
@@ -376,21 +478,30 @@ def workload():
                 max_chunk = min(max_chunk, int(chunk_env))
                 cands = (tuple(r for r in cands if r <= max_chunk)
                          or (max_chunk,))
+            # precision sweep rides the autotuner: fastest certified mode
+            # per shape (skipped when the operator pinned BENCH_PRECISION)
+            prec_cands = (None if prec_env
+                          else ("default", "high"))
             t0 = time.time()
             tuned = tuner.autotune_fused(
-                idx, settings, arr, state, mesh,
-                refresh_candidates=cands, max_chunk=max_chunk)
+                idx, st, arr, state, mesh,
+                refresh_candidates=cands, max_chunk=max_chunk,
+                precision_candidates=prec_cands)
             if tuned is not None:
                 state = tuned.state
                 chunk, refresh_every = tuned.chunk, tuned.refresh_every
                 sweeps = tuned.sweeps_per_iter
+                if tuned.precision != (st.sweep_precision or "highest"):
+                    st = dataclasses.replace(
+                        st, sweep_precision=tuned.precision)
                 log(f"autotune ({time.time() - t0:.1f}s): chunk={chunk} "
                     f"refresh_every={refresh_every} "
+                    f"precision={tuned.precision} "
                     f"{tuned.iters_per_sec:.2f} it/s projected; "
                     f"table={tuned.table}")
         if tuned is None:
             chunk_req = int(chunk_env or "64")
-            cap = sharded.fused_iteration_cap(arr, settings, mesh,
+            cap = sharded.fused_iteration_cap(arr, st, mesh,
                                               refresh_every)
             chunk = min(chunk_req, cap) // refresh_every * refresh_every
 
@@ -399,7 +510,7 @@ def workload():
             # device-side across the whole window: ONE host fetch at the
             # end, no per-chunk syncs
             fused = sharded.make_ph_fused_step(
-                idx, settings, mesh, chunk=chunk,
+                idx, st, mesh, chunk=chunk,
                 refresh_every=refresh_every, collect="trace")
             t0 = time.time()
             state, trace = fused(state, arr, 1.0)  # compile (+chunk iters)
@@ -438,11 +549,14 @@ def workload():
         # matmul flops only, so conservative)
         flops_it = flops_model.ph_iteration_flops(
             batch.num_scenarios, batch.num_vars, batch.num_rows,
-            sweeps or settings.max_iter, refresh_every, settings.restarts,
+            sweeps or st.max_iter, refresh_every, st.restarts,
             factor_batch=batch.num_scenarios)
+        # MFU peak adjusted to the SWEEP precision (sweeps dominate the
+        # iteration): a certified bf16x3 pick both raises the rate and
+        # raises the achievable ceiling it is measured against
         mfu, mfu_note = flops_model.mfu_pct(
             iters_per_sec, flops_it, n_dev, jax.devices()[0],
-            settings.matmul_precision)
+            st.sweep_mode())
 
         # Baseline: serial per-scenario LP loop through HiGHS (reference
         # architecture), timed on a sample, EXTRAPOLATED to all S scenarios
@@ -466,6 +580,7 @@ def workload():
             "chunk": chunk,
             "refresh_every": refresh_every,
             "autotuned": tuned is not None,
+            "precision": st.sweep_mode(),
             "sweeps_per_iter": round(sweeps, 1) if sweeps else None,
             "mfu_pct": round(mfu, 2) if mfu is not None else None,
             "mfu_note": mfu_note,
@@ -483,6 +598,7 @@ def workload():
         "chunk": m_primary["chunk"],
         "refresh_every": m_primary["refresh_every"],
         "autotuned": m_primary["autotuned"],
+        "precision": m_primary["precision"],
         "sweeps_per_iter": m_primary["sweeps_per_iter"],
         "mfu_pct": m_primary["mfu_pct"],
         "mfu_note": m_primary["mfu_note"],

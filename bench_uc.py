@@ -35,12 +35,13 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def uc_metrics(progress=None):
+def uc_metrics(progress=None, wheel=True):
     """UC metrics dict.  ``progress(partial_dict)`` (optional) is called
     with the rate-metric fields the moment they exist — BEFORE the
     long-running wheel — so a kill during the wheel still leaves the
     rate/MFU numbers in the artifact (bench.py relays them as a partial
-    JSON line)."""
+    JSON line).  ``wheel=False`` skips the certified-gap wheel entirely
+    (the ladder's rate-only smoke posture)."""
     import jax
 
     import tpusppy
@@ -139,6 +140,12 @@ def uc_metrics(progress=None):
         scaling_iters=6, polish_passes=1, solve_refine=1,
         sweep_plateau_rtol=0.05, sweep_plateau_window=plateau_window,
     )
+    if os.environ.get("BENCH_PRECISION"):
+        # operator-pinned frozen-sweep precision (the farmer bench's
+        # autotuner sweeps it; the UC rate path takes the pin directly)
+        import dataclasses
+        settings = dataclasses.replace(
+            settings, sweep_precision=os.environ["BENCH_PRECISION"])
 
     if model_name == "data":
         data_dir = _wind_dir
@@ -201,7 +208,7 @@ def uc_metrics(progress=None):
         sparse_factor=sparse_f)
     mfu, mfu_note = flops_model.mfu_pct(
         iters_per_sec, flops_it, len(mesh.devices.flat), jax.devices()[0],
-        settings.matmul_precision)
+        settings.sweep_mode())
 
     # FULL-reference-horizon submetric (horizon 48, n=32016 at S=1000):
     # the shape the dense engine could never fit on one chip (4.1 GB
@@ -263,6 +270,7 @@ def uc_metrics(progress=None):
     rate_fields = {
         "model": model_name,
         "ph_iters_per_sec": round(iters_per_sec, 4),
+        "precision": settings.sweep_mode(),
         "plateau_window": plateau_window,
         "sweeps_per_iter": round(sweeps, 1),
         "mfu_pct": round(mfu, 2) if mfu is not None else None,
@@ -278,6 +286,10 @@ def uc_metrics(progress=None):
         progress(dict(rate_fields, wall_s_to_gap=None, gap_pct=None,
                       gap_target_pct=gap_target * 100, certified=False,
                       wheel_pending=True))
+    if not wheel:
+        return dict(rate_fields, wall_s_to_gap=None, gap_pct=None,
+                    gap_target_pct=gap_target * 100, certified=False,
+                    wheel_skipped=True)
 
     # free the rate-metric's device residency before the wheel: the S=1000
     # arrays + factors (~6 GB at reference shape) plus the compiled S=1000

@@ -1,0 +1,23 @@
+#!/bin/sh
+# CI job partition for tests/test_*.py (used by .github/workflows/
+# straight.yml): prints the job group a test file belongs to.  Exactly one
+# group matches any file — solvers and cylinders-wheel are explicit
+# pattern lists, confint-utils is the catch-all — so the three CI jobs
+# can never double-run or drop a file as tests are added.
+#
+#   $ scripts/ci_test_group.sh tests/test_admm.py
+#   solvers
+case "$(basename "$1")" in
+  test_admm.py|test_shared.py|test_sharded.py|test_segmented.py|\
+  test_pallas.py|test_sparse_structured.py|test_fused_step.py|\
+  test_tune.py|test_precision*.py|test_milp_bound.py|test_bench_smoke.py)
+    echo solvers ;;
+  test_ph.py|test_aph.py|test_fwph.py|test_wheel.py|test_tcp_wheel.py|\
+  test_mp_wheel.py|test_distributed*.py|test_dist_aph.py|\
+  test_window_service.py|test_xhat.py|test_extensions.py|\
+  test_cross_scen.py|test_mip_incumbents.py|test_lshaped.py|test_sc.py|\
+  test_ef.py)
+    echo cylinders-wheel ;;
+  *)
+    echo confint-utils ;;
+esac

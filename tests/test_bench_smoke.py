@@ -91,3 +91,37 @@ def test_bench_smoke_kill_leaves_parseable_artifact():
     assert "mfu_pct" in parsed and "mfu_note" in parsed
     assert parsed["chunk"] >= 1 and parsed["refresh_every"] >= 1
     assert "autotuned" in parsed
+    assert parsed["precision"] in ("default", "high", "highest")
+
+
+def test_bench_ladder_emits_one_entry_per_rung():
+    """--ladder: one parsed entry per rung, each carrying precision +
+    mfu_pct, banked via the same partial-line protocol (rate-only smoke
+    posture: BENCH_LADDER_RATE_ONLY skips the wheels)."""
+    env = _smoke_env()
+    env["BENCH_LADDER_SCENS"] = "2,3"
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--workload", "--ladder"], env=env,
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        timeout=420,
+    )
+    parsed = None
+    n_partial = 0
+    for raw in proc.stdout.decode(errors="replace").splitlines():
+        line = raw.strip()
+        if not line.startswith("{"):
+            continue
+        obj = json.loads(line)      # every emitted line must parse
+        n_partial += bool(obj.get("partial"))
+        parsed = obj
+    assert parsed is not None
+    assert parsed["metric"] == "uc_certified_ladder"
+    assert parsed["value"] == 2            # both rungs completed
+    assert [r["S"] for r in parsed["rungs"]] == [2, 3]
+    assert n_partial >= 2                  # each rung banked a partial line
+    for rung in parsed["rungs"]:
+        assert rung["precision"] in ("default", "high", "highest")
+        assert "mfu_pct" in rung
+        assert rung["ph_iters_per_sec"] > 0
+        # rate-only smoke: the wheel fields exist, flagged skipped
+        assert rung["wheel_skipped"] is True and "gap_pct" in rung

@@ -110,3 +110,184 @@ def test_usable_gating():
     assert bs == 1000 or (bs is not None and bs % 128 == 0)
     # a shape whose per-scenario matrices exceed VMEM must be rejected
     assert pallas_kernels.usable(100000, 4626, 2928, platform="tpu") is None
+    # bf16 matrix storage (precision="default") widens the usable range:
+    # never smaller blocks, sometimes usable where f32 storage is not
+    for S, m, n in [(1000, 28, 44), (10000, 80, 96), (2000, 120, 150)]:
+        b32 = pallas_kernels.usable(S, m, n, platform="tpu")
+        b16 = pallas_kernels.usable(S, m, n, platform="tpu",
+                                    precision="default")
+        if b32 is not None:
+            assert b16 is not None and b16 >= b32
+
+
+def test_fused_sweeps_default_precision_matches_emulation():
+    """Dense kernel at precision="default" (bf16 matrix storage + vector
+    operand rounding) against the XLA mixed-precision sweep recurrence
+    (admm._admm_core with prec="default": solvers/precision.py emulation,
+    f32-exact defect against K)."""
+    import jax.numpy as jnp
+
+    from tpusppy.solvers import precision
+
+    rng = np.random.RandomState(21)
+    S, m, n = 8, 9, 5
+    sigma, alpha = 1e-6, 1.6
+    n_sweeps, n_refine = 4, 2
+
+    A = rng.randn(S, m, n)
+    q = rng.randn(S, n)
+    cl = -np.abs(rng.randn(S, m)) - 0.5
+    cu = np.abs(rng.randn(S, m)) + 0.5
+    lb = -np.ones((S, n)) * 2
+    ub = np.ones((S, n)) * 2
+    rho_a = np.full((S, m), 0.7)
+    rho_x = np.full((S, n), 0.4)
+    K = np.einsum("smn,sm,smk->snk", A, rho_a, A)
+    K += sigma * np.eye(n)[None]
+    K += np.einsum("sn,nk->snk", rho_x, np.eye(n))
+    Kinv = np.linalg.inv(K)
+
+    x = rng.randn(S, n) * 0.1
+    z = np.clip(rng.randn(S, m), cl, cu)
+    zx = np.clip(x, lb, ub)
+    y = rng.randn(S, m) * 0.1
+    yx = rng.randn(S, n) * 0.1
+    Ax = np.einsum("smn,sn->sm", A, x)
+
+    lo = lambda spec, a, b: precision.contract(spec, jnp.asarray(a),
+                                               jnp.asarray(b), "default",
+                                               platform="cpu")
+    hi = lambda spec, a, b: precision.contract(spec, jnp.asarray(a),
+                                               jnp.asarray(b), "highest")
+
+    rx, rz, rzx, ry, ryx, rAx = (jnp.asarray(v)
+                                 for v in (x, z, zx, y, yx, Ax))
+    for _ in range(n_sweeps):
+        rhs = (sigma * rx - q + lo("smn,sm->sn", A, rho_a * rz - ry)
+               + (rho_x * rzx - ryx))
+        xt = lo("snk,sk->sn", Kinv, rhs)
+        for _ in range(n_refine):
+            r = rhs - hi("snk,sk->sn", K, xt)
+            xt = xt + lo("snk,sk->sn", Kinv, r)
+        Axt = lo("smn,sn->sm", A, xt)
+        x_new = alpha * xt + (1 - alpha) * rx
+        Ax_new = alpha * Axt + (1 - alpha) * rAx
+        za = alpha * Axt + (1 - alpha) * rz + ry / rho_a
+        z_new = jnp.clip(za, cl, cu)
+        y_new = ry + rho_a * (alpha * Axt + (1 - alpha) * rz - z_new)
+        zxa = alpha * xt + (1 - alpha) * rzx + ryx / rho_x
+        zx_new = jnp.clip(zxa, lb, ub)
+        yx_new = ryx + rho_x * (alpha * xt + (1 - alpha) * rzx - zx_new)
+        rx, rz, rzx, ry, ryx, rAx = (x_new, z_new, zx_new, y_new, yx_new,
+                                     Ax_new)
+
+    tT = lambda a: jnp.transpose(jnp.asarray(a), (1, 2, 0))
+    bf = lambda a: a.astype(jnp.bfloat16)
+    outs = pallas_kernels.fused_sweeps(
+        jnp.asarray(q).T, bf(tT(A)),
+        bf(jnp.transpose(jnp.asarray(A), (2, 1, 0))), bf(tT(Kinv)), tT(K),
+        jnp.asarray(cl).T, jnp.asarray(cu).T,
+        jnp.asarray(lb).T, jnp.asarray(ub).T,
+        jnp.asarray(rho_a).T, jnp.asarray(rho_x).T,
+        jnp.asarray(x).T, jnp.asarray(z).T, jnp.asarray(zx).T,
+        jnp.asarray(y).T, jnp.asarray(yx).T, jnp.asarray(Ax).T,
+        n_sweeps=n_sweeps, n_refine=n_refine, sigma=sigma, alpha=alpha,
+        bs=S, precision="default", interpret=True,
+    )
+    got = [np.asarray(o).T for o in outs]
+    # tolerance floor: the XLA emulation accumulates in f32 (the TPU MXU
+    # accumulator) while the interpret-mode kernel under x64 accumulates
+    # the IDENTICAL bf16 products in f64 — a ~1e-7 accumulation-order
+    # difference, far below the bf16 operand error the modes introduce
+    for g, r, name in zip(got, (rx, rz, rzx, ry, ryx, rAx),
+                          ["x", "z", "zx", "y", "yx", "Ax"]):
+        np.testing.assert_allclose(g, np.asarray(r), rtol=1e-5, atol=1e-6,
+                                   err_msg=name)
+
+
+@pytest.mark.parametrize("mode", ["highest", "high", "default"])
+def test_fused_sweeps_shared_matches_xla(mode):
+    """Shared-A kernel against the shared_admm._core block() semantics at
+    every precision mode (interpret mode; operand-level bf16 splits make
+    the comparison exact up to summation order)."""
+    import jax.numpy as jnp
+
+    from tpusppy.solvers import precision
+
+    rng = np.random.RandomState(3)
+    S, m, n = 16, 9, 5
+    sigma, alpha = 1e-6, 1.6
+    n_sweeps, n_refine, n_extra = 3, 2, 2
+
+    A = rng.randn(m, n)
+    q = rng.randn(S, n)
+    cl = -np.abs(rng.randn(S, m)) - 0.5
+    cu = np.abs(rng.randn(S, m)) + 0.5
+    lb = -np.ones((S, n)) * 2
+    ub = np.ones((S, n)) * 2
+    rho_a = np.full(m, 0.7)
+    rho_x = np.full(n, 0.4)
+    K = (A.T * rho_a) @ A + sigma * np.eye(n) + np.diag(rho_x)
+    Kinv = np.linalg.inv(K)
+    gamma = 0.5 + rng.rand(S, 1)
+    dq2 = 0.1 * np.abs(rng.randn(S, n))
+    x = rng.randn(S, n) * 0.1
+    z = np.clip(rng.randn(S, m), cl, cu)
+    zx = np.clip(x, lb, ub)
+    y = rng.randn(S, m) * 0.1
+    yx = rng.randn(S, n) * 0.1
+    Ax = x @ A.T
+
+    C = lambda spec, a, b, md: precision.contract(
+        spec, jnp.asarray(a), jnp.asarray(b), md, platform="cpu")
+    g = jnp.asarray(gamma)
+    rho_a_s = g * rho_a[None, :]
+    rho_x_s = g * rho_x[None, :]
+    sigma_s = g * sigma
+    rx, rz, rzx, ry, ryx, rAx = (jnp.asarray(v)
+                                 for v in (x, z, zx, y, yx, Ax))
+    for _ in range(n_sweeps):
+        rhs = (sigma_s * rx - q + C("sm,mn->sn", rho_a_s * rz - ry, A, mode)
+               + (rho_x_s * rzx - ryx))
+        xt = C("...n,nk->...k", rhs / g, Kinv, mode)
+        for _ in range(n_refine + n_extra):   # dq2 != 0: extra passes run
+            r = rhs - (g * C("sn,nk->sk", xt, K, "highest") + dq2 * xt)
+            xt = xt + C("...n,nk->...k", r / g, Kinv, mode)
+        Axt = C("sn,mn->sm", xt, A, mode)
+        x_new = alpha * xt + (1 - alpha) * rx
+        Ax_new = alpha * Axt + (1 - alpha) * rAx
+        za = alpha * Axt + (1 - alpha) * rz + ry / rho_a_s
+        z_new = jnp.clip(za, cl, cu)
+        y_new = ry + rho_a_s * (alpha * Axt + (1 - alpha) * rz - z_new)
+        zxa = alpha * xt + (1 - alpha) * rzx + ryx / rho_x_s
+        zx_new = jnp.clip(zxa, lb, ub)
+        yx_new = ryx + rho_x_s * (alpha * xt + (1 - alpha) * rzx - zx_new)
+        rx, rz, rzx, ry, ryx, rAx = (x_new, z_new, zx_new, y_new, yx_new,
+                                     Ax_new)
+
+    has = jnp.ones((1, 1))
+    outs = pallas_kernels.fused_sweeps_shared(
+        q, A, Kinv, K, cl, cu, lb, ub, rho_a[None, :], rho_x[None, :],
+        dq2, has, gamma, x, z, zx, y, yx, Ax,
+        n_sweeps=n_sweeps, n_refine=n_refine, n_extra=n_extra, sigma=sigma,
+        alpha=alpha, bs=8, precision=mode, interpret=True)
+    # low modes: the emulation accumulates in f32 (the MXU accumulator)
+    # while the x64 interpret-mode kernel accumulates identical bf16
+    # products in f64 — ~1e-7 per contraction, amplified by the
+    # relaxation/refinement feedback to ~1e-5; still 1-2 orders below the
+    # operand rounding the modes themselves introduce.  "highest" has no
+    # rounding and stays tight.
+    rtol, atol = ((1e-10, 1e-12) if mode == "highest" else (1e-4, 1e-5))
+    for got, ref, name in zip(outs, (rx, rz, rzx, ry, ryx, rAx),
+                              ["x", "z", "zx", "y", "yx", "Ax"]):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=rtol, atol=atol, err_msg=name)
+
+
+def test_usable_shared_gating():
+    assert pallas_kernels.usable_shared(100, 20, 10, platform="cpu") is None
+    bs = pallas_kernels.usable_shared(1000, 200, 150, platform="tpu")
+    assert bs is not None and (bs == 1000 or bs % 8 == 0)
+    # reference-scale UC (n=16008): matrices alone dwarf VMEM — declines
+    assert pallas_kernels.usable_shared(
+        1000, 12408, 16008, platform="tpu") is None

@@ -1,0 +1,94 @@
+"""float32-vs-float64 golden accuracy baselines for the sweep kernels'
+building blocks (SparseA matvecs, the block/Woodbury KKT apply).
+
+The mixed-precision sweep engine (ADMMSettings.sweep_precision,
+doc/precision.md) lowers precision BELOW f32; these tests pin the f32
+floor itself against f64 goldens, so any regression in the exact-f32
+operators is caught independently of the bf16 machinery above them —
+the accuracy baseline the mixed-precision work sits on.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpusppy.solvers.sparse import SparseA, detect_structure
+from tpusppy.solvers.structured_kkt import (StructureArrays,
+                                            factor_structured, kinv_apply)
+
+
+def _random_sparse(rng, m, n, density=0.15):
+    A = rng.randn(m, n) * (rng.rand(m, n) < density)
+    # keep every row/col populated so the matrix exercises all segments
+    A[np.arange(m), rng.randint(0, n, m)] += rng.randn(m)
+    return A
+
+
+def test_sparse_matvec_f32_vs_f64_golden():
+    rng = np.random.RandomState(11)
+    m, n, S = 40, 25, 7
+    A = _random_sparse(rng, m, n)
+    x = rng.randn(S, n)
+    golden = x @ A.T                      # f64 numpy
+    sp32 = SparseA.from_dense(A, dtype=jnp.float32)
+    got = np.asarray(sp32.matvec(jnp.asarray(x, jnp.float32)))
+    assert got.dtype == np.float32
+    scale = np.abs(golden).max()
+    assert np.abs(got - golden).max() <= 1e-5 * max(scale, 1.0)
+
+
+def test_sparse_rmatvec_f32_vs_f64_golden():
+    rng = np.random.RandomState(12)
+    m, n, S = 40, 25, 7
+    A = _random_sparse(rng, m, n)
+    y = rng.randn(S, m)
+    golden = y @ A                        # f64 numpy
+    sp32 = SparseA.from_dense(A, dtype=jnp.float32)
+    got = np.asarray(sp32.rmatvec(jnp.asarray(y, jnp.float32)))
+    assert got.dtype == np.float32
+    scale = np.abs(golden).max()
+    assert np.abs(got - golden).max() <= 1e-5 * max(scale, 1.0)
+
+
+def _structured_A(rng, nblocks=6, bs=4, rows_per=3, wide=2):
+    """Block-diagonal narrow rows + a few dense wide rows — the UC-shaped
+    family detect_structure targets."""
+    n = nblocks * bs
+    rows = []
+    for k in range(nblocks):
+        for _ in range(rows_per):
+            row = np.zeros(n)
+            row[k * bs:(k + 1) * bs] = rng.randn(bs)
+            rows.append(row)
+    for _ in range(wide):
+        rows.append(rng.randn(n))
+    return np.asarray(rows)
+
+
+@pytest.mark.parametrize("dtype,tol", [("float64", 1e-9), ("float32", 1e-3)])
+def test_kinv_apply_vs_f64_golden(dtype, tol):
+    """kinv_apply (block/Woodbury) against a dense f64 np.linalg.solve:
+    f64 pins the ALGEBRA (Woodbury identity exact to roundoff), f32 pins
+    the accuracy floor the mixed-precision modes must refine back to."""
+    rng = np.random.RandomState(13)
+    A = _structured_A(rng)
+    m, n = A.shape
+    st = detect_structure(A)
+    assert st is not None and st.r == 2
+    dvec = 0.5 + rng.rand(n)
+    rho_a = 0.3 + rng.rand(m)
+    sigma = 1e-4
+
+    K64 = np.diag(dvec + sigma) + (A.T * rho_a) @ A
+    b = rng.randn(3, n)
+    golden = np.linalg.solve(K64, b.T).T
+
+    dt = jnp.dtype(dtype)
+    sp = SparseA.from_dense(A, dtype=dt)
+    arrays = StructureArrays.from_structure(st)
+    bw = factor_structured(sp, arrays, jnp.asarray(dvec, dt),
+                           jnp.asarray(rho_a, dt), sigma)
+    got = np.asarray(kinv_apply(bw, jnp.asarray(b, dt)))
+    scale = np.abs(golden).max()
+    assert np.abs(got - golden).max() <= tol * max(scale, 1.0)

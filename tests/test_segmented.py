@@ -81,6 +81,36 @@ def test_dispatch_segments_no_segmentation_for_small():
     assert seg_r >= 32 and seg_f >= 8        # floors
 
 
+def test_dispatch_segments_precision_aware():
+    """Lowered sweep precision re-budgets FROZEN dispatches only: sweeps
+    are conservatively faster (flops.SWEEP_SPEEDUP) but each dispatch
+    also carries its worst-case in-dispatch f32 refinement phase
+    (precision_refine_iters), billed off the top; refresh caps never
+    change (refresh solves always run full precision)."""
+    import dataclasses
+
+    from tpusppy.solvers.admm import ADMMSettings
+
+    st = ADMMSettings(max_iter=200, restarts=2, check_every=4)
+    seg_r, seg_f = segmented.dispatch_segments(1000, 16008, 12408, st)
+    st_lo = dataclasses.replace(st, sweep_precision="default")
+    seg_r_lo, seg_f_lo = segmented.dispatch_segments(
+        1000, 16008, 12408, st_lo)
+    assert seg_r_lo == seg_r
+    assert 8 <= seg_f_lo <= st.max_iter
+    # with the refinement phase billed at zero, the speedup strictly
+    # widens the frozen cap; the default refine budget then narrows it
+    st_nr = dataclasses.replace(st_lo, precision_refine_iters=0)
+    _, seg_f_nr = segmented.dispatch_segments(1000, 16008, 12408, st_nr)
+    assert seg_f_nr >= seg_f
+    assert seg_f_lo <= seg_f_nr
+    # fused budgets follow the same accounting
+    fb = segmented.fused_iteration_budget(200, 44, 28, st, 8)
+    fb_nr = segmented.fused_iteration_budget(
+        200, 44, 28, dataclasses.replace(st_nr), 8)
+    assert fb_nr >= fb
+
+
 # ---- in-loop plateau exit (ADMMSettings.sweep_plateau_rtol) -------------
 
 def _toy_lp(S=3, n=6, m=4, seed=0):

@@ -118,6 +118,57 @@ def test_autotuned_cadence_parity_with_step_pair():
                                rtol=1e-9, atol=1e-10)
 
 
+def test_autotune_precision_stage():
+    """The precision stage probes lowered modes at the picked cadence,
+    certifies against the full-precision reference residual, and records
+    every probe in the table; the pick is always a certified mode (or the
+    full-precision reference)."""
+    tune._cache.clear()
+    batch, mesh, settings, arr, idx, refresh, frozen, state = _setup()
+    res = tune.autotune_fused(
+        idx, settings, arr, state, mesh, refresh_candidates=(2,),
+        max_chunk=4, budget_s=300.0,
+        precision_candidates=("default", "high"))
+    assert res is not None
+    assert res.precision in ("default", "high", "highest")
+    prec_rows = [t for t in res.table if "precision" in t]
+    assert any(t.get("reference") for t in prec_rows)
+    for t in prec_rows:
+        if t.get("certified") is False:
+            assert t["precision"] != res.precision
+    # cached: same pick, no re-probing, caller's state handed back
+    r2 = tune.autotune_fused(
+        idx, settings, arr, res.state, mesh, refresh_candidates=(2,),
+        max_chunk=4, budget_s=300.0,
+        precision_candidates=("default", "high"))
+    assert r2.precision == res.precision
+    assert r2.state is res.state
+
+
+def test_autotune_precision_certified_modes_hold_floor():
+    """Whatever mode certifies must actually hold the reference residual
+    bar — re-run the fused step at the certified mode and compare."""
+    tune._cache.clear()
+    batch, mesh, settings, arr, idx, refresh, frozen, state = _setup(
+        max_iter=200)
+    res = tune.autotune_fused(
+        idx, settings, arr, state, mesh, refresh_candidates=(2,),
+        max_chunk=4, budget_s=300.0, precision_candidates=("high",))
+    assert res is not None
+    st_m = dataclasses.replace(settings, sweep_precision=res.precision)
+    fused = sharded.make_ph_fused_step(
+        idx, st_m, mesh, chunk=res.chunk, refresh_every=res.refresh_every,
+        collect="trace", donate=False)
+    _, tr = fused(res.state, arr, 1.0)
+    worst = max(float(np.asarray(tr.pri_res)[-1].max()),
+                float(np.asarray(tr.dua_res)[-1].max()))
+    ref_rows = [t for t in res.table if t.get("reference")]
+    bar = 1.5 * max(ref_rows[0]["worst_residual"],
+                    settings.eps_abs, settings.eps_rel)
+    # generous slack: the probe ran from a slightly different state
+    assert worst <= 10 * bar
+
+
 def test_flops_model_fields():
     from tpusppy.solvers import flops as fm
     sw = fm.sweep_flops(10, 20, 30)

@@ -11,7 +11,21 @@ import numpy as np
 import pytest
 
 from tpusppy.runtime import ShmMailbox, ShmWindowFabric, load_library
-from tpusppy.runtime.window_service import ShmSegment
+from tpusppy.runtime.window_service import (ShmSegment,
+                                            WindowServiceUnavailable)
+
+# Skip — with the explicit reason — ONLY when the toolchain/platform
+# genuinely cannot produce the library (no g++, no POSIX shm).  Any other
+# failure (e.g. a link regression) stays an ERROR: the service builds on
+# every supported CI/dev host.
+try:
+    load_library()
+    _unavailable = None
+except WindowServiceUnavailable as e:
+    _unavailable = str(e)
+pytestmark = pytest.mark.skipif(
+    _unavailable is not None,
+    reason=f"window service cannot be built here: {_unavailable}")
 
 
 def test_library_builds():

@@ -147,6 +147,10 @@ def _gather_per_scenario(xbar_nk, nid_sk):
 def _solver_fns_for(st: ADMMSettings, mesh, axis):
     """(shared_refresh, shared_frozen, dense_refresh, dense_frozen) for one
     settings variant; dense fns are shard_mapped when on a mesh."""
+    # the fused shared-A Pallas kernel cannot ride jit auto-partitioning
+    # (a pallas_call is opaque to the partitioner): permit it only when the
+    # shared engine's program spans a single device
+    shared_pallas_ok = mesh is None or len(mesh.devices.flat) == 1
 
     def shared_refresh(q, q2, A, cl, cu, lb, ub, x, z, y, yx):
         with jax.default_matmul_precision(st.matmul_precision):
@@ -157,7 +161,8 @@ def _solver_fns_for(st: ADMMSettings, mesh, axis):
     def shared_frozen(q, q2, A, cl, cu, lb, ub, x, z, y, yx, factors):
         with jax.default_matmul_precision(st.matmul_precision):
             return shared_admm._solve_shared_frozen_impl(
-                q, q2, A, cl, cu, lb, ub, factors, (x, z, y, yx), st)
+                q, q2, A, cl, cu, lb, ub, factors, (x, z, y, yx), st,
+                allow_pallas=shared_pallas_ok)
 
     def local_refresh(q, q2, A, cl, cu, lb, ub, x, z, y, yx):
         with jax.default_matmul_precision(st.matmul_precision):
@@ -305,7 +310,7 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
         key = (seg_r, seg_f)
         if key not in seg_cache:
             st_r = dataclasses.replace(settings, max_iter=seg_r)
-            st_f = dataclasses.replace(settings, max_iter=seg_f)
+            st_f = segmented_solvers.seg_settings(settings, seg_f)
             sr, _, lr, _ = _solver_fns(st_r)
             _, sf, _, lf = _solver_fns(st_f)
 
@@ -721,7 +726,7 @@ def init_state(arr: PHArrays, default_rho: float, settings: ADMMSettings) -> PHS
 def run_ph(batch, mesh: Mesh, iters: int, default_rho: float = 1.0,
            settings: ADMMSettings | None = None, axis: str = "scen",
            refresh_every: int = 32, fused: bool | str = "auto",
-           chunk: int | None = None):
+           chunk: int | None = None, precision: str | None = None):
     """Sharded PH driver: Iter0 (plain objective via rho=W=0 warmup step
     semantics) + ``iters`` PH iterations.  Returns (state, last PHStepOut).
 
@@ -740,8 +745,15 @@ def run_ph(batch, mesh: Mesh, iters: int, default_rho: float = 1.0,
     ``chunk`` overrides the fused chunk size (else the cap, rounded down
     to a refresh multiple).  conv/eobj stay device-side across chunks —
     the host syncs only once per dispatch window.
+
+    ``precision``: frozen-sweep matmul precision ("default"/"high"/
+    "highest", see doc/precision.md) — shorthand for
+    ``settings.sweep_precision`` so drivers can thread an autotuned mode
+    without rebuilding settings.
     """
     settings = settings or ADMMSettings()
+    if precision is not None:
+        settings = dataclasses.replace(settings, sweep_precision=precision)
     arr = shard_batch(batch, mesh, axis)
     refresh, frozen = make_ph_step_pair(
         batch.tree.nonant_indices, settings, mesh, axis)

@@ -27,20 +27,70 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
+class WindowServiceUnavailable(RuntimeError):
+    """The shm window service cannot exist on this host — no C++
+    toolchain, or the platform lacks working POSIX shm.  Tests skip
+    (with this reason) instead of erroring; a COMPILE failure of the
+    source is deliberately NOT this class — that is a code regression
+    and must stay an error (see tests/test_window_service.py)."""
+
+
+def _compile():
+    """Build the shared library.  ``shm_open`` lives in librt on older
+    glibc (this container) and in libc proper since glibc 2.34 — link
+    ``-lrt`` on Linux either way (a no-op stub where unneeded); macOS has
+    neither librt nor the need for it."""
+    import sys
+
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+           _SRC, "-o", _LIB_PATH]
+    if sys.platform.startswith("linux"):
+        cmd.append("-lrt")
+    try:
+        proc = subprocess.run(cmd, capture_output=True)
+    except FileNotFoundError as e:
+        raise WindowServiceUnavailable(f"no C++ toolchain: {e}") from e
+    if proc.returncode != 0:
+        # a present toolchain failing on our source is a regression, not
+        # an environment limitation: surface it as a hard error
+        raise RuntimeError(
+            "window_service.cpp failed to compile: "
+            f"{proc.stderr.decode(errors='replace')[-500:]}")
+
+
 def load_library() -> ctypes.CDLL:
-    """Compile (once) and load the shared library."""
+    """Compile (once) and load the shared library.
+
+    A stale .so that no longer loads (e.g. built before the ``-lrt`` link
+    fix: ``undefined symbol: shm_open``) is rebuilt once and retried.
+    Raises :class:`WindowServiceUnavailable` when the library genuinely
+    cannot be produced here."""
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
         if (not os.path.exists(_LIB_PATH)
                 or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)):
-            subprocess.run(
-                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                 _SRC, "-o", _LIB_PATH],
-                check=True, capture_output=True,
-            )
-        lib = ctypes.CDLL(_LIB_PATH)
+            _compile()
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            # stale/broken artifact (wrong link flags, interrupted write):
+            # rebuild from source once, then let a second failure surface
+            try:
+                os.remove(_LIB_PATH)
+            except OSError:
+                pass
+            _compile()
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+            except OSError as e:
+                # a FRESHLY compiled library failing to load is a link
+                # regression in our source/flags (the shm_open class this
+                # path exists to catch), not an environment limitation —
+                # it must fail loudly, never skip
+                raise RuntimeError(
+                    f"freshly rebuilt library fails to load: {e}") from e
         lib.ws_create.restype = ctypes.c_void_p
         lib.ws_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                   ctypes.POINTER(ctypes.c_int64)]

@@ -90,6 +90,27 @@ class ADMMSettings:
     # sweep throughput; certified-bound programs (dual_objective/dual_cut)
     # always run "highest" regardless.
     matmul_precision: str = "highest"
+    # Mixed-precision FROZEN sweep engine (solvers/precision.py; see
+    # doc/precision.md).  None (the default) leaves every path exactly as
+    # before; "default" (bf16) or "high" (bf16x3) runs the frozen sweep
+    # phase at lowered MXU precision — with the x-update defect and ALL
+    # residual bookkeeping pinned to full f32, so the OSQP termination
+    # test stays trustworthy — then, if not eps-converged, a bounded
+    # full-precision refinement phase (``precision_refine_iters`` sweeps
+    # on the SAME cached factors) restores the f32 residual floor.
+    # Refresh/adaptive solves and certified-bound programs are never
+    # lowered.  The autotuner (tpusppy.tune) picks this per shape: the
+    # fastest mode whose warmup residuals certify.
+    sweep_precision: str | None = None
+    # f32 refinement sweep budget appended to a low-precision frozen sweep
+    # phase that did not reach eps (skipped entirely when it did — the
+    # f32-measured residuals already certify the iterate).
+    precision_refine_iters: int = 64
+    # Host-side fallback guard (spopt._solve_amortized): a low-precision
+    # frozen solve whose worst residual exceeds ``precision_guard`` x the
+    # last full-precision refresh floor (and is not converged) is re-run
+    # at full precision on the same factors.  <= 0 disables.
+    precision_guard: float = 10.0
     # In-loop plateau exit: leave the sweep while_loop when the batch-worst
     # eps-normalized residual improved by less than this fraction over each
     # of 2 consecutive windows of ``sweep_plateau_window`` sweeps.  Hard LP
@@ -104,6 +125,10 @@ class ADMMSettings:
 
     def jdtype(self):
         return jnp.dtype(self.dtype)
+
+    def sweep_mode(self) -> str:
+        """Effective frozen-sweep matmul precision (for MFU/report use)."""
+        return self.sweep_precision or self.matmul_precision
 
 
 class BatchSolution(NamedTuple):
@@ -293,12 +318,26 @@ def _explicit_inverse_schur(K):
     return jnp.concatenate([top, bot], axis=-2)
 
 
-def _chol_solve(LK, b, refine=2):
+def _chol_solve(LK, b, refine=2, prec=None):
+    """K^-1 b via the explicit inverse + refinement against the exact K.
+
+    ``prec``: None = legacy path (ambient matmul precision, unchanged
+    programs).  A mode string runs the Kinv applies at that precision
+    while the DEFECT ``b - K x`` stays pinned at full f32 — the classic
+    mixed-precision iterative-refinement split (defect at high precision,
+    correction at low)."""
     Kinv, K = LK
-    x = jnp.einsum("snk,sk->sn", Kinv, b)
+    if prec is None:
+        x = jnp.einsum("snk,sk->sn", Kinv, b)
+        for _ in range(refine):
+            r = b - jnp.einsum("snk,sk->sn", K, x)
+            x = x + jnp.einsum("snk,sk->sn", Kinv, r)
+        return x
+    from . import precision
+    x = precision.contract("snk,sk->sn", Kinv, b, prec)
     for _ in range(refine):
-        r = b - jnp.einsum("snk,sk->sn", K, x)
-        x = x + jnp.einsum("snk,sk->sn", Kinv, r)
+        r = b - precision.contract("snk,sk->sn", K, x, "highest")
+        x = x + precision.contract("snk,sk->sn", Kinv, r, prec)
     return x
 
 
@@ -369,14 +408,27 @@ def _plateau_update(s, pri, dua, prinorm, duanorm, st: ADMMSettings,
 
 
 def _admm_core(q, q2, A, cl, cu, lb, ub, state, LK, rho_a, rho_x,
-               st: ADMMSettings, P=None):
-    """Inner ADMM sweep at fixed rho. Returns final state."""
+               st: ADMMSettings, P=None, prec=None):
+    """Inner ADMM sweep at fixed rho. Returns final state.
+
+    ``prec``: None keeps the legacy (ambient-precision) program
+    byte-for-byte; a mode string runs the SWEEP matvecs at that precision
+    (solvers/precision.py) while residual bookkeeping and the
+    checkpoint Ax re-anchor stay pinned at full f32 — so the while_loop's
+    OSQP test measures true residuals whatever the sweep mode."""
     sigma, alpha = st.sigma, st.alpha
+
+    if prec is None:
+        lo = hi = lambda spec, a, b: jnp.einsum(spec, a, b)
+    else:
+        from . import precision
+        lo = lambda spec, a, b: precision.contract(spec, a, b, prec)
+        hi = lambda spec, a, b: precision.contract(spec, a, b, "highest")
 
     def Px(x):
         base = q2 * x
         if P is not None:
-            base = base + jnp.einsum("snk,sk->sn", P, x)
+            base = base + hi("snk,sk->sn", P, x)
         return base
 
     def sweep(x, z, zx, y, yx, Ax):
@@ -385,11 +437,11 @@ def _admm_core(q, q2, A, cl, cu, lb, ub, state, LK, rho_a, rho_x,
         per sweep."""
         rhs = (
             sigma * x - q
-            + jnp.einsum("smn,sm->sn", A, rho_a * z - y)
+            + lo("smn,sm->sn", A, rho_a * z - y)
             + (rho_x * zx - yx)
         )
-        xt = _chol_solve(LK, rhs, refine=st.solve_refine)
-        Axt = jnp.einsum("smn,sn->sm", A, xt)
+        xt = _chol_solve(LK, rhs, refine=st.solve_refine, prec=prec)
+        Axt = lo("smn,sn->sm", A, xt)
         x_new = alpha * xt + (1 - alpha) * x
         Ax_new = alpha * Axt + (1 - alpha) * Ax
 
@@ -407,7 +459,7 @@ def _admm_core(q, q2, A, cl, cu, lb, ub, state, LK, rho_a, rho_x,
             jnp.max(jnp.abs(Ax - z), axis=1),
             jnp.max(jnp.abs(x - zx), axis=1),
         )
-        Aty = jnp.einsum("smn,sm->sn", A, y)
+        Aty = hi("smn,sm->sn", A, y)
         Pxv = Px(x)
         dua = jnp.max(jnp.abs(Pxv + q + Aty + yx), axis=1)
         # OSQP-normalized residual scales, for tolerances and rho adaptation
@@ -443,12 +495,30 @@ def _admm_core(q, q2, A, cl, cu, lb, ub, state, LK, rho_a, rho_x,
             f"use_pallas must be True, False, or 'auto'; got "
             f"{st.use_pallas!r} (strings other than 'auto' would silently "
             f"force the kernel on)")
+    # dense-kernel precision: "default" stores the matrices in bf16 (halved
+    # VMEM per scenario, bf16-rounded operands); "high" keeps f32 — the
+    # kernel's VPU contractions run full f32 anyway, so bf16x3 has nothing
+    # to save there (the kernel is then at least as accurate as the mode
+    # asks; see pallas_kernels.fused_sweeps)
+    kprec = "default" if prec == "default" else "highest"
     if st.use_pallas == "auto":
-        bs = pallas_kernels.usable(S, m, n, P=P)
+        bs = pallas_kernels.usable(S, m, n, P=P, precision=kprec)
         if bs is not None and bs < S and bs > 512:
-            bs = None          # measured-loss regime (many coarse blocks)
+            bs32 = (pallas_kernels.usable(S, m, n, P=P)
+                    if kprec == "default" else bs)
+            if (kprec == "default" and bs32 is not None
+                    and not (bs32 < S and bs32 > 512)):
+                # bf16 storage WIDENED an f32-ACCEPTED block into the
+                # measured-loss band: clamp back to the band's top — the
+                # mode's VMEM dividend must never turn the kernel OFF for
+                # a shape the f32 path accepts.  Shapes the f32 heuristic
+                # itself rejects stay rejected (the loss regime was
+                # measured; bf16 storage doesn't re-litigate it).
+                bs = 512
+            else:
+                bs = None      # measured-loss regime (many coarse blocks)
     elif st.use_pallas:
-        bs = pallas_kernels.usable(S, m, n, P=P)
+        bs = pallas_kernels.usable(S, m, n, P=P, precision=kprec)
     else:
         bs = None
     if bs is not None:
@@ -456,6 +526,12 @@ def _admm_core(q, q2, A, cl, cu, lb, ub, state, LK, rho_a, rho_x,
         tT = lambda a: jnp.transpose(a, (1, 2, 0))
         AT, AtT = tT(A), jnp.transpose(A, (2, 1, 0))
         KinvT, KT = tT(Kinv), tT(K)
+        if kprec == "default":
+            # bf16 storage for the sweep matrices (halved VMEM -> bigger
+            # blocks); K stays f32 — it is the refinement DEFECT operand,
+            # which must be exact (matches the XLA path's pinned-f32 defect)
+            AT, AtT, KinvT = (a.astype(jnp.bfloat16)
+                              for a in (AT, AtT, KinvT))
         qT, clT, cuT, lbT, ubT = q.T, cl.T, cu.T, lb.T, ub.T
         rho_aT, rho_xT = rho_a.T, jnp.broadcast_to(rho_x, (S, n)).T
 
@@ -471,7 +547,7 @@ def _admm_core(q, q2, A, cl, cu, lb, ub, state, LK, rho_a, rho_x,
                 x.T, z.T, zx.T, y.T, yx.T, Ax.T,
                 n_sweeps=max(1, st.check_every),
                 n_refine=st.solve_refine, sigma=float(sigma),
-                alpha=float(alpha), bs=bs,
+                alpha=float(alpha), bs=bs, precision=kprec,
             )
             x, z, zx, y, yx, Ax = (o.T for o in outs)
         else:
@@ -480,7 +556,8 @@ def _admm_core(q, q2, A, cl, cu, lb, ub, state, LK, rho_a, rho_x,
         # re-anchor the incrementally carried Ax: the relaxation combination
         # (alpha=1.6) amplifies carried floating error exponentially across
         # sweeps, so one true matvec per checkpoint resets the drift
-        Ax = jnp.einsum("smn,sn->sm", A, x)
+        # (pinned f32 under a low sweep mode — the defect control)
+        Ax = hi("smn,sn->sm", A, x)
         pri, dua, prinorm, duanorm = residuals(x, z, zx, y, yx, Ax)
         if st.sweep_plateau_rtol > 0:
             best, stall = _plateau_update(s, pri, dua, prinorm, duanorm, st)
@@ -903,6 +980,36 @@ def _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm, P=None,
     return sol
 
 
+def _frozen_sweep_phases(run_core, state0, settings, dt):
+    """Two-phase frozen sweep shared by BOTH engines (dense per-scenario
+    and shared-A — their ``_IterState``s both carry k/best/stall, which is
+    all this touches).  ``run_core(state, st, prec)`` runs one engine core.
+
+    Full precision: a single legacy-path core run.  Lowered
+    (``settings.sweep_precision``): a bf16/bf16x3 sweep phase (f32-pinned
+    residuals, so the while_loop's eps test is real), then — only when
+    not every scenario reached eps — a bounded full-precision refinement
+    phase on the SAME factors restores the f32 floor.  The reported
+    residuals/done always come from f32 measurements; iteration counts
+    accumulate across phases."""
+    from . import precision as _precision
+    if not _precision.is_low(settings.sweep_precision):
+        return run_core(state0, settings, None)
+    mode = _precision.canon(settings.sweep_precision)
+    state = run_core(state0, settings, mode)
+    if settings.precision_refine_iters > 0:
+        k1 = state.k
+        st_r = dataclasses.replace(
+            settings, max_iter=int(settings.precision_refine_iters))
+        state = run_core(
+            state._replace(k=jnp.zeros((), jnp.int32),
+                           best=jnp.asarray(jnp.inf, dt),
+                           stall=jnp.zeros((), jnp.int32)),
+            st_r, "highest")
+        state = state._replace(k=state.k + k1)
+    return state
+
+
 def _solve_frozen_impl(c, q2, A, cl, cu, lb, ub, factors: Factors, warm,
                        settings, P=None, polish=False) -> BatchSolution:
     """Sweep-only solve reusing a previous refresh's :class:`Factors`.
@@ -941,9 +1048,13 @@ def _solve_frozen_impl(c, q2, A, cl, cu, lb, ub, factors: Factors, warm,
                         jnp.zeros((), jnp.int32),
                         jnp.asarray(jnp.inf, dt), jnp.zeros((), jnp.int32))
 
-    state = _admm_core(qs, q2s, As, cls, cus, lbs, ubs, state0,
-                       (factors.Kinv, factors.K), factors.rho_a,
-                       factors.rho_x, settings, Ps)
+    LK = (factors.Kinv, factors.K)
+
+    def run_core(st0, st, prec):
+        return _admm_core(qs, q2s, As, cls, cus, lbs, ubs, st0, LK,
+                          factors.rho_a, factors.rho_x, st, Ps, prec=prec)
+
+    state = _frozen_sweep_phases(run_core, state0, settings, dt)
 
     def unscale(s):
         return (s.x * D, s.z / E, s.y * E / cost[:, None],
@@ -976,17 +1087,53 @@ def solve_batch_frozen(c, q2, A, cl, cu, lb, ub, factors: Factors,
 
 @jax.jit
 def stop_stats(sol: BatchSolution):
-    """[max iters, max pri_res, max dua_res] as ONE device array.
+    """[max iters, max pri_res, max dua_res, all_done] as ONE device array.
 
     Segmented continuations (:mod:`.segmented`) need the iteration counter
-    (stop-dispatch test) and the worst residuals (plateau detector) on the
-    host between segments; fetched separately that is three serial
-    host<->device round-trips per segment — over a remote TPU tunnel each
-    is a full RPC.  This reduces them to one fetch of a 3-vector."""
+    (stop-dispatch test), the worst residuals (plateau detector) and the
+    convergence vote on the host between segments; fetched separately that
+    is several serial host<->device round-trips per segment — over a
+    remote TPU tunnel each is a full RPC.  This reduces them to one fetch.
+    ``all_done`` lets the stop test catch a mixed-precision solve whose
+    phase-1 sweep count hit the segment cap but whose f32 refinement phase
+    then converged (iters alone would schedule a pointless extra
+    dispatch)."""
     dt = sol.pri_res.dtype
     return jnp.stack([sol.iters.max().astype(dt),
                       sol.pri_res.max().astype(dt),
-                      sol.dua_res.max().astype(dt)])
+                      sol.dua_res.max().astype(dt),
+                      jnp.all(sol.done).astype(dt)])
+
+
+def precision_guard_trips(sol: BatchSolution, settings: ADMMSettings,
+                          ref_worst=None) -> bool:
+    """Host-side residual guard for the mixed-precision frozen path.
+
+    True when a low-precision frozen solve must be re-run at full
+    precision: it is not eps-converged AND its worst residual exceeds
+    ``precision_guard`` x the reference floor — the worst residual of the
+    last FULL-precision refresh solve of the same family (``ref_worst``),
+    floored at eps.  Plateau families (whose full-precision floor is far
+    above eps) therefore never trip the guard on residuals full precision
+    could not beat either; a genuinely precision-limited solve (parked
+    orders of magnitude above the f32 floor, or non-finite) always does.
+    """
+    if not settings.sweep_precision or settings.sweep_precision == "highest":
+        return False
+    if settings.precision_guard <= 0:
+        return False
+    # ONE device fetch (stop_stats: iters/residual maxima/all_done) — the
+    # guard sits in the amortized hot path, where separate fetches are
+    # serial RPCs over a remote tunnel
+    st4 = np.asarray(stop_stats(sol))
+    if bool(st4[3]):
+        return False
+    worst = float(max(st4[1], st4[2]))
+    if not np.isfinite(worst):
+        return True
+    floor = max(settings.eps_abs, settings.eps_rel)
+    bar = settings.precision_guard * max(float(ref_worst or 0.0), floor)
+    return worst > bar
 
 
 def _Aty(A, y):

@@ -45,6 +45,24 @@ _TPU_PEAKS_BF16 = (
 # "highest" = bf16x6 f32 emulation, "high" = bf16x3, "default" = plain bf16
 PRECISION_PASSES = {"highest": 6, "high": 3, "default": 1}
 
+# Conservative wall-clock speedup of a SWEEP at lowered matmul precision,
+# used by dispatch sizing (segmented.dispatch_segments).  Deliberately the
+# FLOOR over execution regimes, far below the theoretical pass ratios
+# (6x/2x): the per-scenario dense Pallas kernel runs its contractions in
+# exact f32 VPU math regardless of mode ("high" gains nothing there;
+# "default" gains only the bf16-storage bandwidth saving), while the XLA
+# MXU regimes gain the pass ratio.  Underestimating the speedup is
+# watchdog-safe (dispatches sized smaller than they could be);
+# overestimating would let a fused program outlive the worker's ~60 s
+# execution kill.  Revisit with measured sweep times per mode.
+SWEEP_SPEEDUP = {"highest": 1.0, "high": 1.0, "default": 1.25}
+
+
+def sweep_speedup(mode) -> float:
+    """Dispatch-model throughput factor for a sweep at precision ``mode``
+    (None = "highest" = 1.0)."""
+    return SWEEP_SPEEDUP.get(mode or "highest", 1.0)
+
 # Nominal CPU peak used when nothing better is known (one modern core's
 # order-of-magnitude f64 FMA throughput).  CPU MFU numbers exist so the
 # smoke bench exercises the full reporting path, not as a claim about the

@@ -39,9 +39,17 @@ except Exception:  # pragma: no cover
 _VMEM_BUDGET = 3 * 1024 * 1024
 
 
-def sweep_block_size(S, m, n, itemsize=4) -> int:
-    """Scenarios per grid step so A/Kinv/K (+vectors) fit in VMEM."""
-    per_scen = (m * n + 2 * n * n + 6 * n + 6 * m) * itemsize
+def sweep_block_size(S, m, n, itemsize=4, precision="highest") -> int:
+    """Scenarios per grid step so A/Kinv/K (+vectors) fit in VMEM.
+
+    ``precision="default"`` stores A/At/Kinv in bf16 (half the bytes —
+    the mixed-precision sweep mode's VMEM dividend; K stays f32, it is
+    the refinement-defect operand)."""
+    if precision == "default":
+        mat = (m * n + n * n) * 2 + n * n * itemsize
+    else:
+        mat = (m * n + 2 * n * n) * itemsize
+    per_scen = mat + (6 * n + 6 * m) * itemsize
     bs = max(1, _VMEM_BUDGET // max(per_scen, 1))
     return int(min(S, bs))
 
@@ -50,7 +58,7 @@ def _sweeps_kernel(q_ref, A_ref, At_ref, Kinv_ref, K_ref, cl_ref, cu_ref,
                    lb_ref, ub_ref, rho_a_ref, rho_x_ref, x_ref, z_ref,
                    zx_ref, y_ref, yx_ref, Ax_ref, x_out, z_out, zx_out,
                    y_out, yx_out, Ax_out, *, n_sweeps, n_refine, sigma,
-                   alpha, m, n):
+                   alpha, m, n, precision):
     """Scenario-on-lanes layout: every tensor is (..., Sb) with the scenario
     block on the 128-lane axis, so each matvec step is a full-width VPU
     multiply-accumulate.  Contractions loop over the LEADING (untiled) dim
@@ -59,7 +67,17 @@ def _sweeps_kernel(q_ref, A_ref, At_ref, Kinv_ref, K_ref, cl_ref, cu_ref,
       A'(v):  out[j] += A[i, j, :] * v[i, :]   via A (m, n, Sb), loop i<m
       A x:    out[i] += At[j, i, :] * x[j, :]  via At (n, m, Sb), loop j<n
       K^-1 r: sym matrix, loop over rows.
-    """
+
+    ``precision``: "default" takes A/At/Kinv in bf16 storage and rounds
+    the vector operand of each sweep contraction to bf16 — matching the
+    XLA mixed-precision sweep emulation (solvers/precision.py), with the
+    refinement defect against the f32 K exact.  Every other mode runs the
+    exact f32 path (the VPU has no MXU passes to economize, so "high"
+    here is simply full f32 — at least as accurate as bf16x3 asks)."""
+    dt = K_ref.dtype
+    # matrices stay in their STORAGE dtype (bf16 under "default" — that is
+    # the VMEM dividend); upcasts happen per leading-dim slice inside the
+    # contraction, so no full f32 copy of A/At/Kinv is ever materialized
     A = A_ref[:]          # (m, n, Sb)
     At = At_ref[:]        # (n, m, Sb)
     Kinv = Kinv_ref[:]    # (n, n, Sb)
@@ -69,23 +87,29 @@ def _sweeps_kernel(q_ref, A_ref, At_ref, Kinv_ref, K_ref, cl_ref, cu_ref,
     rho_a, rho_x = rho_a_ref[:], rho_x_ref[:]
     x, z, zx, y, yx, Ax = (x_ref[:], z_ref[:], zx_ref[:], y_ref[:],
                            yx_ref[:], Ax_ref[:])
+    lowered = precision == "default"
+
+    def rnd(v):
+        """bf16 input rounding of the vector operand (lowered mode only)."""
+        return v.astype(jnp.bfloat16).astype(dt) if lowered else v
 
     def contract(M, v, rows):
-        """out[k, :] = sum_i M[i, k, :] * v[i, :] (loop over leading dim)."""
-        acc = M[0] * v[0][None, :]
+        """out[k, :] = sum_i M[i, k, :] * v[i, :] (loop over leading dim;
+        per-slice upcast of bf16-stored matrices)."""
+        acc = M[0].astype(dt) * v[0][None, :]
         for i in range(1, rows):
-            acc = acc + M[i] * v[i][None, :]
+            acc = acc + M[i].astype(dt) * v[i][None, :]
         return acc
 
     def body(_, carry):
         x, z, zx, y, yx, Ax = carry
-        rhs = (sigma * x - q + contract(A, rho_a * z - y, m)
+        rhs = (sigma * x - q + contract(A, rnd(rho_a * z - y), m)
                + (rho_x * zx - yx))
-        xt = contract(Kinv, rhs, n)           # Kinv symmetric
+        xt = contract(Kinv, rnd(rhs), n)      # Kinv symmetric
         for _ in range(n_refine):
-            r = rhs - contract(K, xt, n)
-            xt = xt + contract(Kinv, r, n)
-        Axt = contract(At, xt, n)
+            r = rhs - contract(K, xt, n)      # defect: exact f32 K
+            xt = xt + contract(Kinv, rnd(r), n)
+        Axt = contract(At, rnd(xt), n)
         x_new = alpha * xt + (1 - alpha) * x
         Ax_new = alpha * Axt + (1 - alpha) * Ax
 
@@ -110,12 +134,18 @@ def _sweeps_kernel(q_ref, A_ref, At_ref, Kinv_ref, K_ref, cl_ref, cu_ref,
 
 @functools.partial(jax.jit,
                    static_argnames=("n_sweeps", "n_refine", "sigma", "alpha",
-                                    "bs", "interpret"))
+                                    "bs", "precision", "interpret"))
 def fused_sweeps(q, A, At, Kinv, K, cl, cu, lb, ub, rho_a, rho_x,
                  x, z, zx, y, yx, Ax, n_sweeps, n_refine, sigma, alpha, bs,
-                 interpret=False):
+                 precision="highest", interpret=False):
     """Run ``n_sweeps`` sweeps; ALL arrays in scenario-last layout
     (m,n,S)/(n,S) etc.  Returns transposed-state (x, z, zx, y, yx, Ax).
+
+    ``precision="default"`` is the mixed-precision sweep mode: pass
+    A/At/Kinv in bf16 (callers cast; K stays f32 for exact defects) —
+    VMEM per scenario nearly halves, so blocks grow and fewer grid steps
+    re-stream HBM.  "high"/"highest" run the exact f32 kernel (see
+    ``_sweeps_kernel``).
 
     ``interpret=True`` runs the kernel through the Pallas interpreter —
     platform-independent, used by the CPU correctness tests
@@ -133,8 +163,8 @@ def fused_sweeps(q, A, At, Kinv, K, cl, cu, lb, ub, rho_a, rho_x,
 
     kern = functools.partial(_sweeps_kernel, n_sweeps=n_sweeps,
                              n_refine=n_refine, sigma=sigma, alpha=alpha,
-                             m=m, n=n)
-    dt = A.dtype
+                             m=m, n=n, precision=precision)
+    dt = K.dtype
     out_shape = [
         jax.ShapeDtypeStruct((n, S), dt),   # x
         jax.ShapeDtypeStruct((m, S), dt),   # z
@@ -165,17 +195,221 @@ def fused_sweeps(q, A, At, Kinv, K, cl, cu, lb, ub, rho_a, rho_x,
     )(q, A, At, Kinv, K, cl, cu, lb, ub, rho_a, rho_x, x, z, zx, y, yx, Ax)
 
 
-def usable(S, m, n, platform=None, P=None) -> int | None:
-    """Block size if the fused kernel applies, else None."""
+def usable(S, m, n, platform=None, P=None, precision="highest") -> int | None:
+    """Block size if the fused per-scenario kernel applies, else None.
+
+    ``precision="default"`` widens the applicable range: bf16 matrix
+    storage halves the per-scenario VMEM, so larger (m, n) still fit."""
     if not HAVE_PALLAS or P is not None:
         return None
     platform = platform or jax.default_backend()
     if platform != "tpu":
         return None
-    budget = sweep_block_size(S, m, n)
+    budget = sweep_block_size(S, m, n, precision=precision)
     if budget >= S:
         return S          # one block covering the whole (lane) dimension
     # the lane-dim block must be a multiple of 128 (Mosaic tiling); the grid
     # uses ceiling division, so S need not divide evenly
     bs = (budget // 128) * 128
     return bs if bs >= 128 else None
+
+
+# --------------------------------------------------------------------------
+# Fused shared-A sweep kernel (the frozen shared-engine fast path)
+# --------------------------------------------------------------------------
+#
+# The shared-A engine (solvers/shared_admm) keeps ONE (m, n) constraint
+# matrix and ONE (n, n) KKT inverse for the whole scenario batch; its sweep
+# contractions are genuine (Sb, k) @ (k, j) MXU matmuls — exactly where
+# lowered matmul precision pays (1/3/6 bf16 passes per f32 multiply-add).
+# This kernel runs a whole ``check_every`` sweep block per call with the
+# shared matrices VMEM-resident (constant index_map: Mosaic keeps revisited
+# blocks in place) and the scenario block on the SUBLANE axis, and applies
+# the precision mode with explicit bf16 operand splits — identical
+# semantics under Mosaic and the interpreter, so the CPU parity tests pin
+# it to the XLA mixed-precision sweep (solvers/precision.py emulation).
+
+
+def _prep_mat(M, mode):
+    """(M1, M2) bf16 expansion of a matrix for ``mode`` ("highest": the
+    matrix itself, no split).  Splits go THROUGH f32 — exactly the
+    rounding chain of precision.contract's emulation (and a no-op on the
+    f32 arrays real TPU runs carry), so interpret-mode parity with the
+    XLA mixed-precision path is exact up to summation order."""
+    if mode == "highest":
+        return (M, None)
+    Mf = M.astype(jnp.float32)
+    M1 = Mf.astype(jnp.bfloat16)
+    if mode == "default":
+        return (M1, None)
+    return (M1, (Mf - M1.astype(jnp.float32)).astype(jnp.bfloat16))
+
+
+def _pdot(u, Msplit, mode, dt, transpose=False):
+    """u @ M (or u @ M.T) at ``mode``; u is rounded/split per call, M is
+    pre-split by :func:`_prep_mat`."""
+    dn = (((1,), (1 if transpose else 0,)), ((), ()))
+    d = functools.partial(jax.lax.dot_general, dimension_numbers=dn,
+                          preferred_element_type=dt)
+    M1, M2 = Msplit
+    if mode == "highest":
+        return d(u, M1, precision=jax.lax.Precision.HIGHEST)
+    uf = u.astype(jnp.float32)
+    u1 = uf.astype(jnp.bfloat16)
+    if mode == "default":
+        return d(u1, M1)
+    u2 = (uf - u1.astype(jnp.float32)).astype(jnp.bfloat16)
+    return d(u1, M1) + d(u1, M2) + d(u2, M1)
+
+
+def _shared_sweeps_kernel(q_ref, A_ref, Kinv_ref, K_ref, cl_ref, cu_ref,
+                          lb_ref, ub_ref, rho_a_ref, rho_x_ref, dq2_ref,
+                          has_ref, gamma_ref, x_ref, z_ref, zx_ref, y_ref,
+                          yx_ref, Ax_ref, x_out, z_out, zx_out, y_out,
+                          yx_out, Ax_out, *, n_sweeps, n_refine, n_extra,
+                          sigma, alpha, precision):
+    """One ``n_sweeps`` block of the shared-A frozen sweep (the exact
+    semantics of ``shared_admm._core``'s block(): per-scenario gamma
+    scaling, dq2 refinement against the exact f32 K with the lax.cond
+    extra passes reproduced as a global-``has`` select)."""
+    dt = K_ref.dtype
+    A = _prep_mat(A_ref[:], precision)          # (m, n)
+    Kinv = _prep_mat(Kinv_ref[:], precision)    # (n, n)
+    K = K_ref[:]                                # exact, defect operand
+    q = q_ref[:]                                # (Sb, n)
+    cl, cu, lb, ub = cl_ref[:], cu_ref[:], lb_ref[:], ub_ref[:]
+    g = gamma_ref[:]                            # (Sb, 1)
+    has = has_ref[0, 0]                         # global any(dq2 != 0)
+    dq2 = dq2_ref[:]                            # (Sb, n)
+    sigma_s = g * sigma
+    rho_a_s = g * rho_a_ref[:]                  # (Sb, m)
+    rho_x_s = g * rho_x_ref[:]                  # (Sb, n)
+    x, z, zx, y, yx, Ax = (x_ref[:], z_ref[:], zx_ref[:], y_ref[:],
+                           yx_ref[:], Ax_ref[:])
+
+    def kdefect(rhs, xt):
+        # exact per-scenario system defect at full f32 (the refinement's
+        # accuracy anchor — never lowered)
+        Kx = jax.lax.dot_general(
+            xt, K, (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST, preferred_element_type=dt)
+        return rhs - (g * Kx + dq2 * xt)
+
+    def body(_, carry):
+        x, z, zx, y, yx, Ax = carry
+        rhs = (sigma_s * x - q + _pdot(rho_a_s * z - y, A, precision, dt)
+               + (rho_x_s * zx - yx))
+        xt = _pdot(rhs / g, Kinv, precision, dt)
+        for _ in range(n_refine):
+            xt = xt + _pdot(kdefect(rhs, xt) / g, Kinv, precision, dt)
+        for _ in range(n_extra):
+            xt2 = xt + _pdot(kdefect(rhs, xt) / g, Kinv, precision, dt)
+            xt = jnp.where(has > 0, xt2, xt)
+        Axt = _pdot(xt, A, precision, dt, transpose=True)
+        x_new = alpha * xt + (1 - alpha) * x
+        Ax_new = alpha * Axt + (1 - alpha) * Ax
+
+        za_arg = alpha * Axt + (1 - alpha) * z + y / rho_a_s
+        z_new = jnp.clip(za_arg, cl, cu)
+        y_new = y + rho_a_s * (alpha * Axt + (1 - alpha) * z - z_new)
+
+        zx_arg = alpha * xt + (1 - alpha) * zx + yx / rho_x_s
+        zx_new = jnp.clip(zx_arg, lb, ub)
+        yx_new = yx + rho_x_s * (alpha * xt + (1 - alpha) * zx - zx_new)
+        return x_new, z_new, zx_new, y_new, yx_new, Ax_new
+
+    x, z, zx, y, yx, Ax = jax.lax.fori_loop(
+        0, n_sweeps, body, (x, z, zx, y, yx, Ax))
+    x_out[:] = x
+    z_out[:] = z
+    zx_out[:] = zx
+    y_out[:] = y
+    yx_out[:] = yx
+    Ax_out[:] = Ax
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_sweeps", "n_refine", "n_extra",
+                                    "sigma", "alpha", "bs", "precision",
+                                    "interpret"))
+def fused_sweeps_shared(q, A, Kinv, K, cl, cu, lb, ub, rho_a, rho_x, dq2,
+                        has_dq2, gamma, x, z, zx, y, yx, Ax, n_sweeps,
+                        n_refine, n_extra, sigma, alpha, bs,
+                        precision="highest", interpret=False):
+    """``n_sweeps`` shared-A frozen sweeps per call, scenario-blocked on
+    the sublane axis.  Shapes: A/Kinv/K shared ((m,n)/(n,n)/(n,n)); rho_a
+    (1, m), rho_x (1, n); per-scenario state/bounds (S, m)/(S, n); gamma
+    (S, 1); dq2 (S, n); has_dq2 (1, 1) — the traced global
+    ``any(dq2 != 0)`` flag that reproduces the XLA path's lax.cond.
+    Returns (x, z, zx, y, yx, Ax)."""
+    S, n = q.shape
+    m = cl.shape[1]
+    grid = ((S + bs - 1) // bs,)
+
+    def shared2(d0, d1):
+        return pl.BlockSpec((d0, d1), lambda i: (0, 0),
+                            memory_space=pltpu.VMEM)
+
+    def scen(d1):
+        return pl.BlockSpec((bs, d1), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+
+    kern = functools.partial(_shared_sweeps_kernel, n_sweeps=n_sweeps,
+                             n_refine=n_refine, n_extra=n_extra,
+                             sigma=sigma, alpha=alpha, precision=precision)
+    dt = K.dtype
+    out_shape = [
+        jax.ShapeDtypeStruct((S, n), dt),   # x
+        jax.ShapeDtypeStruct((S, m), dt),   # z
+        jax.ShapeDtypeStruct((S, n), dt),   # zx
+        jax.ShapeDtypeStruct((S, m), dt),   # y
+        jax.ShapeDtypeStruct((S, n), dt),   # yx
+        jax.ShapeDtypeStruct((S, m), dt),   # Ax
+    ]
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            scen(n),             # q
+            shared2(m, n),       # A
+            shared2(n, n),       # Kinv
+            shared2(n, n),       # K
+            scen(m), scen(m),    # cl cu
+            scen(n), scen(n),    # lb ub
+            shared2(1, m),       # rho_a
+            shared2(1, n),       # rho_x
+            scen(n),             # dq2
+            shared2(1, 1),       # has_dq2
+            scen(1),             # gamma
+            scen(n), scen(m), scen(n), scen(m), scen(n),  # x z zx y yx
+            scen(m),             # Ax
+        ],
+        out_specs=[scen(n), scen(m), scen(n), scen(m), scen(n), scen(m)],
+        out_shape=out_shape,
+        interpret=interpret,
+    )(q, A, Kinv, K, cl, cu, lb, ub, rho_a, rho_x, dq2, has_dq2, gamma,
+      x, z, zx, y, yx, Ax)
+
+
+def usable_shared(S, m, n, platform=None, itemsize=4) -> int | None:
+    """Scenario block size if the fused shared-A kernel applies, else None.
+
+    The shared matrices (A + Kinv + K) must fit VMEM alongside one
+    scenario block's state; the block rides the SUBLANE axis (multiples
+    of 8 for f32).  Reference-scale UC (n=16008) exceeds the matrix
+    budget by orders of magnitude and correctly declines — the kernel is
+    the small/medium-n shared-family fast path."""
+    if not HAVE_PALLAS:
+        return None
+    platform = platform or jax.default_backend()
+    if platform != "tpu":
+        return None
+    mat = (m * n + 2 * n * n) * itemsize
+    if mat > _VMEM_BUDGET // 2:
+        return None
+    per_scen = (6 * n + 6 * m + 2) * itemsize
+    bs = (_VMEM_BUDGET - mat) // max(per_scen, 1)
+    if bs >= S:
+        return int(S)
+    bs = (bs // 8) * 8
+    return int(bs) if bs >= 8 else None

@@ -1,0 +1,95 @@
+"""Mixed-precision contraction helpers for the sweep engines.
+
+TPU MXU throughput is precision-tiered: a float32 matmul at jax precision
+"highest" runs as SIX bf16 passes (f32 emulation), "high" as THREE
+(bf16x3), "default" as ONE (plain bf16 inputs, f32 accumulation) — so
+lowering the matmul precision of the sweep-dominated frozen inner loop
+buys up to 6x MXU rate on the same arrays.  This module is the single
+place that maps a *mode string* onto an actual contraction:
+
+- on TPU, :func:`contract` passes the corresponding
+  ``jax.lax.Precision`` through to the native einsum — the hardware does
+  the pass splitting;
+- on every other backend (the CPU test/fallback posture above all), the
+  pass structure is EMULATED: operands are rounded to bf16 ("default")
+  or split into a 2-term bf16 expansion with the three cross products
+  kept ("high" = bf16x3), accumulating in f32.  CPU tests therefore
+  exercise *genuine* low-precision numerics — the refinement guard and
+  the parity gates are real tests, not no-ops.
+
+The solver engines use these helpers only for the LOW-precision sweep
+phase (``ADMMSettings.sweep_precision``); defect/residual bookkeeping is
+always pinned to "highest" so the OSQP termination test measures true
+f32 residuals regardless of the sweep mode (classic mixed-precision
+iterative refinement: defect at full precision, correction at low).
+See doc/precision.md for the full scheme.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: Recognized matmul precision modes, fastest first.  Mirrors
+#: jax.default_matmul_precision's vocabulary (and
+#: flops.PRECISION_PASSES's keys).
+MODES = ("default", "high", "highest")
+
+_JAX_PRECISION = {
+    "default": jax.lax.Precision.DEFAULT,
+    "high": jax.lax.Precision.HIGH,
+    "highest": jax.lax.Precision.HIGHEST,
+}
+
+
+def canon(mode: str | None) -> str:
+    """Validate a mode string; ``None`` means "highest" (full f32)."""
+    if mode is None:
+        return "highest"
+    if mode not in MODES:
+        raise ValueError(
+            f"matmul precision mode must be one of {MODES}; got {mode!r}")
+    return mode
+
+
+def is_low(mode: str | None) -> bool:
+    """True when ``mode`` actually lowers precision below full f32."""
+    return mode is not None and canon(mode) != "highest"
+
+
+def _bf16_round(x):
+    """Round to bf16 and back — the MXU input rounding, kept in the
+    original float dtype so downstream arithmetic is unchanged."""
+    return x.astype(jnp.bfloat16).astype(x.dtype)
+
+
+def contract(spec: str, a, b, mode: str | None = None, platform=None):
+    """``jnp.einsum(spec, a, b)`` at the given precision mode.
+
+    "highest" (or None) is an exact full-precision einsum (explicitly
+    pinned, so callers inside a lowered ``default_matmul_precision``
+    context still get true f32 defects).  Lower modes use native MXU
+    precision flags on TPU and the emulation described in the module
+    docstring elsewhere.  f64 operands are emulated THROUGH f32 (the
+    modes describe MXU behavior; an f64 caller opting into bf16 sweeps
+    gets bf16-grade sweeps, as it asked).
+    """
+    mode = canon(mode)
+    if mode == "highest":
+        return jnp.einsum(spec, a, b, precision=jax.lax.Precision.HIGHEST)
+    platform = platform or jax.default_backend()
+    if platform == "tpu":
+        return jnp.einsum(spec, a, b, precision=_JAX_PRECISION[mode])
+    # Emulation: reproduce the TPU pass structure in f32 arithmetic.
+    dt = jnp.result_type(a, b)
+    a32, b32 = a.astype(jnp.float32), b.astype(jnp.float32)
+    a1, b1 = _bf16_round(a32), _bf16_round(b32)
+    hi = jax.lax.Precision.HIGHEST
+    if mode == "default":
+        out = jnp.einsum(spec, a1, b1, precision=hi)
+    else:  # "high" = bf16x3: 2-term splits, drop the low-low product
+        a2, b2 = _bf16_round(a32 - a1), _bf16_round(b32 - b1)
+        out = (jnp.einsum(spec, a1, b1, precision=hi)
+               + jnp.einsum(spec, a1, b2, precision=hi)
+               + jnp.einsum(spec, a2, b1, precision=hi))
+    return out.astype(dt)

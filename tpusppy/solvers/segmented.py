@@ -48,6 +48,26 @@ _DISPATCH_EFF_FLOPS = 6e12
 _DISPATCH_EFF_FLOPS_DENSE = 4e12
 
 
+def _frozen_refine_iters(st):
+    """Worst-case full-precision refinement sweeps a LOWERED frozen solve
+    appends inside one dispatch (0 for full-precision settings)."""
+    if st.sweep_precision in (None, "highest"):
+        return 0
+    return max(0, int(st.precision_refine_iters))
+
+
+def seg_settings(settings, seg_iter):
+    """Per-dispatch settings for one segment: the sweep cap, plus — for
+    lowered sweep modes — the in-dispatch f32 refinement budget clamped
+    to the same cap, so one dispatch can never embed a refinement phase
+    larger than the watchdog-sized segment itself (dispatch_segments
+    bills exactly this worst case)."""
+    kw = {"max_iter": seg_iter}
+    if _frozen_refine_iters(settings) > seg_iter:
+        kw["precision_refine_iters"] = seg_iter
+    return dataclasses.replace(settings, **kw)
+
+
 def _dense_clamped_eff(eff_flops, factor_batch):
     """Default throughput, dense-clamped.  An EXPLICIT eff_flops stays
     authoritative (callers/tests monkeypatch the module constants to force
@@ -87,17 +107,30 @@ def dispatch_segments(S, n, m, st, factor_batch=1,
     # watchdog with the same 2x margin).  Flop accounting lives in
     # solvers/flops.py (shared with the autotuner + MFU reporting).
     t_sweep = flops_model.sweep_flops(S, n, m, sparse_factor) / eff
+    # frozen sweeps run at the (possibly lowered) sweep precision —
+    # conservatively faster (flops.SWEEP_SPEEDUP), so frozen dispatches may
+    # carry more sweeps; refresh solves always run full precision.  A
+    # lowered frozen dispatch also carries an in-dispatch f32 refinement
+    # phase, which :func:`seg_settings` clamps to the SEGMENT cap — so the
+    # worst case per lowered frozen sweep is one lowered sweep plus one
+    # full-precision refinement sweep, billed jointly here (a flat
+    # subtraction of the unclamped refine budget can go negative at
+    # reference-UC sweep costs, which would break the watchdog bound the
+    # sizing exists for).
+    t_sweep_f = t_sweep / flops_model.sweep_speedup(st.sweep_precision)
+    if _frozen_refine_iters(st) > 0:
+        t_sweep_f = t_sweep_f + t_sweep
     t_factor = flops_model.factor_flops(n, m, factor_batch,
                                         sparse_factor) / eff
     rst = max(1, st.restarts)
 
-    def _cap(budget_secs, floor):
-        raw = budget_secs / max(t_sweep, 1e-12)
+    def _cap(budget_secs, floor, ts):
+        raw = budget_secs / max(ts, 1e-12)
         return int(max(min(floor, st.max_iter),
                        min(st.max_iter, ce * int(raw / ce))))
 
-    seg_r = _cap(target / rst - t_factor, 32)
-    seg_f = _cap(target, 2 * ce)
+    seg_r = _cap(target / rst - t_factor, 32, t_sweep)
+    seg_f = _cap(target, 2 * ce, t_sweep_f)
     return seg_r, seg_f
 
 
@@ -120,7 +153,10 @@ def fused_iteration_budget(S, n, m, st, refresh_every, factor_batch=1,
     t_factor = flops_model.factor_flops(n, m, factor_batch,
                                         sparse_factor) / eff
     rst = max(1, st.restarts)
-    t_frozen_iter = st.max_iter * t_sweep
+    # frozen iterations sweep at the (possibly lowered) sweep precision,
+    # plus the worst-case in-dispatch f32 refinement phase each carries
+    t_frozen_iter = (st.max_iter * t_sweep / flops_model.sweep_speedup(
+        st.sweep_precision) + _frozen_refine_iters(st) * t_sweep)
     # the adaptive solve factorizes once PER RESTART (admm._solve_scaled's
     # restart scan calls _factor each round), matching dispatch_segments'
     # per-restart budget accounting
@@ -182,7 +218,7 @@ def continue_frozen(run_segment, sol, seg_f, budget, all_done=None,
     path's rescue-tolerance ladder already embraces exactly this).
 
     With the default ``all_done`` (None), the per-segment host decision
-    reads ONE fetched 3-vector (:func:`..admm.stop_stats`: iters + worst
+    reads ONE fetched 4-vector (:func:`..admm.stop_stats`: iters + worst
     residuals) instead of three separate array fetches — per-segment host
     syncs are serial RPCs over the remote tunnel, and the segmented UC
     path pays them every dispatch.  A caller-provided ``all_done`` keeps
@@ -198,10 +234,13 @@ def continue_frozen(run_segment, sol, seg_f, budget, all_done=None,
         def _stats(s):
             """(stop_dispatching, worst_residual) — ONE device fetch for a
             real (pytree) BatchSolution; scripted stand-ins (tests) take
-            the plain attribute path."""
+            the plain attribute path.  The eps vote catches solves whose
+            iteration counter includes a refinement phase (mixed
+            precision) on top of a capped sweep phase."""
             if isinstance(s, _admm.BatchSolution):
                 st = np.asarray(_admm.stop_stats(s))
-                return int(st[0]) < seg_f, max(float(st[1]), float(st[2]))
+                stop = int(st[0]) < seg_f or bool(st[3])
+                return stop, max(float(st[1]), float(st[2]))
             return int(np.asarray(s.iters).max()) < seg_f, _worst(s)
     else:
         def _stats(s):
@@ -262,7 +301,7 @@ def solve_factored_segmented(frozen_fn, factored_fn, args, settings,
         sol, factors = factored_fn(*args, settings=settings, warm=warm)
         return sol, factors, bool(np.asarray(sol.done).all())
     st_r = dataclasses.replace(settings, max_iter=seg_r)
-    st_f = dataclasses.replace(settings, max_iter=seg_f)
+    st_f = seg_settings(settings, seg_f)
     sol, factors = factored_fn(*args, settings=st_r, warm=warm)
     sol = _continue_frozen(frozen_fn, args, factors, sol, st_f, seg_f,
                            refresh_budget(settings, seg_r))
@@ -299,7 +338,7 @@ def solve_frozen_segmented(frozen_fn, args, factors, settings, warm=None):
     if seg_f >= settings.max_iter:
         sol = frozen_fn(*args, factors, settings=settings, warm=warm)
         return sol, bool(np.asarray(sol.done).all())
-    st_f = dataclasses.replace(settings, max_iter=seg_f)
+    st_f = seg_settings(settings, seg_f)
     sol = frozen_fn(*args, factors, settings=st_f, warm=warm)
     if int(np.asarray(sol.iters).max()) >= seg_f:
         sol = _continue_frozen(frozen_fn, args, factors, sol, st_f, seg_f,

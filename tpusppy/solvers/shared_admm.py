@@ -42,7 +42,8 @@ import jax
 import jax.numpy as jnp
 
 from .admm import (ADMMSettings, BatchSolution, BIG, _clean_bounds,
-                   _done_mask, _explicit_inverse, _plateau_update)
+                   _done_mask, _explicit_inverse, _frozen_sweep_phases,
+                   _plateau_update)
 from .sparse import SparseA
 from .structured_kkt import (apply_kinv_like, factor_structured,
                              zero_factors)
@@ -143,7 +144,8 @@ def _factor_shared(q2ref, A, rho_a, rho_x, sigma):
     return _explicit_inverse(K[None])[0], K
 
 
-def _solve_shared_K(Kinv, Kmul, dq2, gamma, b, refine, extra_if_dq2=2):
+def _solve_shared_K(Kinv, Kmul, dq2, gamma, b, refine, extra_if_dq2=2,
+                    prec=None):
     """x s.t. (gamma_s K + diag(dq2_s)) x_s = b_s per scenario, via the shared
     inverse + refinement against the exact per-scenario system; ``Kmul``
     applies the exact K (dense row-vector product, or matrix-free via the
@@ -157,14 +159,17 @@ def _solve_shared_K(Kinv, Kmul, dq2, gamma, b, refine, extra_if_dq2=2):
     iteration matrix has spectral radius max_j dq2_j / (gamma K_jj) — the
     adaptation clamps gamma so this stays < 1 (see the QP clamp in the
     restart loop); ``extra_if_dq2`` adds passes only when a nonzero dq2 is
-    actually present (LP batches skip them at runtime via lax.cond)."""
+    actually present (LP batches skip them at runtime via lax.cond).
+
+    ``prec``: mixed-precision mode for the K^-1 applies; ``Kmul`` (the
+    defect) must then be full-precision — the caller builds it pinned."""
     def steps(x, k):
         for _ in range(k):
             r = b - (gamma * Kmul(x) + dq2 * x)
-            x = x + apply_kinv_like(Kinv, r / gamma)
+            x = x + apply_kinv_like(Kinv, r / gamma, prec)
         return x
 
-    x = steps(apply_kinv_like(Kinv, b / gamma), refine)
+    x = steps(apply_kinv_like(Kinv, b / gamma, prec), refine)
     if extra_if_dq2 > 0:
         x = jax.lax.cond(jnp.any(dq2 != 0),
                          lambda v: steps(v, extra_if_dq2), lambda v: v, x)
@@ -188,7 +193,8 @@ class _IterState(NamedTuple):
 
 
 def _core(q, q2s, q2ref, A, cl, cu, lb, ub, state, Kinv, K, rho_a, rho_x,
-          glo, ghi, st: ADMMSettings, adaptive=False):
+          glo, ghi, st: ADMMSettings, adaptive=False, prec=None,
+          allow_pallas=False):
     """Inner ADMM sweep at a fixed shared rho profile with IN-LOOP
     per-scenario gamma adaptation.
 
@@ -200,19 +206,60 @@ def _core(q, q2s, q2ref, A, cl, cu, lb, ub, state, Kinv, K, rho_a, rho_x,
     are (S, m) @ (m, n) or (S, n) @ (n, n) matmuls against shared matrices.
     ``glo``/``ghi`` bound gamma: wide for LP batches (dq2 = 0, exact at any
     gamma), clamped near 1 for QP (keeps the dq2 refinement contractive).
+
+    ``prec``: None keeps the legacy program; a mode string runs the sweep
+    matvecs at lowered matmul precision with defect/residual bookkeeping
+    pinned at full f32 (solvers/precision.py).  ``allow_pallas``: permit
+    the fused shared-A Pallas sweep kernel (frozen path only; callers on
+    a multi-device auto-partitioned mesh must pass False — a pallas_call
+    cannot be auto-partitioned).
     """
+    sparse = isinstance(A, SparseA)
+    if prec is None or sparse:
+        # sparse: gather/segment-sum matvecs are elementwise VPU work — no
+        # MXU passes to economize; only the (n, n)/block-Woodbury x-update
+        # applies run lowered (via _solve_shared_K's prec)
+        mv_lo, rmv_lo = _mv, _rmv
+        mv_hi, rmv_hi = _mv, _rmv
+    else:
+        from . import precision as _precision
+        mv_lo = lambda M, x: _precision.contract("sn,mn->sm", x, M, prec)
+        rmv_lo = lambda M, y: _precision.contract("sm,mn->sn", y, M, prec)
+        mv_hi = lambda M, x: _precision.contract(
+            "sn,mn->sm", x, M, "highest")
+        rmv_hi = lambda M, y: _precision.contract(
+            "sm,mn->sn", y, M, "highest")
     # exact-K application for refinement: dense when K is carried, else
     # matrix-free through the (scaled) shared A — identical product, two
     # (S,m)/(S,n) matmuls instead of one (S,n)x(n,n), and no (n,n) K in
     # the factors (memory matters when several wheel cylinders coexist
-    # on one chip)
+    # on one chip).  Pinned full-precision under a low sweep mode: the
+    # defect is the refinement's accuracy anchor.
     if K is not None:
-        Kmul = lambda x: x @ K
+        if prec is None:
+            Kmul = lambda x: x @ K
+        else:
+            from . import precision as _precision
+            Kmul = lambda x: _precision.contract("sn,nk->sk", x, K,
+                                                 "highest")
     else:
         diagK = q2ref + rho_x + st.sigma
         Kmul = lambda x: (x * diagK[None, :]
-                          + _rmv(A, _mv(A, x) * rho_a[None, :]))
+                          + rmv_hi(A, mv_hi(A, x) * rho_a[None, :]))
     alpha = st.alpha
+
+    # fused shared-A Pallas sweep kernel (frozen path): the whole
+    # check_every block runs with A/Kinv/K VMEM-resident and genuine MXU
+    # dot_generals at the sweep precision — see pallas_kernels
+    from . import pallas_kernels
+    from .structured_kkt import BlockWoodbury
+    bs_sh = None
+    if (allow_pallas and not adaptive and not sparse and K is not None
+            and not isinstance(Kinv, BlockWoodbury)
+            and st.use_pallas is not False):
+        S_all, n_all = q.shape
+        bs_sh = pallas_kernels.usable_shared(S_all, A.shape[0], n_all)
+    kernel_prec = "highest" if prec is None else prec
 
     def block(x, z, zx, y, yx, Ax, gamma):
         g = gamma[:, None]
@@ -221,11 +268,23 @@ def _core(q, q2s, q2ref, A, cl, cu, lb, ub, state, Kinv, K, rho_a, rho_x,
         rho_x_s = g * rho_x[None, :]     # (S, n)
         dq2 = q2s - g * q2ref[None, :]
 
+        if bs_sh is not None:
+            has = jnp.any(dq2 != 0).astype(x.dtype).reshape(1, 1)
+            return pallas_kernels.fused_sweeps_shared(
+                q, A, Kinv, K, cl, cu, lb, ub,
+                rho_a[None, :], rho_x[None, :], dq2, has, g,
+                x, z, zx, y, yx, Ax,
+                n_sweeps=max(1, st.check_every),
+                n_refine=st.solve_refine, n_extra=2,
+                sigma=float(st.sigma), alpha=float(alpha), bs=bs_sh,
+                precision=kernel_prec)
+
         for _ in range(max(1, st.check_every)):
-            rhs = (sigma_s * x - q + _rmv(A, rho_a_s * z - y)
+            rhs = (sigma_s * x - q + rmv_lo(A, rho_a_s * z - y)
                    + (rho_x_s * zx - yx))
-            xt = _solve_shared_K(Kinv, Kmul, dq2, g, rhs, st.solve_refine)
-            Axt = _mv(A, xt)
+            xt = _solve_shared_K(Kinv, Kmul, dq2, g, rhs, st.solve_refine,
+                                 prec=prec)
+            Axt = mv_lo(A, xt)
             x_new = alpha * xt + (1 - alpha) * x
             Ax_new = alpha * Axt + (1 - alpha) * Ax
 
@@ -244,7 +303,7 @@ def _core(q, q2s, q2ref, A, cl, cu, lb, ub, state, Kinv, K, rho_a, rho_x,
             jnp.max(jnp.abs(Ax - z), axis=1),
             jnp.max(jnp.abs(x - zx), axis=1),
         )
-        Aty = _rmv(A, y)
+        Aty = rmv_hi(A, y)
         Pxv = q2s * x
         dua = jnp.max(jnp.abs(Pxv + q + Aty + yx), axis=1)
         prinorm = jnp.maximum(
@@ -266,7 +325,8 @@ def _core(q, q2s, q2ref, A, cl, cu, lb, ub, state, Kinv, K, rho_a, rho_x,
     def multi_step(carry):
         s, Ax = carry
         x, z, zx, y, yx, Ax = block(s.x, s.z, s.zx, s.y, s.yx, Ax, s.gamma)
-        Ax = _mv(A, x)   # re-anchor carried Ax (see admm._admm_core)
+        Ax = mv_hi(A, x)   # re-anchor carried Ax (see admm._admm_core;
+        # pinned f32 under a low sweep mode — the defect control)
         pri, dua, prinorm, duanorm = residuals(x, z, zx, y, yx, Ax)
         # OSQP-style per-scenario adaptation on normalized residual ratios.
         # Cadence matters: adapting every checkpoint thrashes (early ratios
@@ -494,10 +554,18 @@ def _solve_shared_impl(c, q2, A, cl, cu, lb, ub, settings, warm,
 
 
 def _solve_shared_frozen_impl(c, q2, A, cl, cu, lb, ub,
-                              factors: SharedFactors, warm, settings):
+                              factors: SharedFactors, warm, settings,
+                              allow_pallas=False):
     """Sweep-only shared solve reusing a refresh's :class:`SharedFactors`.
     Valid while (A, bounds structure) are unchanged; per-scenario q2 drift is
-    absorbed by the refinement against K + diag(dq2)."""
+    absorbed by the refinement against K + diag(dq2).
+
+    ``settings.sweep_precision`` routes this solve through the
+    mixed-precision fast path: a lowered-precision sweep phase (f32-pinned
+    residual bookkeeping) followed, when not eps-converged, by a bounded
+    full-precision refinement phase on the same factors.  ``allow_pallas``
+    permits the fused shared-A Pallas kernel (single-controller callers
+    only — a pallas_call cannot be auto-partitioned over a mesh)."""
     dt = settings.jdtype()
     c, q2, A, cl, cu, lb, ub, _ = _prep_shared(
         c, q2, A, cl, cu, lb, ub, settings, want_masks=False)
@@ -524,9 +592,13 @@ def _solve_shared_frozen_impl(c, q2, A, cl, cu, lb, ub,
     lp_like = jnp.max(jnp.abs(q2s)) < 1e-12
     glo = jnp.where(lp_like, 1e-4, 0.6)
     ghi = jnp.where(lp_like, 1e4, 1.8)
-    state = _core(qs, q2s, factors.q2ref, As, cls, cus, lbs, ubs, state0,
-                  factors.Kinv, factors.K, factors.rho_a, factors.rho_x,
-                  glo, ghi, settings)
+    def run_core(st0, st, prec):
+        return _core(qs, q2s, factors.q2ref, As, cls, cus, lbs, ubs, st0,
+                     factors.Kinv, factors.K, factors.rho_a,
+                     factors.rho_x, glo, ghi, st, prec=prec,
+                     allow_pallas=allow_pallas)
+
+    state = _frozen_sweep_phases(run_core, state0, settings, dt)
     x, z, y, yx = (state.x * D[None, :], state.z / E[None, :],
                    state.y * E[None, :] / cost,
                    state.yx / D[None, :] / cost)
@@ -563,7 +635,8 @@ def solve_shared_factored(c, q2, A, cl, cu, lb, ub,
 def solve_shared_frozen(c, q2, A, cl, cu, lb, ub, factors: SharedFactors,
                         settings: ADMMSettings = ADMMSettings(),
                         warm=None) -> BatchSolution:
-    """Jitted frozen-factor shared-A solve."""
+    """Jitted frozen-factor shared-A solve (single-controller host path:
+    the fused shared-A Pallas kernel is permitted)."""
     with jax.default_matmul_precision(settings.matmul_precision):
         return _solve_shared_frozen_impl(c, q2, A, cl, cu, lb, ub, factors,
-                                         warm, settings)
+                                         warm, settings, allow_pallas=True)

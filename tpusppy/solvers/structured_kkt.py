@@ -71,17 +71,24 @@ class BlockWoodbury(NamedTuple):
     Cinv: jax.Array    # (r, r) inverse Woodbury cap
 
 
-def _bapply(binv: tuple, bvars: tuple, b):
+def _bapply(binv: tuple, bvars: tuple, b, prec=None):
     """B^-1 b for b (..., n): gather per bucket, batched block matmul,
     scatter back.  Blocks partition the variables, so scatters never
-    collide (the dummy slot n collides only with itself)."""
+    collide (the dummy slot n collides only with itself).
+
+    ``prec``: optional matmul precision mode for the block matmuls
+    (solvers/precision.py); None keeps the legacy ambient-precision op."""
     n = b.shape[-1]
     b_pad = jnp.concatenate(
         [b, jnp.zeros(b.shape[:-1] + (1,), b.dtype)], axis=-1)
     out = jnp.zeros_like(b_pad)
     for inv_k, bv_k in zip(binv, bvars):
         g = b_pad[..., bv_k]                        # (..., nb, bs)
-        r = jnp.einsum("...kb,kbt->...kt", g, inv_k)
+        if prec is None:
+            r = jnp.einsum("...kb,kbt->...kt", g, inv_k)
+        else:
+            from . import precision
+            r = precision.contract("...kb,kbt->...kt", g, inv_k, prec)
         out = out.at[..., bv_k.reshape(-1)].set(
             r.reshape(r.shape[:-2] + (-1,)))
     return out[..., :n]
@@ -136,16 +143,30 @@ def zero_factors(struct: StructureArrays, n: int, dt) -> BlockWoodbury:
                          Cinv=jnp.zeros((r, r), dt))
 
 
-def kinv_apply(bw: BlockWoodbury, b):
-    """K^-1 b for b (..., n) via the Woodbury identity."""
-    t = _bapply(bw.binv, bw.bvars, b)
-    u = t @ bw.Aw.T
-    v = u @ bw.Cinv
-    return t - _bapply(bw.binv, bw.bvars, v @ bw.Aw)
+def kinv_apply(bw: BlockWoodbury, b, prec=None):
+    """K^-1 b for b (..., n) via the Woodbury identity.
+
+    ``prec`` lowers the matmul precision of the apply (the mixed-precision
+    sweep fast path — the defect correction against the exact system lives
+    in the caller, :func:`tpusppy.solvers.shared_admm._solve_shared_K`)."""
+    t = _bapply(bw.binv, bw.bvars, b, prec)
+    if prec is None:
+        u = t @ bw.Aw.T
+        v = u @ bw.Cinv
+        w = v @ bw.Aw
+    else:
+        from . import precision
+        u = precision.contract("...n,rn->...r", t, bw.Aw, prec)
+        v = precision.contract("...r,rq->...q", u, bw.Cinv, prec)
+        w = precision.contract("...r,rn->...n", v, bw.Aw, prec)
+    return t - _bapply(bw.binv, bw.bvars, w, prec)
 
 
-def apply_kinv_like(Kinv, b):
+def apply_kinv_like(Kinv, b, prec=None):
     """Uniform K^-1 application: dense (n, n) array or BlockWoodbury."""
     if isinstance(Kinv, BlockWoodbury):
-        return kinv_apply(Kinv, b)
-    return b @ Kinv
+        return kinv_apply(Kinv, b, prec)
+    if prec is None:
+        return b @ Kinv
+    from . import precision
+    return precision.contract("...n,nk->...k", b, Kinv, prec)

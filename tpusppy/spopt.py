@@ -12,6 +12,7 @@ psums on the scenario axis.
 from __future__ import annotations
 
 import collections
+import dataclasses
 import itertools
 import threading
 import time
@@ -314,7 +315,8 @@ class SPOpt(SPBase):
         # one device copy of identical A across wheel cylinders
         A_d, cl_d, cu_d = self._device_consts(self.admm_settings.jdtype())
         slot = {"warm": self._warm, "factors": self._factors,
-                "sig": self._factors_sig, "age": self._factors_age}
+                "sig": self._factors_sig, "age": self._factors_age,
+                "ref_worst": getattr(self, "_factors_ref_worst", None)}
         sol = self._solve_amortized(
             (q, q2, A_d, cl_d, cu_d, lb, ub), slot, warm, None,
             shared=shared)
@@ -322,6 +324,7 @@ class SPOpt(SPBase):
         self._factors = slot["factors"]
         self._factors_sig = slot["sig"]
         self._factors_age = slot["age"]
+        self._factors_ref_worst = slot.get("ref_worst")
         self.local_x = np.asarray(sol.x)
         self.pri_res = np.asarray(sol.pri_res)
         self.dua_res = np.asarray(sol.dua_res)
@@ -362,6 +365,17 @@ class SPOpt(SPBase):
             cand, fro_conv = segmented.solve_frozen_segmented(
                 frozen_fn, args, slot["factors"], self.admm_settings,
                 warm=slot["warm"])
+            if admm.precision_guard_trips(cand, self.admm_settings,
+                                          slot.get("ref_worst")):
+                # mixed-precision residual guard: the low-precision frozen
+                # solve parked far above the family's full-precision floor
+                # — fall back to the full-precision frozen program on the
+                # SAME cached factors (no refactorization)
+                st_full = dataclasses.replace(self.admm_settings,
+                                              sweep_precision="highest")
+                cand, fro_conv = segmented.solve_frozen_segmented(
+                    frozen_fn, args, slot["factors"], st_full,
+                    warm=slot["warm"])
             # accept when the sweep budget sufficed (converged to eps) OR
             # every scenario already sits inside the rescue-tolerance
             # ladder: an adaptive re-solve of a plateaued batch (UC prox
@@ -378,12 +392,26 @@ class SPOpt(SPBase):
                 sol = cand
                 slot["age"] = slot.get("age", 0) + 1
         if sol is None:
+            # the REFRESH runs full precision end to end — including its
+            # segmented frozen continuations and polish finale — both by
+            # design (doc/precision.md: refresh solves are never lowered)
+            # and so ref_worst below is a genuine full-precision floor for
+            # the guard to anchor on
+            st_adpt = self.admm_settings
+            if st_adpt.sweep_precision not in (None, "highest"):
+                st_adpt = dataclasses.replace(st_adpt,
+                                              sweep_precision="highest")
             sol, factors, _ = segmented.solve_factored_segmented(
-                frozen_fn, factored_fn, args, self.admm_settings,
+                frozen_fn, factored_fn, args, st_adpt,
                 warm=slot.get("warm") if warm else None, shared=shared)
             slot["factors"] = factors
             slot["sig"] = sig
             slot["age"] = 1
+            # full-precision residual floor of this family at this
+            # operating point — the mixed-precision guard's reference
+            slot["ref_worst"] = float(
+                max(np.asarray(sol.pri_res).max(),
+                    np.asarray(sol.dua_res).max()))
             sol = self._rescue_stragglers(sol, args[0], args[1], args[5],
                                           args[6], batch=rescue_batch)
         slot["warm"] = (sol.x, sol.z, sol.y, sol.yx)

@@ -49,6 +49,10 @@ class TuneResult:
     table: list                # per-candidate measurement dicts
     state: Any                 # PH state advanced by the probe iterations
     out: Any                   # last probe's PHStepOut
+    # picked frozen-sweep matmul precision: the fastest mode whose probe
+    # residuals certified against the full-precision reference ("highest"
+    # when no lower mode certified or none were probed)
+    precision: str = "highest"
 
 
 _cache: dict = {}
@@ -78,19 +82,23 @@ def time_jitted(fn, *args, reps=20):
 
 
 def _tune_key(arr, settings, mesh, axis, prox_on, refresh_candidates,
-              max_chunk, target_secs, margin):
+              max_chunk, target_secs, margin, precision_candidates,
+              certify_factor):
     ndev = 1 if mesh is None else len(mesh.devices.flat)
     return (arr.c.shape, arr.cl.shape, arr.A.ndim if hasattr(arr.A, "ndim")
             else "sparse", settings, ndev, axis, float(prox_on),
-            tuple(refresh_candidates), max_chunk, target_secs, margin)
+            tuple(refresh_candidates), max_chunk, target_secs, margin,
+            tuple(precision_candidates or ()), certify_factor)
 
 
 def autotune_fused(nonant_idx, settings, arr, state, mesh=None,
                    axis: str = "scen", prox_on=1.0,
                    refresh_candidates=(8, 16, 32), max_chunk: int = 256,
                    target_secs: float | None = None, margin: float = 0.5,
-                   budget_s: float = 120.0, cache: bool = True):
-    """Measure-and-pick (chunk, refresh_every) for these shapes.
+                   budget_s: float = 120.0, cache: bool = True,
+                   precision_candidates=None, certify_factor: float = 1.5):
+    """Measure-and-pick (chunk, refresh_every[, sweep precision]) for
+    these shapes.
 
     Returns a :class:`TuneResult` (with the probe-advanced ``state``), or
     ``None`` when no candidate fits even a one-block probe under the
@@ -101,6 +109,19 @@ def autotune_fused(nonant_idx, settings, arr, state, mesh=None,
     chunk keeps a measured dispatch at ``margin * target_secs``.
     ``budget_s`` bounds total tuning wall-clock — candidates that don't
     fit the remaining budget fall back to their probe measurement.
+
+    ``precision_candidates`` (e.g. ``("default", "high")``): after the
+    cadence pick, probe each lowered frozen-sweep precision mode at the
+    picked cadence and CERTIFY it — its probe's final worst residual must
+    stay within ``certify_factor`` x the full-precision reference probe's
+    (floored at eps).  The fastest certified mode wins
+    (:attr:`TuneResult.precision`); state advances only along certified
+    iterates (uncertified probes run donate-free from a kept state and
+    are discarded).  None/empty skips the stage entirely.  Cost note: the
+    stage compiles one fresh donate-free program per probed mode PLUS a
+    full-precision reference (the budget gates model run time, not
+    compiles — the persistent XLA cache amortizes those across runs);
+    shapes with minutes-long compiles should pin the mode instead.
 
     The cache (keyed on shapes + settings + mesh width + the tuning
     parameters, budget included) makes repeat calls free but returns the
@@ -115,7 +136,8 @@ def autotune_fused(nonant_idx, settings, arr, state, mesh=None,
                        if sharded._DISPATCH_TARGET_SECS is not None
                        else segmented_solvers._DISPATCH_TARGET_SECS)
     key = _tune_key(arr, settings, mesh, axis, prox_on, refresh_candidates,
-                    max_chunk, target_secs, margin)
+                    max_chunk, target_secs, margin, precision_candidates,
+                    certify_factor)
     if cache and key in _cache:
         hit = _cache[key]
         return dataclasses.replace(hit, state=state, out=None)
@@ -182,11 +204,93 @@ def autotune_fused(nonant_idx, settings, arr, state, mesh=None,
     if best is None:
         return None
     rate, c, r, sweeps = best
+
+    # ---- precision stage: fastest mode whose residuals certify ----------
+    precision = settings.sweep_precision or "highest"
+    # each probe costs ~2 dispatches (compile + timed) of c iterations at
+    # the measured rate; skip the whole stage — reference probe included —
+    # when the cadence stage already spent the budget.  The skip is
+    # RECORDED: the returned precision is then just the caller's setting,
+    # not a certified pick (bench treats a pin the same way)
+    est_probe = 2.5 * c / max(rate, 1e-9)
+    stage_fits = budget_s - (time.time() - t_start) > 2 * est_probe
+    if precision_candidates and not stage_fits:
+        table.append({"precision_stage": "skipped", "reason": "budget"})
+    if precision_candidates and stage_fits:
+        eps_floor = max(settings.eps_abs, settings.eps_rel)
+
+        def _probe_mode(st_m):
+            """(rate, worst_final_residual, sweeps, state, trace) of one
+            timed dispatch at the picked cadence; donate=False so every
+            probe starts from the same kept ``state``."""
+            fused_m = sharded.make_ph_fused_step(
+                nonant_idx, st_m, mesh, axis, chunk=c, refresh_every=r,
+                collect="trace", donate=False)
+            fused_m(state, arr, prox_on)           # compile
+            t0 = time.time()
+            st_out, tr = fused_m(state, arr, prox_on)
+            pri = _fetch(tr.pri_res)
+            dt = time.time() - t0
+            dua = _fetch(tr.dua_res)
+            worst = float(max(pri[-1].max(), dua[-1].max()))
+            return (c / dt, worst, float(_fetch(tr.iters).mean()),
+                    st_out, tr)
+
+        # the certification reference is ALWAYS full precision, whatever
+        # mode the caller's settings carry (the documented contract —
+        # certifying a lowered mode against another lowered floor would
+        # be vacuous)
+        st_ref = dataclasses.replace(settings, sweep_precision=None)
+        ref_rate, ref_worst, ref_sweeps, ref_state, ref_tr = _probe_mode(
+            st_ref)
+        bar = certify_factor * max(ref_worst, eps_floor)
+        table.append({"precision": "highest", "iters_per_sec":
+                      round(ref_rate, 4), "worst_residual": ref_worst,
+                      "reference": True})
+        # a caller whose settings ALREADY carry a lowered mode gets that
+        # mode certified like any candidate (the cadence stage measured
+        # with it, so it must earn its place or be replaced)
+        caller_mode = settings.sweep_precision or "highest"
+        cands = [m for m in precision_candidates if m != "highest"]
+        if caller_mode != "highest" and caller_mode not in cands:
+            cands.insert(0, caller_mode)
+        # reference pick keeps the cadence stage's donated measurements
+        # (rate/sweeps/state/out stay untouched unless a lowered mode
+        # wins); candidates race the reference under IDENTICAL probe
+        # conditions (donate=False), so the comparison is apples-to-apples
+        precision = "highest"
+        pick = None
+        best_rate = ref_rate
+        for mode in cands:
+            remaining = budget_s - (time.time() - t_start)
+            if est_probe > remaining:
+                table.append({"precision": mode, "skipped": "budget"})
+                continue
+            st_m = dataclasses.replace(settings, sweep_precision=mode)
+            rate_m, worst_m, sweeps_m, st_out, tr_m = _probe_mode(st_m)
+            ok = np.isfinite(worst_m) and worst_m <= bar
+            table.append({"precision": mode,
+                          "iters_per_sec": round(rate_m, 4),
+                          "worst_residual": worst_m, "certified": bool(ok)})
+            if ok and rate_m > best_rate:
+                best_rate = rate_m
+                pick = (rate_m, mode, sweeps_m, st_out, tr_m)
+        if pick is not None:
+            rate, precision, sweeps, state, out = pick
+        elif caller_mode != "highest":
+            # no lowered mode certified, but the cadence stage measured at
+            # the caller's (now-rejected) mode — report the full-precision
+            # probe's figures so the returned rate matches the returned
+            # precision
+            rate, sweeps, state, out = (ref_rate, ref_sweeps, ref_state,
+                                        ref_tr)
+
     last = None if out is None else sharded.PHStepOut(
         *(a[-1] for a in out))
     res = TuneResult(chunk=c, refresh_every=r, iters_per_sec=rate,
                      secs_per_iter=1.0 / rate, sweeps_per_iter=sweeps,
-                     table=table, state=state, out=last)
+                     table=table, state=state, out=last,
+                     precision=precision)
     if cache:
         _cache[key] = dataclasses.replace(res, state=None, out=None)
     return res

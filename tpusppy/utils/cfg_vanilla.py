@@ -52,6 +52,8 @@ def _admm_solver_options(cfg) -> dict:
     if _hasit(cfg, "admm_eps"):
         so.setdefault("eps_abs", cfg.admm_eps)
         so.setdefault("eps_rel", cfg.admm_eps)
+    if _hasit(cfg, "admm_sweep_precision"):
+        so.setdefault("sweep_precision", cfg.admm_sweep_precision)
     return so
 
 

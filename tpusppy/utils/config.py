@@ -459,6 +459,11 @@ class Config:
         add("admm_max_iter", "ADMM inner iterations per restart", int, 1000)
         add("admm_restarts", "ADMM rho-adaptation restarts", int, 4)
         add("admm_eps", "ADMM absolute/relative tolerance", float, None)
+        add("admm_sweep_precision",
+            "frozen-sweep matmul precision: default (bf16), high (bf16x3) "
+            "or highest (full f32; the default — None follows "
+            "matmul_precision).  Lower modes add an f32 refinement phase "
+            "and a residual guard (doc/precision.md)", str, None)
 
 
 def global_config() -> Config:
