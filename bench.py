@@ -505,10 +505,14 @@ def workload():
                                               refresh_every)
             chunk = min(chunk_req, cap) // refresh_every * refresh_every
 
+        from tpusppy.solvers import hostsync
+
         if chunk >= refresh_every:
             # collect="trace" carries per-iteration conv/eobj/sweeps
-            # device-side across the whole window: ONE host fetch at the
-            # end, no per-chunk syncs
+            # device-side across the whole window; the measurement loop
+            # double-buffers each chunk's trace D2H against the next
+            # chunk's compute (sharded.collect_traces) so no fetch ever
+            # idles the device
             fused = sharded.make_ph_fused_step(
                 idx, st, mesh, chunk=chunk,
                 refresh_every=refresh_every, collect="trace")
@@ -518,26 +522,38 @@ def workload():
             log(f"fused chunk={chunk} compile: {time.time() - t0:.1f}s")
             n_chunks = max(1, n_iters // chunk)
             t0 = time.time()
-            for _ in range(n_chunks):
-                state, trace = fused(state, arr, 1.0)
-            conv = float(np.asarray(trace.conv)[-1])  # host fetch = fence
+            with hostsync.track() as sync_tr:
+                state, trace = sharded.collect_traces(
+                    fused, state, arr, 1.0, n_chunks)
+            wall = time.time() - t0
+            conv = float(trace.conv[-1])
             measured = n_chunks * chunk
-            sweeps = float(np.asarray(trace.iters).mean())
+            sweeps = float(trace.iters.mean())
             out = sharded.PHStepOut(*(np.asarray(a)[-1] for a in trace))
         else:  # segmentation-regime shapes: per-step dispatches
             state, out, factors = refresh(state, arr, 1.0)
             state, out = frozen(state, arr, 1.0, factors)
             np.asarray(out.conv)  # compile the frozen program too
             t0 = time.time()
-            for i in range(n_iters):
-                if i % refresh_every == 0:
-                    state, out, factors = refresh(state, arr, 1.0)
-                else:
-                    state, out = frozen(state, arr, 1.0, factors)
-            conv = float(np.asarray(out.conv))
+            with hostsync.track() as sync_tr:
+                for i in range(n_iters):
+                    if i % refresh_every == 0:
+                        state, out, factors = refresh(state, arr, 1.0)
+                    else:
+                        state, out = frozen(state, arr, 1.0, factors)
+                conv = float(hostsync.fetch(out.conv))
+            wall = time.time() - t0
             measured = n_iters
             sweeps = float(np.asarray(out.iters))
-        iters_per_sec = measured / (time.time() - t0)
+        iters_per_sec = measured / wall
+        # host-sync accounting (tpusppy/solvers/hostsync.py): how many
+        # decision-path fetches the window performed, and what share of
+        # the wall was spent host-BLOCKED in them (overlapped fetches —
+        # further device work already queued — excluded).  CPU caveat:
+        # in-process fetches are ~free here; the counts are the portable
+        # signal, the pct becomes meaningful on the remote-tunnel posture.
+        host_sync_count = sync_tr.count
+        dispatch_overhead_pct = round(sync_tr.overhead_pct(wall), 3)
         log(f"tpusppy[m{mult}]: {iters_per_sec:.3f} PH iters/sec "
             f"({measured} iters, conv={conv:.3e}, "
             f"eobj={float(np.asarray(out.eobj)):.2f}, "
@@ -584,6 +600,8 @@ def workload():
             "sweeps_per_iter": round(sweeps, 1) if sweeps else None,
             "mfu_pct": round(mfu, 2) if mfu is not None else None,
             "mfu_note": mfu_note,
+            "host_sync_count": host_sync_count,
+            "dispatch_overhead_pct": dispatch_overhead_pct,
             "vs_baseline": round(iters_per_sec / baseline_iters_per_sec, 2),
             "vs_baseline_32rank": round(iters_per_sec / base32, 2),
         }
@@ -602,6 +620,8 @@ def workload():
         "sweeps_per_iter": m_primary["sweeps_per_iter"],
         "mfu_pct": m_primary["mfu_pct"],
         "mfu_note": m_primary["mfu_note"],
+        "host_sync_count": m_primary["host_sync_count"],
+        "dispatch_overhead_pct": m_primary["dispatch_overhead_pct"],
         "vs_baseline": m_primary["vs_baseline"],
         # honest north-star figure: vs IDEAL 32-way scaling of the serial
         # reference architecture (serial/32 accounting, BASELINE.md) —

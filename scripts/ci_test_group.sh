@@ -8,7 +8,8 @@
 #   $ scripts/ci_test_group.sh tests/test_admm.py
 #   solvers
 case "$(basename "$1")" in
-  test_admm.py|test_shared.py|test_sharded.py|test_segmented.py|\
+  test_admm.py|test_shared.py|test_shared_admm.py|test_sharded.py|\
+  test_segmented.py|test_pipeline.py|\
   test_pallas.py|test_sparse_structured.py|test_fused_step.py|\
   test_tune.py|test_precision*.py|test_milp_bound.py|test_bench_smoke.py)
     echo solvers ;;

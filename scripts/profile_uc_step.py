@@ -53,8 +53,12 @@ orig_continue = segmented.continue_frozen
 seg_log = []
 
 
-def logged_continue(run_segment, sol, seg_f, budget, all_done=None,
-                    plateau_rtol=None):
+def logged_continue(run_segment, sol, seg_f, budget, **kw):
+    # forward everything (all_done/plateau_rtol/pipeline/check_incoming…)
+    # — the timing fence below serializes segments, so force the serial
+    # protocol to keep the per-segment numbers meaningful
+    kw["pipeline"] = False
+
     def timed_segment(warm):
         t0 = time.time()
         out = run_segment(warm)
@@ -62,8 +66,7 @@ def logged_continue(run_segment, sol, seg_f, budget, all_done=None,
         seg_log.append(time.time() - t0)
         return out
 
-    return orig_continue(timed_segment, sol, seg_f, budget,
-                         all_done=all_done, plateau_rtol=plateau_rtol)
+    return orig_continue(timed_segment, sol, seg_f, budget, **kw)
 
 
 segmented.continue_frozen = logged_continue

@@ -1,0 +1,47 @@
+"""Worker for the TIER-1 dist_wheel smoke: one controller process of a
+2-process SPOKELESS hub cylinder (tiny farmer, bounded iterations,
+deterministic schedule).  The full wheel (TCP fabric + live spokes) stays
+in the slow tier; this exercises the cross-process PH collective, the
+replicated consensus fetch and the voted termination decision — the paths
+where both historical deadlock classes lived — in seconds.  Prints one
+JSON line."""
+import json
+import os
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from tpusppy.parallel.distributed import initialize_backend
+
+    coord = os.environ["DIST_COORD"]
+    nproc = int(os.environ["DIST_NPROC"])
+    pid = int(os.environ["DIST_PID"])
+    initialize_backend(coord, nproc, pid)   # enables Gloo CPU collectives
+    jax.config.update("jax_enable_x64", True)
+
+    from tpusppy.models import farmer
+    from tpusppy.parallel.dist_wheel import distributed_wheel_hub
+
+    n = int(os.environ.get("DIST_SCENS", "4"))
+    names = farmer.scenario_names_creator(n)
+    res = distributed_wheel_hub(
+        names, farmer.scenario_creator,
+        scenario_creator_kwargs={"num_scens": n},
+        options={"defaultPHrho": 1.0, "PHIterLimit": 3,
+                 "linger_secs": 0.25,
+                 "solver_options": {"dtype": "float64", "eps_abs": 1e-6,
+                                    "eps_rel": 1e-6, "max_iter": 60,
+                                    "restarts": 1, "scaling_iters": 2,
+                                    "polish": False}},
+        fabric=None, spoke_roles=[])
+    print(json.dumps({
+        "pid": pid, "outer": res.BestOuterBound, "conv": res.conv,
+        "eobj": res.eobj, "iters": res.iters,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -18,8 +18,9 @@ def main():
     coord = os.environ["DIST_COORD"]
     nproc = int(os.environ["DIST_NPROC"])
     pid = int(os.environ["DIST_PID"])
-    jax.distributed.initialize(coordinator_address=coord,
-                               num_processes=nproc, process_id=pid)
+    from tpusppy.parallel.distributed import initialize_backend
+
+    initialize_backend(coord, nproc, pid)   # enables Gloo CPU collectives
     jax.config.update("jax_enable_x64", True)
 
     from tpusppy.models import farmer

@@ -92,6 +92,9 @@ def test_bench_smoke_kill_leaves_parseable_artifact():
     assert parsed["chunk"] >= 1 and parsed["refresh_every"] >= 1
     assert "autotuned" in parsed
     assert parsed["precision"] in ("default", "high", "highest")
+    # host-sync accounting (overlapped dispatch pipeline, doc/pipeline.md)
+    assert parsed["host_sync_count"] >= 1
+    assert 0.0 <= parsed["dispatch_overhead_pct"] <= 100.0
 
 
 def test_bench_ladder_emits_one_entry_per_rung():

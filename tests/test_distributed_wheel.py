@@ -118,6 +118,53 @@ def test_read_voted_gives_up():
 
 
 # ---------------------------------------------------------------------------
+# tier-1 smoke: 2-controller SPOKELESS hub, deterministic schedule
+# ---------------------------------------------------------------------------
+
+def test_two_process_hub_smoke():
+    """Fast (<~20 s) tier-1 coverage of the 2-process hub cylinder: the
+    cross-process PH collective, the replicated consensus fetch and the
+    voted termination decision run a BOUNDED deterministic schedule (tiny
+    farmer, 3 iterations, no spokes, no gap target) and both controllers
+    must report identical fully-reduced results.  This path found two
+    deadlock classes and previously had no routine (non-slow) coverage —
+    the full TCP-fabric wheel stays in the slow tier."""
+    port = _free_port()
+    script = os.path.join(REPO, "tests", "dist_wheel_smoke_worker.py")
+    common = {
+        "DIST_COORD": f"127.0.0.1:{port}",
+        "DIST_NPROC": 2,
+        # >= global device count so every process owns real scenarios
+        "DIST_SCENS": 8,
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+    }
+    procs = [
+        subprocess.Popen([sys.executable, script],
+                         env=_env(common | {"DIST_PID": pid}),
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True)
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"worker rc={p.returncode}\n{err[-3000:]}"
+            outs.append(json.loads(
+                [ln for ln in out.splitlines() if ln.startswith("{")][-1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    r0, r1 = outs
+    assert r0["iters"] == r1["iters"] == 3     # the bounded schedule ran
+    assert r0["conv"] == r1["conv"]            # identical reduced results
+    assert r0["eobj"] == r1["eobj"]
+    assert r0["outer"] == r1["outer"]
+    assert np.isfinite(r0["conv"]) and np.isfinite(r0["eobj"])
+
+
+# ---------------------------------------------------------------------------
 # the full topology: 2-controller hub + 2 spoke processes, certified gap
 # ---------------------------------------------------------------------------
 

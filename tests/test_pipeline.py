@@ -1,0 +1,357 @@
+"""Overlapped dispatch pipeline (doc/pipeline.md): speculative frozen
+continuations, single-fetch stop decisions, and the host-sync discipline.
+
+Covers the acceptance contract of the pipeline PR:
+- pipelined and serial continuations produce IDENTICAL results on the same
+  stop decisions (scripted segments AND real solver runs on the dense,
+  shared-A and sparse/structured engines, forced into segmentation);
+- the speculative waste is bounded (<= overlap segments) and billed at
+  dispatch time (the total dispatch count never exceeds the serial worst
+  case);
+- ``ADMMSettings.pipeline=False`` (the ``admm_pipeline`` config flag)
+  forces the legacy serial protocol;
+- host-sync counting: a pipelined continuation performs at most
+  1 + ceil(segments/overlap) decision fetches and overlaps all but the
+  unavoidable ones;
+- transfer-guard discipline: the pipelined frozen continuation and the
+  fused PH measurement window perform no UNPLANNED (implicit) device→host
+  transfers — every planned fetch is explicit (hostsync.fetch).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpusppy.solvers import admm, hostsync, segmented, shared_admm
+from tpusppy.solvers.admm import ADMMSettings
+
+
+class FakeSol:
+    def __init__(self, pri, dua=0.0, iters=52, raw=None):
+        self.pri_res = np.asarray([pri])
+        self.dua_res = np.asarray([dua])
+        self.iters = np.asarray([iters])
+        self.raw = raw or ("x",)
+
+
+def _run(script, pipeline, seg_f=52, budget=520, plateau=0.05, sol0=None,
+         **kw):
+    calls = []
+
+    def run_segment(warm):
+        calls.append(warm)
+        return script[min(len(calls) - 1, len(script) - 1)]
+
+    sol = segmented.continue_frozen(
+        run_segment, sol0 or FakeSol(1.0), seg_f, budget,
+        plateau_rtol=plateau, pipeline=pipeline, **kw)
+    return sol, len(calls)
+
+
+# ---------------------------------------------------------------------------
+# scripted protocol: parity, discard, billing
+# ---------------------------------------------------------------------------
+
+def test_pipelined_stop_parity_and_discard():
+    """Stop at segment 2: serial dispatches 2 segments; pipelined
+    dispatches 3 (one speculative, discarded) and returns the SAME
+    solution object."""
+    sols = [FakeSol(0.5), FakeSol(1e-9, iters=4), FakeSol(0.7)]
+    s_serial, n_serial = _run(sols, pipeline=False)
+    s_pipe, n_pipe = _run(sols, pipeline=True)
+    assert n_serial == 2 and n_pipe == 3
+    assert s_serial is sols[1] and s_pipe is sols[1]
+
+
+def test_pipelined_budget_billed_at_dispatch():
+    """Budget exhaustion: speculation never dispatches MORE total work
+    than the serial worst case — the budget is charged at dispatch time
+    (the watchdog-billing invariant)."""
+    sols = [FakeSol(1.0 / (k + 2)) for k in range(20)]   # keeps improving
+    s_serial, n_serial = _run(sols, pipeline=False)
+    s_pipe, n_pipe = _run(sols, pipeline=True)
+    assert n_serial == 10 and n_pipe == 10      # 520 / 52, both protocols
+    assert s_serial is s_pipe
+
+
+def test_pipelined_plateau_parity():
+    """The two-strike plateau grace fires on the same segment; pipelined
+    pays exactly one extra (discarded) dispatch."""
+    sols = [FakeSol(0.5), FakeSol(0.51), FakeSol(0.3), FakeSol(0.1),
+            FakeSol(0.1), FakeSol(0.1), FakeSol(0.1)]
+    s_serial, n_serial = _run(sols, pipeline=False, budget=52 * 10)
+    s_pipe, n_pipe = _run(sols, pipeline=True, budget=52 * 10)
+    assert n_serial == 6
+    assert n_pipe == 7
+    assert s_serial is s_pipe
+
+
+def test_pipelined_check_incoming_reads_verdict_first():
+    """check_incoming + already-done incoming: the pipelined protocol
+    reads the (already-complete) incoming verdict BEFORE speculating, so
+    the steady-state converged-first-dispatch case wastes NOTHING — same
+    as serial.  A live continuation then speculates normally."""
+    done0 = FakeSol(1e-9, iters=4)
+    sols = [FakeSol(0.5)]
+    sol, n = _run(sols, pipeline=True, sol0=done0, check_incoming=True)
+    assert sol is done0 and n == 0
+    sol, n = _run(sols, pipeline=False, sol0=done0, check_incoming=True)
+    assert sol is done0 and n == 0
+    # incoming NOT done: speculation engages and the early stop at
+    # segment 1 discards exactly one in-flight segment
+    live = [FakeSol(1e-9, iters=4), FakeSol(0.9)]
+    sol, n = _run(live, pipeline=True, sol0=FakeSol(1.0),
+                  check_incoming=True)
+    assert sol is live[0] and n == 2
+
+
+def test_caller_all_done_never_speculates():
+    """A caller-provided all_done (deterministic multi-controller
+    schedules) must force the serial protocol even when pipeline=True."""
+    sols = [FakeSol(0.5) for _ in range(10)]
+    seen = []
+
+    def run_segment(warm):
+        seen.append(warm)
+        return sols[len(seen) - 1]
+
+    segmented.continue_frozen(
+        run_segment, FakeSol(1.0), 52, 52 * 3,
+        all_done=lambda s: len(seen) >= 2, plateau_rtol=None,
+        pipeline=True)
+    # serial semantics: stop checked after each dispatch, no speculation
+    assert len(seen) == 2
+
+
+def test_pipeline_policy_and_flag():
+    """segmented.pipeline_enabled: the settings flag is the hard off
+    switch; a measured per-shape verdict (tune stage) wins under it."""
+    st = ADMMSettings()
+    assert segmented.pipeline_enabled(st, 7, 8, 9) is True
+    segmented.set_pipeline_policy(7, 8, 9, False)
+    try:
+        assert segmented.pipeline_enabled(st, 7, 8, 9) is False
+        assert segmented.pipeline_enabled(st, 7, 8, 10) is True
+        st_off = dataclasses.replace(st, pipeline=False)
+        assert segmented.pipeline_enabled(st_off, 7, 8, 10) is False
+    finally:
+        segmented._PIPELINE_POLICY.pop((7, 8, 9), None)
+
+
+# ---------------------------------------------------------------------------
+# real solver parity: dense / shared-A / sparse-structured, forced into
+# segmentation by monkeypatching the dispatch throughput constants
+# ---------------------------------------------------------------------------
+
+def _force_segmentation(monkeypatch):
+    # astronomically slow model throughput => every frozen cap lands on
+    # its floor (2 * check_every sweeps) and the solve segments
+    monkeypatch.setattr(segmented, "_DISPATCH_EFF_FLOPS", 1.0)
+    monkeypatch.setattr(segmented, "_DISPATCH_EFF_FLOPS_DENSE", 1.0)
+
+
+def _toy_dense(S=3, n=6, m=4, seed=0):
+    rng = np.random.default_rng(seed)
+    A = rng.normal(size=(S, m, n))
+    x0 = rng.normal(size=(S, n))
+    b = np.einsum("smn,sn->sm", A, x0)
+    c = rng.normal(size=(S, n))
+    q2 = np.zeros((S, n))
+    return (c, q2, A, b - 1.0, b + 1.0,
+            np.full((S, n), -10.0), np.full((S, n), 10.0))
+
+
+def _assert_both_modes_identical(frozen_fn, args, factors, st, warm):
+    sol_p, conv_p = segmented.solve_frozen_segmented(
+        frozen_fn, args, factors, st, warm=warm)
+    st_serial = dataclasses.replace(st, pipeline=False)
+    sol_s, conv_s = segmented.solve_frozen_segmented(
+        frozen_fn, args, factors, st_serial, warm=warm)
+    assert conv_p == conv_s
+    for a, b in zip((sol_p.x, sol_p.pri_res, sol_p.dua_res, sol_p.iters),
+                    (sol_s.x, sol_s.pri_res, sol_s.dua_res, sol_s.iters)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_parity_dense(monkeypatch):
+    _force_segmentation(monkeypatch)
+    args = _toy_dense()
+    st = ADMMSettings(max_iter=64, restarts=2, polish=False)
+    sol, factors = admm.solve_batch_factored(*args, settings=st)
+    seg_r, seg_f = segmented.dispatch_segments(3, 6, 4, st, factor_batch=3)
+    assert seg_f < st.max_iter          # segmentation really forced
+    # fresh W-style objective drift so the continuation has work to do
+    args2 = (args[0] + 0.05 * np.abs(args[0]),) + args[1:]
+    _assert_both_modes_identical(admm.solve_batch_frozen, args2, factors,
+                                 st, sol.raw)
+
+
+def test_parity_shared(monkeypatch):
+    _force_segmentation(monkeypatch)
+    rng = np.random.default_rng(1)
+    S, m, n = 4, 8, 6
+    A = rng.normal(size=(m, n))
+    x0 = rng.normal(size=(S, n))
+    b = x0 @ A.T
+    c = rng.normal(size=(S, n))
+    q2 = np.zeros((S, n))
+    args = (c, q2, A, b - 1.0, b + 1.0,
+            np.full((S, n), -10.0), np.full((S, n), 10.0))
+    st = ADMMSettings(max_iter=64, restarts=2, polish=False)
+    sol, factors = shared_admm.solve_shared_factored(*args, settings=st)
+    args2 = (c + 0.05 * np.abs(c),) + args[1:]
+    _assert_both_modes_identical(shared_admm.solve_shared_frozen, args2,
+                                 factors, st, sol.raw)
+
+
+def test_parity_sparse_structured(monkeypatch):
+    from tpusppy.solvers.sparse import SparseA
+
+    _force_segmentation(monkeypatch)
+    rng = np.random.default_rng(2)
+    n_blk, bs, S = 4, 5, 4
+    n = n_blk * bs
+    rows = []
+    for k in range(n_blk):
+        for _ in range(6):
+            r = np.zeros(n)
+            idx = rng.choice(np.arange(k * bs, (k + 1) * bs), 3,
+                             replace=False)
+            r[idx] = rng.normal(size=3)
+            rows.append(r)
+    for _ in range(3):
+        rows.append(np.where(rng.random(n) < 0.6, rng.normal(size=n), 0.0))
+    A = np.array(rows)
+    sp = SparseA.from_dense(A, jnp.float64, structure=True, min_blocks=2)
+    assert sp.structure is not None
+    b = rng.normal(size=(S, n)) @ A.T
+    c = rng.normal(size=(S, n))
+    q2 = np.zeros((S, n))
+    args = (c, q2, sp, b - 1.0, b + 1.0,
+            np.full((S, n), -10.0), np.full((S, n), 10.0))
+    st = ADMMSettings(max_iter=64, restarts=2, polish=False)
+    sol, factors = shared_admm.solve_shared_factored(*args, settings=st)
+    args2 = (c + 0.05 * np.abs(c),) + args[1:]
+    _assert_both_modes_identical(shared_admm.solve_shared_frozen, args2,
+                                 factors, st, sol.raw)
+
+
+# ---------------------------------------------------------------------------
+# host-sync discipline
+# ---------------------------------------------------------------------------
+
+def test_host_sync_count_bound(monkeypatch):
+    """Acceptance bound: the pipelined continuation performs at most
+    1 + ceil(segments/overlap) decision fetches (plus the caller's final
+    convergence fetch), and all but the unavoidable ones overlap queued
+    device work; the serial protocol blocks >= once per segment."""
+    _force_segmentation(monkeypatch)
+    args = _toy_dense(seed=3)
+    st = ADMMSettings(max_iter=64, restarts=2, polish=False)
+    sol, factors = admm.solve_batch_factored(*args, settings=st)
+    args2 = (args[0] + 0.05 * np.abs(args[0]),) + args[1:]
+
+    n_segs = {"n": 0}
+    real = admm.solve_batch_frozen
+
+    def counting_frozen(*a, **kw):
+        n_segs["n"] += 1
+        return real(*a, **kw)
+
+    with hostsync.track() as tr_p:
+        segmented.solve_frozen_segmented(counting_frozen, args2, factors,
+                                         st, warm=sol.raw)
+    segs_p = n_segs["n"]
+
+    n_segs["n"] = 0
+    st_serial = dataclasses.replace(st, pipeline=False)
+    with hostsync.track() as tr_s:
+        segmented.solve_frozen_segmented(counting_frozen, args2, factors,
+                                         st_serial, warm=sol.raw)
+    segs_s = n_segs["n"]
+
+    assert segs_p >= 2                     # the solve really segmented
+    # +1: the incoming check; +1: the final want_converged done fetch
+    assert tr_p.count <= 1 + segs_p + 1
+    assert tr_s.count >= segs_s            # serial: >= 1 fetch per segment
+    # the pipelined protocol overlaps every decision fetch that has
+    # speculative work queued behind it; serial overlaps none
+    assert tr_p.overlapped >= tr_p.count - 2
+    assert tr_s.overlapped == 0
+
+
+def test_frozen_continuation_transfer_guard(monkeypatch):
+    """The pipelined continuation performs NO implicit device→host
+    transfer: every planned fetch is explicit (hostsync.fetch), pinned by
+    jax's transfer guard."""
+    _force_segmentation(monkeypatch)
+    args = _toy_dense(seed=4)
+    st = ADMMSettings(max_iter=64, restarts=2, polish=False)
+    sol, factors = admm.solve_batch_factored(*args, settings=st)
+    args_dev = tuple(jnp.asarray(a) for a in args)
+    warm_dev = tuple(jnp.asarray(a) for a in sol.raw)
+    with jax.transfer_guard_device_to_host("disallow"):
+        segmented.solve_frozen_segmented(
+            admm.solve_batch_frozen, args_dev, factors, st, warm=warm_dev)
+
+
+def test_fused_window_transfer_guard():
+    """The fused PH measurement window (collect_traces double-buffering)
+    performs no implicit device→host transfer either."""
+    from tpusppy.ir import ScenarioBatch
+    from tpusppy.models import farmer
+    from tpusppy.parallel import sharded
+
+    S = 4
+    names = farmer.scenario_names_creator(S)
+    batch = ScenarioBatch.from_problems(
+        [farmer.scenario_creator(nm, num_scens=S) for nm in names])
+    st = ADMMSettings(max_iter=100, restarts=2, polish=False,
+                      eps_abs=1e-6, eps_rel=1e-6)
+    mesh = sharded.make_mesh(1)
+    arr = sharded.shard_batch(batch, mesh)
+    fused = sharded.make_ph_fused_step(
+        batch.tree.nonant_indices, st, mesh, chunk=4, refresh_every=4,
+        collect="trace", donate=False)
+    state = sharded.init_state(arr, 1.0, st)
+    prox = jnp.asarray(1.0)
+    state, _ = fused(state, arr, prox)        # compile outside the guard
+    with jax.transfer_guard_device_to_host("disallow"):
+        state, trace = sharded.collect_traces(fused, state, arr, prox, 2)
+    assert np.asarray(trace.conv).shape == (8,)
+
+
+def test_autotune_pipeline_records_policy(monkeypatch):
+    """tune.autotune_pipeline measures segment-vs-RPC and records the
+    per-shape verdict the segmented entry points consult; a forced huge
+    pay_factor disables speculation for the shape (the tiny-shape rule)."""
+    from tpusppy import tune
+
+    args = _toy_dense(seed=5)
+    st = ADMMSettings(max_iter=64, restarts=2, polish=False)
+    sol, factors = admm.solve_batch_factored(*args, settings=st)
+    S, n = args[0].shape
+    m = args[2].shape[1]
+
+    def run_segment(warm):
+        return admm.solve_batch_frozen(*args, factors, settings=st,
+                                       warm=warm)
+
+    key = (S, n, m)
+    try:
+        res = tune.autotune_pipeline(run_segment, sol, (S, n, m),
+                                     seg_f=8, pay_factor=1e12, cache=False)
+        assert res.enabled is False
+        assert segmented.pipeline_enabled(st, S, n, m) is False
+        assert res.fetch_secs > 0 and res.seg_secs > 0
+        assert res.waste_flops > 0
+        res2 = tune.autotune_pipeline(run_segment, sol, (S, n, m),
+                                      seg_f=8, pay_factor=0.0, cache=False)
+        assert res2.enabled is True
+        assert segmented.pipeline_enabled(st, S, n, m) is True
+    finally:
+        segmented._PIPELINE_POLICY.pop(key, None)

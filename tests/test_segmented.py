@@ -68,6 +68,40 @@ def test_plateau_disabled_runs_full_budget():
     assert n == 7
 
 
+def test_speculative_waste_bounded_and_billed():
+    """Watchdog-billing invariant of the overlapped pipeline: the budget
+    is charged at DISPATCH time, so a speculating continuation never
+    dispatches more total segments than the serial worst case
+    (budget // seg_f), each its own device program under the unchanged
+    per-dispatch caps — no dispatch can exceed the worker kill budget.
+    On an early stop, the waste is bounded at ``overlap`` segments."""
+    calls = []
+
+    def seg(script):
+        def run_segment(warm):
+            calls.append(warm)
+            return script[min(len(calls) - 1, len(script) - 1)]
+        return run_segment
+
+    # budget exhaustion: exactly the serial count, despite speculation
+    never_done = [FakeSol(1.0 / (k + 2)) for k in range(20)]
+    segmented.continue_frozen(seg(never_done), FakeSol(1.0), 52, 520,
+                              plateau_rtol=0.05, pipeline=True)
+    assert len(calls) == 10            # == serial worst case (520 // 52)
+    # early stop: serial would dispatch 2; waste is exactly overlap (1)
+    calls.clear()
+    early = [FakeSol(0.5), FakeSol(1e-9, iters=4), FakeSol(0.9)]
+    sol = segmented.continue_frozen(seg(early), FakeSol(1.0), 52, 520,
+                                    plateau_rtol=0.05, pipeline=True)
+    assert len(calls) == 3 and sol is early[1]
+    # the per-dispatch caps are UNCHANGED by the pipeline flag: the billed
+    # waste model is overlap * seg_f sweeps of flops
+    from tpusppy.solvers import flops
+
+    assert flops.speculation_flops(10, 8, 6, 52) == \
+        52 * flops.sweep_flops(10, 8, 6)
+
+
 def test_dispatch_segments_no_segmentation_for_small():
     from tpusppy.solvers.admm import ADMMSettings
 

@@ -1,0 +1,97 @@
+"""Shared-A engine divergence guard (solvers/shared_admm.py).
+
+Known pre-existing failure mode (PR 2 notes: "shared engine NaNs on
+random fixtures"): when the per-scenario diagonal deviation dq2 is large
+relative to the shared K — e.g. SharedFactors from an LP refresh
+(q2ref = 0) reused for a big-prox frozen solve, or unstructured random
+families whose free gamma adaptation explodes — the shared-K refinement
+iteration is non-contractive, the iterates race to inf within one
+checkpoint block, and every later residual is NaN.  NaN then poisons
+``stop_stats``, the plateau detector and the host acceptance tests.
+
+The in-loop guard freezes exploding scenarios at their last finite
+iterate and reports INF residuals with ``done=False`` — an honest
+"diverged" the host rescue machinery can act on — and the restart-level
+shared-rho adaptation excludes the non-finite ratios so one exploding
+scenario cannot poison the shared base.
+"""
+
+import numpy as np
+import pytest
+
+from tpusppy.solvers import admm, shared_admm
+from tpusppy.solvers.admm import ADMMSettings
+
+
+def _lp_family(seed=0, S=4, m=8, n=6):
+    rng = np.random.default_rng(seed)
+    A = rng.normal(size=(m, n))
+    c = rng.normal(size=(S, n))
+    q2 = np.zeros((S, n))
+    b = rng.normal(size=(S, m))
+    return (c, q2, A, b - 1.0, b + 1.0,
+            np.full((S, n), -100.0), np.full((S, n), 100.0))
+
+
+def test_frozen_dq2_divergence_is_guarded():
+    """Known-diverging reproduction (seed 0): LP-refresh factors reused
+    with a large prox q2.  Without the guard every iterate and residual
+    ends NaN; with it the iterates stay finite, the residuals report inf,
+    done stays False, and stop_stats carries no NaN."""
+    c, q2, A, cl, cu, lb, ub = _lp_family(seed=0)
+    st = ADMMSettings(max_iter=300, restarts=3, polish=False)
+    sol, fac = shared_admm.solve_shared_factored(
+        c, q2, A, cl, cu, lb, ub, settings=st)
+    q2_big = np.full_like(q2, 50.0)     # sudden big prox: dq2 refinement
+    sol2 = shared_admm.solve_shared_frozen(      # is non-contractive
+        c, q2_big, A, cl, cu, lb, ub, fac, settings=st, warm=sol.raw)
+    pri = np.asarray(sol2.pri_res)
+    dua = np.asarray(sol2.dua_res)
+    # the reproduction actually diverges (inf reported, never NaN)
+    assert np.isinf(pri).any() or np.isinf(dua).any()
+    assert not np.isnan(pri).any() and not np.isnan(dua).any()
+    # frozen iterates: every state leaf stays finite
+    for leaf in (sol2.x, sol2.z, sol2.y, sol2.yx, *sol2.raw):
+        assert np.isfinite(np.asarray(leaf)).all()
+    # diverged scenarios are NOT reported converged
+    assert not np.asarray(sol2.done)[np.isinf(pri) | np.isinf(dua)].any()
+    # stop_stats (the segmented continuation's single-fetch decision
+    # vector) carries inf, never NaN
+    st4 = np.asarray(admm.stop_stats(sol2))
+    assert not np.isnan(st4).any()
+    assert not bool(st4[3])
+
+
+def test_guard_does_not_perturb_healthy_solves():
+    """The guard is a no-op on healthy batches: the same LP family solved
+    adaptively converges to its usual residual floor."""
+    c, q2, A, cl, cu, lb, ub = _lp_family(seed=0)
+    st = ADMMSettings(max_iter=2000, restarts=6, polish=False,
+                      eps_abs=1e-8, eps_rel=1e-8)
+    sol = shared_admm.solve_shared(c, q2, A, cl, cu, lb, ub, settings=st)
+    assert float(np.asarray(sol.pri_res).max()) < 1e-5
+    assert float(np.asarray(sol.dua_res).max()) < 1e-5
+    assert np.isfinite(np.asarray(sol.x)).all()
+
+
+def test_adaptive_base_survives_partial_divergence():
+    """One diverging scenario in an otherwise-healthy ADAPTIVE batch must
+    not poison the shared rho base (the restart gmean excludes non-finite
+    ratios): the healthy scenarios still converge."""
+    c, q2, A, cl, cu, lb, ub = _lp_family(seed=1)
+    # scenario 0 gets an absurd objective scale so its iterates blow past
+    # BIG within the first restarts while the rest stay ordinary
+    c = c.copy()
+    c[0] *= 1e18
+    lb = lb.copy(); ub = ub.copy()
+    lb[0] = -1e18
+    ub[0] = 1e18
+    st = ADMMSettings(max_iter=800, restarts=4, polish=False)
+    sol = shared_admm.solve_shared(c, q2, A, cl, cu, lb, ub, settings=st)
+    pri = np.asarray(sol.pri_res)
+    dua = np.asarray(sol.dua_res)
+    assert not np.isnan(pri).any() and not np.isnan(dua).any()
+    # the healthy tail stays at ordinary ADMM accuracy regardless of
+    # scenario 0 (a poisoned shared base drives EVERY scenario to inf/NaN)
+    assert float(np.maximum(pri, dua)[1:].max()) < 1e-1
+    assert np.isfinite(np.asarray(sol.x)).all()

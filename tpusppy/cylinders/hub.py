@@ -141,6 +141,26 @@ class Hub(SPCommunicator):
     def hub_to_spoke(self, values, idx: int):
         self.fabric.to_spoke[idx].put(values)
 
+    def hub_to_spoke_versioned(self, idx: int, token, build):
+        """Put that SKIPS when the payload source (``token``) has not
+        advanced since the last send to this spoke: redundant Puts bump
+        write-ids and make spokes recompute on data they already acted on
+        (acute in the hub linger loop, which polls sync() twice a
+        second).  ``build`` is a zero-arg payload constructor, called only
+        when a send actually happens.  Transports without versioned puts
+        (the TCP window fabric) fall back to hub-side token tracking."""
+        mb = self.fabric.to_spoke[idx]
+        if hasattr(mb, "put_versioned"):
+            mb.put_versioned(token, build)
+            return
+        sent = getattr(self, "_sent_tokens", None)
+        if sent is None:
+            sent = self._sent_tokens = {}
+        if sent.get(idx) == token:
+            return
+        self.hub_to_spoke(build(), idx)
+        sent[idx] = token
+
     def hub_from_spoke(self, idx: int):
         """Returns (payload, True) when the spoke's write-id is fresh."""
         data, wid = self.fabric.to_hub[idx].get()
@@ -265,9 +285,16 @@ class PHHub(Hub):
         linger = float(self.options.get("linger_secs", 0.0))
         if linger <= 0.0 or not self.spokes:
             return
+        # nudge cadence: the versioned puts skip identical state, so
+        # without an advancing epoch the spokes would idle for the whole
+        # linger window after their first non-improving round; a re-send
+        # every ``linger_nudge_secs`` keeps their warm-started refinement
+        # going at a fraction of the old every-poll Put traffic
+        nudge = float(self.options.get("linger_nudge_secs", 2.0))
         t0 = time.time()
         last_trace = 0.0
         while time.time() - t0 < linger:
+            self._nudge_epoch = int((time.time() - t0) / max(nudge, 0.25))
             self.sync()
             # quiet convergence check (is_converged prints a trace row per
             # call — at poll frequency that floods the screen); trace at
@@ -285,27 +312,62 @@ class PHHub(Hub):
     def finalize(self):
         return self.opt.post_loops()
 
-    def send_ws(self):
-        payload = np.concatenate(
-            [np.asarray(self.opt.W, dtype=np.float64).ravel(),
-             [self.BestOuterBound, self.BestInnerBound]]
-        )
-        for idx in self.w_spoke_indices:
-            self.hub_to_spoke(payload, idx)
+    def _state_token(self, kind):
+        """Freshness token for outbound payloads: the opt's PH state
+        version (bumped by solves / W updates, frozen during linger)
+        plus the bounds that ride every payload, plus the linger NUDGE
+        epoch — during the linger harvest a slow periodic re-send of the
+        (unchanged) final state keeps spokes refining on it (their
+        warm-started solves tighten bounds across re-runs), without the
+        old 2x/sec redundant Puts during the hot loop."""
+        return (kind, getattr(self.opt, "sync_version", None),
+                getattr(self, "_nudge_epoch", 0),
+                self.BestOuterBound, self.BestInnerBound)
 
-    def send_nonants(self):
-        xk = self.opt.nonants_of(self.opt.local_x)
-        payload = np.concatenate(
+    @staticmethod
+    def _build_once(build):
+        """Memoize a payload constructor for one send round: the payload
+        is identical for every spoke of the round, and Mailbox.put copies
+        it into each buffer — assemble it at most once even when several
+        spokes accept the token."""
+        box = []
+
+        def cached():
+            if not box:
+                box.append(build())
+            return box[0]
+
+        return cached
+
+    def send_ws(self):
+        build = self._build_once(lambda: np.concatenate(
+            [np.asarray(self.opt.W, dtype=np.float64).ravel(),
+             [self.BestOuterBound, self.BestInnerBound]]))
+        token = self._state_token("W")
+        for idx in self.w_spoke_indices:
+            self.hub_to_spoke_versioned(idx, token, build)
+
+    def _nonant_payload(self):
+        xk = (self.opt._nonants_cached()
+              if hasattr(self.opt, "_nonants_cached")
+              else self.opt.nonants_of(self.opt.local_x))
+        return np.concatenate(
             [np.asarray(xk, dtype=np.float64).ravel(),
              [self.BestOuterBound, self.BestInnerBound]]
         )
+
+    def send_nonants(self):
+        token = self._state_token("nonants")
+        build = self._build_once(self._nonant_payload)
         for idx in self.nonant_spoke_indices:
-            self.hub_to_spoke(payload, idx)
+            self.hub_to_spoke_versioned(idx, token, build)
 
     def send_boundsout(self):
-        payload = np.array([self.BestOuterBound, self.BestInnerBound])
+        token = self._state_token("bounds")
+        build = self._build_once(
+            lambda: np.array([self.BestOuterBound, self.BestInnerBound]))
         for idx in self.bounds_only_indices:
-            self.hub_to_spoke(payload, idx)
+            self.hub_to_spoke_versioned(idx, token, build)
 
 
 class CrossScenarioHub(PHHub):
@@ -326,16 +388,13 @@ class CrossScenarioHub(PHHub):
         super().sync()
         if not self.cs_spoke_indices:
             return
-        xk = self.opt.nonants_of(self.opt.local_x)
-        payload = np.concatenate(
-            [np.asarray(xk, dtype=np.float64).ravel(),
-             [self.BestOuterBound, self.BestInnerBound]]
-        )
+        token = self._state_token("cs-nonants")
+        build = self._build_once(self._nonant_payload)
         S = self.opt.batch.num_scenarios
         K = self.opt.nonant_length
         ext = getattr(self.opt, "extobject", None)
         for idx in self.cs_spoke_indices:
-            self.hub_to_spoke(payload, idx)
+            self.hub_to_spoke_versioned(idx, token, build)
             data, is_new = self.hub_from_spoke(idx)
             if is_new and ext is not None and hasattr(ext, "add_cuts"):
                 ext.add_cuts(data.reshape(S, K + 1))

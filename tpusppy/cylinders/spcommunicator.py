@@ -38,6 +38,7 @@ class Mailbox:
         self.length = int(length)
         self._buf = np.zeros(self.length + 1)
         self._lock = threading.Lock()
+        self._last_token = None
 
     def put(self, values) -> int:
         """Owner-side Put: write payload, bump write_id (spoke.py:60-82)."""
@@ -56,6 +57,28 @@ class Mailbox:
             self._buf[:-1] = values
             self._buf[-1] = new_id
         return new_id
+
+    def put_versioned(self, token, values) -> int:
+        """Owner-side Put that SKIPS when the writer's state snapshot
+        (``token``, any ==-comparable value) has not advanced since the
+        previous versioned put.
+
+        Re-Putting unchanged state would bump the write-id and force
+        every reader to re-digest a payload it has already acted on — the
+        hub's linger loop polls ``sync()`` twice a second, and each
+        redundant Put used to re-trigger a full spoke solve round on
+        identical (W, bounds).  ``values`` may be a zero-arg callable so
+        payload ASSEMBLY is skipped too.  Returns the write-id (unchanged
+        on skip); the kill sentinel stays terminal exactly as in
+        :meth:`put`.
+        """
+        with self._lock:
+            if self._last_token is not None and token == self._last_token:
+                return int(self._buf[-1])
+        wid = self.put(values() if callable(values) else values)
+        if wid != KILL_ID:
+            self._last_token = token
+        return wid
 
     def get(self) -> tuple[np.ndarray, int]:
         """Reader-side Get: snapshot (payload copy, write_id)."""

@@ -208,6 +208,7 @@ class APH(PHBase):
         else:
             self.theta = self.global_phi * self.nu / self.global_tau
         self.W = self.W + self.theta * self.uk
+        self._bump_state_version()    # APHHub mailbox writes key on this
         if self._iter != 1:
             self.z = self.z + (self.theta / self.APHgamma) * self.ybars
         else:
@@ -258,6 +259,8 @@ class APH(PHBase):
         elif not self.local_x.flags.writeable:
             self.local_x = np.array(self.local_x)
         self.local_x[rows] = np.asarray(sol.x)
+        self._xk_src = None   # in-place row update: drop the nonant cache
+        self._bump_state_version()
         if self._warm is None:
             S = b.num_scenarios
             self._warm = (

@@ -103,6 +103,11 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
     ``TcpWindowFabric(connect=...)``).  ``spoke_roles[i]`` (for strata rank
     i+1) is ``{"bound": "outer"|"inner", "wants": "W"|"nonants"}`` — the
     role vocabulary of the spoke type lattice (cylinders/spoke.py).
+    ``fabric=None`` with empty ``spoke_roles`` runs the spokeless hub
+    cylinder alone — the tier-1 smoke posture exercising the 2-process PH
+    collective + voted-termination path on a deterministic schedule
+    (exactly where the historical deadlock classes lived) without any
+    window-service dependency.
 
     Controller 0 is the single WRITER (payloads are replicated consensus
     state, identical on every controller); ALL controllers read spoke
@@ -274,7 +279,7 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
                         break
                     time.sleep(0.5)
     finally:
-        if writer:
+        if writer and fabric is not None:
             fabric.send_terminate()
 
     # harvest late spoke bounds posted between our last pull and the kill

@@ -40,6 +40,45 @@ class DistPHResult(NamedTuple):
     iters: int
 
 
+def initialize_backend(coordinator_address, num_processes, process_id,
+                       **kwargs):
+    """``jax.distributed.initialize`` with the CPU collectives backend
+    enabled first.
+
+    Current jaxlib defaults ``jax_cpu_collectives_implementation`` to
+    "none", so a multi-controller CPU job initializes fine and then every
+    cross-process computation dies with "Multiprocess computations aren't
+    implemented on the CPU backend" — selecting the Gloo implementation
+    BEFORE backend initialization is required.  TPU/GPU jobs ignore the
+    setting entirely, so every worker can use this wrapper unconditionally
+    (and should: it is the single place the requirement is encoded).
+    """
+    import jax
+
+    # explicit presence check, no exception swallowing: a jaxlib whose
+    # knob EXISTS but rejects "gloo" (renamed value, dropped backend —
+    # exactly the drift the nightly deps-canary watches) must fail HERE,
+    # loudly, not three collectives later with the cryptic "Multiprocess
+    # computations aren't implemented on the CPU backend"
+    if "jax_cpu_collectives_implementation" in jax.config.values:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    else:
+        # renamed/removed knob (upstream drift): keep the loud-failure
+        # contract — a CPU multi-process job without a collectives
+        # backend only fails at its first cross-process computation
+        import warnings
+
+        warnings.warn(
+            "jax.config has no jax_cpu_collectives_implementation knob "
+            "(upstream rename/removal?): CPU multi-process collectives "
+            "may be unavailable — expect 'Multiprocess computations "
+            "aren't implemented on the CPU backend' if so",
+            RuntimeWarning, stacklevel=2)
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id, **kwargs)
+
+
 def scen_to_process(num_scenarios: int, num_processes: int,
                     process_id: int | None = None):
     """Contiguous block scenario->process map (sputils.py:774-812 analogue:

@@ -372,20 +372,24 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
     # decisions: sol.iters' shards are non-addressable (fetch raises), and
     # even local-shard votes could disagree across processes — different
     # dispatch counts would deadlock the collectives.  Run the full budget
-    # deterministically there; single-process meshes early-exit normally.
+    # deterministically there (and NEVER speculate — continue_frozen
+    # disables the pipeline for caller-provided all_done); single-process
+    # meshes early-exit normally through the single-fetch stop-stats path,
+    # which also unlocks the speculative overlapped continuation.
     multiproc = mesh is not None and len(
         {d.process_index for d in mesh.devices.flat}) > 1
 
-    def _all_done_fn(seg_f):
-        # stop-dispatching signal (NOT convergence — see BatchSolution.done):
-        # an early while_loop exit means eps met or plateau-exited; both end
-        # the continuation
-        if multiproc:
-            return lambda sol: False
-        return lambda sol: int(np.asarray(sol.iters).max()) < seg_f
-
     # plateau stop is data-dependent => multi-process meshes must not use it
     plateau = None if multiproc else settings.segment_plateau_rtol
+
+    def _continue_kw(arr):
+        """continue_frozen keywords for this mesh posture."""
+        if multiproc:
+            return {"all_done": lambda sol: False, "plateau_rtol": None}
+        S_dev, n, m, _, _ = _dispatch_model_params(arr, mesh)
+        return {"plateau_rtol": plateau,
+                "pipeline": segmented_solvers.pipeline_enabled(
+                    settings, S_dev, n, m)}
 
     def refresh_step(state: PHState, arr: PHArrays, prox_on):
         seg_r, seg_f = _segments_for(arr)
@@ -398,7 +402,7 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
         sol = segmented_solvers.continue_frozen(
             lambda w: fsolve(q, q2, arr, w, factors), sol, seg_f,
             segmented_solvers.refresh_budget(settings, seg_r),
-            all_done=_all_done_fn(seg_f), plateau_rtol=plateau)
+            **_continue_kw(arr))
         if arr.A.ndim == 3 and settings.polish and settings.polish_passes:
             sol = psolve(q, q2, arr, sol.raw, factors)
         new_state, out = _finish_jit(state, arr, sol, W, rho)
@@ -412,12 +416,21 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
         q, q2, W, rho = _prep_jit(state, arr, prox_on)
         warm = (state.x, state.z, state.y, state.yx)
         sol = fsolve(q, q2, arr, warm, factors)
-        all_done = _all_done_fn(seg_f)
-        if not all_done(sol):
+        if multiproc:
+            # deterministic schedule: the first dispatch cannot be checked
+            # (non-addressable shards), so the continuation always runs
+            # the full budget
             sol = segmented_solvers.continue_frozen(
                 lambda w: fsolve(q, q2, arr, w, factors), sol, seg_f,
-                settings.max_iter - seg_f, all_done=all_done,
-                plateau_rtol=plateau)
+                settings.max_iter - seg_f, all_done=lambda s: False,
+                plateau_rtol=None)
+        else:
+            # check_incoming folds the first-dispatch verdict into the
+            # (possibly pipelined) continuation's single-fetch protocol
+            sol = segmented_solvers.continue_frozen(
+                lambda w: fsolve(q, q2, arr, w, factors), sol, seg_f,
+                settings.max_iter - seg_f, check_incoming=True,
+                **_continue_kw(arr))
         new_state, out = _finish_jit(state, arr, sol, W, rho)
         return new_state, out
 
@@ -550,6 +563,47 @@ def make_ph_fused_step(nonant_idx: np.ndarray, settings: ADMMSettings,
         return state, jax.tree.map(lambda a: a[-1], trace)
 
     return fused
+
+
+def collect_traces(fused, state, arr, prox_on, n_chunks: int):
+    """Drive ``n_chunks`` fused dispatches, DOUBLE-BUFFERING each chunk's
+    trace D2H against the next chunk's device compute.
+
+    The serial pattern (fetch chunk k's trace, then dispatch chunk k+1)
+    leaves the device idle for a full host round-trip per chunk — over a
+    remote tunnel, a serial RPC each.  Here chunk k+1 is dispatched
+    FIRST; chunk k's trace (complete by then — the device executes in
+    dispatch order) starts its host copy asynchronously and the blocking
+    read happens while k+1 runs, so the fetch RPC overlaps compute.  The
+    fetches ride :func:`~tpusppy.solvers.hostsync.fetch` (explicit
+    transfers, counted by open sync trackers).
+
+    Requires a ``fused`` from :func:`make_ph_fused_step` with
+    ``collect="trace"``.  Returns ``(state, trace)`` with the per-chunk
+    traces concatenated on the host along the iteration axis.
+    """
+    from ..solvers import hostsync
+
+    def _start_copy(tr):
+        # start the D2H DMA now; the later blocking read only waits on
+        # the copy, not on a cold fetch issued after the next dispatch
+        jax.tree.map(lambda a: a.copy_to_host_async()
+                     if hasattr(a, "copy_to_host_async") else None, tr)
+        return tr
+
+    # fetch takes the WHOLE trace pytree in one call: one counted sync
+    # per chunk, matching the one round-trip it actually is
+    traces = []
+    prev = None
+    for _ in range(max(1, int(n_chunks))):
+        state, trace = fused(state, arr, prox_on)
+        if prev is not None:
+            traces.append(hostsync.fetch(prev, overlapped=True))
+        prev = _start_copy(trace)
+    traces.append(hostsync.fetch(prev))
+    out = (traces[0] if len(traces) == 1 else jax.tree.map(
+        lambda *xs: np.concatenate([np.asarray(x) for x in xs]), *traces))
+    return state, out
 
 
 def dispatch_window(mesh: Mesh) -> int:

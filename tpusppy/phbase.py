@@ -65,9 +65,35 @@ class PHBase(SPOpt):
         return rho
 
     # ---- reductions ---------------------------------------------------------
+    def _nonants_cached(self) -> np.ndarray:
+        """(S, K) nonants of the CURRENT ``local_x``, gathered once per
+        solve: Compute_Xbar / Update_W / convergence_diff and the hub's
+        nonant payload all read the same snapshot instead of re-gathering
+        4x per iteration (part of the single-fetch wheel-iteration
+        discipline, doc/pipeline.md).  Keyed on the ``local_x`` object
+        identity — every solve path ASSIGNS a fresh array; paths that
+        mutate rows in place (APH's fractional dispatch) drop the cache
+        explicitly."""
+        if getattr(self, "_xk_src", None) is not self.local_x:
+            self._xk = self.nonants_of(self.local_x)
+            self._xk_src = self.local_x
+        return self._xk
+
+    @property
+    def sync_version(self):
+        """Monotone token of the hub-visible PH state (W / nonants /
+        iteration).  The hub's mailbox writes skip when it has not
+        advanced — the linger loop polls sync several times a second, and
+        re-Putting identical payloads would bump write-ids and force every
+        spoke to recompute on data it already acted on."""
+        return (self._iter, getattr(self, "_state_version", 0))
+
+    def _bump_state_version(self):
+        self._state_version = getattr(self, "_state_version", 0) + 1
+
     def Compute_Xbar(self, verbose=False):
         """Per-node weighted averages of nonants (phbase.py:27-107)."""
-        xk = self.nonants_of(self.local_x)                      # (S, K)
+        xk = self._nonants_cached()                              # (S, K)
         p = self.probs[:, None]                                  # (S, 1)
         num = np.einsum("skn,sk->nk", self._onehot, p * xk)      # (N, K)
         sqnum = np.einsum("skn,sk->nk", self._onehot, p * xk * xk)
@@ -83,14 +109,15 @@ class PHBase(SPOpt):
 
     def Update_W(self, verbose=False):
         """Dual update W += rho (x - xbar) (phbase.py:293-318)."""
-        xk = self.nonants_of(self.local_x)
+        xk = self._nonants_cached()
         self.W = self.W + self.rho * (xk - self.xbars)
+        self._bump_state_version()
         if verbose:
             global_toc(f"W[0][:8]={self.W[0][:8]}")
 
     def convergence_diff(self) -> float:
         """Scaled norm of x - xbar (phbase.py:321-343)."""
-        xk = self.nonants_of(self.local_x)
+        xk = self._nonants_cached()
         dev = np.abs(xk - self.xbars).mean(axis=1)
         return float(self.probs @ dev)
 

@@ -122,6 +122,17 @@ class ADMMSettings:
     # a plateau exit is never mistaken for convergence by callers.
     sweep_plateau_rtol: float = 0.0
     sweep_plateau_window: int = 32
+    # Overlapped dispatch pipeline (doc/pipeline.md): segmented frozen
+    # continuations speculatively launch segment k+1 from segment k's
+    # device-resident iterate BEFORE fetching segment k's stop-stats, so
+    # the per-segment host RPC overlaps device compute.  Results are
+    # identical to the serial protocol (speculative segments are
+    # discarded when the verdict says stop; waste is bounded at one
+    # segment and billed against the sweep budget).  False forces the
+    # legacy serial fetch-then-dispatch protocol everywhere (the
+    # ``admm_pipeline`` config flag).  Host-dispatch-only: the traced
+    # programs are unchanged.
+    pipeline: bool = True
 
     def jdtype(self):
         return jnp.dtype(self.dtype)
@@ -1106,7 +1117,7 @@ def stop_stats(sol: BatchSolution):
 
 
 def precision_guard_trips(sol: BatchSolution, settings: ADMMSettings,
-                          ref_worst=None) -> bool:
+                          ref_worst=None, stats=None) -> bool:
     """Host-side residual guard for the mixed-precision frozen path.
 
     True when a low-precision frozen solve must be re-run at full
@@ -1117,23 +1128,71 @@ def precision_guard_trips(sol: BatchSolution, settings: ADMMSettings,
     above eps) therefore never trip the guard on residuals full precision
     could not beat either; a genuinely precision-limited solve (parked
     orders of magnitude above the f32 floor, or non-finite) always does.
+
+    ``stats``: optional precomputed ``(worst_residual, all_done)`` pair —
+    callers that already hold a fetched measurement (the single-fetch
+    amortized path, :func:`measure_unpack`) pass it so the guard costs
+    ZERO additional device round-trips; without it the guard performs one
+    :func:`stop_stats` fetch itself.
     """
     if not settings.sweep_precision or settings.sweep_precision == "highest":
         return False
     if settings.precision_guard <= 0:
         return False
-    # ONE device fetch (stop_stats: iters/residual maxima/all_done) — the
-    # guard sits in the amortized hot path, where separate fetches are
-    # serial RPCs over a remote tunnel
-    st4 = np.asarray(stop_stats(sol))
-    if bool(st4[3]):
+    if stats is not None:
+        worst, all_done = float(stats[0]), bool(stats[1])
+    else:
+        # ONE device fetch (stop_stats: iters/residual maxima/all_done) —
+        # the guard sits in the amortized hot path, where separate fetches
+        # are serial RPCs over a remote tunnel
+        from . import hostsync
+        st4 = hostsync.fetch(stop_stats(sol))
+        worst, all_done = float(max(st4[1], st4[2])), bool(st4[3])
+    if all_done:
         return False
-    worst = float(max(st4[1], st4[2]))
     if not np.isfinite(worst):
         return True
     floor = max(settings.eps_abs, settings.eps_rel)
     bar = settings.precision_guard * max(float(ref_worst or 0.0), floor)
     return worst > bar
+
+
+@jax.jit
+def measure_pack(sol: BatchSolution):
+    """Everything the host wheel iteration reads from one solve, as ONE
+    flat device vector: ``[pri_res (S) | dua_res (S) | iters_max |
+    all_done | x.ravel (S*n)]``.
+
+    The amortized solve loop used to fetch ``x``, ``pri_res`` and
+    ``dua_res`` separately (plus a ``stop_stats`` fetch when the
+    mixed-precision guard is armed) — 3-4 serial RPCs per PH iteration
+    over a remote tunnel.  Assembling the measurement device-side
+    collapses them into a single fetch (:func:`measure_unpack` splits it
+    back on the host); the warm-start state stays device-resident and is
+    never fetched at all.
+    """
+    dt = sol.pri_res.dtype
+    return jnp.concatenate([
+        sol.pri_res.astype(dt),
+        sol.dua_res.astype(dt),
+        sol.iters.max().astype(dt)[None],
+        jnp.all(sol.done).astype(dt)[None],
+        sol.x.astype(dt).reshape(-1),
+    ])
+
+
+def measure_unpack(vec, S, n):
+    """Split a fetched :func:`measure_pack` vector; returns a dict with
+    ``pri`` (S,), ``dua`` (S,), ``iters`` (int), ``all_done`` (bool) and
+    ``x`` (S, n)."""
+    vec = np.asarray(vec)
+    return {
+        "pri": vec[:S],
+        "dua": vec[S:2 * S],
+        "iters": int(vec[2 * S]),
+        "all_done": bool(vec[2 * S + 1]),
+        "x": vec[2 * S + 2:].reshape(S, n),
+    }
 
 
 def _Aty(A, y):
@@ -1223,6 +1282,25 @@ def dual_objective_margin(c, q2, A, cl, cu, lb, ub, y, x_hint,
     per = jnp.where((need_hi | need_lo) & engaged,
                     jnp.abs(g) * (widen - 1.0) * X, 0.0)
     return jnp.sum(per, axis=1)
+
+
+@_highest_precision
+@jax.jit
+def dual_objective_with_margin(c, q2, A, cl, cu, lb, ub, y, x_hint,
+                               margin_scale=100.0):
+    """(2, S): :func:`dual_objective` stacked with
+    :func:`dual_objective_margin` in ONE device program.
+
+    Bound spokes evaluate both every wheel iteration; as two separate
+    jitted calls they cost two serial host RPCs per iteration over a
+    remote tunnel — this packs them into a single dispatch + fetch (the
+    single-fetch wheel-iteration discipline, doc/pipeline.md).
+    """
+    base = dual_objective(c, q2, A, cl, cu, lb, ub, y, x_hint,
+                          margin_scale)
+    marg = dual_objective_margin(c, q2, A, cl, cu, lb, ub, y, x_hint,
+                                 margin_scale)
+    return jnp.stack([base, marg])
 
 
 @_highest_precision

@@ -81,6 +81,23 @@ def factor_flops(n, m, factor_batch=1, sparse_factor=1.0):
         * 2.0 * sparse_factor
 
 
+def speculation_flops(S, n, m, seg_f, overlap=1, sparse_factor=1.0):
+    """Worst-case model flops a PIPELINED frozen continuation may burn on
+    DISCARDED speculative segments per solve (``overlap`` segments of
+    ``seg_f`` sweeps each — see ``segmented.continue_frozen``).
+
+    This is the billing term for the overlapped dispatch pipeline: the
+    continuation charges its sweep budget at dispatch time, so the waste
+    is bounded by exactly this amount and the total dispatched work never
+    exceeds the serial worst case.  The tune stage
+    (``tpusppy.tune.autotune_pipeline``) weighs it against the measured
+    stop-stats RPC latency to decide whether speculation pays for a
+    shape.
+    """
+    return max(0, int(overlap)) * max(0, int(seg_f)) \
+        * sweep_flops(S, n, m, sparse_factor)
+
+
 def ph_iteration_flops(S, n, m, sweeps, refresh_every=16, restarts=1,
                        factor_batch=1, sparse_factor=1.0):
     """Model flops of one PH iteration, refresh cost amortized over the

@@ -20,11 +20,13 @@ length becomes a correctness concern, not a tuning knob.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 
 import numpy as np
 
 from . import flops as flops_model
+from . import hostsync
 
 # Per-dispatch budget: must stay well under the remote worker's ~60 s
 # execution kill, but long enough that the solver's IN-LOOP plateau exit
@@ -96,6 +98,15 @@ def dispatch_segments(S, n, m, st, factor_batch=1,
     frozen segment must exceed one check interval or a converged batch
     (which always burns its first ``check_every`` sweeps) is
     indistinguishable from an unconverged one.
+
+    Pipelined continuations (:func:`continue_frozen` with speculation)
+    need NO extra headroom here: a speculative segment is its own device
+    program under exactly these caps — the worker watchdog is
+    per-EXECUTION, and queued programs each get their own budget — and
+    its sweeps are billed against the continuation budget at dispatch
+    time, so the total dispatched work (the waste included, modeled by
+    :func:`..flops.speculation_flops`) never exceeds the serial worst
+    case of ``refresh_budget``/``max_iter`` sweeps.
     """
     eff = _dense_clamped_eff(eff_flops, factor_batch)
     target = _DISPATCH_TARGET_SECS if target_secs is None else target_secs
@@ -194,8 +205,39 @@ def refresh_budget(settings, seg_r):
     return rst * settings.max_iter - rst * seg_r
 
 
+# ---------------------------------------------------------------------------
+# Pipelined continuation policy.  Per-shape verdicts measured by
+# tpusppy.tune.autotune_pipeline land here: tiny shapes whose segment is
+# cheaper than a stop-stats RPC gain nothing from speculation (the fetch
+# dominates wall time either way) and are disabled.  Unmeasured shapes
+# default to speculating — the waste is bounded at ``overlap`` segments
+# per solve and billed against the sweep budget (see continue_frozen).
+# ---------------------------------------------------------------------------
+_PIPELINE_POLICY: dict = {}
+
+
+def _policy_key(S, n, m):
+    return (int(S), int(n), int(m))
+
+
+def set_pipeline_policy(S, n, m, enabled: bool):
+    """Record a measured per-shape speculation verdict (tune stage)."""
+    _PIPELINE_POLICY[_policy_key(S, n, m)] = bool(enabled)
+
+
+def pipeline_enabled(settings, S, n, m) -> bool:
+    """Whether the segmented continuation for these shapes may speculate:
+    the ``pipeline`` setting (the ``admm_pipeline`` config flag) is the
+    hard off-switch; under it, a measured per-shape verdict wins, and
+    unmeasured shapes speculate."""
+    if not getattr(settings, "pipeline", True):
+        return False
+    return _PIPELINE_POLICY.get(_policy_key(S, n, m), True)
+
+
 def continue_frozen(run_segment, sol, seg_f, budget, all_done=None,
-                    plateau_rtol=None):
+                    plateau_rtol=None, pipeline=False, overlap=1,
+                    check_incoming=False):
     """Generic frozen-continuation loop shared by the host solve path and
     the jitted sharded PH step: re-dispatch ``run_segment(warm)`` until
     converged, plateaued, or the sweep budget is spent.
@@ -222,40 +264,96 @@ def continue_frozen(run_segment, sol, seg_f, budget, all_done=None,
     residuals) instead of three separate array fetches — per-segment host
     syncs are serial RPCs over the remote tunnel, and the segmented UC
     path pays them every dispatch.  A caller-provided ``all_done`` keeps
-    the legacy separate-fetch protocol.
+    the legacy separate-fetch protocol (and NEVER speculates — the same
+    restriction as the deterministic multi-controller schedules).
+
+    ``pipeline=True`` (single-controller, default ``all_done`` only)
+    overlaps the host decision with device compute: segment k+1 is
+    dispatched from segment k's device-resident raw iterate BEFORE
+    segment k's stop-stats are fetched, so the fetch RPC resolves while
+    k+1 runs.  The stop-stats program for each segment is dispatched
+    immediately after the segment itself (ahead of its successor), so
+    its value is ready the moment the segment finishes and the host read
+    never waits on speculative work.  If the verdict says "stop", the
+    in-flight speculative segments are DISCARDED — pure-functional state
+    makes this safe, and the result is identical to the serial protocol
+    on the same stop decisions (the parity tests pin this).  Waste is
+    bounded at ``overlap`` segments per continuation and BILLED: the
+    sweep budget is charged at dispatch time, so the total dispatched
+    work never exceeds the serial worst case (budget exhaustion) and no
+    single dispatch grows — every speculative segment is its own device
+    program under the same ``dispatch_segments`` watchdog cap.
+
+    ``check_incoming=True`` additionally evaluates the INCOMING
+    solution's stats first and returns it untouched when it already says
+    stop (the first-frozen-dispatch test previously inlined in
+    :func:`solve_frozen_segmented`).  The pipelined protocol reads this
+    verdict BEFORE its first speculative dispatch: the stats value is
+    already complete so the fetch costs exactly what serial pays, and
+    the steady-state hot case — a warm frozen solve converged in its
+    first dispatch, every PH iteration — then wastes nothing; later
+    segments' verdicts are the ones worth overlapping.
     """
+    from . import admm as _admm
+
     def _worst(s):
-        return max(float(np.asarray(s.pri_res).max()),
-                   float(np.asarray(s.dua_res).max()))
+        return max(float(hostsync.fetch(s.pri_res).max()),
+                   float(hostsync.fetch(s.dua_res).max()))
 
     if all_done is None:
-        from . import admm as _admm
-
-        def _stats(s):
-            """(stop_dispatching, worst_residual) — ONE device fetch for a
-            real (pytree) BatchSolution; scripted stand-ins (tests) take
-            the plain attribute path.  The eps vote catches solves whose
-            iteration counter includes a refinement phase (mixed
-            precision) on top of a capped sweep phase."""
+        def _stats_launch(s):
+            """Dispatch the (tiny) stop-stats program for a real pytree
+            BatchSolution; scripted stand-ins (tests) carry their stats as
+            plain attributes and need no device program."""
             if isinstance(s, _admm.BatchSolution):
-                st = np.asarray(_admm.stop_stats(s))
+                return _admm.stop_stats(s)
+            return None
+
+        def _stats_read(s, dev, overlapped=False):
+            """(stop_dispatching, worst_residual) — ONE host fetch.  The
+            eps vote catches solves whose iteration counter includes a
+            refinement phase (mixed precision) on top of a capped sweep
+            phase."""
+            if dev is not None:
+                st = hostsync.fetch(dev, overlapped=overlapped)
                 stop = int(st[0]) < seg_f or bool(st[3])
                 return stop, max(float(st[1]), float(st[2]))
-            return int(np.asarray(s.iters).max()) < seg_f, _worst(s)
+            stop = int(hostsync.fetch(
+                s.iters, overlapped=overlapped).max()) < seg_f
+            return stop, _worst(s)
     else:
-        def _stats(s):
+        pipeline = False      # legacy protocol: deterministic schedules
+        # (multi-controller) and custom stop functions must not speculate
+
+        def _stats_launch(s):
+            return None
+
+        def _stats_read(s, dev, overlapped=False):
             return all_done(s), _worst(s) if plateau_rtol else None
 
-    # best is seeded from the INCOMING iterate so an already-parked batch
-    # exits quickly; two consecutive non-improving segments are required so
-    # a transient residual uptick (ADMM is not monotone segment-to-segment)
-    # cannot abort a budget that was still making progress
-    best = _worst(sol) if plateau_rtol else None
+    if pipeline and overlap >= 1:
+        return _continue_frozen_pipelined(
+            run_segment, sol, seg_f, budget, _stats_launch, _stats_read,
+            plateau_rtol, check_incoming, overlap)
+
+    # ---- serial protocol --------------------------------------------------
+    if check_incoming:
+        done, worst = _stats_read(sol, _stats_launch(sol))
+        if done:
+            return sol
+        best = worst if plateau_rtol else None
+    else:
+        # best is seeded from the INCOMING iterate so an already-parked
+        # batch exits quickly
+        best = _worst(sol) if plateau_rtol else None
+    # two consecutive non-improving segments are required so a transient
+    # residual uptick (ADMM is not monotone segment-to-segment) cannot
+    # abort a budget that was still making progress
     stall = 0
     while budget > 0:
         sol = run_segment(sol.raw)
         budget -= seg_f
-        done, worst = _stats(sol)
+        done, worst = _stats_read(sol, _stats_launch(sol))
         if done:
             break
         if plateau_rtol:
@@ -269,22 +367,94 @@ def continue_frozen(run_segment, sol, seg_f, budget, all_done=None,
     return sol
 
 
+def _continue_frozen_pipelined(run_segment, sol, seg_f, budget,
+                               stats_launch, stats_read, plateau_rtol,
+                               check_incoming, overlap):
+    """Speculative variant of the continuation loop (see
+    :func:`continue_frozen`).  Dispatch order per segment is
+    segment → its stop-stats program → successor segment, so each stats
+    vector is computed before any speculative work and the host fetch of
+    segment k's verdict overlaps segment k+1's execution."""
+    pend = collections.deque()    # (candidate, stats_device) to validate
+
+    def _fill(newest):
+        """Dispatch speculative segments from the newest iterate until the
+        pipeline is ``overlap`` deep or the budget is spent.  The budget
+        is charged at DISPATCH time: a discarded segment is still paid
+        for, so the total dispatched work can never exceed the serial
+        worst case."""
+        nonlocal budget
+        while len(pend) < overlap and budget > 0:
+            src = pend[-1][0] if pend else newest
+            cand = run_segment(src.raw)
+            budget -= seg_f
+            pend.append((cand, stats_launch(cand)))
+
+    # the incoming iterate's stats are launched BEFORE any speculative
+    # dispatch (the stats program must not queue behind one)
+    seed_dev = (stats_launch(sol)
+                if (check_incoming or plateau_rtol) else None)
+    if check_incoming:
+        # read the incoming verdict FIRST: its device value is already
+        # complete, so this costs exactly the serial protocol's fetch —
+        # and the steady-state hot case (a warm frozen solve converged in
+        # its first dispatch, every PH iteration) then dispatches NOTHING
+        # instead of burning a discarded segment per solve.  Speculation
+        # starts only once the continuation is confirmed live.
+        done, worst = stats_read(sol, seed_dev)
+        if done:
+            return sol
+        best = worst if plateau_rtol else None
+        _fill(sol)
+    else:
+        _fill(sol)
+        best = (stats_read(sol, seed_dev, overlapped=bool(pend))[1]
+                if plateau_rtol else None)
+    stall = 0
+    cur = sol
+    while pend:
+        cand, sdev = pend.popleft()
+        _fill(cand)
+        cur = cand
+        if not pend:
+            # budget exhausted and nothing speculative in flight: the
+            # verdict cannot change what is returned — skip the fetch
+            break
+        done, worst = stats_read(cand, sdev, overlapped=True)
+        if done:
+            break                 # in-flight speculation discarded
+        if plateau_rtol:
+            if worst > (1.0 - plateau_rtol) * best:
+                stall += 1
+                if stall >= 2:
+                    break
+            else:
+                stall = 0
+            best = min(best, worst)
+    return cur
+
+
 def _continue_frozen(frozen_fn, args, factors, sol, st_f, seg_f, budget,
-                     **kw):
+                     pipeline=False, check_incoming=False, **kw):
     """Host-path adapter for :func:`continue_frozen`."""
     return continue_frozen(
         lambda warm: frozen_fn(*args, factors, settings=st_f, warm=warm,
                                **kw),
         sol, seg_f, budget,
-        plateau_rtol=st_f.segment_plateau_rtol)
+        plateau_rtol=st_f.segment_plateau_rtol, pipeline=pipeline,
+        check_incoming=check_incoming)
 
 
 def solve_factored_segmented(frozen_fn, factored_fn, args, settings,
-                             warm=None, shared=False):
+                             warm=None, shared=False, want_converged=True):
     """Adaptive solve + factors, segmented when the shapes demand it.
 
     Equivalent to ``factored_fn(*args, settings=settings, warm=warm)`` for
-    shapes that fit one dispatch.  Returns (sol, factors, converged).
+    shapes that fit one dispatch.  Returns (sol, factors, converged);
+    ``want_converged=False`` skips the final ``sol.done`` fetch (one host
+    RPC) and returns ``converged=None`` — for callers that read the
+    convergence vote from their own packed measurement fetch
+    (``admm.measure_pack``).
 
     SINGLE-CONTROLLER ONLY: the ``converged`` flag (and the continuation's
     defaults) fetch scenario-sharded device data, which raises on a
@@ -297,14 +467,19 @@ def solve_factored_segmented(frozen_fn, factored_fn, args, settings,
     seg_r, seg_f = dispatch_segments(S, n, m, settings,
                                      factor_batch=1 if shared else S,
                                      sparse_factor=_sparse_factor(args))
+    def _conv(s):
+        return (bool(hostsync.fetch(s.done).all()) if want_converged
+                else None)
+
     if seg_r >= settings.max_iter and seg_f >= settings.max_iter:
         sol, factors = factored_fn(*args, settings=settings, warm=warm)
-        return sol, factors, bool(np.asarray(sol.done).all())
+        return sol, factors, _conv(sol)
     st_r = dataclasses.replace(settings, max_iter=seg_r)
     st_f = seg_settings(settings, seg_f)
     sol, factors = factored_fn(*args, settings=st_r, warm=warm)
     sol = _continue_frozen(frozen_fn, args, factors, sol, st_f, seg_f,
-                           refresh_budget(settings, seg_r))
+                           refresh_budget(settings, seg_r),
+                           pipeline=pipeline_enabled(settings, S, n, m))
     if not shared and settings.polish and settings.polish_passes:
         # dense-path parity with the one-dispatch adaptive solve, which
         # polishes its final iterate; frozen continuations don't
@@ -314,17 +489,20 @@ def solve_factored_segmented(frozen_fn, factored_fn, args, settings,
                         polish=True)
     # convergence from the RETURNED sol (post-polish), so the flag and
     # sol.done can never disagree
-    return sol, factors, bool(np.asarray(sol.done).all())
+    return sol, factors, _conv(sol)
 
 
-def solve_frozen_segmented(frozen_fn, args, factors, settings, warm=None):
+def solve_frozen_segmented(frozen_fn, args, factors, settings, warm=None,
+                           want_converged=True):
     """Frozen solve, segmented when the shapes demand it.
 
     Returns (sol, converged) — callers must use ``converged`` (computed
     from ``BatchSolution.done``, the solver's own eps test) instead of any
     iters-vs-cap compare: iters reflects only the LAST segment's counter,
     and the in-loop plateau exit (``sweep_plateau_rtol``) leaves the sweep
-    loop early without convergence.
+    loop early without convergence.  ``want_converged=False`` skips that
+    final done fetch (converged=None) for callers reading the vote from
+    their own packed measurement fetch.
 
     SINGLE-CONTROLLER ONLY — same contract as
     :func:`solve_factored_segmented`: the convergence fetch and the
@@ -335,12 +513,20 @@ def solve_frozen_segmented(frozen_fn, args, factors, settings, warm=None):
     seg_r, seg_f = dispatch_segments(S, n, m, settings,
                                      factor_batch=1 if shared else S,
                                      sparse_factor=_sparse_factor(args))
+    def _conv(s):
+        return (bool(hostsync.fetch(s.done).all()) if want_converged
+                else None)
+
     if seg_f >= settings.max_iter:
         sol = frozen_fn(*args, factors, settings=settings, warm=warm)
-        return sol, bool(np.asarray(sol.done).all())
+        return sol, _conv(sol)
     st_f = seg_settings(settings, seg_f)
     sol = frozen_fn(*args, factors, settings=st_f, warm=warm)
-    if int(np.asarray(sol.iters).max()) >= seg_f:
-        sol = _continue_frozen(frozen_fn, args, factors, sol, st_f, seg_f,
-                               settings.max_iter - seg_f)
-    return sol, bool(np.asarray(sol.done).all())
+    # check_incoming replaces the separate first-dispatch iters fetch the
+    # serial protocol used to inline here (single-fetch stop_stats; the
+    # pipelined policy overlaps every LATER segment's verdict)
+    sol = _continue_frozen(frozen_fn, args, factors, sol, st_f, seg_f,
+                           settings.max_iter - seg_f,
+                           pipeline=pipeline_enabled(settings, S, n, m),
+                           check_incoming=True)
+    return sol, _conv(sol)

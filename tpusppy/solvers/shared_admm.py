@@ -323,11 +323,41 @@ def _core(q, q2s, q2ref, A, cl, cu, lb, ub, state, Kinv, K, rho_a, rho_x,
         return go
 
     def multi_step(carry):
-        s, Ax = carry
-        x, z, zx, y, yx, Ax = block(s.x, s.z, s.zx, s.y, s.yx, Ax, s.gamma)
+        s, Ax_prev = carry
+        x, z, zx, y, yx, Ax = block(s.x, s.z, s.zx, s.y, s.yx, Ax_prev,
+                                    s.gamma)
         Ax = mv_hi(A, x)   # re-anchor carried Ax (see admm._admm_core;
         # pinned f32 under a low sweep mode — the defect control)
         pri, dua, prinorm, duanorm = residuals(x, z, zx, y, yx, Ax)
+        # Per-scenario divergence guard: unstructured random families (and
+        # frozen solves whose dq2 deviation is large enough to make the
+        # shared-K refinement non-contractive) can EXPLODE — iterates race
+        # to inf within one checkpoint block and every later residual is
+        # NaN, which poisons stop_stats and the plateau detector.  Freeze
+        # exploding scenarios at their last finite iterate (the carried-in
+        # Ax_prev is exactly A @ s.x from the previous re-anchor, so the
+        # revert costs no extra matvec) and report INF residuals: done
+        # stays False, the host sees an honest "diverged" instead of NaN,
+        # and the straggler rescue / rho-restart machinery owns recovery.
+        finite = (jnp.all(jnp.isfinite(x), axis=1)
+                  & jnp.all(jnp.isfinite(z), axis=1)
+                  & jnp.all(jnp.isfinite(zx), axis=1)
+                  & jnp.all(jnp.isfinite(y), axis=1)
+                  & jnp.all(jnp.isfinite(yx), axis=1))
+        # negated <= so NaN residuals land in the guard set too
+        bad = ~finite | ~(pri <= BIG) | ~(dua <= BIG)
+        bv = bad[:, None]
+        x = jnp.where(bv, s.x, x)
+        z = jnp.where(bv, s.z, z)
+        zx = jnp.where(bv, s.zx, zx)
+        y = jnp.where(bv, s.y, y)
+        yx = jnp.where(bv, s.yx, yx)
+        Ax = jnp.where(bv, Ax_prev, Ax)
+        inf_dt = jnp.asarray(jnp.inf, pri.dtype)
+        pri = jnp.where(bad, inf_dt, pri)
+        dua = jnp.where(bad, inf_dt, dua)
+        prinorm = jnp.where(bad, s.prinorm, prinorm)
+        duanorm = jnp.where(bad, s.duanorm, duanorm)
         # OSQP-style per-scenario adaptation on normalized residual ratios.
         # Cadence matters: adapting every checkpoint thrashes (early ratios
         # are always imbalanced and rho oscillates); every ~128 sweeps
@@ -493,9 +523,14 @@ def _solve_shared_impl(c, q2, A, cl, cu, lb, ub, settings, warm,
             jnp.maximum(pri_rel, 1e-12) / jnp.maximum(dua_rel, 1e-12))
         # shared base: adapt on the geometric-mean ratio of UNCONVERGED
         # scenarios (converged ones would anchor the ratio at its stale
-        # value); per-scenario adaptation lives in-loop via gamma
-        logr = jnp.where(done, 0.0, jnp.log(jnp.clip(ratio, 0.1, 10.0)))
-        denom = jnp.maximum(jnp.sum(~done), 1)
+        # value); per-scenario adaptation lives in-loop via gamma.
+        # Diverged scenarios (inf residuals from the in-loop guard) have a
+        # NaN ratio and are EXCLUDED — one exploding scenario must not
+        # poison the shared base for the whole batch.
+        ok = jnp.isfinite(ratio)
+        logr = jnp.where(done | ~ok, 0.0,
+                         jnp.log(jnp.clip(ratio, 0.1, 10.0)))
+        denom = jnp.maximum(jnp.sum(~done & ok), 1)
         gmean = jnp.exp(jnp.sum(logr) / denom)
         base = jnp.where(jnp.all(done), base,
                          jnp.clip(base * gmean, st.rho_min, st.rho_max))

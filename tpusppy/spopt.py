@@ -21,7 +21,7 @@ import numpy as np
 
 from . import global_toc
 from .spbase import SPBase
-from .solvers import admm
+from .solvers import admm, hostsync
 
 _BATCH_TOKENS = itertools.count(1)
 
@@ -172,10 +172,13 @@ def _certified_dual_eval(args):
     margin (admm.dual_objective_margin: extends the certificate's validity
     box on free coordinates from X to 10X; ~0 for tight duals).  Single
     source for every certified dual-bound site (Edualbound_perscen, donor
-    transfer)."""
-    dvals = np.asarray(admm.dual_objective(*args), dtype=float)
-    margin = np.asarray(admm.dual_objective_margin(*args), dtype=float)
-    return dvals, margin
+    transfer).  ONE device program + ONE fetch
+    (admm.dual_objective_with_margin) — bound spokes call this every wheel
+    iteration, and two separate jitted evaluations cost two serial RPCs
+    over a remote tunnel."""
+    packed = hostsync.fetch(admm.dual_objective_with_margin(*args))
+    packed = np.asarray(packed, dtype=float)
+    return packed[0], packed[1]
 
 
 def _pick_dual_sign(q, A, cl, cu, lb, ub, duals, x, obj):
@@ -317,7 +320,7 @@ class SPOpt(SPBase):
         slot = {"warm": self._warm, "factors": self._factors,
                 "sig": self._factors_sig, "age": self._factors_age,
                 "ref_worst": getattr(self, "_factors_ref_worst", None)}
-        sol = self._solve_amortized(
+        sol, meas = self._solve_amortized(
             (q, q2, A_d, cl_d, cu_d, lb, ub), slot, warm, None,
             shared=shared)
         self._warm = slot["warm"]
@@ -325,12 +328,23 @@ class SPOpt(SPBase):
         self._factors_sig = slot["sig"]
         self._factors_age = slot["age"]
         self._factors_ref_worst = slot.get("ref_worst")
-        self.local_x = np.asarray(sol.x)
-        self.pri_res = np.asarray(sol.pri_res)
-        self.dua_res = np.asarray(sol.dua_res)
+        # everything the iteration reads came back in the ONE packed fetch
+        # _solve_amortized already performed (doc/pipeline.md)
+        self.local_x = meas["x"]
+        self.pri_res = meas["pri"]
+        self.dua_res = meas["dua"]
         if ext is not None:
             ext.post_solve()
         return self.local_x
+
+    def _fetch_measure(self, sol):
+        """ONE device fetch of everything the host reads from a solve
+        (admm.measure_pack: residuals + iteration counter + convergence
+        vote + x) — the single-fetch wheel-iteration discipline
+        (doc/pipeline.md).  Returns the measure_unpack dict."""
+        S, n = sol.x.shape
+        return admm.measure_unpack(
+            hostsync.fetch(admm.measure_pack(sol)), S, n)
 
     def _solve_amortized(self, args, slot: dict, warm: bool, rescue_batch,
                          shared: bool = False):
@@ -342,7 +356,20 @@ class SPOpt(SPBase):
         dispatching to the shared-A engine).  Polished states warm-start
         the NEXT objective's solve well (the PH persistent-solver pattern);
         raw iterates matter only when re-solving the SAME problem repeatedly
-        (e.g. the Benders root)."""
+        (e.g. the Benders root).
+
+        Returns ``(sol, meas)``: the device solution (its warm state never
+        leaves the device) and the single-fetch measurement dict
+        (:meth:`_fetch_measure`) every downstream host read — acceptance
+        test, mixed-precision guard, straggler rescue, ``local_x`` — is
+        served from.  Steady-state frozen cost: ONE measurement RPC per
+        PH iteration for shapes that fit a single dispatch (the common
+        wheel families), plus — only when the shape segments — the
+        continuation's own per-segment stop-stats fetches (one for the
+        incoming verdict, the rest overlapped with device compute under
+        the pipelined protocol).  Previously every iteration paid 3-4
+        separate array fetches regardless.
+        """
         if shared:
             from .solvers import shared_admm
             frozen_fn = shared_admm.solve_shared_frozen
@@ -353,7 +380,7 @@ class SPOpt(SPBase):
         refresh_every = int(self.options.get("solver_refresh_every", 16) or 0)
         sig = (self._solve_sig(args[1], args[5], args[6])
                if refresh_every > 1 else None)
-        sol = None
+        sol = meas = None
         from .solvers import segmented
 
         if (refresh_every > 1 and warm and slot.get("warm") is not None
@@ -361,21 +388,28 @@ class SPOpt(SPBase):
                 and slot.get("sig") == sig
                 and slot.get("age", 0) < refresh_every):
             # segmented: oversized sweep loops are split into bounded
-            # dispatches (the remote TPU worker kills ~60s+ executions)
-            cand, fro_conv = segmented.solve_frozen_segmented(
+            # dispatches (the remote TPU worker kills ~60s+ executions);
+            # want_converged=False — the convergence vote rides the packed
+            # measurement below instead of a separate done fetch
+            cand, _ = segmented.solve_frozen_segmented(
                 frozen_fn, args, slot["factors"], self.admm_settings,
-                warm=slot["warm"])
-            if admm.precision_guard_trips(cand, self.admm_settings,
-                                          slot.get("ref_worst")):
+                warm=slot["warm"], want_converged=False)
+            meas_c = self._fetch_measure(cand)
+            worst_c = float(max(np.max(meas_c["pri"]),
+                                np.max(meas_c["dua"])))
+            if admm.precision_guard_trips(
+                    cand, self.admm_settings, slot.get("ref_worst"),
+                    stats=(worst_c, meas_c["all_done"])):
                 # mixed-precision residual guard: the low-precision frozen
                 # solve parked far above the family's full-precision floor
                 # — fall back to the full-precision frozen program on the
                 # SAME cached factors (no refactorization)
                 st_full = dataclasses.replace(self.admm_settings,
                                               sweep_precision="highest")
-                cand, fro_conv = segmented.solve_frozen_segmented(
+                cand, _ = segmented.solve_frozen_segmented(
                     frozen_fn, args, slot["factors"], st_full,
-                    warm=slot["warm"])
+                    warm=slot["warm"], want_converged=False)
+                meas_c = self._fetch_measure(cand)
             # accept when the sweep budget sufficed (converged to eps) OR
             # every scenario already sits inside the rescue-tolerance
             # ladder: an adaptive re-solve of a plateaued batch (UC prox
@@ -385,11 +419,10 @@ class SPOpt(SPBase):
             tol_lp, tol_qp = self._straggler_tols()
             tol_s = np.where(
                 np.any(np.asarray(args[1]) != 0.0, axis=-1), tol_qp, tol_lp)
-            pri_c = np.asarray(cand.pri_res)
-            dua_c = np.asarray(cand.dua_res)
-            if (fro_conv
-                    or bool(np.all((pri_c <= tol_s) & (dua_c <= tol_s)))):
-                sol = cand
+            if (meas_c["all_done"]
+                    or bool(np.all((meas_c["pri"] <= tol_s)
+                                   & (meas_c["dua"] <= tol_s)))):
+                sol, meas = cand, meas_c
                 slot["age"] = slot.get("age", 0) + 1
         if sol is None:
             # the REFRESH runs full precision end to end — including its
@@ -403,19 +436,21 @@ class SPOpt(SPBase):
                                               sweep_precision="highest")
             sol, factors, _ = segmented.solve_factored_segmented(
                 frozen_fn, factored_fn, args, st_adpt,
-                warm=slot.get("warm") if warm else None, shared=shared)
+                warm=slot.get("warm") if warm else None, shared=shared,
+                want_converged=False)
             slot["factors"] = factors
             slot["sig"] = sig
             slot["age"] = 1
+            meas = self._fetch_measure(sol)
             # full-precision residual floor of this family at this
             # operating point — the mixed-precision guard's reference
             slot["ref_worst"] = float(
-                max(np.asarray(sol.pri_res).max(),
-                    np.asarray(sol.dua_res).max()))
-            sol = self._rescue_stragglers(sol, args[0], args[1], args[5],
-                                          args[6], batch=rescue_batch)
+                max(np.max(meas["pri"]), np.max(meas["dua"])))
+            sol, meas = self._rescue_stragglers(
+                sol, args[0], args[1], args[5], args[6],
+                batch=rescue_batch, meas=meas)
         slot["warm"] = (sol.x, sol.z, sol.y, sol.yx)
-        return sol
+        return sol, meas
 
     def _solve_loop_bucketed(self, b, q, q2, lb, ub, warm):
         """Per-bucket batched solves for ragged families (one compact
@@ -435,10 +470,10 @@ class SPOpt(SPBase):
             args = (np.asarray(q)[idx, :n], np.asarray(q2)[idx, :n],
                     sub.A, sub.cl, sub.cu,
                     np.asarray(lb)[idx, :n], np.asarray(ub)[idx, :n])
-            sol = self._solve_amortized(args, slots[k], warm, sub)
-            x_out[idx, :n] = np.asarray(sol.x)
-            pri[idx] = np.asarray(sol.pri_res)
-            dua[idx] = np.asarray(sol.dua_res)
+            _, meas = self._solve_amortized(args, slots[k], warm, sub)
+            x_out[idx, :n] = meas["x"]
+            pri[idx] = meas["pri"]
+            dua[idx] = meas["dua"]
         self._warm = None          # homogeneous-path caches do not apply
         self._factors = None
         self.local_x = x_out
@@ -472,9 +507,9 @@ class SPOpt(SPBase):
             tol_qp = max(1e-2, tol_lp)
         return tol_lp, tol_qp
 
-    def _rescue_stragglers(self, sol, q, q2, lb, ub, batch=None):
+    def _rescue_stragglers(self, sol, q, q2, lb, ub, batch=None, meas=None):
         """Host-exact re-solve of the few scenarios batched ADMM left
-        unconverged.
+        unconverged.  Returns ``(sol, meas)``.
 
         Strongly-coupled LPs (UC ramp/genlim rows) occasionally stall a
         handful of scenarios at ~1e-1 residuals regardless of sweep budget.
@@ -487,19 +522,26 @@ class SPOpt(SPBase):
         hybrid mirrors the reference's posture: an exact solver where
         exactness matters (spopt.py:85-223), tensor batching everywhere
         else.
+
+        ``meas`` (the caller's packed measurement) serves pri/dua/x; the
+        ADMM aux state (z, y, yx, done) is fetched only when stragglers
+        actually exist — the common all-converged refresh costs ZERO
+        device round-trips here.
         """
+        if meas is None:
+            meas = self._fetch_measure(sol)
         if not self.options.get("straggler_rescue", True):
-            return sol
+            return sol, meas
         tol_lp, tol_qp = self._straggler_tols()
-        pri = np.asarray(sol.pri_res)
-        dua = np.asarray(sol.dua_res)
+        pri = meas["pri"]
+        dua = meas["dua"]
         q2_np = np.asarray(q2)
         is_qp = np.any(q2_np != 0.0, axis=-1)
         tol_s = np.where(is_qp, tol_qp, tol_lp)
         # negated <= so NaN residuals (diverged solves) are selected too
         bad = np.flatnonzero(~(pri <= tol_s) | ~(dua <= tol_s))
         if bad.size == 0:
-            return sol
+            return sol, meas
         from .solvers import scipy_backend
 
         b = self.batch if batch is None else batch
@@ -507,11 +549,13 @@ class SPOpt(SPBase):
         q2 = np.asarray(q2, dtype=float)
         lb = np.asarray(lb, dtype=float)
         ub = np.asarray(ub, dtype=float)
-        x, z, y, yx = (np.array(np.asarray(a), copy=True)
-                       for a in (sol.x, sol.z, sol.y, sol.yx))
+        x = np.array(meas["x"], copy=True)
+        # straggler path only: the aux state the rescue rewrites
+        z, y, yx = (np.array(hostsync.fetch(a), copy=True)
+                    for a in (sol.z, sol.y, sol.yx))
         pri = pri.copy()
         dua = dua.copy()
-        done = np.array(np.asarray(sol.done), copy=True)
+        done = np.array(hostsync.fetch(sol.done), copy=True)
         n_resc = 0
         qp_bad = bad[is_qp[bad]]
         if qp_bad.size:
@@ -594,8 +638,9 @@ class SPOpt(SPBase):
             global_toc(
                 f"straggler rescue: {n_resc}/{b.num_scenarios} scenarios "
                 "re-solved host-exact", self.options.get("verbose", False))
-        return sol._replace(x=x, z=z, y=y, yx=yx, pri_res=pri, dua_res=dua,
-                            done=done, raw=(x, z, y, yx))
+        meas = dict(meas, x=x, pri=pri, dua=dua, all_done=bool(done.all()))
+        return (sol._replace(x=x, z=z, y=y, yx=yx, pri_res=pri, dua_res=dua,
+                             done=done, raw=(x, z, y, yx)), meas)
 
     # ---- expectations (Allreduce analogues) ---------------------------------
     def Eobjective(self, x=None) -> float:
@@ -804,8 +849,7 @@ class SPOpt(SPBase):
                     jnp.asarray(lb[idx_arr, :n], dt),
                     jnp.asarray(ub[idx_arr, :n], dt),
                     jnp.asarray(y, dt), jnp.asarray(x, dt))
-            dv = np.asarray(admm.dual_objective(*args), dtype=float)
-            mg = np.asarray(admm.dual_objective_margin(*args), dtype=float)
+            dv, mg = _certified_dual_eval(args)
             vals[idx_arr] = dv
             margin_out[idx_arr] = mg
         self.last_bound_margin = margin_out

@@ -294,3 +294,112 @@ def autotune_fused(nonant_idx, settings, arr, state, mesh=None,
     if cache:
         _cache[key] = dataclasses.replace(res, state=None, out=None)
     return res
+
+
+@dataclasses.dataclass
+class PipelineTune:
+    enabled: bool              # speculation pays for this shape
+    seg_secs: float            # measured wall of one frozen re-dispatch
+    fetch_secs: float          # measured stop-stats RPC round-trip
+    waste_flops: float         # model flops of one discarded segment
+    sol: Any                   # the probe segment's solution (real work —
+    # callers may keep it as their next warm state)
+
+
+_pipe_cache: dict = {}
+
+
+def autotune_pipeline(run_segment, sol, shape, seg_f, pay_factor=1.0,
+                      reps=3, cache=True, sparse_factor=1.0):
+    """Measure whether the speculative frozen continuation pays for a
+    shape, and record the verdict in the segmented dispatch policy.
+
+    The pipelined continuation (``segmented.continue_frozen``) hides one
+    stop-stats fetch RPC behind each segment's device compute, at a
+    worst-case cost of one discarded segment per solve.  Two measurements
+    decide whether that trade wins:
+
+    - ``fetch_secs``: the stop-stats round-trip on an ALREADY-computed
+      solution — pure host<->device latency, the thing speculation hides;
+    - ``seg_secs``: one frozen re-dispatch (``run_segment(sol.raw)``)
+      end to end — the speculative unit of work, and the worst-case waste.
+
+    Speculation pays when a segment costs at least ``pay_factor`` x the
+    RPC: the latency hidden per segment then rivals or exceeds the
+    bounded waste.  Tiny shapes whose segment is CHEAPER than the RPC
+    (farmer-sized batches on a remote tunnel) gain nothing — the fetch
+    dominates wall time with or without overlap — and are disabled via
+    :func:`tpusppy.solvers.segmented.set_pipeline_policy`, which
+    ``solve_frozen_segmented`` / ``solve_factored_segmented`` and the
+    sharded step pair consult per shape.
+
+    ``shape`` is (S, n, m) in the DISPATCH-model convention of
+    :func:`segmented.dispatch_segments`: the PER-DEVICE scenario count on
+    a mesh (what one segment actually sweeps — and the key the sharded
+    step pair queries), the global S on the single-device host path.
+    The probe segments (a compile-absorbing warmup plus the timed
+    dispatch) are REAL work — the returned ``sol`` advanced by two
+    segments; keep it as the next warm state.  Cached per (shape, seg_f,
+    pay_factor); repeat calls are free, re-record the verdict, and do
+    not re-advance the solution.  This is an opt-in measurement utility for drivers and
+    benches on the remote-tunnel posture — nothing calls it implicitly;
+    unmeasured shapes default to speculating (waste bounded + billed).
+    """
+    from .solvers import admm, hostsync
+    from .solvers import flops as flops_model
+    from .solvers import segmented
+
+    S, n, m = (int(v) for v in shape)
+    key = (S, n, m, int(seg_f), float(pay_factor))
+    if cache and key in _pipe_cache:
+        hit = _pipe_cache[key]
+        # re-apply the verdict: the policy dict in `segmented` is a
+        # separate store and may have been cleared/reset since it was
+        # recorded — a cached verdict that is not re-recorded would
+        # silently fall back to the default
+        segmented.set_pipeline_policy(S, n, m, hit.enabled)
+        return dataclasses.replace(hit, sol=sol)
+
+    # fetch latency: dispatch + host read of a FRESH stop-stats program
+    # per rep — re-fetching one array would time jax's cached host value
+    # (ArrayImpl memoizes its numpy value after the first transfer), not
+    # the RPC.  The stats compute is a handful of reductions, negligible
+    # against the round-trip this exists to measure; the first (warmup)
+    # call absorbs the compile.
+    hostsync.fetch(admm.stop_stats(sol))
+    t0 = time.time()
+    for _ in range(max(1, reps)):
+        hostsync.fetch(admm.stop_stats(sol))
+    fetch_secs = (time.time() - t0) / max(1, reps)
+
+    # frozen re-dispatch cost: a compile-absorbing WARMUP segment first
+    # (the frozen program is a different executable from whatever
+    # produced ``sol``, and 0.1-10 s of one-time XLA compile inside the
+    # timed window would bias every verdict toward "enabled" — the same
+    # reason autotune_fused warms its probes), then one timed dispatch,
+    # fetch-fenced end to end (includes its own stats fetch — exactly
+    # what a serial continuation step costs).  Both segments are real
+    # work: the returned sol advanced by two.
+    probe = run_segment(sol.raw)
+    hostsync.fetch(admm.stop_stats(probe))
+    t0 = time.time()
+    probe = run_segment(probe.raw)
+    hostsync.fetch(admm.stop_stats(probe))
+    seg_secs = time.time() - t0
+
+    # the verdict weighs the segment's COMPUTE cost (what a discarded
+    # speculative segment wastes) against the RPC it hides: seg_secs
+    # includes its own fence fetch, so comparing it raw would be >=
+    # fetch_secs by construction and the tiny-shape disable could never
+    # fire at the default pay_factor
+    compute_secs = max(0.0, seg_secs - fetch_secs)
+    enabled = compute_secs >= pay_factor * fetch_secs
+    segmented.set_pipeline_policy(S, n, m, enabled)
+    res = PipelineTune(
+        enabled=enabled, seg_secs=seg_secs, fetch_secs=fetch_secs,
+        waste_flops=flops_model.speculation_flops(
+            S, n, m, seg_f, sparse_factor=sparse_factor),
+        sol=probe)
+    if cache:
+        _pipe_cache[key] = dataclasses.replace(res, sol=None)
+    return res

@@ -54,6 +54,8 @@ def _admm_solver_options(cfg) -> dict:
         so.setdefault("eps_rel", cfg.admm_eps)
     if _hasit(cfg, "admm_sweep_precision"):
         so.setdefault("sweep_precision", cfg.admm_sweep_precision)
+    if _hasit(cfg, "admm_pipeline"):
+        so.setdefault("pipeline", bool(cfg.admm_pipeline))
     return so
 
 

@@ -464,6 +464,12 @@ class Config:
             "or highest (full f32; the default — None follows "
             "matmul_precision).  Lower modes add an f32 refinement phase "
             "and a residual guard (doc/precision.md)", str, None)
+        add("admm_pipeline",
+            "overlapped dispatch pipeline for segmented continuations "
+            "(doc/pipeline.md): speculative segments overlap the per-"
+            "segment stop-stats RPC with device compute; identical "
+            "results, bounded+billed waste.  False forces the legacy "
+            "serial fetch-then-dispatch protocol", bool, True)
 
 
 def global_config() -> Config:
