@@ -58,6 +58,14 @@ execution completes, so all timing fences are host fetches (``np.asarray``).
 Set BENCH_UC=1 for the UC metric alone (see bench_uc.py).
 BENCH_SMOKE=1 shrinks everything (tiny S, pinned cadence, no UC) for the
 CI kill-safety test.
+
+``--trace`` (or BENCH_TRACE=1) arms the flight recorder (tpusppy.obs):
+every finished segment dumps ``BENCH_TRACE_DIR/bench_<tag>.perfetto.json``
+(open at ui.perfetto.dev) plus a ``.report.json`` summary, the parsed
+lines carry {path, report} per segment, and a small certified farmer
+WHEEL segment is added whose trace shows the hub/spoke/dispatch/host-sync
+tracks and whose report's gap-vs-wall array ends at the certified gap.
+See doc/observability.md.
 """
 
 import dataclasses
@@ -220,9 +228,13 @@ def main():
 
     # --ladder: the certified-gap wheel over a scenario ladder (one parsed
     # entry per rung) instead of the farmer/UC flagship line; the child
-    # reuses the same kill-safe partial-line protocol
+    # reuses the same kill-safe partial-line protocol.  --trace: the
+    # flight recorder rides the run (tpusppy.obs) — one Perfetto JSON +
+    # report per segment (BENCH_TRACE_DIR), plus a small traced farmer
+    # WHEEL segment whose gap-vs-wall array the report carries
     child_args = ["--workload"] + (
-        ["--ladder"] if "--ladder" in sys.argv[1:] else [])
+        ["--ladder"] if "--ladder" in sys.argv[1:] else []) + (
+        ["--trace"] if "--trace" in sys.argv[1:] else [])
 
     tpu_error = None
     if not force_cpu:
@@ -299,6 +311,137 @@ def emit_partial(line):
     print(json.dumps(out), flush=True)
 
 
+def _tracing_on():
+    """Flight recorder armed for this child?  --trace / BENCH_TRACE are
+    the bench knobs; a recorder already enabled some other way (the
+    TPUSPPY_TRACE env knob enables at import) counts too, so the bench
+    behaves identically — per-segment windows, wheel showcase — no
+    matter which documented switch armed it."""
+    if "--trace" in sys.argv[1:] or os.environ.get("BENCH_TRACE"):
+        return True
+    try:
+        from tpusppy.obs import trace
+
+        return trace.enabled()
+    except ImportError:      # parent process posture: no tpusppy import
+        return False
+
+
+# metrics window spanning the CURRENT trace segment (armed when tracing
+# turns on, re-armed after each dump) so each segment's report carries
+# its own counter deltas, not the process-cumulative totals
+_SEG_WIN = None
+
+
+def _arm_segment_window():
+    global _SEG_WIN
+    from tpusppy.obs import metrics
+
+    _SEG_WIN = metrics.window().__enter__()
+
+
+def trace_segment_dump(tag):
+    """Bank the trace ring accumulated during one finished segment as
+    ``BENCH_TRACE_DIR/bench_<tag>.perfetto.json`` (+ ``.report.json``)
+    and return {path, report} for the segment's parsed-JSON entry; the
+    ring is then cleared (and the counter window re-armed) so the next
+    segment's artifact stands alone.  No-op (None) when tracing is off —
+    and NEVER raises: a dump I/O failure (unwritable dir, full disk)
+    must not cost the measurement it describes (the kill-safe bench
+    contract)."""
+    from tpusppy.obs import metrics, perfetto, report, trace
+
+    if not trace.enabled():
+        return None
+    try:
+        out_dir = os.environ.get("BENCH_TRACE_DIR", ".")
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, f"bench_{tag}.perfetto.json")
+        evs = trace.events()
+        dropped = trace.dropped()
+        win = _SEG_WIN if _SEG_WIN is not None else metrics.Window()
+        rep = report.build_report(evs, counters=win.deltas(),
+                                  dropped=dropped)
+        perfetto.export(evs, path=path)
+        with open(path + ".report.json", "w") as f:
+            json.dump(rep, f, indent=1)
+        log(f"trace[{tag}]: {len(evs)} events -> {path}")
+        return {"path": path, "report": rep}
+    except Exception as e:
+        log(f"trace dump failed for segment {tag} (measurement kept): "
+            f"{e!r}")
+        return None
+    finally:
+        trace.reset()
+        _arm_segment_window()
+
+
+def traced_farmer_wheel():
+    """A small certified farmer WHEEL under the flight recorder: PH hub +
+    Lagrangian outer + XhatShuffle inner (the minimum full wheel), traced
+    end to end so the artifact shows hub iterations, spoke bound passes,
+    dispatches, mailbox traffic and host syncs on one timeline — and the
+    report's gap-vs-wall array ends at the final certified gap.  Runs
+    only under ``--trace`` (it is the recorder's showcase segment, not a
+    rate measurement)."""
+    from tpusppy.cylinders import (LagrangianOuterBound, PHHub,
+                                   XhatShuffleInnerBound)
+    from tpusppy.models import farmer
+    from tpusppy.opt.ph import PH
+    from tpusppy.phbase import PHBase
+    from tpusppy.spin_the_wheel import WheelSpinner
+    from tpusppy.xhat_eval import Xhat_Eval
+
+    S = int(os.environ.get("BENCH_TRACE_WHEEL_SCENS", "3"))
+    iters = int(os.environ.get("BENCH_TRACE_WHEEL_ITERS", "40"))
+
+    def opt_kwargs():
+        return {
+            "options": {
+                "defaultPHrho": 1.0, "PHIterLimit": iters,
+                "convthresh": -1.0,
+                "xhat_looper_options": {"scen_limit": 3},
+            },
+            "all_scenario_names": farmer.scenario_names_creator(S),
+            "scenario_creator": farmer.scenario_creator,
+            "scenario_creator_kwargs": {"num_scens": S},
+        }
+
+    hub_dict = {
+        "hub_class": PHHub,
+        "hub_kwargs": {"options": {"rel_gap": 1e-3, "abs_gap": 1.0,
+                                   "linger_secs": 60.0}},
+        "opt_class": PH, "opt_kwargs": opt_kwargs(),
+    }
+    spokes = [
+        {"spoke_class": LagrangianOuterBound, "spoke_kwargs": {},
+         "opt_class": PHBase, "opt_kwargs": opt_kwargs()},
+        {"spoke_class": XhatShuffleInnerBound, "spoke_kwargs": {},
+         "opt_class": Xhat_Eval, "opt_kwargs": opt_kwargs()},
+    ]
+    t0 = time.time()
+    ws = WheelSpinner(hub_dict, spokes).spin()
+    # one more gap computation AFTER the wheel finishes: it emits the
+    # final rel_gap sample, so the report's gap-vs-wall array ends at
+    # exactly the gap this entry reports
+    abs_gap, rel_gap = ws.spcomm.compute_gaps()
+    entry = {
+        "S": S,
+        "wall_secs": round(time.time() - t0, 2),
+        "inner": float(ws.BestInnerBound),
+        "outer": float(ws.BestOuterBound),
+        "abs_gap": float(abs_gap),
+        "rel_gap": float(rel_gap),
+    }
+    dump = trace_segment_dump(f"wheel_farmer{S}")
+    if dump is not None:
+        entry["trace"] = dump
+        gvw = dump["report"]["gap_vs_wall"]
+        assert gvw and abs(gvw[-1][1] - entry["rel_gap"]) < 1e-12, \
+            "flight-recorder gap series must end at the reported gap"
+    return entry
+
+
 def ladder_workload():
     """Certified-gap wheel over a scenario ladder (VERDICT r5 item 5):
     one :func:`bench_uc.uc_metrics` run per rung S, all inside ONE
@@ -365,6 +508,11 @@ def ladder_workload():
         except Exception as e:   # a failed rung never loses earlier rungs
             log(f"ladder rung S={S} failed: {e!r}")
             m = {"S": S, "error": repr(e)}
+        # per-rung flight-recorder artifact (no-op when tracing is off;
+        # also resets ring + counter window so rungs never bleed)
+        d = trace_segment_dump(f"ladder_S{S}")
+        if d is not None:
+            m["trace"] = {"path": d["path"]}
         entries.append(m)
         line["value"] = _n_ok()
         emit_partial(line)
@@ -384,6 +532,14 @@ def ladder_workload():
 def workload():
     if _smoke():
         _apply_smoke_defaults()
+    if _tracing_on():
+        # arm the flight recorder for the whole child (segments dump +
+        # clear the ring as they finish via trace_segment_dump) and the
+        # first segment's counter window
+        from tpusppy.obs import trace as _obs_trace
+
+        _obs_trace.enable()
+        _arm_segment_window()
     if "--ladder" in sys.argv[1:]:
         ladder_workload()
         return
@@ -505,6 +661,7 @@ def workload():
                                               refresh_every)
             chunk = min(chunk_req, cap) // refresh_every * refresh_every
 
+        from tpusppy.obs import metrics as obs_metrics
         from tpusppy.solvers import hostsync
 
         if chunk >= refresh_every:
@@ -522,7 +679,7 @@ def workload():
             log(f"fused chunk={chunk} compile: {time.time() - t0:.1f}s")
             n_chunks = max(1, n_iters // chunk)
             t0 = time.time()
-            with hostsync.track() as sync_tr:
+            with obs_metrics.window() as mwin, hostsync.track() as sync_tr:
                 state, trace = sharded.collect_traces(
                     fused, state, arr, 1.0, n_chunks)
             wall = time.time() - t0
@@ -535,7 +692,7 @@ def workload():
             state, out = frozen(state, arr, 1.0, factors)
             np.asarray(out.conv)  # compile the frozen program too
             t0 = time.time()
-            with hostsync.track() as sync_tr:
+            with obs_metrics.window() as mwin, hostsync.track() as sync_tr:
                 for i in range(n_iters):
                     if i % refresh_every == 0:
                         state, out, factors = refresh(state, arr, 1.0)
@@ -546,14 +703,30 @@ def workload():
             measured = n_iters
             sweeps = float(np.asarray(out.iters))
         iters_per_sec = measured / wall
-        # host-sync accounting (tpusppy/solvers/hostsync.py): how many
-        # decision-path fetches the window performed, and what share of
-        # the wall was spent host-BLOCKED in them (overlapped fetches —
-        # further device work already queued — excluded).  CPU caveat:
-        # in-process fetches are ~free here; the counts are the portable
-        # signal, the pct becomes meaningful on the remote-tunnel posture.
-        host_sync_count = sync_tr.count
-        dispatch_overhead_pct = round(sync_tr.overhead_pct(wall), 3)
+        # host-sync accounting, now SOURCED FROM THE METRICS REGISTRY
+        # (tpusppy/obs/metrics.py; hostsync feeds it on every fetch): how
+        # many decision-path fetches the window performed, and what share
+        # of the wall was spent host-BLOCKED in them (overlapped fetches —
+        # further device work already queued — excluded).  Same meaning as
+        # the legacy thread-local tracker (sync_tr, kept as the scoped
+        # cross-check: single-threaded windows agree exactly — the
+        # absorption-parity test pins this).  CPU caveat: in-process
+        # fetches are ~free here; the counts are the portable signal, the
+        # pct becomes meaningful on the remote-tunnel posture.
+        host_sync_count = int(mwin.delta("host_sync.count"))
+        blocked_secs = mwin.delta("host_sync.blocked_secs")
+        dispatch_overhead_pct = round(
+            min(100.0, 100.0 * blocked_secs / wall) if wall > 0 else 0.0, 3)
+        if host_sync_count != sync_tr.count:
+            # registry (process-global) vs tracker (thread-local) can
+            # legitimately differ when ANOTHER thread fetched during the
+            # window — e.g. a hung wheel spoke the spinner deliberately
+            # survives.  Say so loudly, keep the registry number, and
+            # NEVER kill the bench over it (the kill-safe contract; the
+            # single-threaded parity equality is pinned in test_obs.py)
+            log(f"WARNING: host-sync registry window ({host_sync_count}) "
+                f"!= thread tracker ({sync_tr.count}) — cross-thread "
+                f"fetches during the measured window")
         log(f"tpusppy[m{mult}]: {iters_per_sec:.3f} PH iters/sec "
             f"({measured} iters, conv={conv:.3e}, "
             f"eobj={float(np.asarray(out.eobj)):.2f}, "
@@ -573,6 +746,11 @@ def workload():
         mfu, mfu_note = flops_model.mfu_pct(
             iters_per_sec, flops_it, n_dev, jax.devices()[0],
             st.sweep_mode())
+        # bank the segment's headline numbers as registry gauges so the
+        # flight-recorder report's counter dump carries them too
+        obs_metrics.gauge(f"bench.iters_per_sec.m{mult}").set(iters_per_sec)
+        if mfu is not None:
+            obs_metrics.gauge(f"bench.mfu_pct.m{mult}").set(mfu)
 
         # Baseline: serial per-scenario LP loop through HiGHS (reference
         # architecture), timed on a sample, EXTRAPOLATED to all S scenarios
@@ -628,21 +806,46 @@ def workload():
         # extrapolated, not a measured 32-rank run
         "vs_baseline_32rank": m_primary["vs_baseline_32rank"],
     }
+    dump = trace_segment_dump(f"farmer{S}_m{mult}")
+    if dump is not None:
+        line["trace"] = dump
     emit_partial(line)   # farmer primary segment banked
+    if _tracing_on():
+        # the flight-recorder showcase: a small certified farmer wheel
+        # whose trace shows hub/spoke/dispatch/host-sync tracks and whose
+        # report's gap-vs-wall array ends at the certified gap
+        try:
+            line["wheel"] = traced_farmer_wheel()
+        except Exception as e:
+            log(f"traced wheel segment failed: {e!r}")
+            line["wheel"] = {"error": repr(e)}
+            trace_segment_dump("wheel_failed")   # bank + reset
+        emit_partial(line)   # wheel segment banked
     if mult != 1 and not os.environ.get("BENCH_SKIP_CM1"):
         try:  # latency-bound companion shape (VERDICT r4 weak #7)
             line["crops1"] = measure_farmer(1, iters)
+            d = trace_segment_dump(f"farmer{S}_m1")
+            if d is not None:
+                line["crops1"]["trace"] = {"path": d["path"]}
         except Exception as e:
             line["crops1"] = {"error": repr(e)}
+            # dump-and-reset even on failure: the partial trace is the
+            # diagnostic artifact, and a dirty ring/window would bleed
+            # this segment's events into the next segment's report
+            trace_segment_dump(f"farmer{S}_m1_failed")
         emit_partial(line)   # crops1 segment banked
     if not os.environ.get("BENCH_SKIP_UC"):
         try:
             import bench_uc
             line["uc"] = bench_uc.uc_metrics(
                 progress=lambda m: emit_partial(dict(line, uc=m)))
+            d = trace_segment_dump("uc")
+            if d is not None:
+                line["uc"]["trace"] = {"path": d["path"]}
         except Exception as e:   # UC numbers are additive; never lose farmer
             log(f"uc benchmark failed: {e!r}")
             line["uc"] = {"error": repr(e)}
+            trace_segment_dump("uc_failed")   # bank + reset (see crops1)
     print(json.dumps(line))
     sys.stdout.flush()
     sys.stderr.flush()

@@ -33,6 +33,30 @@ jax.config.update("jax_compilation_cache_dir",
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _obs_isolation():
+    """Observability state must never bleed between tests: a host-sync
+    tracker left open by a failed/interrupted test (thread-local stacks
+    survive the test body) would keep counting fetches into a later
+    test's ``host_sync_count`` assertion, and trace/metrics are
+    process-global by design.  Reset all three around every test."""
+    from tpusppy.obs import metrics, trace
+    from tpusppy.solvers import hostsync
+
+    hostsync.reset()
+    trace.disable()
+    trace.reset(capacity=trace.DEFAULT_CAPACITY)
+    metrics.reset()
+    yield
+    hostsync.reset()
+    trace.disable()
+    trace.reset(capacity=trace.DEFAULT_CAPACITY)
+    metrics.reset()
+
+
 def pytest_collection_finish(session):
     """Cold-run guard (VERDICT r4 weak #6): the pinned jaxlib's XLA:CPU
     compiler can segfault after many compiles in ONE process (reproduced

@@ -16,6 +16,8 @@ def _batch(S=10, **kw):
         [battery.scenario_creator(nm, num_scens=S, **kw) for nm in names])
 
 
+@pytest.mark.slow   # ~33s (PR-4 tier-1 budget reclaim): the admm-vs-
+#   highs EF cross-check; PH-vs-EF parity below keeps tier-1 coverage
 def test_battery_ef_parity():
     batch = _batch(10)
     oh, xh = solve_ef(batch, solver="highs")

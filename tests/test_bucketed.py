@@ -117,6 +117,8 @@ def test_bucketed_certified_dual_bound():
     assert bound >= exact - 0.05 * abs(exact)
 
 
+@pytest.mark.slow   # ~41s (PR-4 tier-1 budget reclaim): continuous
+#   xhat + PH/EF parity on bucketed batches remain tier-1 above
 def test_bucketed_integer_xhat_eval():
     """Integer fix-and-evaluate on ragged bundles: per-bucket diving
     (closes the r2 homogeneous-only limitation).  uc_lite bundles carry

@@ -198,6 +198,8 @@ def test_cuts_keep_shared_A():
     assert np.shares_memory(b.A, b.A_shared)
 
 
+@pytest.mark.slow   # ~68s: slowest tier-1 test (PR-4 budget reclaim);
+#   the cut protocol itself stays tier-1 via the five tests above
 def test_cut_wheel_shared_family_ef_parity():
     """EF parity for the cut-steered wheel on a shared-A family: bounds
     certified, incumbent near the EF optimum, sharing intact end-to-end."""

@@ -1,0 +1,486 @@
+"""Observability subsystem (tpusppy.obs): trace ring, Perfetto export,
+metrics registry absorption, report arrays, logger fold.
+
+The disabled-path guard here is the contract that lets instrumentation
+live in hot paths permanently: tracing off must mean zero events, a
+shared no-op span singleton, and a pinned (loose, but bounding) per-call
+overhead.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpusppy.obs import log as obs_log
+from tpusppy.obs import metrics, perfetto, report, trace
+from tpusppy.solvers import hostsync
+
+
+# ---------------------------------------------------------------------------
+# trace ring buffer
+# ---------------------------------------------------------------------------
+
+def test_ring_overflow_keeps_newest():
+    buf = trace.TraceBuffer(capacity=8)
+    for i in range(20):
+        buf.add(trace.Event(float(i), 0, "t", f"e{i}", "instant", None,
+                            None))
+    evs = buf.snapshot()
+    assert len(evs) == 8
+    assert buf.dropped == 12
+    assert [e.name for e in evs] == [f"e{i}" for i in range(12, 20)]
+
+
+def test_spans_nest_and_carry_payload():
+    trace.enable()
+    with trace.span("hub", "outer", k=1) as sp:
+        time.sleep(0.002)
+        with trace.span("hub", "inner"):
+            time.sleep(0.001)
+        sp.add(late=True)
+    evs = [e for e in trace.events() if e.kind == "span"]
+    assert [e.name for e in evs] == ["inner", "outer"]  # exit order
+    inner, outer = evs
+    # nesting: inner's window sits inside outer's
+    assert outer.t <= inner.t
+    assert inner.t + inner.dur <= outer.t + outer.dur + 1e-9
+    assert outer.payload == {"k": 1, "late": True}
+
+
+def test_ring_thread_safety_under_writer_storm():
+    trace.enable(capacity=4096)
+    n_threads, per_thread = 4, 3000
+    errs = []
+
+    def storm(tid):
+        try:
+            for i in range(per_thread):
+                if i % 3 == 0:
+                    with trace.span("storm", f"s{tid}"):
+                        pass
+                elif i % 3 == 1:
+                    trace.instant("storm", f"i{tid}", i=i)
+                else:
+                    trace.counter("storm", f"c{tid}", i)
+        except Exception as e:                      # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=storm, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    evs = trace.events()
+    assert len(evs) == 4096                  # ring full, newest kept
+    assert len(evs) + trace.dropped() == n_threads * per_thread
+    assert all(isinstance(e, trace.Event) for e in evs)
+
+
+def test_disabled_path_guard():
+    """Tracing off: zero events, a SHARED no-op singleton (no per-call
+    span allocation), and pinned overhead."""
+    assert not trace.enabled()       # autouse fixture disables
+    with trace.span("hub", "x", payload=1):
+        pass
+    trace.instant("hub", "y", a=2)
+    trace.counter("hub", "z", 3.0)
+    trace.record_span("hub", "w", 0.0, 1.0, {"big": "dict"})
+    assert trace.events() == []
+    # singleton identity — the disabled path allocates no span object
+    # (and therefore no internal payload dict / Event tuple)
+    assert trace.span("a", "b") is trace.span("c", "d")
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with trace.span(None, "noop"):
+            pass
+    dt = time.perf_counter() - t0
+    # generous absolute pin (~5us/call budget): catches an accidentally
+    # always-on path (ring append ~20x this) without flaking on slow CI
+    assert dt < n * 5e-6, f"disabled span path too slow: {dt / n * 1e9:.0f}ns"
+    assert trace.events() == []
+
+
+# ---------------------------------------------------------------------------
+# Perfetto export
+# ---------------------------------------------------------------------------
+
+def _make_doc():
+    trace.enable()
+    with trace.span("hub", "iter", k=1):
+        with trace.span("hub", "solve"):
+            pass
+    trace.instant("dispatch", "segment", seg_f=8)
+    trace.counter("hub", "rel_gap", 0.25)
+    with trace.span("spoke1:Lagrangian", "bound_pass"):
+        pass
+    return perfetto.export(trace.events())
+
+
+def test_perfetto_schema_sanity(tmp_path):
+    doc = _make_doc()
+    # loadable: a strict JSON round-trip
+    path = tmp_path / "t.perfetto.json"
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    doc2 = json.loads(path.read_text())
+    evs = doc2["traceEvents"]
+    body = [e for e in evs if e["ph"] != "M"]
+    ts = [e["ts"] for e in body]
+    assert ts == sorted(ts), "timestamps must be monotone"
+    # matched B/E pairs per thread row, properly nested
+    stacks = {}
+    for e in body:
+        if e["ph"] == "B":
+            stacks.setdefault(e["tid"], []).append(e["name"])
+        elif e["ph"] == "E":
+            assert stacks.get(e["tid"]), "E without matching B"
+            stacks[e["tid"]].pop()
+    assert all(not s for s in stacks.values()), "unclosed B events"
+    # named thread rows exist for every logical track
+    names = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+    assert {"hub", "dispatch", "spoke1:Lagrangian"} <= names
+    # counters carry values
+    cs = [e for e in body if e["ph"] == "C"]
+    assert cs and cs[0]["args"]["value"] == 0.25
+
+
+def test_perfetto_nonfinite_payloads_stay_strict_json(tmp_path):
+    """The hub's FIRST bound update carries old=±inf by construction;
+    json.dump would emit bare Infinity tokens (invalid JSON) and
+    ui.perfetto.dev's JSON.parse would reject the whole artifact."""
+    trace.enable()
+    trace.instant("hub", "outer_bound_update", old=float("-inf"),
+                  new=-110.0, worst=float("nan"))
+    path = tmp_path / "inf.perfetto.json"
+    perfetto.export(trace.events(), path=str(path))
+    text = path.read_text()
+    # strict parse (Python's json.loads is lenient about Infinity/NaN —
+    # check the raw text instead)
+    assert "Infinity" not in text and "NaN" not in text
+    ev = [e for e in json.loads(text)["traceEvents"]
+          if e.get("name") == "outer_bound_update"][0]
+    assert ev["args"]["old"] == "-inf" and ev["args"]["new"] == -110.0
+
+
+# ---------------------------------------------------------------------------
+# metrics registry + hostsync absorption
+# ---------------------------------------------------------------------------
+
+def test_registry_absorption_parity_with_tracker():
+    """host_sync_count via the registry window == the legacy thread-local
+    tracker over the same measured window (what bench's per-segment
+    fields are now sourced from)."""
+    with metrics.window() as win, hostsync.track() as tr:
+        for i in range(7):
+            hostsync.fetch(np.arange(4.0), overlapped=(i % 2 == 1))
+    assert int(win.delta("host_sync.count")) == tr.count == 7
+    assert int(win.delta("host_sync.overlapped")) == tr.overlapped == 3
+    assert win.delta("host_sync.blocked_secs") == pytest.approx(
+        tr.blocked_secs, rel=1e-9)
+    assert win.delta("host_sync.fetch_secs") == pytest.approx(
+        tr.fetch_secs, rel=1e-9)
+    # and the window is a DELTA view: a second window starts clean
+    with metrics.window() as win2:
+        hostsync.fetch(np.zeros(2))
+    assert int(win2.delta("host_sync.count")) == 1
+
+
+def test_registry_reset_keeps_module_bound_counters_live():
+    """reset() must zero in place: instrumented modules bind counter
+    objects at import (hostsync._CTR_COUNT) — dropping them would fork
+    the registry and absorption would silently go stale."""
+    hostsync.fetch(np.zeros(2))
+    assert metrics.value("host_sync.count") >= 1
+    metrics.reset()
+    assert metrics.value("host_sync.count") == 0
+    hostsync.fetch(np.zeros(2))
+    assert metrics.value("host_sync.count") == 1
+
+
+def test_hostsync_reset_clears_leaked_trackers():
+    """A tracker left open (failed test, missing finally) must stop
+    counting once reset() runs — the conftest autouse fixture calls it
+    so counts can never bleed across tests."""
+    t = hostsync.SyncTracker()
+    hostsync._stack().append(t)     # leak it deliberately
+    hostsync.reset()
+    hostsync.fetch(np.zeros(2))
+    assert t.count == 0
+
+
+def test_histogram_and_gauge():
+    h = metrics.histogram("h.test")
+    for v in (1.0, 3.0, 2.0):
+        h.add(v)
+    assert h.summary() == {"count": 3, "total": 6.0, "min": 1.0,
+                           "max": 3.0}
+    metrics.gauge("g.test").set(4.5)
+    d = metrics.dump()
+    assert d["g.test"] == 4.5 and d["h.test"]["count"] == 3
+    # window deltas over a histogram are WINDOW totals, not lifetime
+    with metrics.window() as win:
+        h.add(5.0)
+    assert win.delta("h.test") == 5.0
+
+
+def test_span_open_across_disable_is_dropped():
+    """A span still open when tracing is disabled/reset (lingering daemon
+    cylinder thread) must not leak its event into the next owner's ring."""
+    trace.enable()
+    sp = trace.span("hub", "stale")
+    sp.__enter__()
+    trace.disable()
+    trace.reset()
+    trace.enable()
+    sp.__exit__(None, None, None)
+    assert [e.name for e in trace.events()] == []
+
+
+# ---------------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------------
+
+def test_report_series_and_span_totals():
+    trace.enable()
+    for i, g in enumerate((0.5, 0.2, 0.05)):
+        trace.counter("hub", "rel_gap", g)
+        trace.counter("hub", "best_outer", -110.0 - i)
+    with trace.span("hub", "ph_iter"):
+        pass
+    with trace.span("hub", "ph_iter"):
+        pass
+    trace.instant("dispatch", "speculation_discard", segments=1)
+    rep = report.build_report(trace.events())
+    assert [v for _, v in rep["gap_vs_wall"]] == [0.5, 0.2, 0.05]
+    assert rep["gap_vs_wall"][-1][1] == 0.05          # ends at final gap
+    assert len(rep["bounds_vs_wall"]["best_outer"]) == 3
+    assert rep["tracks"]["hub"]["ph_iter"]["count"] == 2
+    assert rep["instants"]["dispatch"]["speculation_discard"] == 1
+    assert rep["dropped_events"] == 0
+    json.dumps(rep)                                   # serializable
+    # scoped variants: a counters override (per-segment window deltas)
+    # and a pinned drop count survive verbatim — the live ring may have
+    # moved on by the time a snapshot's report is built
+    rep2 = report.build_report(trace.events(),
+                               counters={"seg.only": 2.0}, dropped=5)
+    assert rep2["counters"] == {"seg.only": 2.0}
+    assert rep2["dropped_events"] == 5
+    # Window.deltas: counters windowed, gauges current
+    metrics.inc("w.count", 3)
+    metrics.gauge("w.gauge").set(7.0)
+    with metrics.window() as win:
+        metrics.inc("w.count", 2)
+    d = win.deltas()
+    assert d["w.count"] == 2.0 and d["w.gauge"] == 7.0
+
+
+# ---------------------------------------------------------------------------
+# logger fold
+# ---------------------------------------------------------------------------
+
+def test_get_logger_track_format():
+    import io
+    import logging
+
+    sink = io.StringIO()
+    h = logging.StreamHandler(sink)
+    h.setFormatter(obs_log._TrackFormatter())
+    obs_log.root.addHandler(h)
+    try:
+        obs_log.get_logger("cylinders.hub").info("gap certified")
+        obs_log.root.info("bare root line")
+    finally:
+        obs_log.root.removeHandler(h)
+    out = sink.getvalue()
+    assert "[cylinders.hub] gap certified" in out
+    # the root logger renders untagged (global_toc-era output preserved)
+    assert "\nbare root line" in "\n" + out
+    # tpusppy.log compat surface still routes here
+    import tpusppy.log as compat
+
+    assert compat.get_logger is obs_log.get_logger
+    assert compat.logger is obs_log.root
+
+
+def test_log_level_knob():
+    lg = obs_log.get_logger("lvl.test")
+    try:
+        obs_log.set_level("WARNING")
+        assert not lg.isEnabledFor(20)   # INFO suppressed
+        obs_log.set_level("DEBUG")
+        assert lg.isEnabledFor(10)
+    finally:
+        obs_log.set_level("INFO")
+
+
+# ---------------------------------------------------------------------------
+# config wiring
+# ---------------------------------------------------------------------------
+
+def test_config_tracing_enables_and_flushes(tmp_path):
+    from tpusppy.utils.config import Config
+
+    cfg = Config()
+    cfg.tracing_args()
+    assert trace.maybe_enable_from_config(cfg) is False   # default off
+    path = str(tmp_path / "run.perfetto.json")
+    cfg["tracing"] = path
+    assert trace.maybe_enable_from_config(cfg) is True
+    trace.instant("hub", "mark")
+    assert trace.flush(path) == path
+    doc = json.loads(open(path).read())
+    assert any(e.get("name") == "mark" for e in doc["traceEvents"])
+    rep = json.loads(open(path + ".report.json").read())
+    assert rep["n_events"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# instrumented seams (cheap, no jax compiles)
+# ---------------------------------------------------------------------------
+
+def test_mailbox_counters_and_versioned_put_skips():
+    from tpusppy.cylinders import Mailbox
+
+    trace.enable()
+    with metrics.window() as win:
+        mb = Mailbox(2, name="t")
+        mb.put(np.zeros(2))
+        mb.put_versioned(("tok", 1), lambda: np.ones(2))
+        mb.put_versioned(("tok", 1), lambda: np.ones(2))   # skip
+        mb.get()
+        mb.kill()
+    assert int(win.delta("mailbox.puts")) == 2
+    assert int(win.delta("mailbox.put_skips")) == 1
+    assert int(win.delta("mailbox.gets")) == 1
+    assert int(win.delta("mailbox.kills")) == 1
+    names = {e.name for e in trace.events()}
+    assert {"put", "put_skip", "kill"} <= names
+
+
+def test_hub_bound_updates_and_termination_events():
+    from tpusppy.cylinders.hub import Hub
+
+    trace.enable()
+    h = Hub.__new__(Hub)
+    h.options = {"rel_gap": 1e-3}
+
+    class _Opt:
+        is_minimizing = True
+
+    h.opt = _Opt()
+    h.initialize_bound_values()
+    h.outerbound_spoke_chars = {1: 'L'}
+    h.innerbound_spoke_chars = {2: 'X'}
+    h.last_gap = np.inf
+    h.stalled_iter_cnt = 0
+    h.OuterBoundUpdate(-110.0, idx=1)
+    h.InnerBoundUpdate(-109.99, idx=2)
+    assert h.determine_termination()
+    evs = trace.events()
+    names = [e.name for e in evs]
+    assert "outer_bound_update" in names and "inner_bound_update" in names
+    term = [e for e in evs if e.name == "terminate"]
+    assert term and term[0].payload["reason"] == "rel_gap"
+    assert term[0].payload["best_outer"] == -110.0
+    rep = report.build_report(evs)
+    assert rep["gap_vs_wall"][-1][1] == pytest.approx(0.01 / 110.0, rel=1e-6)
+    assert metrics.value("hub.outer_bound_updates") == 1
+
+
+def test_continue_frozen_dispatch_billing():
+    """Serial + pipelined continuations bill segments/flops into the
+    registry, and a stop verdict bills the discarded speculation."""
+    from tpusppy.solvers import segmented
+
+    class FakeSol:
+        def __init__(self, v, iters):
+            self.raw = v
+            self.iters = np.array([iters])
+            self.pri_res = np.array([v])
+            self.dua_res = np.array([v])
+
+    # serial: 3 dispatches exhaust the budget (never done)
+    with metrics.window() as win:
+        segmented.continue_frozen(
+            lambda w: FakeSol(w * 0.5, 8), FakeSol(1.0, 8), 8, 24,
+            all_done=lambda s: False, seg_flops=100.0)
+    assert int(win.delta("dispatch.segments")) == 3
+    assert win.delta("dispatch.flops") == 300.0
+    assert int(win.delta("speculation.segments")) == 0
+
+    # pipelined: incoming already-stopped iterate discards nothing;
+    # a later stop with a spec segment in flight bills the discard
+    calls = []
+
+    def run_segment(w):
+        calls.append(w)
+        return FakeSol(w * 0.5, 4 if len(calls) >= 2 else 8)
+
+    with metrics.window() as win:
+        segmented.continue_frozen(
+            run_segment, FakeSol(1.0, 8), 8, 80, pipeline=True,
+            overlap=2, seg_flops=10.0)
+    assert int(win.delta("speculation.discarded_segments")) >= 1
+    assert win.delta("speculation.discarded_flops") == pytest.approx(
+        10.0 * win.delta("speculation.discarded_segments"))
+    # billing invariant: discarded <= speculative <= dispatched
+    assert (win.delta("speculation.discarded_segments")
+            <= win.delta("speculation.segments")
+            <= win.delta("dispatch.segments"))
+
+    # the PRODUCTION depth (overlap=1, the default): every steady-state
+    # dispatch launches from the just-popped candidate before its
+    # verdict fetch — that IS the overlap, and it must bill as
+    # speculative (a stop with one in flight then discards 1 <= spec)
+    calls2 = []
+
+    def run_segment2(w):
+        calls2.append(w)
+        return FakeSol(w * 0.5, 4 if len(calls2) >= 3 else 8)
+
+    with metrics.window() as win1:
+        segmented.continue_frozen(
+            run_segment2, FakeSol(1.0, 8), 8, 80, pipeline=True,
+            check_incoming=True, seg_flops=10.0)
+    assert win1.delta("speculation.segments") >= 1
+    assert (win1.delta("speculation.discarded_segments")
+            <= win1.delta("speculation.segments")
+            <= win1.delta("dispatch.segments"))
+
+
+@pytest.mark.slow
+def test_wheel_trace_has_cylinder_tracks_and_final_gap(tmp_path,
+                                                       monkeypatch):
+    """The flight-recorder acceptance shape on a REAL (tiny) wheel: the
+    trace shows >= 4 distinct tracks (hub, spoke, dispatch, host-sync)
+    and the report's gap-vs-wall array ends at the reported final gap.
+
+    Slow tier (new-test policy: >~5s, and thread-timing variable — spoke
+    cold-start + linger put it anywhere from ~6 to ~25s); the cheap
+    synthetic tests above cover the report/track logic in tier-1 and the
+    nightly traced-bench job exercises this same path end to end."""
+    import bench
+
+    monkeypatch.setenv("BENCH_TRACE_DIR", str(tmp_path))
+    trace.enable()
+    ws_entry = bench.traced_farmer_wheel()
+    assert "error" not in ws_entry
+    dump = ws_entry["trace"]
+    tracks = set(dump["report"]["tracks"]) | {
+        t for t in dump["report"]["instants"]}
+    assert "hub" in tracks
+    assert any(t.startswith("spoke") for t in tracks)
+    assert "dispatch" in tracks
+    assert "host-sync" in tracks
+    assert len(tracks) >= 4
+    gvw = dump["report"]["gap_vs_wall"]
+    assert gvw and gvw[-1][1] == pytest.approx(ws_entry["rel_gap"])
+    # perfetto artifact exists and is loadable
+    doc = json.loads(open(dump["path"]).read())
+    assert doc["traceEvents"]

@@ -232,6 +232,8 @@ def test_shared_2d_mesh_row_sharding():
     assert float(out2.eobj) == pytest.approx(float(out1.eobj), rel=1e-4)
 
 
+@pytest.mark.slow   # ~38s (PR-4 tier-1 budget reclaim): L-shaped is
+#   covered in test_lshaped.py, shared-engine routing by tests above
 def test_lshaped_on_shared_batch():
     """Two-stage Benders on a shared-A family must route every batched
     solve through the shared engine and reach EF parity."""

@@ -16,6 +16,8 @@ from math import inf
 import numpy as np
 
 from .. import global_toc
+from ..obs import metrics as _metrics
+from ..obs import trace as _trace
 from .spcommunicator import SPCommunicator
 from .spoke import ConvergerSpokeType
 
@@ -90,6 +92,12 @@ class Hub(SPCommunicator):
             rel_gap = abs_gap / (abs(self.BestOuterBound) or 1.0)
         else:
             rel_gap = inf
+        if _trace.enabled() and np.isfinite(rel_gap):
+            # the gap-vs-wall series of the flight recorder: one sample
+            # per gap computation, so the report's array ends at the
+            # final certified gap (report.py collects "rel_gap"/"abs_gap")
+            _trace.counter("hub", "rel_gap", rel_gap)
+            _trace.counter("hub", "abs_gap", abs_gap)
         return abs_gap, rel_gap
 
     def determine_termination(self) -> bool:
@@ -114,6 +122,16 @@ class Hub(SPCommunicator):
             global_toc(f"Terminating: relative gap {rel_gap * 100:.3f}%", True)
         if stalled:
             global_toc(f"Terminating: stalled {self.stalled_iter_cnt} iters", True)
+        if (abs_ok or rel_ok or stalled) and _trace.enabled():
+            # the termination verdict WITH its evidence, on the timeline
+            _trace.instant(
+                "hub", "terminate",
+                reason=("abs_gap" if abs_ok else
+                        "rel_gap" if rel_ok else "stalled"),
+                abs_gap=abs_gap, rel_gap=rel_gap,
+                best_outer=self.BestOuterBound,
+                best_inner=self.BestInnerBound,
+                stalled_iters=self.stalled_iter_cnt)
         return abs_ok or rel_ok or stalled
 
     # ---- screen trace (hub.py:111-123) --------------------------------------
@@ -184,18 +202,30 @@ class Hub(SPCommunicator):
 
     def OuterBoundUpdate(self, new_bound, idx=None, char='*'):
         if self._ob_better(new_bound, self.BestOuterBound):
+            old = self.BestOuterBound
             self.latest_ob_char = (
                 char if idx is None else self.outerbound_spoke_chars[idx]
             )
             self.BestOuterBound = new_bound
+            _metrics.inc("hub.outer_bound_updates")
+            if _trace.enabled():
+                _trace.instant("hub", "outer_bound_update", old=old,
+                               new=new_bound, spoke=idx, char=char)
+                _trace.counter("hub", "best_outer", new_bound)
         return self.BestOuterBound
 
     def InnerBoundUpdate(self, new_bound, idx=None, char='*'):
         if self._ib_better(new_bound, self.BestInnerBound):
+            old = self.BestInnerBound
             self.latest_ib_char = (
                 char if idx is None else self.innerbound_spoke_chars[idx]
             )
             self.BestInnerBound = new_bound
+            _metrics.inc("hub.inner_bound_updates")
+            if _trace.enabled():
+                _trace.instant("hub", "inner_bound_update", old=old,
+                               new=new_bound, spoke=idx, char=char)
+                _trace.counter("hub", "best_inner", new_bound)
         return self.BestInnerBound
 
     def send_terminate(self):
@@ -236,16 +266,17 @@ class PHHub(Hub):
             )
 
     def sync(self):
-        if self.has_w_spokes:
-            self.send_ws()
-        if self.has_nonant_spokes:
-            self.send_nonants()
-        if self.has_bounds_only_spokes:
-            self.send_boundsout()
-        if self.has_outerbound_spokes:
-            self.receive_outerbounds()
-        if self.has_innerbound_spokes:
-            self.receive_innerbounds()
+        with _trace.span("hub", "sync"):
+            if self.has_w_spokes:
+                self.send_ws()
+            if self.has_nonant_spokes:
+                self.send_nonants()
+            if self.has_bounds_only_spokes:
+                self.send_boundsout()
+            if self.has_outerbound_spokes:
+                self.receive_outerbounds()
+            if self.has_innerbound_spokes:
+                self.receive_innerbounds()
 
     sync_with_spokes = sync
 

@@ -22,7 +22,18 @@ import time
 
 import numpy as np
 
+from ..obs import metrics as _metrics
+from ..obs import trace as _trace
+
 KILL_ID = -1
+
+# mailbox traffic counters (tpusppy.obs.metrics): puts vs versioned-put
+# SKIPS are the observable of the linger-loop fix (redundant Puts used to
+# re-trigger full spoke solve rounds); gets are the spokes' poll traffic
+_CTR_PUTS = _metrics.counter("mailbox.puts")
+_CTR_PUT_SKIPS = _metrics.counter("mailbox.put_skips")
+_CTR_GETS = _metrics.counter("mailbox.gets")
+_CTR_KILLS = _metrics.counter("mailbox.kills")
 
 
 class Mailbox:
@@ -56,6 +67,9 @@ class Mailbox:
             new_id = int(self._buf[-1]) + 1
             self._buf[:-1] = values
             self._buf[-1] = new_id
+        _CTR_PUTS.inc(1)
+        if _trace.enabled():
+            _trace.instant("mailbox", "put", box=self.name, write_id=new_id)
         return new_id
 
     def put_versioned(self, token, values) -> int:
@@ -74,6 +88,9 @@ class Mailbox:
         """
         with self._lock:
             if self._last_token is not None and token == self._last_token:
+                _CTR_PUT_SKIPS.inc(1)
+                if _trace.enabled():
+                    _trace.instant("mailbox", "put_skip", box=self.name)
                 return int(self._buf[-1])
         wid = self.put(values() if callable(values) else values)
         if wid != KILL_ID:
@@ -82,6 +99,7 @@ class Mailbox:
 
     def get(self) -> tuple[np.ndarray, int]:
         """Reader-side Get: snapshot (payload copy, write_id)."""
+        _CTR_GETS.inc(1)
         with self._lock:
             return self._buf[:-1].copy(), int(self._buf[-1])
 
@@ -95,6 +113,9 @@ class Mailbox:
         """
         with self._lock:
             self._buf[-1] = KILL_ID
+        _CTR_KILLS.inc(1)
+        if _trace.enabled():
+            _trace.instant("mailbox", "kill", box=self.name)
 
     @property
     def write_id(self) -> int:

@@ -1,40 +1,17 @@
-"""Per-module logger configuration.
+"""Compatibility shim: logging moved into :mod:`tpusppy.obs.log`.
 
-Analogue of ``mpisppy/log.py:52-67``: a root ``tpusppy`` logger writing
-messages to stdout at INFO, plus :func:`setup_logger` for components that
-want their own stream/file logger (the reference's hub/spoke modules create
-``hub.log``-style CRITICAL loggers this way; ours do the same through this
-factory).
+The observability subsystem owns the logger factory now — one
+``get_logger(name)`` with the ``[track] message`` format and the
+``TPUSPPY_LOG_LEVEL`` env knob.  This module keeps the historical import
+surface (``tpusppy.log.logger`` / ``setup_logger``, the analogue of
+``mpisppy/log.py:52-67``) pointing at the same objects.
 """
 
 from __future__ import annotations
 
-import logging
-import sys
+from .obs.log import get_logger, root as logger, set_level, setup_logger
 
-log_format = "%(message)s"
+log_format = "%(message)s"   # historical constant (pre-obs consumers)
 
-logger = logging.getLogger("tpusppy")
-logger.setLevel(logging.INFO)
-if not logger.handlers:
-    _h = logging.StreamHandler(sys.stdout)
-    _h.setFormatter(logging.Formatter(log_format))
-    logger.addHandler(_h)
-
-
-def setup_logger(name, out, level=logging.DEBUG, mode="w", fmt=None):
-    """Set up a custom logger quickly (mpisppy/log.py:52-67 semantics):
-    ``out`` is a stream (stdout/stderr) or a filename."""
-    if fmt is None:
-        fmt = "(%(asctime)s) %(message)s"
-    lg = logging.getLogger(name)
-    lg.setLevel(level)
-    lg.propagate = False
-    formatter = logging.Formatter(fmt)
-    if out in (sys.stdout, sys.stderr):
-        handler = logging.StreamHandler(out)
-    else:
-        handler = logging.FileHandler(out, mode=mode)
-    handler.setFormatter(formatter)
-    lg.addHandler(handler)
-    return lg
+__all__ = ["get_logger", "logger", "set_level", "setup_logger",
+           "log_format"]

@@ -1,0 +1,89 @@
+"""Logger factory with track-attributable output.
+
+One ``get_logger(name)`` for the whole stack: every record renders as
+``[name] message`` so multi-process wheel output (hub, spokes, dist-APH
+listeners) is attributable to its cylinder/rank, and the level is one
+env knob: ``TPUSPPY_LOG_LEVEL`` (DEBUG/INFO/WARNING/ERROR, default
+INFO).  Folds the old :mod:`tpusppy.log` (which re-exports from here):
+the root ``tpusppy`` logger still writes to stdout, and
+:func:`setup_logger` keeps the reference's custom stream/file factory
+(mpisppy/log.py:52-67 semantics).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+
+class _TrackFormatter(logging.Formatter):
+    """``[track] message`` — track is the logger name below ``tpusppy``
+    (bare root records render untagged, preserving global_toc-era
+    output)."""
+
+    def format(self, record):
+        msg = record.getMessage()
+        track = record.name
+        if track.startswith("tpusppy."):
+            track = track[len("tpusppy."):]
+        out = msg if track in ("tpusppy", "root", "") else f"[{track}] {msg}"
+        # keep the logging.Formatter contract: exc_info/stack_info must
+        # not be silently dropped (error paths log with exc_info=True)
+        if record.exc_info:
+            out += "\n" + self.formatException(record.exc_info)
+        if record.stack_info:
+            out += "\n" + self.formatStack(record.stack_info)
+        return out
+
+
+def _env_level(default=logging.INFO):
+    name = os.environ.get("TPUSPPY_LOG_LEVEL", "").strip().upper()
+    if not name:
+        return default
+    return getattr(logging, name, default)
+
+
+#: Root logger of the stack (stdout, [track]-formatted, env-leveled).
+root = logging.getLogger("tpusppy")
+root.setLevel(_env_level())
+if not root.handlers:
+    _h = logging.StreamHandler(sys.stdout)
+    _h.setFormatter(_TrackFormatter())
+    root.addHandler(_h)
+
+
+def get_logger(name: str | None = None) -> logging.Logger:
+    """Child of the ``tpusppy`` root whose records render as
+    ``[name] message``.  ``name`` is the track — a module tag
+    ("cylinders.hub"), a cylinder ("spoke1:Lagrangian"), or a rank-tagged
+    form ("dist_aph[p3]") for multi-process wheels."""
+    if not name:
+        return root
+    return logging.getLogger(f"tpusppy.{name}")
+
+
+def set_level(level):
+    """Programmatic override of the env knob (accepts names or ints)."""
+    if isinstance(level, str):
+        level = getattr(logging, level.strip().upper())
+    root.setLevel(level)
+
+
+def setup_logger(name, out, level=logging.DEBUG, mode="w", fmt=None):
+    """Set up a custom stream/file logger quickly (mpisppy/log.py:52-67
+    semantics, kept for reference parity): ``out`` is a stream
+    (stdout/stderr) or a filename."""
+    if fmt is None:
+        fmt = "(%(asctime)s) %(message)s"
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    lg.propagate = False
+    formatter = logging.Formatter(fmt)
+    if out in (sys.stdout, sys.stderr):
+        handler = logging.StreamHandler(out)
+    else:
+        handler = logging.FileHandler(out, mode=mode)
+    handler.setFormatter(formatter)
+    lg.addHandler(handler)
+    return lg

@@ -1,0 +1,97 @@
+"""Export the trace ring as Chrome/Perfetto trace-event JSON.
+
+Open the output at https://ui.perfetto.dev (or chrome://tracing): every
+logical track renders as its own named thread row — hub iterations,
+spoke bound passes, mailbox puts/gets, segmented dispatches, speculation
+discards and host-sync fetches land on one causally-ordered timeline.
+
+Mapping (trace-event "JSON array format"):
+
+- one fake process (pid 1) per export, one fake thread per (track, OS
+  thread) pair — concurrent spans on the same logical track from
+  different cylinder threads get sibling rows ("host-sync", "host-sync/2")
+  instead of interleaving their B/E pairs;
+- spans emit matched ``B``/``E`` pairs (the ring stores one event per
+  completed span, so pairs are matched by construction);
+- instants emit thread-scoped ``i`` events;
+- counters emit ``C`` events (Perfetto renders a numeric series).
+
+Timestamps are microseconds relative to the first event, sorted
+monotonically.  Payloads ride in ``args`` (values stringified only if
+not JSON-serializable).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+
+def _json_safe(v):
+    if isinstance(v, float):
+        # strict-JSON guard: json.dump would emit bare Infinity/NaN
+        # tokens (valid Python, INVALID JSON) and ui.perfetto.dev's
+        # JSON.parse would reject the whole file — the hub's first bound
+        # update carries old=±inf by construction
+        return v if math.isfinite(v) else repr(v)
+    if isinstance(v, (bool, int, str)) or v is None:
+        return v
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    try:
+        return _json_safe(float(v))           # numpy scalars
+    except (TypeError, ValueError):
+        return repr(v)
+
+
+def to_trace_events(events) -> list:
+    """Flatten ring events into a ts-sorted trace-event list (dicts)."""
+    if not events:
+        return []
+    t0 = min(ev.t for ev in events)
+    # stable tid per (track, os-thread): first-seen order, named rows
+    tids: dict = {}
+    names: dict = {}
+
+    def tid_of(track, os_tid):
+        key = (track, os_tid)
+        if key not in tids:
+            tids[key] = len(tids) + 1
+            n = sum(1 for (tr, _) in tids if tr == track)
+            names[tids[key]] = track if n == 1 else f"{track}/{n}"
+        return tids[key]
+
+    out = []
+    for ev in events:
+        ts = (ev.t - t0) * 1e6
+        tid = tid_of(ev.track, ev.tid)
+        args = _json_safe(ev.payload) if ev.payload else {}
+        if ev.kind == "span":
+            dur = max(0.0, (ev.dur or 0.0) * 1e6)
+            out.append({"name": ev.name, "ph": "B", "pid": 1, "tid": tid,
+                        "ts": ts, "args": args})
+            out.append({"name": ev.name, "ph": "E", "pid": 1, "tid": tid,
+                        "ts": ts + dur})
+        elif ev.kind == "counter":
+            val = (ev.payload or {}).get("value", 0.0)
+            out.append({"name": ev.name, "ph": "C", "pid": 1, "tid": tid,
+                        "ts": ts, "args": {"value": _json_safe(val)}})
+        else:
+            out.append({"name": ev.name, "ph": "i", "pid": 1, "tid": tid,
+                        "ts": ts, "s": "t", "args": args})
+    out.sort(key=lambda e: (e["ts"], 0 if e["ph"] != "E" else 1))
+    meta = [{"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+             "args": {"name": name}} for tid, name in sorted(names.items())]
+    return meta + out
+
+
+def export(events, path: str | None = None) -> dict:
+    """Build (and optionally write) the Perfetto JSON document."""
+    doc = {"traceEvents": to_trace_events(events),
+           "displayTimeUnit": "ms"}
+    if path:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    return doc

@@ -1,0 +1,80 @@
+"""Post-run "flight recorder" summary of a trace ring + the metrics registry.
+
+The artifact that turns a run into evidence (the S=1000 campaign burned
+three instrumented reruns discovering an invalid trivial bound and a
+starved Lagrangian — both visible in this summary's bound-vs-wall
+arrays): :func:`build_report` reduces the event ring to
+
+- ``gap_vs_wall``: ``[t_rel_secs, value]`` samples of every ``rel_gap``
+  counter event (the hub emits one per gap computation; the LAST entry is
+  the run's final certified gap);
+- ``bounds_vs_wall``: the same for ``best_outer`` / ``best_inner`` /
+  ``abs_gap`` series;
+- ``tracks``: per-track per-name span totals (count / total_secs) — where
+  the wall went, cylinder by cylinder;
+- ``instants``: per-track per-name instant counts (speculation discards,
+  guard trips, terminations, ...);
+- ``counters``: the full metrics-registry dump;
+- ``dropped_events``: ring-overflow count (0 means the timeline is
+  complete).
+
+``bench.py --trace`` attaches this JSON per segment next to the Perfetto
+file; :func:`tpusppy.obs.trace.flush` writes it as ``<path>.report.json``.
+"""
+
+from __future__ import annotations
+
+#: Counter-event names collected into *-vs-wall arrays.
+SERIES = ("rel_gap", "abs_gap", "best_outer", "best_inner")
+
+
+def build_report(events, registry=None, counters=None,
+                 dropped=None) -> dict:
+    """Reduce ring events (+ the metrics registry) to the summary dict.
+
+    ``counters`` (optional dict) overrides the registry dump — pass a
+    :meth:`metrics.Window.deltas` snapshot so a per-segment report
+    carries that segment's traffic, not the process lifetime's.
+    ``dropped`` (optional int) pins the ring-overflow count to the
+    moment ``events`` was snapshotted — the live ring may have been
+    reset (or still be recording) by the time the report is built.
+    """
+    from . import metrics as _metrics
+    from . import trace as _trace
+
+    registry = registry if registry is not None else _metrics.REGISTRY
+    t0 = min((ev.t for ev in events), default=0.0)
+
+    series: dict = {name: [] for name in SERIES}
+    tracks: dict = {}
+    instants: dict = {}
+    for ev in events:
+        if ev.kind == "span":
+            per = tracks.setdefault(ev.track, {})
+            agg = per.setdefault(ev.name, {"count": 0, "total_secs": 0.0})
+            agg["count"] += 1
+            agg["total_secs"] += ev.dur or 0.0
+        elif ev.kind == "counter":
+            if ev.name in series:
+                series[ev.name].append(
+                    [ev.t - t0, (ev.payload or {}).get("value")])
+        else:
+            per = instants.setdefault(ev.track, {})
+            per[ev.name] = per.get(ev.name, 0) + 1
+    for per in tracks.values():
+        for agg in per.values():
+            agg["total_secs"] = round(agg["total_secs"], 6)
+    return {
+        "n_events": len(events),
+        "dropped_events": (dropped if dropped is not None
+                           else _trace.dropped()),
+        "gap_vs_wall": series["rel_gap"],
+        "bounds_vs_wall": {
+            "best_outer": series["best_outer"],
+            "best_inner": series["best_inner"],
+            "abs_gap": series["abs_gap"],
+        },
+        "tracks": tracks,
+        "instants": instants,
+        "counters": counters if counters is not None else registry.dump(),
+    }

@@ -1,0 +1,300 @@
+"""Structured trace ring buffer: spans, instants, counters.
+
+The flight-recorder core: a thread-safe bounded ring of
+:class:`Event` tuples ``(t, tid, track, name, kind, dur, payload)``.
+Recording is OFF by default and the disabled fast path is pinned by a
+test: every public record function starts with one module-flag check and
+returns a shared singleton (no event tuple, no payload dict is
+constructed), so instrumentation can stay in hot paths permanently.
+
+Tracks are logical timelines (one per cylinder / controller /
+listener-thread — see doc/observability.md for the naming scheme).  Most
+instrumentation passes ``track=None`` which resolves to the calling
+thread's track (:func:`set_thread_track` — the wheel spinner names its
+cylinder threads); fixed subsystem timelines ("host-sync", "dispatch",
+"mailbox", …) pass their track explicitly.  The OS thread ident is
+recorded per event so the Perfetto exporter can keep concurrent spans on
+one logical track from interleaving their begin/end pairs.
+
+Enablement: ``TPUSPPY_TRACE=<path>`` in the environment turns tracing on
+at import and registers an atexit flush of ``<path>`` (Perfetto JSON)
+plus ``<path>.report.json`` (the :mod:`.report` summary); programmatic
+:func:`enable`/:func:`disable` and :func:`flush` do the same on demand.
+``Config.tracing`` (see :meth:`tpusppy.utils.config.Config.tracing_args`)
+routes here through :func:`maybe_enable_from_config`.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import threading
+import time
+from typing import NamedTuple
+
+#: Default ring capacity (events).  At the wheel's event rates (~10-100
+#: events/iteration) this keeps minutes of history; the ring drops the
+#: OLDEST events on overflow (``dropped`` counts them).
+DEFAULT_CAPACITY = 131072
+
+_perf = time.perf_counter
+
+
+class Event(NamedTuple):
+    t: float            # perf_counter timestamp (seconds)
+    tid: int            # OS thread ident at record time
+    track: str          # logical timeline name
+    name: str           # event name
+    kind: str           # "span" | "instant" | "counter"
+    dur: float | None   # span duration (seconds); None otherwise
+    payload: dict | None
+
+
+class TraceBuffer:
+    """Thread-safe bounded ring of events (newest kept on overflow)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = int(capacity)
+        self._dq: collections.deque = collections.deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def add(self, ev: Event):
+        with self._lock:
+            if len(self._dq) == self.capacity:
+                self.dropped += 1
+            self._dq.append(ev)
+
+    def snapshot(self) -> list:
+        """Copy of the current events, oldest first."""
+        with self._lock:
+            return list(self._dq)
+
+    def clear(self):
+        with self._lock:
+            self._dq.clear()
+            self.dropped = 0
+
+    def __len__(self):
+        with self._lock:
+            return len(self._dq)
+
+
+# ---------------------------------------------------------------------------
+# Module state.  `_enabled` is THE fast-path flag: every record function
+# checks it first and allocates nothing when False.
+# ---------------------------------------------------------------------------
+_enabled = False
+_buffer = TraceBuffer()
+_flush_path: str | None = None
+_atexit_registered = False
+_tls = threading.local()
+# recording generation: bumped by disable()/reset() so a span OPENED in
+# an earlier generation (a lingering daemon cylinder thread crossing a
+# test fixture's disable+reset+re-enable) drops its event instead of
+# leaking it into the next owner's ring
+_gen = 0
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_thread_track(name: str | None):
+    """Set (or clear) the calling thread's default track — events recorded
+    with ``track=None`` land here.  The wheel spinner names its cylinder
+    threads this way ("hub", "spoke1:LagrangianOuterBound", ...)."""
+    _tls.track = name
+
+
+def thread_track() -> str:
+    return getattr(_tls, "track", None) or "main"
+
+
+def enable(path: str | None = None, capacity: int | None = None):
+    """Turn recording on.  ``path`` (optional) arms :func:`flush` and an
+    atexit flush; ``capacity`` resizes (and clears) the ring."""
+    global _enabled, _flush_path, _buffer, _atexit_registered
+    if capacity is not None and capacity != _buffer.capacity:
+        _buffer = TraceBuffer(capacity)
+    if path:
+        _flush_path = str(path)
+        if not _atexit_registered:
+            import atexit
+
+            atexit.register(_flush_atexit)
+            _atexit_registered = True
+    _enabled = True
+
+
+def disable():
+    global _enabled, _gen
+    _enabled = False
+    _gen += 1
+
+
+def reset(capacity: int | None = None):
+    """Clear the ring (recording flag unchanged) — test isolation hook.
+    ``capacity`` also restores the ring size (an ``enable(capacity=...)``
+    from one owner must not shrink every later owner's ring)."""
+    global _gen, _buffer
+    _gen += 1
+    if capacity is not None and capacity != _buffer.capacity:
+        _buffer = TraceBuffer(capacity)
+    else:
+        _buffer.clear()
+
+
+def events() -> list:
+    """Snapshot of the recorded events, oldest first."""
+    return _buffer.snapshot()
+
+
+def dropped() -> int:
+    return _buffer.dropped
+
+
+# ---------------------------------------------------------------------------
+# Recording.  Spans via context manager; `_NULL` is the shared disabled
+# singleton (identity-checkable by the overhead test).
+# ---------------------------------------------------------------------------
+class _NullSpan:
+    """Shared no-op span: returned whenever tracing is off."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def add(self, **kw):   # payload attach is a no-op too
+        pass
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("track", "name", "payload", "t0", "gen")
+
+    def __init__(self, track, name, payload):
+        self.track = track
+        self.name = name
+        self.payload = payload
+
+    def __enter__(self):
+        self.gen = _gen
+        self.t0 = _perf()
+        return self
+
+    def add(self, **kw):
+        """Attach payload discovered mid-span (recorded at exit)."""
+        if self.payload is None:
+            self.payload = {}
+        self.payload.update(kw)
+
+    def __exit__(self, *exc):
+        if not _enabled or self.gen != _gen:
+            # tracing was disabled or reset while this span was open —
+            # e.g. a lingering daemon spoke thread the wheel spinner
+            # deliberately survives, crossing a test fixture's
+            # disable+reset(+re-enable).  Dropping the event keeps
+            # foreign spans out of the next owner's ring.
+            return False
+        t1 = _perf()
+        _buffer.add(Event(self.t0, threading.get_ident(),
+                          self.track or thread_track(), self.name, "span",
+                          t1 - self.t0, self.payload))
+        return False
+
+
+def span(track: str | None, name: str, **payload):
+    """Context manager recording a duration event on ``track`` (None =
+    the calling thread's track).  Disabled: returns the shared no-op
+    singleton — nothing is allocated beyond the kwargs dict, so hot paths
+    with payloads should guard on :func:`enabled` first."""
+    if not _enabled:
+        return _NULL
+    return _Span(track, name, payload or None)
+
+
+def record_span(track: str | None, name: str, t0: float, dur: float,
+                payload: dict | None = None):
+    """Record an ALREADY-timed span (callers that measured their own
+    ``perf_counter`` window, e.g. the host-sync fetch wrapper)."""
+    if not _enabled:
+        return
+    _buffer.add(Event(t0, threading.get_ident(),
+                      track or thread_track(), name, "span", dur, payload))
+
+
+def instant(track: str | None, name: str, **payload):
+    """Point event (a marker on the timeline)."""
+    if not _enabled:
+        return
+    _buffer.add(Event(_perf(), threading.get_ident(),
+                      track or thread_track(), name, "instant", None,
+                      payload or None))
+
+
+def counter(track: str | None, name: str, value):
+    """Sampled numeric series (rendered as a counter track; the report
+    collects named series like ``rel_gap`` into *-vs-wall arrays)."""
+    if not _enabled:
+        return
+    _buffer.add(Event(_perf(), threading.get_ident(),
+                      track or thread_track(), name, "counter", None,
+                      {"value": float(value)}))
+
+
+# ---------------------------------------------------------------------------
+# Flush / wiring
+# ---------------------------------------------------------------------------
+def flush(path: str | None = None) -> str | None:
+    """Write the current ring as Perfetto JSON to ``path`` (default: the
+    armed flush path) plus the report summary to ``<path>.report.json``.
+    Returns the path written, or None when there is nowhere to write."""
+    path = path or _flush_path
+    if not path:
+        return None
+    import json
+
+    from . import perfetto, report
+
+    perfetto.export(events(), path=path)
+    with open(path + ".report.json", "w") as f:
+        json.dump(report.build_report(events()), f, indent=1)
+    return path
+
+
+def flush_if_enabled():
+    """Flush when tracing is on and a path is armed (wheel/bench hook —
+    safe to call unconditionally)."""
+    if _enabled and _flush_path:
+        flush()
+
+
+def _flush_atexit():
+    with contextlib.suppress(Exception):   # interpreter teardown
+        flush_if_enabled()
+
+
+def maybe_enable_from_config(cfg) -> bool:
+    """Enable tracing when a Config carries a truthy ``tracing`` field
+    (the path to flush to).  Returns whether tracing is now enabled."""
+    path = None
+    try:
+        path = cfg.get("tracing")
+    except Exception:
+        path = getattr(cfg, "tracing", None)
+    if path:
+        enable(path=str(path))
+    return _enabled
+
+
+_env_path = os.environ.get("TPUSPPY_TRACE")
+if _env_path:
+    enable(path=_env_path)

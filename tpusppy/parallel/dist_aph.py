@@ -31,12 +31,14 @@ multistage stays on the single-controller path.
 
 from __future__ import annotations
 
-import sys
 import threading
 import time
 
 import numpy as np
 
+from ..obs import metrics as _metrics
+from ..obs import trace as _trace
+from ..obs.log import get_logger
 from ..opt.aph import APH
 
 
@@ -131,11 +133,21 @@ class APHPartialSync:
                             self._global = red
                         for p in range(1, self.nproc):
                             self.fabric.to_spoke[p].put(red)
+                        _metrics.inc("dist_aph.listener_reductions")
+                        if _trace.enabled():
+                            _trace.instant("listener", "reduce",
+                                           min_serial=serial,
+                                           parts=len(parts))
                 else:
                     data, wid = self.fabric.to_spoke[self.pid].get()
                     if wid > 0:
                         with self._lock:
                             self._global = data
+                        if wid != last_ids.get("global"):
+                            # count NEW reductions only (the poll re-reads
+                            # the same box every few ms)
+                            last_ids["global"] = wid
+                            _metrics.inc("dist_aph.listener_pulls")
             except Exception as e:
                 # a torn-down fabric mid-poll must not spin a traceback
                 # storm — but a LIVE run degrading to stale/local-only
@@ -145,8 +157,11 @@ class APHPartialSync:
                     return
                 if self.listener_error is None:
                     self.listener_error = repr(e)
-                    print(f"APHPartialSync listener error (reductions may "
-                          f"go stale): {e!r}", file=sys.stderr, flush=True)
+                    _metrics.inc("dist_aph.listener_errors")
+                    # rank-attributable logger, not a bare print: several
+                    # wheel processes interleave on one terminal
+                    get_logger(f"dist_aph[p{self.pid}].listener").error(
+                        "listener error (reductions may go stale): %r", e)
             time.sleep(self.sleep_secs)
 
     def close(self):
@@ -260,4 +275,8 @@ class DistributedAPH(APH):
             return None
         if got[1] < self._iter:
             self._stale_dist_reductions += 1
+            _metrics.inc("dist_aph.stale_reductions")
+            if _trace.enabled():
+                _trace.instant("listener", "stale_reduction",
+                               serial=got[1], iter=self._iter)
         return got[0]

@@ -33,6 +33,8 @@ from typing import NamedTuple
 
 import numpy as np
 
+from ..obs import metrics as _metrics
+from ..obs import trace as _trace
 from .distributed import _setup_distributed
 
 
@@ -67,12 +69,21 @@ def read_voted(mailbox, allgather, max_tries: int = 10000,
     not a slow writer).
     """
     retries = 0
+    _metrics.inc("dist_wheel.voted_reads")
     for _ in range(max_tries):
         data, wid = mailbox.get()
         ids = allgather(wid)
         if all(i == ids[0] for i in ids):
             return data, int(wid), retries
         retries += 1
+        # a disagreeing round = controllers re-read after racing a writer
+        # mid-Put — the exact hazard the vote exists for, so it is the
+        # covered-path observable (DistWheelResult.vote_retries totals it)
+        _metrics.inc("dist_wheel.vote_retries")
+        if _trace.enabled():
+            _trace.instant("hub", "vote_retry",
+                           box=getattr(mailbox, "name", "?"),
+                           ids=list(ids))
         time.sleep(sleep_s)
     raise RuntimeError(
         f"write-id vote failed to converge after {max_tries} rounds "
@@ -178,9 +189,17 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
                 b = float(data[0])
                 if np.isfinite(b):
                     if role["bound"] == "outer" and better_outer(b, BestOuter):
+                        if _trace.enabled():
+                            _trace.instant("hub", "outer_bound_update",
+                                           old=BestOuter, new=b, spoke=idx)
+                            _trace.counter("hub", "best_outer", b)
                         BestOuter = b
                     elif (role["bound"] == "inner"
                           and better_inner(b, BestInner)):
+                        if _trace.enabled():
+                            _trace.instant("hub", "inner_bound_update",
+                                           old=BestInner, new=b, spoke=idx)
+                            _trace.counter("hub", "best_inner", b)
                         BestInner = b
 
     def fetch_consensus():
@@ -243,18 +262,23 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
         votes = allgather(1.0 if stop else 0.0)
         assert all(v == votes[0] for v in votes), \
             "controllers disagreed on termination — determinism bug"
+        if votes[0] and _trace.enabled():
+            _trace.instant("hub", "terminate", reason="rel_gap",
+                           rel_gap=gap(), best_outer=BestOuter,
+                           best_inner=BestInner, iter=it)
         return bool(votes[0])
 
     try:
         for it in range(1, iters + 1):
-            if (it - 1) % refresh_every == 0:
-                state, out, factors = refresh(state, arr, 1.0)
-            else:
-                state, out = frozen(state, arr, 1.0, factors)
-            conv = float(np.asarray(out.conv))
-            eobj = float(np.asarray(out.eobj))
-            push_state()
-            pull_bounds()
+            with _trace.span("hub", "wheel_iter"):
+                if (it - 1) % refresh_every == 0:
+                    state, out, factors = refresh(state, arr, 1.0)
+                else:
+                    state, out = frozen(state, arr, 1.0, factors)
+                conv = float(np.asarray(out.conv))
+                eobj = float(np.asarray(out.eobj))
+                push_state()
+                pull_bounds()
             if voted_stop():
                 break
         else:

@@ -368,6 +368,12 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
                                   factor_batch=factor_batch,
                                   sparse_factor=sf)
 
+    def _seg_flops_for(arr, seg_f):
+        """Per-segment model flops (speculation/dispatch billing unit)."""
+        from ..solvers import flops as flops_model
+        S_dev, n, m, _, sf = _dispatch_model_params(arr, mesh)
+        return flops_model.sweep_flops(S_dev, n, m, sf) * seg_f
+
     # A mesh spanning several processes cannot make data-dependent host
     # decisions: sol.iters' shards are non-addressable (fetch raises), and
     # even local-shard votes could disagree across processes — different
@@ -402,7 +408,7 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
         sol = segmented_solvers.continue_frozen(
             lambda w: fsolve(q, q2, arr, w, factors), sol, seg_f,
             segmented_solvers.refresh_budget(settings, seg_r),
-            **_continue_kw(arr))
+            seg_flops=_seg_flops_for(arr, seg_f), **_continue_kw(arr))
         if arr.A.ndim == 3 and settings.polish and settings.polish_passes:
             sol = psolve(q, q2, arr, sol.raw, factors)
         new_state, out = _finish_jit(state, arr, sol, W, rho)
@@ -423,14 +429,15 @@ def make_ph_step_pair(nonant_idx: np.ndarray, settings: ADMMSettings,
             sol = segmented_solvers.continue_frozen(
                 lambda w: fsolve(q, q2, arr, w, factors), sol, seg_f,
                 settings.max_iter - seg_f, all_done=lambda s: False,
-                plateau_rtol=None)
+                plateau_rtol=None,
+                seg_flops=_seg_flops_for(arr, seg_f))
         else:
             # check_incoming folds the first-dispatch verdict into the
             # (possibly pipelined) continuation's single-fetch protocol
             sol = segmented_solvers.continue_frozen(
                 lambda w: fsolve(q, q2, arr, w, factors), sol, seg_f,
                 settings.max_iter - seg_f, check_incoming=True,
-                **_continue_kw(arr))
+                seg_flops=_seg_flops_for(arr, seg_f), **_continue_kw(arr))
         new_state, out = _finish_jit(state, arr, sol, W, rho)
         return new_state, out
 

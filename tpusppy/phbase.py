@@ -21,6 +21,7 @@ from __future__ import annotations
 import numpy as np
 
 from . import global_toc
+from .obs import trace as _trace
 from .spopt import SPOpt
 from .extensions.extension import Extension
 
@@ -147,7 +148,8 @@ class PHBase(SPOpt):
         (phbase.py:758-872)."""
         self.extobject.pre_iter0()
         self._iter = 0
-        self.solve_loop()  # plain objective
+        with _trace.span(None, "iter0"):
+            self.solve_loop()  # plain objective
         feas = self.feas_prob()
         if feas < 1.0 - 1e-6:
             # residuals above feas_tol conflate two states: a truly
@@ -222,12 +224,18 @@ class PHBase(SPOpt):
         max_iters = self.options["PHIterLimit"]
         for k in range(1, max_iters + 1):
             self._iter = k
-            self.extobject.miditer()
-            self.solve_ph_subproblems()
-            self.Compute_Xbar()
-            self.Update_W()
-            self.conv = self.convergence_diff()
-            self.extobject.enditer()
+            # one span per PH iteration on the cylinder's own track
+            # (the wheel spinner names cylinder threads; solo runs land
+            # on "main") — the hub/spoke timeline rows of the trace
+            with _trace.span(None, "ph_iter") as _sp:
+                self.extobject.miditer()
+                self.solve_ph_subproblems()
+                self.Compute_Xbar()
+                self.Update_W()
+                self.conv = self.convergence_diff()
+                if _trace.enabled():   # payload dicts only when tracing
+                    _sp.add(iter=k, conv=self.conv)
+                self.extobject.enditer()
             if self.spcomm is not None:
                 self.spcomm.sync()
                 self.extobject.enditer_after_sync()

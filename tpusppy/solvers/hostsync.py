@@ -31,7 +31,19 @@ import time
 
 import numpy as np
 
+from ..obs import metrics as _metrics
+from ..obs import trace as _trace
+
 _local = threading.local()
+
+# process-wide absorption into the metrics registry (tpusppy.obs.metrics):
+# every fetch feeds these counters so bench/report numbers come from ONE
+# source; the thread-local trackers below remain the scoped per-window
+# view (and the parity test pins that single-threaded windows agree)
+_CTR_COUNT = _metrics.counter("host_sync.count")
+_CTR_OVERLAPPED = _metrics.counter("host_sync.overlapped")
+_CTR_BLOCKED = _metrics.counter("host_sync.blocked_secs")
+_CTR_FETCH = _metrics.counter("host_sync.fetch_secs")
 
 
 def _stack():
@@ -39,6 +51,16 @@ def _stack():
     if st is None:
         st = _local.stack = []
     return st
+
+
+def reset():
+    """Drop the calling thread's tracker stack.
+
+    Test-isolation hook (an autouse fixture calls it): a tracker left
+    open by a failed/interrupted test — or pushed by library code that
+    never unwound — must not keep counting fetches into a later test's
+    ``host_sync_count`` assertion."""
+    _local.stack = []
 
 
 class SyncTracker:
@@ -95,4 +117,14 @@ def fetch(x, overlapped: bool = False):
     dt = time.perf_counter() - t0
     for tr in _stack():
         tr.add(dt, overlapped)
+    _CTR_COUNT.inc(1)
+    _CTR_FETCH.inc(dt)
+    if overlapped:
+        _CTR_OVERLAPPED.inc(1)
+    else:
+        _CTR_BLOCKED.inc(dt)
+    if _trace.enabled():
+        # retroactive span: the fetch wall-time on the "host-sync" track
+        _trace.record_span("host-sync", "fetch", t0, dt,
+                           {"overlapped": overlapped})
     return out

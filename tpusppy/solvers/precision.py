@@ -30,6 +30,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..obs import metrics as _metrics
+
 #: Recognized matmul precision modes, fastest first.  Mirrors
 #: jax.default_matmul_precision's vocabulary (and
 #: flops.PRECISION_PASSES's keys).
@@ -77,6 +79,11 @@ def contract(spec: str, a, b, mode: str | None = None, platform=None):
     mode = canon(mode)
     if mode == "highest":
         return jnp.einsum(spec, a, b, precision=jax.lax.Precision.HIGHEST)
+    # TRACE-time counter (this function runs while building the program,
+    # not per device execution): how many lowered contractions each
+    # compiled solver embeds — the observable that a "default"/"high"
+    # sweep program really was built lowered
+    _metrics.inc(f"precision.lowered_contractions.{mode}")
     platform = platform or jax.default_backend()
     if platform == "tpu":
         return jnp.einsum(spec, a, b, precision=_JAX_PRECISION[mode])

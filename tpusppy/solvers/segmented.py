@@ -25,6 +25,8 @@ import dataclasses
 
 import numpy as np
 
+from ..obs import metrics as _metrics
+from ..obs import trace as _trace
 from . import flops as flops_model
 from . import hostsync
 
@@ -198,6 +200,23 @@ def _shapes(args, shared):
     return S, n, m
 
 
+def _seg_flops(args, shared, seg_f):
+    """Model flops of ONE frozen segment — the speculation billing unit
+    (``flops.sweep_flops`` x the segment's sweep cap)."""
+    S, n, m = _shapes(args, shared)
+    return flops_model.sweep_flops(S, n, m, _sparse_factor(args)) * seg_f
+
+
+def _segmenting_events(S, n, m, seg_r, seg_f):
+    """Observability of a watchdog-driven segmentation decision: the
+    per-dispatch sweep caps this shape was sized to (the worker kills
+    ~60s+ executions — these caps ARE the watchdog posture)."""
+    _metrics.inc("dispatch.segmented_solves")
+    if _trace.enabled():
+        _trace.instant("dispatch", "watchdog_caps", S=S, n=n, m=m,
+                       seg_refresh=seg_r, seg_frozen=seg_f)
+
+
 def refresh_budget(settings, seg_r):
     """Sweep budget left for frozen continuations after a segmented
     adaptive dispatch (which ran ``restarts`` rounds of ``seg_r``)."""
@@ -237,7 +256,7 @@ def pipeline_enabled(settings, S, n, m) -> bool:
 
 def continue_frozen(run_segment, sol, seg_f, budget, all_done=None,
                     plateau_rtol=None, pipeline=False, overlap=1,
-                    check_incoming=False):
+                    check_incoming=False, seg_flops=None):
     """Generic frozen-continuation loop shared by the host solve path and
     the jitted sharded PH step: re-dispatch ``run_segment(warm)`` until
     converged, plateaued, or the sweep budget is spent.
@@ -283,6 +302,12 @@ def continue_frozen(run_segment, sol, seg_f, budget, all_done=None,
     work never exceeds the serial worst case (budget exhaustion) and no
     single dispatch grows — every speculative segment is its own device
     program under the same ``dispatch_segments`` watchdog cap.
+
+    ``seg_flops`` (optional): model flops of ONE segment, used to bill
+    dispatched/speculated/discarded work into the metrics registry
+    (``dispatch.flops``, ``speculation.flops``,
+    ``speculation.discarded_flops`` — doc/observability.md); segment
+    counts are billed regardless.
 
     ``check_incoming=True`` additionally evaluates the INCOMING
     solution's stats first and returns it untouched when it already says
@@ -334,7 +359,7 @@ def continue_frozen(run_segment, sol, seg_f, budget, all_done=None,
     if pipeline and overlap >= 1:
         return _continue_frozen_pipelined(
             run_segment, sol, seg_f, budget, _stats_launch, _stats_read,
-            plateau_rtol, check_incoming, overlap)
+            plateau_rtol, check_incoming, overlap, seg_flops)
 
     # ---- serial protocol --------------------------------------------------
     if check_incoming:
@@ -351,7 +376,15 @@ def continue_frozen(run_segment, sol, seg_f, budget, all_done=None,
     # abort a budget that was still making progress
     stall = 0
     while budget > 0:
-        sol = run_segment(sol.raw)
+        # payload attach is guarded so the disabled path builds no dict
+        # (the module contract: hot sites stay allocation-free when off)
+        with _trace.span("dispatch", "segment") as _sp:
+            if _trace.enabled():
+                _sp.add(seg_f=seg_f)
+            sol = run_segment(sol.raw)
+        _metrics.inc("dispatch.segments")
+        if seg_flops:
+            _metrics.inc("dispatch.flops", seg_flops)
         budget -= seg_f
         done, worst = _stats_read(sol, _stats_launch(sol))
         if done:
@@ -369,7 +402,7 @@ def continue_frozen(run_segment, sol, seg_f, budget, all_done=None,
 
 def _continue_frozen_pipelined(run_segment, sol, seg_f, budget,
                                stats_launch, stats_read, plateau_rtol,
-                               check_incoming, overlap):
+                               check_incoming, overlap, seg_flops=None):
     """Speculative variant of the continuation loop (see
     :func:`continue_frozen`).  Dispatch order per segment is
     segment → its stop-stats program → successor segment, so each stats
@@ -377,18 +410,51 @@ def _continue_frozen_pipelined(run_segment, sol, seg_f, budget,
     segment k's verdict overlaps segment k+1's execution."""
     pend = collections.deque()    # (candidate, stats_device) to validate
 
-    def _fill(newest):
+    def _fill(newest, newest_read=False):
         """Dispatch speculative segments from the newest iterate until the
         pipeline is ``overlap`` deep or the budget is spent.  The budget
         is charged at DISPATCH time: a discarded segment is still paid
         for, so the total dispatched work can never exceed the serial
-        worst case."""
+        worst case.
+
+        Speculation billing: a dispatch is speculative iff its SOURCE
+        iterate's stop verdict is unread at dispatch time — entries on
+        ``pend`` always are, and ``newest`` is unless the caller just
+        read it (``newest_read``; only the check-incoming seed).  At the
+        production ``overlap=1`` every steady-state dispatch launches
+        from the just-popped candidate BEFORE its verdict fetch — that
+        is the overlap, and it is speculative."""
         nonlocal budget
         while len(pend) < overlap and budget > 0:
+            speculative = bool(pend) or not newest_read
             src = pend[-1][0] if pend else newest
-            cand = run_segment(src.raw)
+            with _trace.span("dispatch", "segment") as _sp:
+                if _trace.enabled():
+                    _sp.add(seg_f=seg_f, speculative=speculative)
+                cand = run_segment(src.raw)
+            _metrics.inc("dispatch.segments")
+            if seg_flops:
+                _metrics.inc("dispatch.flops", seg_flops)
+            if speculative:
+                _metrics.inc("speculation.segments")
+                if seg_flops:
+                    _metrics.inc("speculation.flops", seg_flops)
             budget -= seg_f
             pend.append((cand, stats_launch(cand)))
+
+    def _discard():
+        """Bill the in-flight speculative segments a stop verdict just
+        invalidated (the work was dispatched and paid for — the billing
+        contract — but its results are dropped)."""
+        if not pend:
+            return
+        _metrics.inc("speculation.discarded_segments", len(pend))
+        if seg_flops:
+            _metrics.inc("speculation.discarded_flops",
+                         len(pend) * seg_flops)
+        if _trace.enabled():
+            _trace.instant("dispatch", "speculation_discard",
+                           segments=len(pend))
 
     # the incoming iterate's stats are launched BEFORE any speculative
     # dispatch (the stats program must not queue behind one)
@@ -405,9 +471,13 @@ def _continue_frozen_pipelined(run_segment, sol, seg_f, budget,
         if done:
             return sol
         best = worst if plateau_rtol else None
-        _fill(sol)
+        _fill(sol, newest_read=True)   # seed verdict just read: confirmed
     else:
-        _fill(sol)
+        # the first dispatch from the incoming iterate is MANDATORY work
+        # the serial protocol performs identically (it has no incoming
+        # verdict to read either) — billing it as speculation would
+        # overstate the pipeline's waste vs serial
+        _fill(sol, newest_read=True)
         best = (stats_read(sol, seed_dev, overlapped=bool(pend))[1]
                 if plateau_rtol else None)
     stall = 0
@@ -422,11 +492,13 @@ def _continue_frozen_pipelined(run_segment, sol, seg_f, budget,
             break
         done, worst = stats_read(cand, sdev, overlapped=True)
         if done:
-            break                 # in-flight speculation discarded
+            _discard()            # in-flight speculation discarded
+            break
         if plateau_rtol:
             if worst > (1.0 - plateau_rtol) * best:
                 stall += 1
                 if stall >= 2:
+                    _discard()
                     break
             else:
                 stall = 0
@@ -435,14 +507,15 @@ def _continue_frozen_pipelined(run_segment, sol, seg_f, budget,
 
 
 def _continue_frozen(frozen_fn, args, factors, sol, st_f, seg_f, budget,
-                     pipeline=False, check_incoming=False, **kw):
+                     pipeline=False, check_incoming=False, seg_flops=None,
+                     **kw):
     """Host-path adapter for :func:`continue_frozen`."""
     return continue_frozen(
         lambda warm: frozen_fn(*args, factors, settings=st_f, warm=warm,
                                **kw),
         sol, seg_f, budget,
         plateau_rtol=st_f.segment_plateau_rtol, pipeline=pipeline,
-        check_incoming=check_incoming)
+        check_incoming=check_incoming, seg_flops=seg_flops)
 
 
 def solve_factored_segmented(frozen_fn, factored_fn, args, settings,
@@ -472,14 +545,20 @@ def solve_factored_segmented(frozen_fn, factored_fn, args, settings,
                 else None)
 
     if seg_r >= settings.max_iter and seg_f >= settings.max_iter:
-        sol, factors = factored_fn(*args, settings=settings, warm=warm)
+        with _trace.span("dispatch", "adaptive_solve"):
+            sol, factors = factored_fn(*args, settings=settings, warm=warm)
         return sol, factors, _conv(sol)
+    _segmenting_events(S, n, m, seg_r, seg_f)
     st_r = dataclasses.replace(settings, max_iter=seg_r)
     st_f = seg_settings(settings, seg_f)
-    sol, factors = factored_fn(*args, settings=st_r, warm=warm)
+    with _trace.span("dispatch", "adaptive_segment") as _sp:
+        if _trace.enabled():
+            _sp.add(S=S, seg_r=seg_r)
+        sol, factors = factored_fn(*args, settings=st_r, warm=warm)
     sol = _continue_frozen(frozen_fn, args, factors, sol, st_f, seg_f,
                            refresh_budget(settings, seg_r),
-                           pipeline=pipeline_enabled(settings, S, n, m))
+                           pipeline=pipeline_enabled(settings, S, n, m),
+                           seg_flops=_seg_flops(args, shared, seg_f))
     if not shared and settings.polish and settings.polish_passes:
         # dense-path parity with the one-dispatch adaptive solve, which
         # polishes its final iterate; frozen continuations don't
@@ -518,15 +597,21 @@ def solve_frozen_segmented(frozen_fn, args, factors, settings, warm=None,
                 else None)
 
     if seg_f >= settings.max_iter:
-        sol = frozen_fn(*args, factors, settings=settings, warm=warm)
+        with _trace.span("dispatch", "frozen_solve"):
+            sol = frozen_fn(*args, factors, settings=settings, warm=warm)
         return sol, _conv(sol)
+    _segmenting_events(S, n, m, seg_r, seg_f)
     st_f = seg_settings(settings, seg_f)
-    sol = frozen_fn(*args, factors, settings=st_f, warm=warm)
+    with _trace.span("dispatch", "frozen_segment") as _sp:
+        if _trace.enabled():
+            _sp.add(S=S, seg_f=seg_f)
+        sol = frozen_fn(*args, factors, settings=st_f, warm=warm)
     # check_incoming replaces the separate first-dispatch iters fetch the
     # serial protocol used to inline here (single-fetch stop_stats; the
     # pipelined policy overlaps every LATER segment's verdict)
     sol = _continue_frozen(frozen_fn, args, factors, sol, st_f, seg_f,
                            settings.max_iter - seg_f,
                            pipeline=pipeline_enabled(settings, S, n, m),
-                           check_incoming=True)
+                           check_incoming=True,
+                           seg_flops=_seg_flops(args, shared, seg_f))
     return sol, _conv(sol)

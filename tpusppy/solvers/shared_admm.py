@@ -41,6 +41,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..obs import metrics as _metrics
 from .admm import (ADMMSettings, BatchSolution, BIG, _clean_bounds,
                    _done_mask, _explicit_inverse, _frozen_sweep_phases,
                    _plateau_update)
@@ -448,6 +449,9 @@ def _scale_shared(c, q2, A, cl, cu, lb, ub, D, E, cost, warm, dt):
 
 def _solve_shared_impl(c, q2, A, cl, cu, lb, ub, settings, warm,
                        want_factors=False):
+    # TRACE-time counter (wrappers are jitted; this body runs only while
+    # XLA builds the program): one per adaptive shared-A program compiled
+    _metrics.inc("shared_admm.adaptive_programs")
     dt = settings.jdtype()
     c, q2, A, cl, cu, lb, ub, masks = _prep_shared(
         c, q2, A, cl, cu, lb, ub, settings)
@@ -601,6 +605,8 @@ def _solve_shared_frozen_impl(c, q2, A, cl, cu, lb, ub,
     full-precision refinement phase on the same factors.  ``allow_pallas``
     permits the fused shared-A Pallas kernel (single-controller callers
     only — a pallas_call cannot be auto-partitioned over a mesh)."""
+    # TRACE-time counter: one per frozen shared-A program compiled
+    _metrics.inc("shared_admm.frozen_programs")
     dt = settings.jdtype()
     c, q2, A, cl, cu, lb, ub, _ = _prep_shared(
         c, q2, A, cl, cu, lb, ub, settings, want_masks=False)

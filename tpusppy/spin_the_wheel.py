@@ -23,6 +23,7 @@ import numpy as np
 
 from . import global_toc
 from .cylinders.spcommunicator import WindowFabric
+from .obs import trace as _trace
 
 
 class WheelSpinner:
@@ -86,23 +87,29 @@ class WheelSpinner:
         threads = []
         errors = []
 
-        def spoke_runner(comm):
+        def spoke_runner(comm, track):
+            # each cylinder thread is its own trace timeline — the
+            # per-cylinder rows of the Perfetto view (doc/observability.md)
+            _trace.set_thread_track(track)
             try:
                 comm.main()
             except Exception as e:          # surface spoke crashes at join
                 errors.append((comm.__class__.__name__, e))
 
-        for comm in spoke_comms:
+        for i, comm in enumerate(spoke_comms):
             t = threading.Thread(
-                target=spoke_runner, args=(comm,),
+                target=spoke_runner,
+                args=(comm, f"spoke{i + 1}:{comm.__class__.__name__}"),
                 name=comm.__class__.__name__, daemon=True,
             )
             t.start()
             threads.append(t)
 
+        _trace.set_thread_track("hub")
         try:
             hub_comm.main()
         finally:
+            _trace.set_thread_track(None)
             hub_comm.send_terminate()
             # construction + hub loop: gap-based termination happened HERE;
             # the spoke teardown below (final bound-tightening passes,
@@ -147,6 +154,9 @@ class WheelSpinner:
         self.BestOuterBound = hub_comm.BestOuterBound
         self.local_nonant_cache = self._best_nonant_cache()
         self._write_result_sidecar()
+        # a traced wheel banks its artifact NOW (not at interpreter exit:
+        # the driver may SIGKILL a lingering process)
+        _trace.flush_if_enabled()
         return self
 
     def _write_result_sidecar(self):

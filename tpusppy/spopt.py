@@ -20,6 +20,8 @@ import time
 import numpy as np
 
 from . import global_toc
+from .obs import metrics as _metrics
+from .obs import trace as _trace
 from .spbase import SPBase
 from .solvers import admm, hostsync
 
@@ -319,7 +321,8 @@ class SPOpt(SPBase):
         A_d, cl_d, cu_d = self._device_consts(self.admm_settings.jdtype())
         slot = {"warm": self._warm, "factors": self._factors,
                 "sig": self._factors_sig, "age": self._factors_age,
-                "ref_worst": getattr(self, "_factors_ref_worst", None)}
+                "ref_worst": getattr(self, "_factors_ref_worst", None),
+                "n_div_prev": getattr(self, "_n_div_prev", 0)}
         sol, meas = self._solve_amortized(
             (q, q2, A_d, cl_d, cu_d, lb, ub), slot, warm, None,
             shared=shared)
@@ -328,6 +331,7 @@ class SPOpt(SPBase):
         self._factors_sig = slot["sig"]
         self._factors_age = slot["age"]
         self._factors_ref_worst = slot.get("ref_worst")
+        self._n_div_prev = slot.get("n_div_prev", 0)
         # everything the iteration reads came back in the ONE packed fetch
         # _solve_amortized already performed (doc/pipeline.md)
         self.local_x = meas["x"]
@@ -391,10 +395,14 @@ class SPOpt(SPBase):
             # dispatches (the remote TPU worker kills ~60s+ executions);
             # want_converged=False — the convergence vote rides the packed
             # measurement below instead of a separate done fetch
-            cand, _ = segmented.solve_frozen_segmented(
-                frozen_fn, args, slot["factors"], self.admm_settings,
-                warm=slot["warm"], want_converged=False)
-            meas_c = self._fetch_measure(cand)
+            with _trace.span(None, "solve.frozen") as _sp:
+                cand, _ = segmented.solve_frozen_segmented(
+                    frozen_fn, args, slot["factors"], self.admm_settings,
+                    warm=slot["warm"], want_converged=False)
+                meas_c = self._fetch_measure(cand)
+                if _trace.enabled():   # payload dicts only when tracing
+                    _sp.add(iters=meas_c["iters"],
+                            all_done=meas_c["all_done"])
             worst_c = float(max(np.max(meas_c["pri"]),
                                 np.max(meas_c["dua"])))
             if admm.precision_guard_trips(
@@ -404,12 +412,18 @@ class SPOpt(SPBase):
                 # solve parked far above the family's full-precision floor
                 # — fall back to the full-precision frozen program on the
                 # SAME cached factors (no refactorization)
+                _metrics.inc("precision.guard_trips")
+                if _trace.enabled():
+                    _trace.instant(None, "precision_guard_trip",
+                                   worst=worst_c,
+                                   ref_worst=slot.get("ref_worst"))
                 st_full = dataclasses.replace(self.admm_settings,
                                               sweep_precision="highest")
-                cand, _ = segmented.solve_frozen_segmented(
-                    frozen_fn, args, slot["factors"], st_full,
-                    warm=slot["warm"], want_converged=False)
-                meas_c = self._fetch_measure(cand)
+                with _trace.span(None, "solve.frozen_full_precision"):
+                    cand, _ = segmented.solve_frozen_segmented(
+                        frozen_fn, args, slot["factors"], st_full,
+                        warm=slot["warm"], want_converged=False)
+                    meas_c = self._fetch_measure(cand)
             # accept when the sweep budget sufficed (converged to eps) OR
             # every scenario already sits inside the rescue-tolerance
             # ladder: an adaptive re-solve of a plateaued batch (UC prox
@@ -434,14 +448,15 @@ class SPOpt(SPBase):
             if st_adpt.sweep_precision not in (None, "highest"):
                 st_adpt = dataclasses.replace(st_adpt,
                                               sweep_precision="highest")
-            sol, factors, _ = segmented.solve_factored_segmented(
-                frozen_fn, factored_fn, args, st_adpt,
-                warm=slot.get("warm") if warm else None, shared=shared,
-                want_converged=False)
-            slot["factors"] = factors
-            slot["sig"] = sig
-            slot["age"] = 1
-            meas = self._fetch_measure(sol)
+            with _trace.span(None, "solve.refresh"):
+                sol, factors, _ = segmented.solve_factored_segmented(
+                    frozen_fn, factored_fn, args, st_adpt,
+                    warm=slot.get("warm") if warm else None, shared=shared,
+                    want_converged=False)
+                slot["factors"] = factors
+                slot["sig"] = sig
+                slot["age"] = 1
+                meas = self._fetch_measure(sol)
             # full-precision residual floor of this family at this
             # operating point — the mixed-precision guard's reference
             slot["ref_worst"] = float(
@@ -449,6 +464,22 @@ class SPOpt(SPBase):
             sol, meas = self._rescue_stragglers(
                 sol, args[0], args[1], args[5], args[6],
                 batch=rescue_batch, meas=meas)
+        # shared-A divergence guard observability: frozen (exploded)
+        # scenarios surface as non-finite residuals in the packed
+        # measurement — count them so a run quietly degrading to frozen
+        # iterates is visible in the flight recorder, not just in a
+        # failed convergence assertion three reruns later.  Billed on
+        # the INCREASE over this slot's previous solve only: a frozen
+        # scenario stays non-finite every subsequent iteration, and
+        # re-counting it would inflate the freeze count ~iterations-fold
+        n_div = int(np.count_nonzero(~np.isfinite(meas["pri"])))
+        new_div = n_div - slot.get("n_div_prev", 0)
+        slot["n_div_prev"] = n_div
+        if new_div > 0:
+            _metrics.inc("solve.divergence_freezes", new_div)
+            if _trace.enabled():
+                _trace.instant(None, "divergence_freeze", scenarios=new_div,
+                               total_frozen=n_div)
         slot["warm"] = (sol.x, sol.z, sol.y, sol.yx)
         return sol, meas
 

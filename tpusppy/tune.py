@@ -35,8 +35,20 @@ from typing import Any
 
 import numpy as np
 
+from .obs import metrics as _metrics
+from .obs import trace as _trace
 from .parallel import sharded
 from .solvers import segmented as segmented_solvers
+
+
+def _probe_event(kind: str, entry: dict):
+    """One autotune probe verdict onto the "tune" track + counters —
+    the autotuner's decisions (cadence picks, precision certifications,
+    pipeline enables) are exactly the knobs a perf regression hunt needs
+    on the timeline."""
+    _metrics.inc(f"tune.{kind}_probes")
+    if _trace.enabled():
+        _trace.instant("tune", kind, **entry)
 
 
 @dataclasses.dataclass
@@ -152,10 +164,12 @@ def autotune_fused(nonant_idx, settings, arr, state, mesh=None,
             # max_chunk is the caller's per-dispatch bound; even the
             # one-block probe of this candidate would exceed it
             table.append({"refresh_every": r, "skipped": "max_chunk"})
+            _probe_event("cadence", table[-1])
             continue
         cap = sharded.fused_iteration_cap(arr, settings, mesh, r)
         if cap < r:
             table.append({"refresh_every": r, "skipped": "static cap"})
+            _probe_event("cadence", table[-1])
             continue
         fused_probe = sharded.make_ph_fused_step(
             nonant_idx, settings, mesh, axis, chunk=r, refresh_every=r,
@@ -197,6 +211,7 @@ def autotune_fused(nonant_idx, settings, arr, state, mesh=None,
             entry["sweeps_per_iter"] = round(sweeps, 1)
         entry["iters_per_sec"] = round(rate, 4)
         table.append(entry)
+        _probe_event("cadence", entry)
         if best is None or rate > best[0]:
             best = (rate, c, r, sweeps)
         if time.time() - t_start > budget_s:
@@ -272,6 +287,9 @@ def autotune_fused(nonant_idx, settings, arr, state, mesh=None,
             table.append({"precision": mode,
                           "iters_per_sec": round(rate_m, 4),
                           "worst_residual": worst_m, "certified": bool(ok)})
+            _probe_event("precision", table[-1])
+            _metrics.inc("tune.precision_certified" if ok
+                         else "tune.precision_rejected")
             if ok and rate_m > best_rate:
                 best_rate = rate_m
                 pick = (rate_m, mode, sweeps_m, st_out, tr_m)
@@ -395,6 +413,9 @@ def autotune_pipeline(run_segment, sol, shape, seg_f, pay_factor=1.0,
     compute_secs = max(0.0, seg_secs - fetch_secs)
     enabled = compute_secs >= pay_factor * fetch_secs
     segmented.set_pipeline_policy(S, n, m, enabled)
+    _probe_event("pipeline", {"S": S, "n": n, "m": m, "enabled": enabled,
+                              "seg_secs": seg_secs,
+                              "fetch_secs": fetch_secs})
     res = PipelineTune(
         enabled=enabled, seg_secs=seg_secs, fetch_secs=fetch_secs,
         waste_flops=flops_model.speculation_flops(

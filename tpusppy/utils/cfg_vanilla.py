@@ -60,7 +60,18 @@ def _admm_solver_options(cfg) -> dict:
 
 
 def shared_options(cfg) -> dict:
-    """The option dict every cylinder starts from (cfg_vanilla.py:41-63)."""
+    """The option dict every cylinder starts from (cfg_vanilla.py:41-63).
+
+    Also the observability entry point for Config-driven CLIs: a truthy
+    ``cfg.tracing`` (see :meth:`Config.tracing_args`) arms the flight
+    recorder exactly like ``TPUSPPY_TRACE=<path>``, and ``cfg.log_level``
+    sets the ``tpusppy`` logger level."""
+    from ..obs import log as _obs_log
+    from ..obs import trace as _trace
+
+    _trace.maybe_enable_from_config(cfg)
+    if cfg.get("log_level"):
+        _obs_log.set_level(cfg.get("log_level"))
     shoptions = {
         "solver_name": cfg.get("solver_name"),
         "solver_options": _admm_solver_options(cfg),

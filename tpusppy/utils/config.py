@@ -200,6 +200,20 @@ class Config:
             False)
         add("auxilliary", "Free text for use by hackers (default '')", str, '')
 
+    def tracing_args(self):
+        """Observability knobs (tpusppy.obs): ``tracing`` names the
+        Perfetto trace path — a truthy value turns the flight recorder on
+        (``tpusppy.obs.trace.maybe_enable_from_config``), equivalent to
+        the ``TPUSPPY_TRACE=<path>`` env knob; the report JSON lands next
+        to it as ``<path>.report.json``."""
+        add = self.add_to_config
+        add("tracing",
+            "Path for a Perfetto trace of the run (None: tracing off)",
+            str, None)
+        add("log_level",
+            "tpusppy log level (TPUSPPY_LOG_LEVEL overrides; default INFO)",
+            str, None)
+
     def ph_args(self):
         add = self.add_to_config
         # adaptive per-slot rho (NormRhoUpdater, the reference's
