@@ -59,6 +59,14 @@ Set BENCH_UC=1 for the UC metric alone (see bench_uc.py).
 BENCH_SMOKE=1 shrinks everything (tiny S, pinned cadence, no UC) for the
 CI kill-safety test.
 
+``--resume`` (with ``--ladder``) continues a killed ladder run
+(tpusppy.resilience): finished rungs reload from the atomic state file
+under BENCH_RESUME_DIR (default BENCH_TRACE_DIR/bench_resume), the
+interrupted rung's WHEEL warm-starts from its own checkpoint directory
+(BENCH_UC_CKPT_DIR, wired automatically), and the autotuner's verdicts
+persist via TPUSPPY_TUNE_CACHE — so a SIGKILL costs at most one
+checkpoint cadence of wheel progress, not the rung.
+
 ``--trace`` (or BENCH_TRACE=1) arms the flight recorder (tpusppy.obs):
 every finished segment dumps ``BENCH_TRACE_DIR/bench_<tag>.perfetto.json``
 (open at ui.perfetto.dev) plus a ``.report.json`` summary, the parsed
@@ -232,9 +240,14 @@ def main():
     # flight recorder rides the run (tpusppy.obs) — one Perfetto JSON +
     # report per segment (BENCH_TRACE_DIR), plus a small traced farmer
     # WHEEL segment whose gap-vs-wall array the report carries
+    # --resume: the ladder continues from its banked rung state file and
+    # each rung's wheel warm-starts from its own checkpoint dir
+    # (tpusppy.resilience) — a SIGKILLed bench re-run picks up where the
+    # kill landed instead of restarting the rung
     child_args = ["--workload"] + (
         ["--ladder"] if "--ladder" in sys.argv[1:] else []) + (
-        ["--trace"] if "--trace" in sys.argv[1:] else [])
+        ["--trace"] if "--trace" in sys.argv[1:] else []) + (
+        ["--resume"] if "--resume" in sys.argv[1:] else [])
 
     tpu_error = None
     if not force_cpu:
@@ -465,6 +478,57 @@ def ladder_workload():
     line = {"metric": "uc_certified_ladder", "unit": "rungs", "value": 0,
             "rungs": entries}
 
+    # --resume (tpusppy.resilience): rung results bank into a state file
+    # after each rung, each rung's WHEEL checkpoints into its own dir, and
+    # the autotuner's verdicts persist — a killed ladder re-run skips the
+    # finished rungs, warm-starts the interrupted rung's wheel from its
+    # last checkpoint, and pays no warmup probes again.
+    resuming = "--resume" in sys.argv[1:]
+    state_dir = os.environ.get(
+        "BENCH_RESUME_DIR",
+        os.path.join(os.environ.get("BENCH_TRACE_DIR", "."),
+                     "bench_resume"))
+    os.makedirs(state_dir, exist_ok=True)
+    state_path = os.path.join(state_dir, "ladder_state.json")
+    os.environ.setdefault("TPUSPPY_TUNE_CACHE",
+                          os.path.join(state_dir, "tune_cache.json"))
+    # resume is EXPLICIT end to end: without --resume a fresh run must be
+    # a fresh measurement, so stale rung state (the banked result file
+    # AND the rungs' wheel checkpoints) is wiped — a prior run's final
+    # checkpoint silently warm-starting a "cold" wheel would bank
+    # near-instant time-to-gap numbers as if measured cold.  The tune
+    # cache survives (verdicts are measurement-neutral warmup skips).
+    os.environ["BENCH_UC_RESUME"] = "1" if resuming else "0"
+    done_rungs = {}
+    if resuming and os.path.exists(state_path):
+        try:
+            with open(state_path) as f:
+                done_rungs = {int(k): v
+                              for k, v in json.load(f)["rungs"].items()}
+            log(f"ladder resume: rungs already banked: "
+                f"{sorted(done_rungs)}")
+        except (OSError, ValueError, KeyError) as e:
+            log(f"ladder resume: unreadable state file ({e!r}) — cold run")
+    if not resuming:
+        import shutil
+
+        for stale in [state_path] + [
+                os.path.join(state_dir, d) for d in os.listdir(state_dir)
+                if d.startswith("rung_S")]:
+            if os.path.isdir(stale):
+                shutil.rmtree(stale, ignore_errors=True)
+            elif os.path.exists(stale):
+                os.remove(stale)
+
+    def _bank_state():
+        """Atomic rung-state write (the checkpoint engine's shared
+        helper) so a kill can't tear the resume file."""
+        from tpusppy.resilience.checkpoint import atomic_write_json
+
+        atomic_write_json(state_path, {
+            "rungs": {str(e["S"]): e for e in entries
+                      if "error" not in e and "skipped" not in e}})
+
     def _n_ok():
         """Completed rungs — errored and deadline-skipped ones excluded."""
         return len([e for e in entries
@@ -473,6 +537,13 @@ def ladder_workload():
     import bench_uc
 
     for i, S in enumerate(rungs):
+        if S in done_rungs:
+            m = dict(done_rungs[S], resumed_from_state=True)
+            entries.append(m)
+            line["value"] = _n_ok()
+            emit_partial(line)
+            log(f"ladder rung S={S}: banked result reloaded (--resume)")
+            continue
         remaining = deadline - time.time()
         if remaining < 120.0:
             entries.extend({"S": s, "skipped": "deadline"}
@@ -483,6 +554,10 @@ def ladder_workload():
         rung_budget = remaining / (len(rungs) - i)
         os.environ["BENCH_UC_SCENS"] = str(S)
         os.environ["BENCH_UC_WHEEL_SCENS"] = str(S)
+        # mid-rung continuation: the rung's wheel checkpoints here, and a
+        # resumed run warm-starts from the newest snapshot (bench_uc)
+        os.environ["BENCH_UC_CKPT_DIR"] = os.path.join(
+            state_dir, f"rung_S{S}")
         os.environ["BENCH_CHILD_DEADLINE"] = str(
             time.time() + rung_budget)
         # the per-rung budget must actually bind: uc_metrics' deadline-
@@ -516,6 +591,10 @@ def ladder_workload():
         entries.append(m)
         line["value"] = _n_ok()
         emit_partial(line)
+        try:
+            _bank_state()   # the rung is durable the moment it finishes
+        except OSError as e:
+            log(f"ladder resume state write failed (kept going): {e!r}")
         # drop the rung's device residency before the next shape compiles
         import gc
         import jax

@@ -422,9 +422,31 @@ def uc_metrics(progress=None, wheel=True):
 
     hub_iters = int(os.environ.get(
         "BENCH_UC_PH_ITERS", "16" if full_scale else "40"))
+    # resilience (tpusppy.resilience): with BENCH_UC_CKPT_DIR set (the
+    # ladder's --resume path wires it per rung) the wheel checkpoints
+    # asynchronously and a re-run warm-starts from the newest snapshot —
+    # a SIGKILLed rung loses at most one checkpoint cadence, not the rung
+    hub_opts = {"rel_gap": gap_target}
+    wheel_resume = None
+    ckpt_dir = os.environ.get("BENCH_UC_CKPT_DIR")
+    if ckpt_dir:
+        hub_opts.update(
+            checkpoint_dir=ckpt_dir,
+            checkpoint_every_secs=float(
+                os.environ.get("BENCH_UC_CKPT_SECS", "60")),
+            checkpoint_every_iters=int(
+                os.environ.get("BENCH_UC_CKPT_ITERS", "0")) or None)
+        # resuming is EXPLICIT (BENCH_UC_RESUME, set by bench.py's
+        # --resume): a stale checkpoint must never silently warm-start a
+        # run that claims to be a cold measurement
+        if os.environ.get("BENCH_UC_RESUME") == "1":
+            from tpusppy.resilience import checkpoint as _ckpt
+            if _ckpt.latest(ckpt_dir) is not None:
+                wheel_resume = ckpt_dir
+                log(f"uc wheel: resuming from checkpoint dir {ckpt_dir}")
     hub_dict = {
         "hub_class": PHHub,
-        "hub_kwargs": {"options": {"rel_gap": gap_target}},
+        "hub_kwargs": {"options": hub_opts},
         "opt_class": PH,
         "opt_kwargs": okw(hub_iters),
     }
@@ -486,7 +508,7 @@ def uc_metrics(progress=None, wheel=True):
     def _spin():
         t0 = time.time()
         try:
-            ws = WheelSpinner(hub_dict, spokes).spin()
+            ws = WheelSpinner(hub_dict, spokes, resume=wheel_resume).spin()
         except Exception as e:       # error != timeout; surface which
             result["error"] = repr(e)
             return

@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""Kill-resume smoke: SIGKILL a live wheel mid-run, resume, compare gaps.
+
+The nightly CI acceptance for the resilience subsystem
+(doc/resilience.md), runnable locally too::
+
+    JAX_PLATFORMS=cpu python scripts/kill_resume_smoke.py
+
+Three legs, each a REAL OS process running a farmer wheel (PH hub +
+Lagrangian outer + XhatShuffle inner):
+
+1. **golden** — uninterrupted run to a certified rel_gap <= 1e-3; its
+   final gap is the bar.
+2. **victim** — the same wheel with an impossible gap target and
+   per-iteration checkpointing; the parent waits until >= KILL_AFTER
+   checkpoints exist, then SIGKILLs it (no cleanup, no atexit — the
+   preemption posture).
+3. **resume** — the golden configuration warm-started from the victim's
+   checkpoint directory; it must certify a rel_gap no worse than the
+   golden run's (+ tolerance dust) with bounds monotone w.r.t. the
+   snapshot it resumed from.
+
+Exit code 0 = pass.  The worker legs are this same file with
+``--worker`` (config via SMOKE_* env), so the smoke has no test-harness
+dependencies.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+KILL_AFTER = int(os.environ.get("SMOKE_KILL_AFTER_CKPTS", "3"))
+
+
+def log(msg):
+    print(f"kill-resume-smoke: {msg}", file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Worker leg (child process)
+# ---------------------------------------------------------------------------
+def worker():
+    sys.path.insert(0, REPO)
+    from tpusppy.cylinders import (LagrangianOuterBound, PHHub,
+                                   XhatShuffleInnerBound)
+    from tpusppy.models import farmer
+    from tpusppy.opt.ph import PH
+    from tpusppy.phbase import PHBase
+    from tpusppy.spin_the_wheel import WheelSpinner
+    from tpusppy.xhat_eval import Xhat_Eval
+
+    mode = os.environ["SMOKE_MODE"]            # golden | victim | resume
+    ckdir = os.environ["SMOKE_DIR"]
+    n = int(os.environ.get("SMOKE_SCENS", "3"))
+
+    def okw(iters):
+        return {
+            "options": {"defaultPHrho": 1.0, "PHIterLimit": iters,
+                        "convthresh": -1.0,
+                        "xhat_looper_options": {"scen_limit": 3}},
+            "all_scenario_names": farmer.scenario_names_creator(n),
+            "scenario_creator": farmer.scenario_creator,
+            "scenario_creator_kwargs": {"num_scens": n},
+        }
+
+    hub_options = {"rel_gap": 1e-3, "abs_gap": 1.0, "linger_secs": 60.0}
+    # the resume leg's TOTAL budget is set by the parent relative to the
+    # kill iteration (SMOKE_RESUME_ITERS), so a fast box that banked many
+    # iterations before the SIGKILL still genuinely CONTINUES the run
+    iters = int(os.environ.get("SMOKE_RESUME_ITERS", "40"))
+    resume = None
+    if mode == "victim":
+        # impossible target + per-iteration checkpoints: the run CANNOT
+        # finish before the parent's SIGKILL lands
+        hub_options = {"rel_gap": 1e-12, "linger_secs": 0.0,
+                       "checkpoint_dir": ckdir,
+                       "checkpoint_every_iters": 1,
+                       "checkpoint_every_secs": None}
+        iters = 100000
+    elif mode == "resume":
+        resume = ckdir
+    hub = {"hub_class": PHHub, "hub_kwargs": {"options": hub_options},
+           "opt_class": PH, "opt_kwargs": okw(iters)}
+    spokes = [
+        {"spoke_class": LagrangianOuterBound, "opt_class": PHBase,
+         "opt_kwargs": okw(60)},
+        {"spoke_class": XhatShuffleInnerBound, "opt_class": Xhat_Eval,
+         "opt_kwargs": okw(60)},
+    ]
+    ws = WheelSpinner(hub, spokes, resume=resume).spin()
+    gap = ((ws.BestInnerBound - ws.BestOuterBound)
+           / abs(ws.BestOuterBound))
+    with open(os.path.join(ckdir, f"result_{mode}.json"), "w") as f:
+        json.dump({"inner": ws.BestInnerBound, "outer": ws.BestOuterBound,
+                   "rel_gap": gap,
+                   "resumed_from": ws.resumed_from}, f)
+    print(json.dumps({"mode": mode, "rel_gap": gap}), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Orchestration (parent)
+# ---------------------------------------------------------------------------
+def _run_leg(mode, ckdir, timeout=900):
+    env = dict(os.environ, SMOKE_MODE=mode, SMOKE_DIR=ckdir,
+               PYTHONPATH=REPO)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen([sys.executable, os.path.abspath(__file__),
+                             "--worker"], env=env), timeout
+
+
+def _wait(proc, timeout, leg):
+    try:
+        rc = proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        raise SystemExit(f"{leg} leg timed out after {timeout}s")
+    if rc != 0:
+        raise SystemExit(f"{leg} leg failed rc={rc}")
+
+
+def main():
+    import tempfile
+
+    from tpusppy.resilience import checkpoint  # parent: pure-host import
+
+    base = tempfile.mkdtemp(prefix="kill_resume_smoke_")
+    log(f"workdir {base}")
+
+    golden_dir = os.path.join(base, "golden")
+    os.makedirs(golden_dir)
+    proc, t = _run_leg("golden", golden_dir)
+    _wait(proc, t, "golden")
+    golden = json.load(open(os.path.join(golden_dir, "result_golden.json")))
+    log(f"golden rel_gap={golden['rel_gap']:.3e}")
+    assert golden["rel_gap"] <= 1e-3 + 1e-12, "golden run did not certify"
+
+    victim_dir = os.path.join(base, "victim")
+    os.makedirs(victim_dir)
+    proc, _ = _run_leg("victim", victim_dir)
+    def _banked_iteration():
+        """Newest checkpointed iteration (0 when none yet) — iteration,
+        not file count: the manager prunes to keep=3 files, so counting
+        files would cap KILL_AFTER at the retention depth."""
+        try:
+            ck = checkpoint.load_latest(victim_dir)
+            return 0 if ck is None else ck.iteration
+        except Exception:        # mid-write transient: just poll again
+            return 0
+
+    t0 = time.time()
+    try:
+        while _banked_iteration() < KILL_AFTER:
+            if proc.poll() is not None:
+                raise SystemExit(
+                    f"victim exited early rc={proc.returncode} — cannot "
+                    "SIGKILL a finished run")
+            if time.time() - t0 > 600:
+                raise SystemExit("victim produced no checkpoints in 600s")
+            time.sleep(0.2)
+        os.kill(proc.pid, signal.SIGKILL)    # the preemption, for real
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    ck = checkpoint.load_latest(victim_dir)
+    log(f"victim SIGKILLed at checkpoint iteration {ck.iteration} "
+        f"(outer={ck.best_outer:.2f} inner={ck.best_inner:.2f})")
+    assert ck.iteration >= KILL_AFTER
+
+    # the resumed wheel must RUN, not just reload: give it a real
+    # iteration budget past the snapshot whatever speed the box killed at
+    os.environ["SMOKE_RESUME_ITERS"] = str(max(40, ck.iteration + 20))
+    proc, t = _run_leg("resume", victim_dir)
+    _wait(proc, t, "resume")
+    res = json.load(open(os.path.join(victim_dir, "result_resume.json")))
+    log(f"resumed rel_gap={res['rel_gap']:.3e} "
+        f"(golden {golden['rel_gap']:.3e})")
+
+    assert res["resumed_from"] == ck.iteration, \
+        f"resume did not pick up the snapshot: {res['resumed_from']}"
+    # bounds monotone across the restart
+    assert res["outer"] >= ck.best_outer - 1e-9, "outer bound regressed"
+    assert res["inner"] <= ck.best_inner + 1e-9, "inner bound regressed"
+    # certified no worse than the uninterrupted golden
+    assert res["rel_gap"] <= max(golden["rel_gap"], 1e-3) + 1e-9, \
+        f"resumed gap {res['rel_gap']} worse than golden {golden['rel_gap']}"
+    log("PASS")
+
+
+if __name__ == "__main__":
+    if "--worker" in sys.argv[1:]:
+        worker()
+    else:
+        sys.path.insert(0, REPO)
+        main()

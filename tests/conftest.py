@@ -43,18 +43,24 @@ def _obs_isolation():
     survive the test body) would keep counting fetches into a later
     test's ``host_sync_count`` assertion, and trace/metrics are
     process-global by design.  Reset all three around every test."""
+    from tpusppy import tune
     from tpusppy.obs import metrics, trace
+    from tpusppy.resilience import faults
     from tpusppy.solvers import hostsync
 
     hostsync.reset()
     trace.disable()
     trace.reset(capacity=trace.DEFAULT_CAPACITY)
     metrics.reset()
+    faults.disarm()
+    tune.reset_persist()
     yield
     hostsync.reset()
     trace.disable()
     trace.reset(capacity=trace.DEFAULT_CAPACITY)
     metrics.reset()
+    faults.disarm()
+    tune.reset_persist()
 
 
 def pytest_collection_finish(session):

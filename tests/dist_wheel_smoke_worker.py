@@ -27,20 +27,43 @@ def main():
 
     n = int(os.environ.get("DIST_SCENS", "4"))
     names = farmer.scenario_names_creator(n)
+    base_options = {
+        "defaultPHrho": 1.0, "PHIterLimit": 3,
+        "linger_secs": 0.25,
+        "solver_options": {"dtype": "float64", "eps_abs": 1e-6,
+                           "eps_rel": 1e-6, "max_iter": 60,
+                           "restarts": 1, "scaling_iters": 2,
+                           "polish": False}}
+    # resilience smoke (DIST_CKPT_DIR): run 1 checkpoints (controller 0
+    # writes), run 2 RESUMES from the snapshot with a larger budget — the
+    # sharded-W restore (make_array_from_callback over the 2-process
+    # mesh) and the it_base continuation are exercised on the real
+    # multi-controller topology
+    ckpt_dir = os.environ.get("DIST_CKPT_DIR")
+    options = dict(base_options)
+    if ckpt_dir:
+        options.update(checkpoint_dir=ckpt_dir, checkpoint_every_iters=1,
+                       checkpoint_every_secs=None)
     res = distributed_wheel_hub(
         names, farmer.scenario_creator,
         scenario_creator_kwargs={"num_scens": n},
-        options={"defaultPHrho": 1.0, "PHIterLimit": 3,
-                 "linger_secs": 0.25,
-                 "solver_options": {"dtype": "float64", "eps_abs": 1e-6,
-                                    "eps_rel": 1e-6, "max_iter": 60,
-                                    "restarts": 1, "scaling_iters": 2,
-                                    "polish": False}},
-        fabric=None, spoke_roles=[])
-    print(json.dumps({
-        "pid": pid, "outer": res.BestOuterBound, "conv": res.conv,
-        "eobj": res.eobj, "iters": res.iters,
-    }), flush=True)
+        options=options, fabric=None, spoke_roles=[])
+    out = {"pid": pid, "outer": res.BestOuterBound, "conv": res.conv,
+           "eobj": res.eobj, "iters": res.iters}
+    if ckpt_dir:
+        # BARRIER before the resume leg: controller 0's writer thread must
+        # land the file before controller 1 looks for it (divergent
+        # it_base would desynchronize the collectives)
+        from tpusppy.parallel.dist_wheel import default_allgather
+        default_allgather()(1.0)
+        res2 = distributed_wheel_hub(
+            names, farmer.scenario_creator,
+            scenario_creator_kwargs={"num_scens": n},
+            options=dict(base_options, PHIterLimit=5, resume=ckpt_dir),
+            fabric=None, spoke_roles=[])
+        out.update(iters2=res2.iters, outer2=res2.BestOuterBound,
+                   conv2=res2.conv)
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

@@ -121,14 +121,7 @@ def test_read_voted_gives_up():
 # tier-1 smoke: 2-controller SPOKELESS hub, deterministic schedule
 # ---------------------------------------------------------------------------
 
-def test_two_process_hub_smoke():
-    """Fast (<~20 s) tier-1 coverage of the 2-process hub cylinder: the
-    cross-process PH collective, the replicated consensus fetch and the
-    voted termination decision run a BOUNDED deterministic schedule (tiny
-    farmer, 3 iterations, no spokes, no gap target) and both controllers
-    must report identical fully-reduced results.  This path found two
-    deadlock classes and previously had no routine (non-slow) coverage —
-    the full TCP-fabric wheel stays in the slow tier."""
+def _run_smoke_workers(extra_env, timeout):
     port = _free_port()
     script = os.path.join(REPO, "tests", "dist_wheel_smoke_worker.py")
     common = {
@@ -137,6 +130,7 @@ def test_two_process_hub_smoke():
         # >= global device count so every process owns real scenarios
         "DIST_SCENS": 8,
         "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        **extra_env,
     }
     procs = [
         subprocess.Popen([sys.executable, script],
@@ -148,7 +142,7 @@ def test_two_process_hub_smoke():
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=120)
+            out, err = p.communicate(timeout=timeout)
             assert p.returncode == 0, f"worker rc={p.returncode}\n{err[-3000:]}"
             outs.append(json.loads(
                 [ln for ln in out.splitlines() if ln.startswith("{")][-1]))
@@ -162,6 +156,41 @@ def test_two_process_hub_smoke():
     assert r0["eobj"] == r1["eobj"]
     assert r0["outer"] == r1["outer"]
     assert np.isfinite(r0["conv"]) and np.isfinite(r0["eobj"])
+    return r0, r1
+
+
+def test_two_process_hub_smoke():
+    """Fast (<~20 s) tier-1 coverage of the 2-process hub cylinder: the
+    cross-process PH collective, the replicated consensus fetch and the
+    voted termination decision run a BOUNDED deterministic schedule (tiny
+    farmer, 3 iterations, no spokes, no gap target) and both controllers
+    must report identical fully-reduced results.  This path found two
+    deadlock classes and previously had no routine (non-slow) coverage —
+    the full TCP-fabric wheel stays in the slow tier."""
+    _run_smoke_workers({}, timeout=120)
+
+
+@pytest.mark.slow
+def test_two_process_hub_checkpoint_resume(tmp_path):
+    """Resilience on the real 2-process mesh (tpusppy.resilience,
+    doc/resilience.md): run 1 checkpoints (controller 0 writes the
+    snapshots), then — same jax.distributed job, after a barrier — run 2
+    RESUMES with a larger budget, exercising the sharded-W restore
+    (make_array_from_callback) and the iteration-base continuation.
+    Slow tier: the two-leg worker doubles the collective lifetime, and
+    under full-suite CPU contention the coordination-service heartbeat
+    window is too easy to starve for a routine tier-1 spot."""
+    ckdir = str(tmp_path / "dist_ck")
+    r0, r1 = _run_smoke_workers({"DIST_CKPT_DIR": ckdir}, timeout=300)
+    # the resumed run continued the TOTAL iteration count (3 banked + 2
+    # more), identically on both controllers; the artifact is on disk
+    from tpusppy.resilience import checkpoint as _ckpt
+
+    assert r0["iters2"] == r1["iters2"] == 5
+    assert r0["conv2"] == r1["conv2"]
+    assert r0["outer2"] == r1["outer2"]
+    ck = _ckpt.load_latest(ckdir)
+    assert ck is not None and ck.iteration >= 3
 
 
 # ---------------------------------------------------------------------------

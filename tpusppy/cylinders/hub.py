@@ -35,6 +35,84 @@ class Hub(SPCommunicator):
         self.print_init = True
         self.stalled_iter_cnt = 0
         self.last_gap = inf
+        # resilience attachments (tpusppy.resilience): the wheel spinner
+        # wires a SpokeSupervisor (degradation) and a CheckpointManager
+        # (async snapshots) when configured; both stay None otherwise
+        self.supervisor = None
+        self._ckpt_mgr = None
+        self.latest_spoke_bounds = {}        # idx -> last bound read (meta)
+        self.resumed_from_iteration = None
+
+    # ---- resilience (tpusppy.resilience) ------------------------------------
+    def attach_supervisor(self, sup):
+        self.supervisor = sup
+
+    def attach_checkpointer(self, mgr):
+        self._ckpt_mgr = mgr
+
+    def seed_resume(self, ckpt):
+        """Re-seed the hub's bounds from a checkpoint (call after
+        ``setup_hub``).  Bound updates only ever improve on these, so the
+        certified gap trajectory is monotone across the restart.
+
+        Per-spoke bounds re-seed by their STORED kind ([kind, bound]
+        entries — the kind, not the resumed wheel's slot assignment,
+        decides whether a value may tighten the outer or the inner side,
+        so a reordered/trimmed spoke list can never install an outer
+        bound as an incumbent).  Kind-less legacy floats are skipped —
+        the global bests already carry their contribution."""
+        if np.isfinite(ckpt.best_outer):
+            self.OuterBoundUpdate(float(ckpt.best_outer), char='R')
+        if np.isfinite(ckpt.best_inner):
+            self.InnerBoundUpdate(float(ckpt.best_inner), char='R')
+        for key, entry in (ckpt.spoke_bounds or {}).items():
+            if not (isinstance(entry, (list, tuple)) and len(entry) == 2):
+                continue
+            kind, b = entry
+            try:
+                idx, b = int(key), float(b)
+            except (TypeError, ValueError):
+                continue
+            if not np.isfinite(b):
+                continue
+            self.latest_spoke_bounds[idx] = b
+            # idx only picks the display char, and only when the resumed
+            # slot still has the same role
+            if kind == "outer":
+                same = idx in self.outerbound_spoke_indices
+                self.OuterBoundUpdate(b, idx if same else None, char='R')
+            elif kind == "inner":
+                same = idx in self.innerbound_spoke_indices
+                self.InnerBoundUpdate(b, idx if same else None, char='R')
+        self.resumed_from_iteration = int(ckpt.iteration)
+
+    def _resilience_tick(self):
+        """Per-sync health + checkpoint pass: observe spoke liveness and
+        capture a snapshot when the cadence is due.  The snapshot reads
+        only host-resident PH state (capture_ph), so this adds zero
+        blocking fetches to the dispatch decision path."""
+        if self.supervisor is not None:
+            self.supervisor.observe()
+        if self._ckpt_mgr is not None:
+            from ..resilience import checkpoint as _ckpt
+            from ..resilience import supervisor as _sup
+
+            _sup.heartbeat("hub")
+            try:
+                self._ckpt_mgr.maybe_capture(
+                    self.current_iteration(),
+                    lambda: _ckpt.capture_ph(self.opt, hub=self))
+            except Exception as e:
+                # a capture failure (host OOM copying (S, K) arrays, a
+                # transfer-guard trip on an exotic opt) costs the run's
+                # RESUMABILITY, never the run — same policy as the write
+                # path and the final capture
+                _metrics.inc("checkpoint.capture_errors")
+                if not getattr(self, "_ckpt_err_warned", False):
+                    self._ckpt_err_warned = True
+                    global_toc(
+                        f"WARNING: checkpoint capture failed (run "
+                        f"continues, resumability degraded): {e!r}", True)
 
     # ---- spoke typing (hub.py:297-344) --------------------------------------
     def initialize_spoke_indices(self):
@@ -189,15 +267,19 @@ class Hub(SPCommunicator):
         return data, False
 
     def receive_outerbounds(self):
+        # lost spokes are still READ (a bound posted before death is
+        # valid); loss only stops the hub waiting on them (linger/join)
         for idx in self.outerbound_spoke_indices:
             data, is_new = self.hub_from_spoke(idx)
             if is_new:
+                self.latest_spoke_bounds[idx] = float(data[0])
                 self.OuterBoundUpdate(float(data[0]), idx)
 
     def receive_innerbounds(self):
         for idx in self.innerbound_spoke_indices:
             data, is_new = self.hub_from_spoke(idx)
             if is_new:
+                self.latest_spoke_bounds[idx] = float(data[0])
                 self.InnerBoundUpdate(float(data[0]), idx)
 
     def OuterBoundUpdate(self, new_bound, idx=None, char='*'):
@@ -277,11 +359,14 @@ class PHHub(Hub):
                 self.receive_outerbounds()
             if self.has_innerbound_spokes:
                 self.receive_innerbounds()
+        self._resilience_tick()
 
     sync_with_spokes = sync
 
     def is_converged(self):
-        if self.opt._iter == 1:
+        # first PAST-THE-BASE iteration: resumed runs offer the (re-derived)
+        # trivial bound too — the update keeps whichever is better
+        if self.opt._iter - getattr(self.opt, "_iter_base", 0) == 1:
             self.OuterBoundUpdate(self.opt.trivial_bound, char='T')
         # in-hub xhat extensions land their incumbents on the opt object
         bib = getattr(self.opt, "best_inner_bound", None)
@@ -325,6 +410,12 @@ class PHHub(Hub):
         t0 = time.time()
         last_trace = 0.0
         while time.time() - t0 < linger:
+            if self.supervisor is not None and self.supervisor.all_lost():
+                # nobody left to harvest from: idling out the linger
+                # budget would only delay the (already best-known) result
+                global_toc("Hub linger: all spokes lost — ending harvest",
+                           True)
+                break
             self._nudge_epoch = int((time.time() - t0) / max(nudge, 0.25))
             self.sync()
             # quiet convergence check (is_converged prints a trace row per
@@ -470,6 +561,7 @@ class LShapedHub(Hub):
             self.receive_outerbounds()
         if self.has_innerbound_spokes:
             self.receive_innerbounds()
+        self._resilience_tick()   # Benders roots have no W: capture skips
 
     def is_converged(self):
         # the Benders root objective is itself a valid outer bound

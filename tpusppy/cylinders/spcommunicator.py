@@ -24,6 +24,7 @@ import numpy as np
 
 from ..obs import metrics as _metrics
 from ..obs import trace as _trace
+from ..resilience import faults as _faults
 
 KILL_ID = -1
 
@@ -101,7 +102,10 @@ class Mailbox:
         """Reader-side Get: snapshot (payload copy, write_id)."""
         _CTR_GETS.inc(1)
         with self._lock:
-            return self._buf[:-1].copy(), int(self._buf[-1])
+            data, wid = self._buf[:-1].copy(), int(self._buf[-1])
+        if _faults.active():   # deterministic stale-write-id injection
+            wid = _faults.on_mailbox_get(self.name, wid)
+        return data, wid
 
     def kill(self):
         """Write the termination sentinel (write_id = -1, hub.py:438-450).

@@ -17,6 +17,8 @@ import time
 
 import numpy as np
 
+from ..resilience import faults as _faults
+from ..resilience import supervisor as _supervisor
 from .spcommunicator import KILL_ID, SPCommunicator
 
 
@@ -33,6 +35,11 @@ class Spoke(SPCommunicator):
     def __init__(self, spbase_object, strata_rank, fabric, options=None):
         super().__init__(spbase_object, strata_rank, fabric, options)
         self.remote_write_id = 0
+        self._recv_count = 0     # fresh hub payloads seen (fault-plan clock)
+        # gauge hoisted out of the ~500 Hz poll loop (the registry
+        # get-or-create costs a lock + dict probe per call)
+        self._hb_gauge = _supervisor.heartbeat_gauge(
+            f"spoke{self.strata_rank}")
 
     # lengths negotiated by WheelSpinner before mailbox construction
     def buffer_lengths(self) -> tuple[int, int]:
@@ -46,10 +53,17 @@ class Spoke(SPCommunicator):
         """Snapshot the hub's outbound payload; True when fresh
         (spoke.py:84-118 with the all-ranks-agree vote collapsed: one host
         thread per cylinder reads one consistent snapshot)."""
+        # liveness for the hub's supervisor: a spoke polling its mailbox
+        # is alive even when it has nothing new to Put
+        self._hb_gauge.set(time.monotonic())
         data, wid = self.fabric.to_spoke[self.strata_rank].get()
         self._locals = data
         if wid > self.remote_write_id or wid < 0:
             self.remote_write_id = wid
+            if wid >= 0:
+                self._recv_count += 1
+                if _faults.active():   # deterministic dead-spoke injection
+                    _faults.on_spoke_payload(self)
             return True
         return False
 

@@ -2,12 +2,20 @@
 
 TPU-native analogue of ``mpisppy/utils/wxbarreader.py``: options
 ``init_W_fname`` / ``init_Xbar_fname`` / ``init_separate_W_files``.
+
+Routed through the resilience checkpoint engine
+(:func:`tpusppy.resilience.checkpoint.read_wxbar`): a ``.npz`` path
+restores W, xbar AND rho from a real wheel checkpoint in one shot; any
+other path keeps reading the reference's csv formats
+(``scenario,varname,value`` W rows, ``varname,value`` xbar rows) via
+:mod:`tpusppy.utils.wxbarutils` — checkpoints stay interchangeable with
+mpi-sppy runs (doc/porting_from_mpisppy.md).
 """
 
 from __future__ import annotations
 
+from ..resilience import checkpoint as _checkpoint
 from .extension import Extension
-from ..utils import wxbarutils
 
 
 class WXBarReader(Extension):
@@ -18,8 +26,6 @@ class WXBarReader(Extension):
         self.sep_files = opt.options.get("init_separate_W_files", False)
 
     def post_iter0(self):
-        if self.W_fname:
-            wxbarutils.set_W_from_file(self.W_fname, self.opt,
-                                       sep_files=self.sep_files)
-        if self.Xbar_fname:
-            wxbarutils.set_xbar_from_file(self.Xbar_fname, self.opt)
+        if self.W_fname or self.Xbar_fname:
+            _checkpoint.read_wxbar(self.opt, self.W_fname, self.Xbar_fname,
+                                   sep_files=self.sep_files)

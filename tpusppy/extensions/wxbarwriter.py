@@ -1,16 +1,24 @@
 """WXBarWriter: checkpoint W / xbar each iteration (or at the end).
 
-TPU-native analogue of ``mpisppy/utils/wxbarwriter.py`` (an Extension in the
-reference's utils): options ``W_fname`` / ``Xbar_fname`` /
+TPU-native analogue of ``mpisppy/utils/wxbarwriter.py`` (an Extension in
+the reference's utils): options ``W_fname`` / ``Xbar_fname`` /
 ``separate_W_files``.
+
+Routed through the resilience checkpoint engine
+(:func:`tpusppy.resilience.checkpoint.write_wxbar`): a ``.npz`` target
+gets a REAL checkpoint — atomic write-tmp-then-rename, versioned, W and
+xbar (and rho) together, loadable by ``WheelSpinner(resume=...)`` — while
+csv targets keep the reference's append-per-iteration
+``scenario,varname,value`` format byte-compatible for mpi-sppy
+interchange (the golden round-trip is pinned in tests/test_resilience).
 """
 
 from __future__ import annotations
 
 import os
 
+from ..resilience import checkpoint as _checkpoint
 from .extension import Extension
-from ..utils import wxbarutils
 
 
 class WXBarWriter(Extension):
@@ -19,14 +27,15 @@ class WXBarWriter(Extension):
         self.W_fname = opt.options.get("W_fname")
         self.Xbar_fname = opt.options.get("Xbar_fname")
         self.sep_files = opt.options.get("separate_W_files", False)
-        # start fresh (the writers append per iteration)
+        # start fresh (the csv writers append per iteration; npz
+        # checkpoints replace atomically and need no unlink)
         for fname in (self.W_fname, self.Xbar_fname):
-            if fname and not self.sep_files and os.path.exists(fname):
+            if (fname and not self.sep_files
+                    and not str(fname).endswith(".npz")
+                    and os.path.exists(fname)):
                 os.remove(fname)
 
     def enditer(self):
-        if self.W_fname:
-            wxbarutils.write_W_to_file(self.opt, self.W_fname,
-                                       sep_files=self.sep_files)
-        if self.Xbar_fname:
-            wxbarutils.write_xbar_to_file(self.opt, self.Xbar_fname)
+        if self.W_fname or self.Xbar_fname:
+            _checkpoint.write_wxbar(self.opt, self.W_fname, self.Xbar_fname,
+                                    sep_files=self.sep_files)

@@ -35,7 +35,10 @@ import numpy as np
 
 from ..obs import metrics as _metrics
 from ..obs import trace as _trace
+from ..obs.log import get_logger
 from .distributed import _setup_distributed
+
+_log = get_logger("dist_wheel")
 
 
 def default_allgather():
@@ -166,6 +169,62 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
     def better_outer(new, old):
         return new > old if is_minimizing else new < old
 
+    # ---- resilience: resume + async checkpointing (doc/resilience.md) ----
+    # Every controller loads the SAME checkpoint (shared filesystem, the
+    # same contract the fabric's launch recipe already assumes for
+    # secrets) so the restored consensus state is bit-identical; only
+    # controller 0 ever writes snapshots.
+    from ..resilience import checkpoint as _ckpt
+
+    it_base = 0
+    resume_src = options.get("resume")
+    ck0 = _ckpt.load_latest(resume_src) if resume_src else None
+    if ck0 is not None:
+        # exact-S match (snapshots carry exactly S rows): the PADDED
+        # state row count would silently accept a different scenario
+        # count and certify against a foreign run's bounds
+        if (ck0.W is None or ck0.W.shape[1] != state.W.shape[1]
+                or ck0.W.shape[0] != S):
+            raise RuntimeError(
+                f"checkpoint W {getattr(ck0.W, 'shape', None)} does not "
+                f"match this wheel ({S} scenarios, K="
+                f"{state.W.shape[1]}) — resuming a different family?")
+        if np.isfinite(ck0.best_inner) and better_inner(ck0.best_inner,
+                                                        BestInner):
+            BestInner = float(ck0.best_inner)
+        if np.isfinite(ck0.best_outer) and better_outer(ck0.best_outer,
+                                                        BestOuter):
+            BestOuter = float(ck0.best_outer)
+        it_base = int(ck0.iteration)
+        if ck0.tune_state:
+            from .. import tune as _tune
+
+            _tune.import_state(ck0.tune_state)   # skip warmup probes
+
+    def _restore_W(state):
+        """Re-seat the checkpointed W AFTER Iter0 (the phbase seam):
+        Iter0 must run with W=0 — its prox-off eobj is only the valid
+        wait-and-see trivial bound at W=0 (the solve minimizes (c+W)x
+        while eobj prices plain c), and the wholesale replacement also
+        discards Iter0's W-update so the loop continues from exactly the
+        snapshot's duals."""
+        # state's own dtype, not the npz's (always f64): an f32 wheel
+        # must not have a mixed-dtype carry swapped into its compiled
+        # state pytree
+        W_full = np.zeros(state.W.shape, dtype=state.W.dtype)
+        W_full[:ck0.W.shape[0]] = ck0.W
+        W_dev = jax.make_array_from_callback(
+            W_full.shape, state.W.sharding, lambda idx: W_full[idx])
+        return state._replace(W=W_dev)
+    ckpt_mgr = None
+    if writer and options.get("checkpoint_dir"):
+        ckpt_mgr = _ckpt.CheckpointManager(
+            options["checkpoint_dir"],
+            every_secs=options.get("checkpoint_every_secs", 60.0),
+            every_iters=options.get("checkpoint_every_iters"),
+            keep=options.get("checkpoint_keep", 3), tag="dist_wheel",
+            fresh_start=ck0 is None)
+
     def gap():
         ag = (BestInner - BestOuter) if is_minimizing \
             else (BestOuter - BestInner)
@@ -249,9 +308,11 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
     state, out, factors, trivial = robust_collective(_iter0)
     if better_outer(trivial, BestOuter):
         BestOuter = trivial
+    if ck0 is not None:
+        state = _restore_W(state)
 
     conv = eobj = inf
-    it = 0
+    it = it_base
 
     def voted_stop():
         # the termination DECISION is itself voted: identical voted
@@ -268,17 +329,51 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
                            best_inner=BestInner, iter=it)
         return bool(votes[0])
 
+    last_consensus = [None]
+
+    def _snap(it, consensus):
+        from .. import tune as _tune
+
+        W_host, _ = consensus
+        K = W_host.size // max(1, S)
+        return _ckpt.WheelCheckpoint(
+            iteration=it, W=np.asarray(W_host).reshape(S, K).copy(),
+            best_inner=BestInner, best_outer=BestOuter,
+            tune_state=_tune.export_state(),
+            meta={"S": S, "K": K, "kind": "dist_wheel"})
+
+    def maybe_checkpoint(it, consensus):
+        """Bank a snapshot from the ALREADY-fetched consensus (push_state
+        needed the same host arrays this very iteration), so
+        checkpointing adds zero fetches — and, critically, zero
+        COLLECTIVES — to the wheel's decision path (only controller 0
+        owns a manager; a collective here would desynchronize it from
+        the other controllers)."""
+        last_consensus[0] = consensus
+        if ckpt_mgr is None:
+            return
+        try:
+            ckpt_mgr.maybe_capture(it, lambda: _snap(it, consensus))
+        except Exception as e:
+            # capture costs resumability, never the run (hub.py policy) —
+            # and on THIS topology an exception here would also strand
+            # the other controllers mid-collective
+            _metrics.inc("checkpoint.capture_errors")
+            _log.warning("checkpoint capture failed (run continues): %r", e)
+
     try:
-        for it in range(1, iters + 1):
+        for it in range(it_base + 1, iters + 1):
             with _trace.span("hub", "wheel_iter"):
-                if (it - 1) % refresh_every == 0:
+                if (it - it_base - 1) % refresh_every == 0:
                     state, out, factors = refresh(state, arr, 1.0)
                 else:
                     state, out = frozen(state, arr, 1.0, factors)
                 conv = float(np.asarray(out.conv))
                 eobj = float(np.asarray(out.eobj))
-                push_state()
+                consensus = fetch_consensus()
+                push_state(consensus)
                 pull_bounds()
+                maybe_checkpoint(it, consensus)
             if voted_stop():
                 break
         else:
@@ -317,6 +412,18 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
     for _ in range(polls):
         pull_bounds()
         time.sleep(0.25)
+
+    if ckpt_mgr is not None:
+        # terminal snapshot with the HARVESTED bounds, from the loop's
+        # last fetched consensus — never a fresh collective fetch (the
+        # other controllers are no longer in lockstep with this code)
+        try:
+            if last_consensus[0] is not None:
+                ckpt_mgr.capture(it, lambda: _snap(it, last_consensus[0]))
+        except Exception as e:   # never lose the certified result over it
+            _metrics.inc("checkpoint.capture_errors")
+            _log.warning("final checkpoint capture failed: %r", e)
+        ckpt_mgr.close()
 
     return DistWheelResult(BestInner, BestOuter, gap(), conv, eobj, it,
                            total_retries)

@@ -207,6 +207,7 @@ class PHBase(SPOpt):
         self.best_bound = self.trivial_bound
         self.Compute_Xbar()
         self.Update_W()
+        self._apply_resume()
         self.conv = self.convergence_diff()
         self.extobject.post_iter0()
         if self.spcomm is not None:
@@ -218,11 +219,32 @@ class PHBase(SPOpt):
         )
         return self.trivial_bound
 
+    def _apply_resume(self):
+        """Re-seat checkpointed PH state, when a resume was requested.
+
+        Runs at the END of Iter0 (the WXBarReader seam): the plain warm-up
+        solve has populated warm states and the trivial bound, and the
+        (W, xbars, rho) it derived are REPLACED wholesale by the
+        checkpoint's, so the first iterk solve reproduces the augmented
+        objective of the iteration after the snapshot.  Also sets
+        ``_iter_base`` so ``PHIterLimit`` keeps meaning TOTAL iterations
+        across restarts (``iterk_loop`` starts past the base)."""
+        ck = getattr(self, "_resume_ckpt", None)
+        if ck is None:
+            return
+        from .resilience import checkpoint as _ckpt
+
+        _ckpt.restore_ph(self, ck)
+        self._resume_ckpt = None
+
     def iterk_loop(self):
         """Main PH loop (phbase.py:875-979)."""
         convthresh = self.options.get("convthresh", 0.0)
         max_iters = self.options["PHIterLimit"]
-        for k in range(1, max_iters + 1):
+        # resumed runs continue the ITERATION COUNT from the checkpoint:
+        # the limit stays the total-budget knob it always was
+        start = int(getattr(self, "_iter_base", 0)) + 1
+        for k in range(start, max_iters + 1):
             self._iter = k
             # one span per PH iteration on the cylinder's own track
             # (the wheel spinner names cylinder threads; solo runs land

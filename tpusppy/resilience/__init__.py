@@ -1,0 +1,31 @@
+"""tpusppy.resilience: checkpoint/restart, fault injection, degradation.
+
+The reference treats warm starts as an afterthought (csv dumps of W/xbar
+read back by extensions, ``mpisppy/utils/wxbarutils.py``); at production
+scale a TPU preemption, a dropped TCP connection, or one dead spoke
+currently meant losing the whole run or hanging the hub.  This package is
+the robustness layer:
+
+- :mod:`.checkpoint` — versioned, atomic (write-tmp-then-rename),
+  asynchronous snapshots of full wheel state (W / xbar / rho, iteration
+  counter, best bounds, autotuner verdicts) on a wall-clock or iteration
+  cadence, plus the ``resume=`` restore path the wheel spinners consume.
+  Capture reads only host-resident state (the single-fetch wheel
+  iteration already mirrors everything the host needs — doc/pipeline.md),
+  so snapshotting adds ZERO blocking fetches to the dispatch decision
+  path (regression-pinned under ``jax.transfer_guard``).
+- :mod:`.faults` — a deterministic fault-injection harness: kill a spoke
+  at payload k, drop/delay TCP window reads, stale mailbox write-ids.
+  Tests PROVE the recovery paths instead of hoping for them.
+- :mod:`.supervisor` — per-cylinder heartbeat gauges and the hub-side
+  spoke supervisor: a dead or wedged spoke (stale mailbox generation past
+  a timeout) is marked LOST and the wheel keeps certifying with the
+  remaining bounders instead of hanging.
+
+See doc/resilience.md for the checkpoint format, cadence and resume
+semantics, and the degradation rules.
+"""
+
+from . import checkpoint, faults, supervisor  # noqa: F401
+
+__all__ = ["checkpoint", "faults", "supervisor"]

@@ -1,0 +1,183 @@
+"""Deterministic fault injection: dead spokes, dropped TCP reads, stale ids.
+
+Recovery paths that are only exercised by real outages rot silently.
+This harness injects the three failure classes the resilience layer
+handles — a spoke dying mid-run, a transient TCP window-service IO
+failure, and a mailbox serving stale write-ids — at DETERMINISTIC points
+(payload counts, read counts), so tests prove the degradation and
+retry/reconnect machinery instead of hoping for it.
+
+Usage (tests/test_resilience.py is the living example)::
+
+    plan = FaultPlan(kill_spoke={"LagrangianOuterBound": 2})
+    with faults.inject(plan) as stats:
+        WheelSpinner(hub, spokes).spin()
+    assert stats["spoke_kills"] == 1
+
+The hooks live on hot paths (mailbox gets, spoke polls, TCP ops) and cost
+ONE module-attribute check when disarmed (``_PLAN is None``) — the same
+contract the trace ring's disabled fast path keeps.
+
+Injection is process-local: a multiprocess wheel's spokes run in child
+processes and do not see the parent's plan (the threaded
+:class:`~tpusppy.spin_the_wheel.WheelSpinner` is the deterministic
+harness; TCP faults for cross-process runs are injected on whichever
+side armed the plan).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+
+from ..obs import metrics as _metrics
+
+KILL_ID = -1
+
+
+class InjectedFault(RuntimeError):
+    """Base class of every injected failure."""
+
+
+class SpokeKilled(InjectedFault):
+    """Raised inside a spoke's main loop to simulate its death."""
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """What to break, and exactly when.
+
+    kill_spoke: {spoke key: k} — raise :class:`SpokeKilled` inside the
+      spoke when it receives its k-th FRESH hub payload.  Keys are strata
+      ranks (int) or spoke class names (str).
+    stale_mailbox: {mailbox name: n} — the next ``n`` reads of that
+      mailbox report write-id 0 (as if no Put ever landed), simulating a
+      stale window generation.  The kill sentinel (-1) is never masked —
+      it is terminal by protocol, and masking it would turn a bounded
+      test into a hang.
+    drop_tcp: {mailbox name or "*": n} — the next ``n`` TCP window ops on
+      that box raise a transient connection-lost error (consumed by the
+      bounded retry/reconnect path in
+      :mod:`tpusppy.runtime.tcp_window_service`).
+    delay_reads: {mailbox name or "*": secs} — sleep before each read
+      (slow-network emulation; bounded by the caller's own timeouts).
+    """
+
+    kill_spoke: dict = dataclasses.field(default_factory=dict)
+    stale_mailbox: dict = dataclasses.field(default_factory=dict)
+    drop_tcp: dict = dataclasses.field(default_factory=dict)
+    delay_reads: dict = dataclasses.field(default_factory=dict)
+
+
+_PLAN: FaultPlan | None = None
+_LOCK = threading.Lock()
+_STATS: dict = {}
+
+
+def _record(kind: str):
+    with _LOCK:
+        _STATS[kind] = _STATS.get(kind, 0) + 1
+    _metrics.inc(f"faults.{kind}")
+
+
+def injected_counts() -> dict:
+    with _LOCK:
+        return dict(_STATS)
+
+
+def arm(plan: FaultPlan):
+    global _PLAN
+    with _LOCK:
+        _STATS.clear()
+    # remaining-budget counters live on a working copy so a plan object
+    # can be reused across tests without carrying decremented state
+    plan = dataclasses.replace(
+        plan, stale_mailbox=dict(plan.stale_mailbox),
+        drop_tcp=dict(plan.drop_tcp))
+    _PLAN = plan
+    return plan
+
+
+def disarm():
+    global _PLAN
+    _PLAN = None
+
+
+@contextlib.contextmanager
+def inject(plan: FaultPlan):
+    """Arm ``plan`` for the duration; yields the live stats dict view
+    (read it after the block via :func:`injected_counts` for a copy)."""
+    arm(plan)
+    try:
+        yield _STATS
+    finally:
+        disarm()
+
+
+# ---------------------------------------------------------------------------
+# Hooks (called from instrumented seams; no-ops unless armed)
+# ---------------------------------------------------------------------------
+def on_spoke_payload(spoke):
+    """Called by ``Spoke.spoke_from_hub`` on every FRESH payload; raises
+    :class:`SpokeKilled` when the plan schedules this spoke's death at
+    the current payload count."""
+    plan = _PLAN
+    if plan is None or not plan.kill_spoke:
+        return
+    k = plan.kill_spoke.get(spoke.strata_rank)
+    if k is None:
+        k = plan.kill_spoke.get(type(spoke).__name__)
+    if k is not None and spoke._recv_count >= int(k):
+        _record("spoke_kills")
+        raise SpokeKilled(
+            f"injected death of {type(spoke).__name__} "
+            f"(strata {spoke.strata_rank}) at payload {spoke._recv_count}")
+
+
+def on_mailbox_get(name: str, write_id: int) -> int:
+    """Called by ``Mailbox.get``: may return a STALE write-id (0) for the
+    next budgeted reads of ``name``.  Kill sentinels pass through."""
+    plan = _PLAN
+    if plan is None or not plan.stale_mailbox or write_id == KILL_ID:
+        return write_id
+    with _LOCK:
+        left = plan.stale_mailbox.get(name, 0)
+        if left <= 0:
+            return write_id
+        plan.stale_mailbox[name] = left - 1
+    _record("stale_reads")
+    return 0
+
+
+def _budget(table: dict, name: str) -> bool:
+    with _LOCK:
+        for key in (name, "*"):
+            left = table.get(key, 0)
+            if left > 0:
+                table[key] = left - 1
+                return True
+    return False
+
+
+def on_tcp_io(name: str):
+    """Called inside each TCP window op attempt: sleeps (delay plan) and
+    raises a transient connection-lost error (drop plan) so the bounded
+    retry/backoff/reconnect path is exercised on demand."""
+    plan = _PLAN
+    if plan is None:
+        return
+    if plan.delay_reads:
+        secs = plan.delay_reads.get(name, plan.delay_reads.get("*"))
+        if secs:
+            _record("delayed_reads")
+            time.sleep(float(secs))
+    if plan.drop_tcp and _budget(plan.drop_tcp, name):
+        _record("tcp_drops")
+        raise InjectedFault(
+            f"TCP window service connection lost (injected, box {name})")
+
+
+def active() -> bool:
+    return _PLAN is not None

@@ -1,0 +1,179 @@
+"""Cylinder heartbeats + the hub-side spoke supervisor (degradation).
+
+A wheel's availability used to be min() over its cylinders: one dead
+spoke thread surfaced only as an exception AFTER the hub finished (or as
+a 900 s teardown join), and a wedged spoke (alive but making no mailbox
+progress) could pin the hub's linger harvest for its whole budget.  The
+supervisor turns spoke health into data the hub acts on each ``sync()``:
+
+- every cylinder publishes a **heartbeat gauge**
+  (``heartbeat.<cylinder>`` in :mod:`tpusppy.obs.metrics`, monotonic
+  seconds) from its poll loop;
+- the hub's :class:`SpokeSupervisor` watches, per spoke, the inbound
+  mailbox write-id (real progress), the heartbeat gauge (liveness), and
+  the thread/process handle (death), and marks a spoke **LOST** when it
+  crashed, silently died, or — with ``spoke_timeout_secs`` set — made no
+  progress past the timeout;
+- a lost spoke stops gating anything: the linger harvest ends early when
+  every spoke is lost, teardown joins give lost spokes a short grace
+  instead of the full deadline, their finalize is skipped, and the wheel
+  completes with whatever the remaining bounders certified
+  (``WheelSpinner.lost_spokes`` names them; the strict_spokes option
+  restores the old raise-at-join behavior).
+
+Payloads a spoke posted BEFORE dying remain valid and are still read —
+loss only stops the hub WAITING on the dead, never discards bounds.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import global_toc
+from ..obs import metrics as _metrics
+from ..obs import trace as _trace
+
+HEARTBEAT_PREFIX = "heartbeat."
+
+_CTR_LOST = _metrics.counter("supervisor.spokes_lost")
+
+
+def heartbeat_gauge(cylinder: str):
+    """The liveness gauge for ``cylinder`` — poll loops hoist this once
+    and ``set(time.monotonic())`` per beat (one lock + a float store)."""
+    return _metrics.gauge(HEARTBEAT_PREFIX + cylinder)
+
+
+def heartbeat(cylinder: str):
+    """Publish liveness for ``cylinder`` (gauge = monotonic seconds)."""
+    heartbeat_gauge(cylinder).set(time.monotonic())
+
+
+def last_heartbeat(cylinder: str):
+    return _metrics.gauge(HEARTBEAT_PREFIX + cylinder).get()
+
+
+class _Watch:
+    __slots__ = ("name", "last_wid", "last_progress", "thread", "proc",
+                 "lost", "reason", "error")
+
+    def __init__(self, name):
+        self.name = name
+        self.last_wid = None
+        self.last_progress = time.monotonic()
+        self.thread = None
+        self.proc = None
+        self.lost = False
+        self.reason = None
+        self.error = None
+
+
+class SpokeSupervisor:
+    """Hub-side per-spoke health tracker.
+
+    ``fabric`` supplies the inbound (``to_hub``) mailboxes whose write-id
+    progression is the progress signal; ``spoke_names`` maps strata rank
+    -> display name.  ``timeout_secs=None`` disables staleness-based loss
+    (death-based loss is always on): a spoke legitimately deep in a host
+    MILP makes no mailbox progress for minutes, so the timeout is an
+    operator knob, not a default.
+    """
+
+    def __init__(self, fabric, spoke_names: dict, timeout_secs=None):
+        self.fabric = fabric
+        self.timeout_secs = (None if timeout_secs in (None, 0)
+                             else float(timeout_secs))
+        self._lock = threading.Lock()
+        self._watch = {int(i): _Watch(str(nm))
+                       for i, nm in (spoke_names or {}).items()}
+
+    # ---- registration ------------------------------------------------------
+    def note_thread(self, idx: int, thread):
+        with self._lock:
+            if idx in self._watch:
+                self._watch[idx].thread = thread
+
+    def note_process(self, idx: int, proc):
+        with self._lock:
+            if idx in self._watch:
+                self._watch[idx].proc = proc
+
+    def note_error(self, idx: int, exc):
+        """A spoke's main loop raised: immediate loss."""
+        with self._lock:
+            w = self._watch.get(idx)
+            if w is not None:
+                w.error = exc
+        self._mark_lost(idx, "crashed")
+
+    # ---- observation (hub sync cadence) ------------------------------------
+    def observe(self):
+        """One health pass over every non-lost spoke; called by the hub
+        each sync.  Reads are mailbox write-ids and gauges — never a
+        device or network round-trip beyond what the fabric's write_id
+        accessor costs."""
+        now = time.monotonic()
+        for idx, w in list(self._watch.items()):
+            if w.lost:
+                continue
+            progressed = False
+            try:
+                wid = self.fabric.to_hub[idx].write_id
+            except Exception:
+                wid = None          # fabric op failed: no progress signal
+            if wid is not None and wid != w.last_wid:
+                w.last_wid = wid
+                progressed = True
+            hb = last_heartbeat(f"spoke{idx}")
+            if hb is not None and hb > w.last_progress:
+                progressed = True
+            if progressed:
+                w.last_progress = now
+                continue
+            dead = (w.thread is not None and not w.thread.is_alive()) or \
+                   (w.proc is not None and w.proc.exitcode is not None)
+            if dead:
+                self._mark_lost(idx, "died")
+            elif (self.timeout_secs is not None
+                    and now - w.last_progress > self.timeout_secs):
+                self._mark_lost(idx, "wedged")
+
+    def _mark_lost(self, idx: int, reason: str):
+        with self._lock:
+            w = self._watch.get(idx)
+            if w is None or w.lost:
+                return
+            w.lost = True
+            w.reason = reason
+        _CTR_LOST.inc(1)
+        if _trace.enabled():
+            _trace.instant("hub", "spoke_lost", spoke=idx, name=w.name,
+                           reason=reason)
+        global_toc(
+            f"WARNING: spoke {idx} ({w.name}) marked LOST ({reason}) — "
+            "continuing with the remaining cylinders", True)
+
+    # ---- queries -----------------------------------------------------------
+    def is_lost(self, idx: int) -> bool:
+        w = self._watch.get(idx)
+        return bool(w and w.lost)
+
+    def lost(self) -> dict:
+        """{idx: (name, reason)} of every lost spoke."""
+        with self._lock:
+            return {i: (w.name, w.reason)
+                    for i, w in self._watch.items() if w.lost}
+
+    def lost_names(self) -> list:
+        return [f"{nm} ({why})" for nm, why in self.lost().values()]
+
+    def errors(self) -> list:
+        with self._lock:
+            return [(w.name, w.error) for w in self._watch.values()
+                    if w.error is not None]
+
+    def all_lost(self) -> bool:
+        with self._lock:
+            return bool(self._watch) and all(
+                w.lost for w in self._watch.values())

@@ -25,8 +25,12 @@ import ctypes
 import os
 import subprocess
 import threading
+import time
 
 import numpy as np
+
+from ..obs import metrics as _obs_metrics
+from ..resilience import faults as _faults
 
 _SRC = os.path.join(os.path.dirname(__file__), "csrc",
                     "tcp_window_service.cpp")
@@ -38,6 +42,20 @@ _lib_lock = threading.Lock()
 KILL_ID = -1
 _LEN_ERR = -2
 _IO_ERR = -4
+
+# mid-run fault tolerance knobs (doc/resilience.md): a CLIENT endpoint
+# retries a failed op with bounded exponential backoff, reconnecting
+# between attempts — a transient network blip or hub restart inside the
+# run no longer kills the spoke (previously only the FIRST-collective
+# rendezvous skew was retried, by the connect timeout).  Servers never
+# retry: their ops are local mutexed memory and an error there is a bug.
+_RETRIES = int(os.environ.get("TPUSPPY_TCP_RETRIES", "4"))
+_BACKOFF_BASE = float(os.environ.get("TPUSPPY_TCP_BACKOFF", "0.1"))
+_BACKOFF_CAP = float(os.environ.get("TPUSPPY_TCP_BACKOFF_CAP", "5.0"))
+
+_CTR_IO_ERRORS = _obs_metrics.counter("tcp_window.io_errors")
+_CTR_RETRIES = _obs_metrics.counter("tcp_window.retries")
+_CTR_RECONNECTS = _obs_metrics.counter("tcp_window.reconnects")
 
 
 def load_library() -> ctypes.CDLL:
@@ -99,6 +117,8 @@ class TcpEndpoint:
         if connect is not None:
             host, prt = connect
             self.secret = int(secret or 0)
+            self._connect_spec = (str(host), int(prt),
+                                  float(connect_timeout))
             handle = self._lib.tws_connect(
                 str(host).encode(), int(prt), int(connect_timeout * 1000),
                 ctypes.c_uint64(self.secret))
@@ -136,8 +156,38 @@ class TcpEndpoint:
 
     def _check(self, rc: int) -> int:
         if rc == _IO_ERR:
+            _CTR_IO_ERRORS.inc(1)
             raise RuntimeError("TCP window service connection lost")
         return int(rc)
+
+    @property
+    def can_reconnect(self) -> bool:
+        return not self.is_server and hasattr(self, "_connect_spec")
+
+    def reconnect(self):
+        """Tear down the (possibly dead) client connection and dial the
+        server again with the original host/port/secret — the mid-run
+        recovery primitive behind the mailbox retry path."""
+        if not self.can_reconnect:
+            raise RuntimeError("server endpoints cannot reconnect")
+        host, prt, timeout = self._connect_spec
+        self.close()
+        handle = self._lib.tws_connect(
+            host.encode(), prt, int(timeout * 1000),
+            ctypes.c_uint64(self.secret))
+        if not handle:
+            _CTR_IO_ERRORS.inc(1)
+            raise RuntimeError(
+                f"reconnect to window service at {host}:{prt} failed")
+        self._handle = ctypes.c_void_p(handle)
+        _CTR_RECONNECTS.inc(1)
+
+    def drop_for_test(self):
+        """Sever the connection NOW (close the handle) without touching
+        the server — the deterministic 'network died' hook the reconnect
+        test drives.  Subsequent ops raise connection-lost until a
+        :meth:`reconnect` (the mailbox retry path does it)."""
+        self.close()
 
     def close(self):
         if getattr(self, "_handle", None):
@@ -152,7 +202,16 @@ class TcpEndpoint:
 
 
 class TcpMailbox:
-    """Mailbox-API view over one box (put/get/kill/write_id, −1 sentinel)."""
+    """Mailbox-API view over one box (put/get/kill/write_id, −1 sentinel).
+
+    Client-side ops are wrapped in a bounded retry: a transient IO
+    failure (dead connection, injected fault) backs off exponentially
+    (``TPUSPPY_TCP_BACKOFF`` base, doubled per attempt, capped), the
+    endpoint RECONNECTS, and the op re-runs — up to
+    ``TPUSPPY_TCP_RETRIES`` retries, then the error propagates.  Server
+    ops never retry (local memory).  All traffic is billed to the
+    ``tcp_window.*`` obs counters.
+    """
 
     KILL_ID = KILL_ID
 
@@ -160,7 +219,38 @@ class TcpMailbox:
         self.ep = ep
         self.box = int(box)
         self.name = name
-        self.length = ep.length(box)
+        self.length = self._io("length", lambda: ep.length(self.box))
+
+    def _io(self, opname: str, fn):
+        """Run one window op under the transient-failure retry policy."""
+        delay = _BACKOFF_BASE
+        for attempt in range(_RETRIES + 1):
+            try:
+                if _faults.active():    # deterministic drop/delay injection
+                    _faults.on_tcp_io(self.name)
+                if self.ep._handle is None:
+                    # a severed connection: NULL handles must never reach
+                    # the C side (that would be UB, not an error return)
+                    _CTR_IO_ERRORS.inc(1)
+                    raise RuntimeError(
+                        "TCP window service connection lost")
+                return fn()
+            except (RuntimeError, OSError) as e:
+                # injected faults count under faults.*; real IO errors are
+                # already billed where they surface (_check / reconnect)
+                transient = "connection lost" in str(e)
+                if (not transient or not self.ep.can_reconnect
+                        or attempt == _RETRIES):
+                    raise
+                _CTR_RETRIES.inc(1)
+                time.sleep(delay)
+                delay = min(delay * 2.0, _BACKOFF_CAP)
+                try:
+                    self.ep.reconnect()
+                except RuntimeError:
+                    # server still unreachable: keep backing off — the
+                    # next attempt's handle-None guard re-raises cleanly
+                    continue
 
     def put(self, values) -> int:
         values = np.ascontiguousarray(values, dtype=np.float64)
@@ -168,10 +258,10 @@ class TcpMailbox:
             raise RuntimeError(
                 f"TcpMailbox {self.name}: putting length {values.shape} "
                 f"into buffer of length {self.length}")
-        rc = self.ep._check(self.ep._lib.tws_put(
+        rc = self._io("put", lambda: self.ep._check(self.ep._lib.tws_put(
             self.ep._handle, self.box,
             values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-            self.length))
+            self.length)))
         if rc == _LEN_ERR:
             raise RuntimeError("length mismatch in tws_put")
         return rc
@@ -180,21 +270,22 @@ class TcpMailbox:
         """(values, write_id) snapshot; always immediate (server-side boxes
         are mutex-consistent — no seqlock wait states)."""
         out = np.empty(self.length, dtype=np.float64)
-        wid = self.ep._check(self.ep._lib.tws_get(
+        wid = self._io("get", lambda: self.ep._check(self.ep._lib.tws_get(
             self.ep._handle, self.box,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-            self.length))
+            self.length)))
         if wid == _LEN_ERR:
             raise RuntimeError("length mismatch in tws_get")
         return out, int(wid)
 
     def kill(self):
-        self.ep._check(self.ep._lib.tws_kill(self.ep._handle, self.box))
+        self._io("kill", lambda: self.ep._check(
+            self.ep._lib.tws_kill(self.ep._handle, self.box)))
 
     @property
     def write_id(self) -> int:
-        return self.ep._check(
-            self.ep._lib.tws_write_id(self.ep._handle, self.box))
+        return self._io("write_id", lambda: self.ep._check(
+            self.ep._lib.tws_write_id(self.ep._handle, self.box)))
 
 
 class TcpWindowFabric:

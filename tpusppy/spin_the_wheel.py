@@ -27,17 +27,115 @@ from .obs import trace as _trace
 
 
 class WheelSpinner:
-    """Spin a hub and list of spokes (spin_the_wheel.py:12-159)."""
+    """Spin a hub and list of spokes (spin_the_wheel.py:12-159).
 
-    def __init__(self, hub_dict, list_of_spoke_dict):
+    Resilience (tpusppy.resilience, doc/resilience.md): the hub options
+    may carry ``checkpoint_dir`` (+ ``checkpoint_every_secs`` /
+    ``checkpoint_every_iters`` / ``checkpoint_keep``) to snapshot the
+    wheel asynchronously, ``resume`` (or the ``resume=`` ctor arg) to
+    warm-start from the newest checkpoint, ``spoke_timeout_secs`` to
+    declare a progress-less spoke wedged, and ``strict_spokes`` to
+    restore the legacy raise-on-spoke-crash teardown.  By default a
+    crashed spoke is marked LOST (``self.lost_spokes``) and the wheel
+    completes with whatever the remaining bounders certified.
+    """
+
+    def __init__(self, hub_dict, list_of_spoke_dict, resume=None):
         self.hub_dict = dict(hub_dict)
         self.list_of_spoke_dict = [dict(d) for d in (list_of_spoke_dict or [])]
         self.on_hub = True  # single-process: we always see the hub
         self.spun = False
+        self.resume = resume
+        self.lost_spokes = []
+        self.spoke_errors = []
+        self.resumed_from = None
 
     def spin(self, comm_world=None):
         """comm_world accepted for reference API parity; unused in-process."""
         return self.run()
+
+    def _hub_options(self) -> dict:
+        return dict(self.hub_dict.get("hub_kwargs", {}).get("options") or {})
+
+    def _load_resume(self):
+        """The checkpoint to warm-start from (ctor arg wins over the hub
+        option); None means cold start — including a --resume pointed at
+        a dir that has no checkpoint yet (first run of a retried job)."""
+        from .resilience import checkpoint as _ckpt
+
+        src = self.resume or self._hub_options().get("resume")
+        if not src:
+            return None
+        ck = _ckpt.load_latest(src)
+        if ck is None:
+            global_toc(f"resume: no checkpoint under {src!r} — cold start",
+                       True)
+        return ck
+
+    def _make_checkpointer(self, fresh_start: bool = False):
+        opts = self._hub_options()
+        if not opts.get("checkpoint_dir"):
+            return None
+        from .resilience.checkpoint import CheckpointManager
+
+        return CheckpointManager(
+            opts["checkpoint_dir"],
+            every_secs=opts.get("checkpoint_every_secs", 60.0),
+            every_iters=opts.get("checkpoint_every_iters"),
+            keep=opts.get("checkpoint_keep", 3),
+            fresh_start=fresh_start)
+
+    def _wire_resilience(self, hub_comm, hub_opt):
+        """Shared resume + checkpointer hookup for both spinner variants
+        (call after ``setup_hub``).  Returns the CheckpointManager (or
+        None).  Bounds always re-seed; the PH-state restore is consumed
+        by ``PHBase.Iter0`` — opt classes that never run it (APH's own
+        driver) get a bounds-only resume, reported by
+        :meth:`_warn_unconsumed_resume` at teardown."""
+        ckpt = self._load_resume()
+        if ckpt is not None:
+            hub_opt._resume_ckpt = ckpt
+            hub_comm.seed_resume(ckpt)
+            self.resumed_from = ckpt.iteration
+        mgr = self._make_checkpointer(fresh_start=ckpt is None)
+        if mgr is not None:
+            hub_comm.attach_checkpointer(mgr)
+        return mgr
+
+    @staticmethod
+    def _warn_unconsumed_resume(hub_opt):
+        """A resume checkpoint nobody consumed means the opt class never
+        ran the PHBase.Iter0 restore seam (e.g. APH's own driver): the
+        run still got the re-seeded bounds, but W/rho restarted cold and
+        the iteration count did NOT continue — say so instead of letting
+        ``resumed_from`` imply a full warm start."""
+        if getattr(hub_opt, "_resume_ckpt", None) is not None:
+            hub_opt._resume_ckpt = None
+            global_toc(
+                f"WARNING: resume checkpoint was NOT consumed by "
+                f"{type(hub_opt).__name__} (no PHBase.Iter0 in its "
+                "driver): bounds were re-seeded but PH state restarted "
+                "cold and PHIterLimit did not continue from the "
+                "snapshot", True)
+
+    def _final_checkpoint(self, hub_comm, mgr):
+        """Bank the terminal state (post bound-harvest) and drain the
+        writer: a later ``--resume`` of a COMPLETED run then reloads the
+        certified end state instead of re-running the wheel."""
+        if mgr is None:
+            return
+        from .resilience import checkpoint as _ckpt
+
+        try:
+            mgr.capture(hub_comm.current_iteration(),
+                        lambda: _ckpt.capture_ph(hub_comm.opt, hub=hub_comm))
+        except Exception as e:     # capture must never cost the results
+            from .obs import metrics as _metrics
+
+            _metrics.inc("checkpoint.capture_errors")
+            global_toc(f"WARNING: final checkpoint capture failed: {e!r}",
+                       True)
+        mgr.close()
 
     @staticmethod
     def _cylinder_opt_kwargs(opt_kwargs):
@@ -54,6 +152,8 @@ class WheelSpinner:
         return opt_kwargs
 
     def run(self):
+        from .resilience import supervisor as _supervisor
+
         t_build0 = time.monotonic()
         fabric = WindowFabric()
 
@@ -78,6 +178,15 @@ class WheelSpinner:
             spoke_comms.append(comm)
 
         hub_comm.setup_hub()
+        # resume + checkpointing (doc/resilience.md): bounds re-seed the
+        # hub NOW (post-setup); PH state re-seats after the warm-up Iter0
+        ckpt_mgr = self._wire_resilience(hub_comm, hub_opt)
+        sup = _supervisor.SpokeSupervisor(
+            fabric,
+            {i + 1: c.__class__.__name__ for i, c in enumerate(spoke_comms)},
+            timeout_secs=self._hub_options().get("spoke_timeout_secs"))
+        if spoke_comms:
+            hub_comm.attach_supervisor(sup)
         global_toc(
             f"wheel constructed ({1 + len(spoke_comms)} cylinders) in "
             f"{time.monotonic() - t_build0:.1f}s", True)
@@ -87,7 +196,7 @@ class WheelSpinner:
         threads = []
         errors = []
 
-        def spoke_runner(comm, track):
+        def spoke_runner(comm, track, idx):
             # each cylinder thread is its own trace timeline — the
             # per-cylinder rows of the Perfetto view (doc/observability.md)
             _trace.set_thread_track(track)
@@ -95,15 +204,17 @@ class WheelSpinner:
                 comm.main()
             except Exception as e:          # surface spoke crashes at join
                 errors.append((comm.__class__.__name__, e))
+                sup.note_error(idx, e)
 
         for i, comm in enumerate(spoke_comms):
             t = threading.Thread(
                 target=spoke_runner,
-                args=(comm, f"spoke{i + 1}:{comm.__class__.__name__}"),
+                args=(comm, f"spoke{i + 1}:{comm.__class__.__name__}", i + 1),
                 name=comm.__class__.__name__, daemon=True,
             )
             t.start()
             threads.append(t)
+            sup.note_thread(i + 1, t)
 
         _trace.set_thread_track("hub")
         try:
@@ -117,8 +228,12 @@ class WheelSpinner:
             # time-to-certified-gap — benchmarks report this figure
             self.gap_wall_secs = time.monotonic() - t_build0
         deadline = time.monotonic() + 900.0   # shared across all joins
-        for t in threads:
-            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        for i, t in enumerate(threads):
+            # lost spokes get a short grace, not the whole deadline: a
+            # crashed thread is already dead and a wedged one is exactly
+            # what the supervisor told us not to wait for
+            cap = 5.0 if sup.is_lost(i + 1) else deadline - time.monotonic()
+            t.join(timeout=max(0.0, min(cap, deadline - time.monotonic())))
         hung = [t.name for t in threads if t.is_alive()]
         if hung:
             # A spoke stuck inside an uninterruptible host MILP (e.g. the
@@ -131,18 +246,33 @@ class WheelSpinner:
                 f"WARNING: spoke thread(s) still running at teardown "
                 f"(skipping their finalize): {hung}", True)
             self.hung_spokes = hung
-        if errors:
+        self.lost_spokes = sup.lost_names()
+        self.spoke_errors = list(errors)
+        if errors and self._hub_options().get("strict_spokes"):
+            self._final_checkpoint(hub_comm, ckpt_mgr)
             raise RuntimeError(f"Spoke failures: {errors}")
+        if errors:
+            # graceful degradation (the default): the wheel completed on
+            # the surviving bounders; the loss is loud, recorded, and on
+            # the trace — but it is not an exception
+            global_toc(
+                f"WARNING: wheel degraded — spoke failures survived: "
+                f"{[(n, repr(e)) for n, e in errors]}", True)
 
         # finalize: each cylinder flushes, then the hub collects (131-144).
         # Identity pairing (threads were created in spoke_comms order): a
         # hung instance must not suppress finalize for a healthy sibling
-        # of the same class.
+        # of the same class; a CRASHED spoke's finalize is skipped too
+        # (its state is whatever the exception left behind).
         hub_comm.finalize()
-        for t, comm in zip(threads, spoke_comms):
-            if not t.is_alive():
+        crashed = {idx for idx, (nm, why) in sup.lost().items()
+                   if why == "crashed"}
+        for i, (t, comm) in enumerate(zip(threads, spoke_comms)):
+            if not t.is_alive() and (i + 1) not in crashed:
                 comm.finalize()
         hub_comm.hub_finalize()
+        self._warn_unconsumed_resume(hub_opt)
+        self._final_checkpoint(hub_comm, ckpt_mgr)
 
         self.spcomm = hub_comm
         self.opt = hub_opt
@@ -329,8 +459,9 @@ class MultiprocessWheelSpinner(WheelSpinner):
     in-process (threaded) WheelSpinner remains the default.
     """
 
-    def __init__(self, hub_dict, list_of_spoke_dict, fabric: str = "shm"):
-        super().__init__(hub_dict, list_of_spoke_dict)
+    def __init__(self, hub_dict, list_of_spoke_dict, fabric: str = "shm",
+                 resume=None):
+        super().__init__(hub_dict, list_of_spoke_dict, resume=resume)
         if fabric not in ("shm", "tcp"):
             raise ValueError(f"fabric must be 'shm' or 'tcp', got {fabric!r}")
         self.fabric_kind = fabric
@@ -388,6 +519,24 @@ class MultiprocessWheelSpinner(WheelSpinner):
             **hub.get("hub_kwargs", {}),
         )
         hub_comm.setup_hub()
+        # resume + checkpointing live on the HUB side (it owns W and the
+        # bounds); spokes re-seed from the first sync's payloads
+        ckpt_mgr = self._wire_resilience(hub_comm, hub_opt)
+        from .resilience import supervisor as _supervisor
+
+        # death-only loss detection here: heartbeat gauges are
+        # process-local (the obs registry does not cross the fork), so a
+        # healthy child spoke idling between bounds would look exactly
+        # like a wedged one — spoke_timeout_secs applies to the THREADED
+        # spinner only (doc/resilience.md)
+        sup = _supervisor.SpokeSupervisor(
+            fabric,
+            {i + 1: sd["spoke_class"].__name__
+             for i, sd in enumerate(self.list_of_spoke_dict)},
+            timeout_secs=None)
+        for i, p in enumerate(procs):
+            sup.note_process(i + 1, p)
+        hub_comm.attach_supervisor(sup)
         # First-contact barrier: spawned cylinders cold-start a full python +
         # jax(+XLA compile) pipeline; a fast hub would otherwise finish and
         # kill them before they ever participate.  (MPI ranks start
@@ -411,28 +560,41 @@ class MultiprocessWheelSpinner(WheelSpinner):
                 os.remove(rp)
             except OSError:
                 pass
+        strict = bool(self._hub_options().get("strict_spokes"))
         try:
             try:
                 hub_comm.main()
             finally:
                 hub_comm.send_terminate()
-            for p in procs:
-                p.join(timeout=300)
+            for i, p in enumerate(procs):
+                p.join(timeout=5 if sup.is_lost(i + 1) else 300)
             hung = [p.name for p in procs if p.is_alive()]
             for p in procs:
                 if p.is_alive():
                     p.terminate()
-            if hung:
+            if hung and strict:
                 raise RuntimeError(
                     f"Spoke processes did not terminate: {hung}")
-            bad = [(p.name, p.exitcode) for p in procs if p.exitcode != 0]
-            if bad:
+            bad = [(p.name, p.exitcode) for p in procs
+                   if p.exitcode not in (0, None)]
+            self.spoke_errors = bad
+            if bad and strict:
                 raise RuntimeError(f"Spoke process failures: {bad}")
+            if bad or hung:
+                # graceful degradation (the default, matching the threaded
+                # spinner): the hub's accepted bounds stand
+                global_toc(
+                    f"WARNING: wheel degraded — spoke processes "
+                    f"failed/hung: {bad + [(h, 'hung') for h in hung]}",
+                    True)
         finally:
             # failure paths must not abandon the hub's results or leak the
             # POSIX shm segment
             hub_comm.finalize()
             hub_comm.hub_finalize()
+            self._warn_unconsumed_resume(hub_opt)
+            self._final_checkpoint(hub_comm, ckpt_mgr)
+            self.lost_spokes = sup.lost_names()
             self.spcomm = hub_comm
             self.opt = hub_opt
             self.spoke_comms = []

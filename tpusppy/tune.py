@@ -25,11 +25,22 @@ Grew out of ``scripts/profile_sweep_parts.py`` (whose jit/fetch timing
 helper lives here now as :func:`time_jitted`); results feed ``bench.py``
 and any driver that wants a per-shape cadence instead of a global
 default.
+
+Verdicts PERSIST: every fresh pick is banked in a JSON-able store keyed
+by the same shape+settings+mesh key plus the jax version, saved
+atomically to ``TPUSPPY_TUNE_CACHE`` when that knob names a file and
+carried inside wheel checkpoints (:mod:`tpusppy.resilience.checkpoint`),
+so repeated bench/wheel runs — and resumed ones — skip the warmup
+probes entirely (:func:`export_state` / :func:`import_state` /
+:func:`save_cache` / :func:`load_cache`).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
+import threading
 import time
 from typing import Any
 
@@ -68,6 +79,143 @@ class TuneResult:
 
 
 _cache: dict = {}
+
+
+# ---------------------------------------------------------------------------
+# Persistent verdict store (disk + checkpoint interchange).
+#
+# Repeated bench/wheel runs used to re-pay the warmup probes (cadence,
+# precision, pipeline) on every process start.  Verdicts are banked here
+# keyed by ``repr`` of the SAME shape+settings+mesh key the in-memory
+# cache uses, partitioned by jax version (a jaxlib bump can change every
+# measured rate), and persisted to ``TPUSPPY_TUNE_CACHE`` (a JSON file)
+# with the engine-wide atomic write-tmp-then-rename discipline.  The
+# resilience checkpoint engine snapshots/reseeds the same store
+# (:func:`export_state` / :func:`import_state`), so a resumed wheel
+# skips its warmup probes too.  Multiple processes banking concurrently
+# are last-writer-wins per save — acceptable for a cache whose entries
+# are independently recomputable.
+# ---------------------------------------------------------------------------
+_PERSIST_VERSION = 1
+_persist: dict = {"fused": {}, "pipeline": {}}
+_persist_lock = threading.Lock()
+_disk_loaded_from: str | None = None
+
+
+def _jax_version() -> str:
+    try:
+        import jax
+
+        return str(jax.__version__)
+    except ImportError:             # key-building unit tests without jax
+        return "none"
+
+
+_cache_path_override: str | None = None
+
+
+def set_cache_path(path: str | None):
+    """Programmatic override of the TPUSPPY_TUNE_CACHE knob (what
+    ``Config.tune_cache`` routes through — scoped to this process's tune
+    module instead of leaking an env var into every child)."""
+    global _cache_path_override
+    _cache_path_override = str(path) if path else None
+
+
+def cache_path() -> str | None:
+    """The armed persistent-cache path (programmatic override first, then
+    TPUSPPY_TUNE_CACHE; empty/unset disables persistence — tests stay
+    hermetic by default)."""
+    return (_cache_path_override
+            or os.environ.get("TPUSPPY_TUNE_CACHE") or None)
+
+
+def export_state() -> dict:
+    """JSON-able snapshot of every banked verdict (fused + pipeline) —
+    what wheel checkpoints carry so a resume skips warmup probes."""
+    with _persist_lock:
+        return {"version": _PERSIST_VERSION, "jax": _jax_version(),
+                "fused": dict(_persist["fused"]),
+                "pipeline": dict(_persist["pipeline"])}
+
+
+def import_state(state: dict):
+    """Merge a snapshot produced by :func:`export_state` (same-jax-version
+    entries only; foreign measurements must not masquerade as local)."""
+    if not state or state.get("jax") not in (None, _jax_version()):
+        return
+    with _persist_lock:
+        for kind in ("fused", "pipeline"):
+            _persist[kind].update(state.get(kind) or {})
+
+
+def save_cache(path: str | None = None) -> str | None:
+    """Atomically write the banked verdicts to ``path`` (default: the
+    TPUSPPY_TUNE_CACHE knob).  No-op (None) when no path is armed."""
+    path = path or cache_path()
+    if not path:
+        return None
+    from .resilience.checkpoint import atomic_write_json
+
+    return atomic_write_json(path, export_state())
+
+
+def load_cache(path: str | None = None) -> int:
+    """Load a verdict file into the in-process store; returns the number
+    of entries now banked.  Files from another jax version are ignored
+    (their measurements are not this toolchain's)."""
+    path = path or cache_path()
+    if not path or not os.path.exists(path):
+        return 0
+    try:
+        with open(path) as f:
+            state = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return 0                 # a torn/foreign file is just a cold cache
+    import_state(state)
+    with _persist_lock:
+        return len(_persist["fused"]) + len(_persist["pipeline"])
+
+
+def _maybe_load_disk():
+    """Lazy one-shot load of the armed cache file (re-armed paths reload)."""
+    global _disk_loaded_from
+    path = cache_path()
+    if path and path != _disk_loaded_from:
+        _disk_loaded_from = path
+        n = load_cache(path)
+        if n:
+            _metrics.inc("tune.disk_entries_loaded", n)
+
+
+def _persist_get(kind: str, key_str: str):
+    _maybe_load_disk()
+    with _persist_lock:
+        return _persist[kind].get(key_str)
+
+
+def _persist_put(kind: str, key_str: str, entry: dict):
+    with _persist_lock:
+        _persist[kind][key_str] = entry
+    if cache_path():
+        try:
+            save_cache()
+        except OSError as e:     # a read-only cache dir must not kill tuning
+            _metrics.inc("tune.disk_save_errors")
+            from .obs.log import get_logger
+
+            get_logger("tune").warning(
+                "persistent cache save failed: %r", e)
+
+
+def reset_persist():
+    """Drop banked verdicts (test isolation)."""
+    global _disk_loaded_from, _cache_path_override
+    with _persist_lock:
+        _persist["fused"].clear()
+        _persist["pipeline"].clear()
+    _disk_loaded_from = None
+    _cache_path_override = None
 
 
 def _fetch(x):
@@ -153,6 +301,22 @@ def autotune_fused(nonant_idx, settings, arr, state, mesh=None,
     if cache and key in _cache:
         hit = _cache[key]
         return dataclasses.replace(hit, state=state, out=None)
+    if cache:
+        # persistent verdicts (TPUSPPY_TUNE_CACHE / resumed checkpoints):
+        # a banked same-key pick skips the whole warmup probe ladder
+        dk = _persist_get("fused", repr(key))
+        if dk is not None:
+            _metrics.inc("tune.disk_hits")
+            res = TuneResult(
+                chunk=int(dk["chunk"]), refresh_every=int(dk["refresh_every"]),
+                iters_per_sec=float(dk["iters_per_sec"]),
+                secs_per_iter=float(dk["secs_per_iter"]),
+                sweeps_per_iter=float(dk["sweeps_per_iter"]),
+                table=list(dk.get("table", [])) + [{"from": "disk_cache"}],
+                state=state, out=None,
+                precision=str(dk.get("precision", "highest")))
+            _cache[key] = dataclasses.replace(res, state=None, out=None)
+            return res
 
     t_start = time.time()
     table = []
@@ -311,7 +475,25 @@ def autotune_fused(nonant_idx, settings, arr, state, mesh=None,
                      precision=precision)
     if cache:
         _cache[key] = dataclasses.replace(res, state=None, out=None)
+        _persist_put("fused", repr(key), {
+            "chunk": int(c), "refresh_every": int(r),
+            "iters_per_sec": float(rate), "secs_per_iter": float(1.0 / rate),
+            "sweeps_per_iter": float(sweeps), "precision": str(precision),
+            "table": _json_safe(table)})
     return res
+
+
+def _json_safe(obj):
+    """Probe tables carry numpy scalars; the persistent store is JSON."""
+    if isinstance(obj, dict):
+        return {str(k): _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    if isinstance(obj, (np.floating, np.integer)):
+        return obj.item()
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj if obj == obj else None     # NaN -> null (strict JSON)
+    return repr(obj)
 
 
 @dataclasses.dataclass
@@ -377,6 +559,17 @@ def autotune_pipeline(run_segment, sol, shape, seg_f, pay_factor=1.0,
         # silently fall back to the default
         segmented.set_pipeline_policy(S, n, m, hit.enabled)
         return dataclasses.replace(hit, sol=sol)
+    if cache:
+        dk = _persist_get("pipeline", repr(key))
+        if dk is not None:
+            _metrics.inc("tune.disk_hits")
+            hit = PipelineTune(
+                enabled=bool(dk["enabled"]), seg_secs=float(dk["seg_secs"]),
+                fetch_secs=float(dk["fetch_secs"]),
+                waste_flops=float(dk["waste_flops"]), sol=None)
+            _pipe_cache[key] = hit
+            segmented.set_pipeline_policy(S, n, m, hit.enabled)
+            return dataclasses.replace(hit, sol=sol)
 
     # fetch latency: dispatch + host read of a FRESH stop-stats program
     # per rep — re-fetching one array would time jax's cached host value
@@ -423,4 +616,8 @@ def autotune_pipeline(run_segment, sol, shape, seg_f, pay_factor=1.0,
         sol=probe)
     if cache:
         _pipe_cache[key] = dataclasses.replace(res, sol=None)
+        _persist_put("pipeline", repr(key), {
+            "enabled": bool(enabled), "seg_secs": float(seg_secs),
+            "fetch_secs": float(fetch_secs),
+            "waste_flops": float(res.waste_flops)})
     return res

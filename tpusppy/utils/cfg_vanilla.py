@@ -59,19 +59,39 @@ def _admm_solver_options(cfg) -> dict:
     return so
 
 
+def resilience_hub_options(cfg) -> dict:
+    """Hub-side resilience options from a Config (the ``resilience_args``
+    group): checkpoint cadence + resume + degradation knobs, threaded
+    into ``hub_kwargs["options"]`` by the hub builders so any
+    Config-driven CLI gets preemption-safe wheels with two flags
+    (doc/resilience.md)."""
+    out = {}
+    for k in ("checkpoint_dir", "checkpoint_every_secs",
+              "checkpoint_every_iters", "checkpoint_keep", "resume",
+              "spoke_timeout_secs", "strict_spokes"):
+        if _hasit(cfg, k):
+            out[k] = cfg.get(k)
+    return out
+
+
 def shared_options(cfg) -> dict:
     """The option dict every cylinder starts from (cfg_vanilla.py:41-63).
 
     Also the observability entry point for Config-driven CLIs: a truthy
     ``cfg.tracing`` (see :meth:`Config.tracing_args`) arms the flight
     recorder exactly like ``TPUSPPY_TRACE=<path>``, and ``cfg.log_level``
-    sets the ``tpusppy`` logger level."""
+    sets the ``tpusppy`` logger level.  A ``tune_cache`` field arms the
+    persistent autotuner verdict store (TPUSPPY_TUNE_CACHE semantics)."""
     from ..obs import log as _obs_log
     from ..obs import trace as _trace
 
     _trace.maybe_enable_from_config(cfg)
     if cfg.get("log_level"):
         _obs_log.set_level(cfg.get("log_level"))
+    if cfg.get("tune_cache"):
+        from .. import tune as _tune
+
+        _tune.set_cache_path(cfg.get("tune_cache"))
     shoptions = {
         "solver_name": cfg.get("solver_name"),
         "solver_options": _admm_solver_options(cfg),
@@ -134,6 +154,7 @@ def ph_hub(
             "rel_gap": cfg.get("rel_gap"),
             "abs_gap": cfg.get("abs_gap"),
             "max_stalled_iters": cfg.get("max_stalled_iters"),
+            **resilience_hub_options(cfg),
         }},
         "opt_class": PH,
         "opt_kwargs": {
@@ -224,10 +245,11 @@ def lshaped_hub(
     return {
         "hub_class": LShapedHub,
         "hub_kwargs": {"options": {
-            k: v for k, v in {
+            **{k: v for k, v in {
                 "rel_gap": cfg.get("rel_gap"),
                 "abs_gap": cfg.get("abs_gap"),
-            }.items() if v is not None
+            }.items() if v is not None},
+            **resilience_hub_options(cfg),
         }},
         "opt_class": LShapedMethod,
         "opt_kwargs": {

@@ -214,6 +214,38 @@ class Config:
             "tpusppy log level (TPUSPPY_LOG_LEVEL overrides; default INFO)",
             str, None)
 
+    def resilience_args(self):
+        """Checkpoint/restart + degradation knobs (tpusppy.resilience,
+        doc/resilience.md).  ``checkpoint_dir`` arms asynchronous wheel
+        snapshots; ``resume`` warm-starts from the newest checkpoint
+        there (bounds monotone across the restart, PHIterLimit still
+        counts TOTAL iterations); ``spoke_timeout_secs`` lets the hub
+        declare a progress-less spoke wedged and keep certifying with
+        the rest; ``strict_spokes`` restores raise-on-spoke-crash;
+        ``tune_cache`` persists autotuner verdicts across runs (the
+        TPUSPPY_TUNE_CACHE knob as a Config field)."""
+        add = self.add_to_config
+        add("checkpoint_dir",
+            "directory for async wheel checkpoints (None: off)", str, None)
+        add("checkpoint_every_secs",
+            "wall-clock checkpoint cadence (default 60)", float, 60.0)
+        add("checkpoint_every_iters",
+            "iteration checkpoint cadence (None: wall-clock only)", int,
+            None)
+        add("checkpoint_keep",
+            "checkpoints retained before pruning (default 3)", int, 3)
+        add("resume",
+            "checkpoint dir/file to warm-start the wheel from", str, None)
+        add("spoke_timeout_secs",
+            "mark a spoke lost after this long with no mailbox/heartbeat "
+            "progress (None: only death is loss)", float, None)
+        add("strict_spokes",
+            "raise on spoke failure instead of degrading gracefully",
+            bool, False)
+        add("tune_cache",
+            "path of the persistent autotuner verdict cache "
+            "(TPUSPPY_TUNE_CACHE equivalent; None: off)", str, None)
+
     def ph_args(self):
         add = self.add_to_config
         # adaptive per-slot rho (NormRhoUpdater, the reference's
