@@ -405,39 +405,49 @@ def traced_farmer_wheel():
     from tpusppy.spin_the_wheel import WheelSpinner
     from tpusppy.xhat_eval import Xhat_Eval
 
+    from tpusppy.obs import metrics as obs_metrics
+
     S = int(os.environ.get("BENCH_TRACE_WHEEL_SCENS", "3"))
     iters = int(os.environ.get("BENCH_TRACE_WHEEL_ITERS", "40"))
 
-    def opt_kwargs():
+    def opt_kwargs(megastep=0):
         return {
             "options": {
                 "defaultPHrho": 1.0, "PHIterLimit": iters,
                 "convthresh": -1.0,
                 "xhat_looper_options": {"scen_limit": 3},
+                "solver_options": {"megastep": megastep},
             },
             "all_scenario_names": farmer.scenario_names_creator(S),
             "scenario_creator": farmer.scenario_creator,
             "scenario_creator_kwargs": {"num_scens": S},
         }
 
-    hub_dict = {
-        "hub_class": PHHub,
-        "hub_kwargs": {"options": {"rel_gap": 1e-3, "abs_gap": 1.0,
-                                   "linger_secs": 60.0}},
-        "opt_class": PH, "opt_kwargs": opt_kwargs(),
-    }
-    spokes = [
-        {"spoke_class": LagrangianOuterBound, "spoke_kwargs": {},
-         "opt_class": PHBase, "opt_kwargs": opt_kwargs()},
-        {"spoke_class": XhatShuffleInnerBound, "spoke_kwargs": {},
-         "opt_class": Xhat_Eval, "opt_kwargs": opt_kwargs()},
-    ]
+    def wheel_dicts(megastep=0):
+        hub_dict = {
+            "hub_class": PHHub,
+            "hub_kwargs": {"options": {"rel_gap": 1e-3, "abs_gap": 1.0,
+                                       "linger_secs": 60.0}},
+            "opt_class": PH, "opt_kwargs": opt_kwargs(megastep),
+        }
+        spokes = [
+            {"spoke_class": LagrangianOuterBound, "spoke_kwargs": {},
+             "opt_class": PHBase, "opt_kwargs": opt_kwargs(megastep)},
+            {"spoke_class": XhatShuffleInnerBound, "spoke_kwargs": {},
+             "opt_class": Xhat_Eval, "opt_kwargs": opt_kwargs(megastep)},
+        ]
+        return hub_dict, spokes
+
     t0 = time.time()
-    ws = WheelSpinner(hub_dict, spokes).spin()
+    with obs_metrics.window() as mwin:
+        ws = WheelSpinner(*wheel_dicts()).spin()
     # one more gap computation AFTER the wheel finishes: it emits the
     # final rel_gap sample, so the report's gap-vs-wall array ends at
     # exactly the gap this entry reports
     abs_gap, rel_gap = ws.spcomm.compute_gaps()
+    megasteps = int(mwin.delta("dispatch.megasteps"))
+    mega_iters = int(mwin.delta("dispatch.mega_iterations"))
+    hub_iters = int(ws.spcomm.opt._iter)
     entry = {
         "S": S,
         "wall_secs": round(time.time() - t0, 2),
@@ -445,13 +455,49 @@ def traced_farmer_wheel():
         "outer": float(ws.BestOuterBound),
         "abs_gap": float(abs_gap),
         "rel_gap": float(rel_gap),
+        # wheel-wide host-sync accounting under the megakernel (one
+        # packed fetch per megastep instead of one per hub iteration)
+        "host_sync_count": int(mwin.delta("host_sync.count")),
+        "megasteps": megasteps,
+        "mega_iterations": mega_iters,
+        "megastep_n": (round(mega_iters / megasteps, 1)
+                       if megasteps else 0),
+        # hub-scoped measurement-fetch accounting, exact by construction
+        # (one packed fetch per solve window: legacy iterations pay one
+        # each, a megastep pays one for all its iterations) — counted
+        # from the hub's ACTUAL final iteration (rel_gap termination can
+        # end the wheel early), not the configured limit.  The
+        # process-wide host_sync_count above includes the spokes' own
+        # (unchanged) bound fetches.
+        "hub_iter_fetches": hub_iters - mega_iters + megasteps,
+        "hub_iter_fetches_legacy": hub_iters,
+        "hub_fetch_drop_factor": round(
+            hub_iters / max(1, hub_iters - mega_iters + megasteps), 2),
     }
+    # bank the megakernel wheel's trace BEFORE the legacy comparison run:
+    # the artifact's gap-vs-wall series must end at THIS entry's gap, and
+    # the comparison wheel's events must not bleed into it
     dump = trace_segment_dump(f"wheel_farmer{S}")
     if dump is not None:
         entry["trace"] = dump
         gvw = dump["report"]["gap_vs_wall"]
         assert gvw and abs(gvw[-1][1] - entry["rel_gap"]) < 1e-12, \
             "flight-recorder gap series must end at the reported gap"
+    # legacy-dispatch comparison wheel (ADMMSettings.megastep = 1): the
+    # same certified run, one dispatch + one fetch per hub iteration —
+    # the host-sync drop factor is the megakernel's headline number
+    if not os.environ.get("BENCH_SKIP_WHEEL_LEGACY"):
+        with obs_metrics.window() as lwin:
+            ws_l = WheelSpinner(*wheel_dicts(megastep=1)).spin()
+        ws_l.spcomm.compute_gaps()
+        entry["host_sync_count_legacy"] = int(lwin.delta("host_sync.count"))
+        if entry["host_sync_count"]:
+            entry["host_sync_drop_factor"] = round(
+                entry["host_sync_count_legacy"]
+                / entry["host_sync_count"], 2)
+        # bank + reset the comparison run's events so they can never
+        # bleed into the NEXT segment's window
+        trace_segment_dump(f"wheel_farmer{S}_legacy")
     return entry
 
 
@@ -694,11 +740,18 @@ def workload():
         refresh, frozen = sharded.make_ph_step_pair(idx, st, mesh)
         state = sharded.init_state(arr, 1.0, st)
 
-        # warmup/compile + Iter0
+        # warmup/compile + Iter0 — under a "compile" span so the cold
+        # start (farmer ~3.5s, UC ~17s per BENCH_r05) is visible on the
+        # Perfetto timeline and regression-trackable before the AOT
+        # compile cache (ROADMAP item 3) lands
+        from tpusppy.obs import trace as obs_trace
+
         t0 = time.time()
-        state, out, _ = refresh(state, arr, 0.0)
-        eobj0 = float(np.asarray(out.eobj))
-        log(f"compile+iter0: {time.time() - t0:.1f}s eobj={eobj0:.2f}")
+        with obs_trace.span("compile", "compile.iter0"):
+            state, out, _ = refresh(state, arr, 0.0)
+            eobj0 = float(np.asarray(out.eobj))
+        compile_iter0_s = time.time() - t0
+        log(f"compile+iter0: {compile_iter0_s:.1f}s eobj={eobj0:.2f}")
 
         sweeps = None
         tuned = None
@@ -753,9 +806,11 @@ def workload():
                 idx, st, mesh, chunk=chunk,
                 refresh_every=refresh_every, collect="trace")
             t0 = time.time()
-            state, trace = fused(state, arr, 1.0)  # compile (+chunk iters)
-            np.asarray(trace.conv)
-            log(f"fused chunk={chunk} compile: {time.time() - t0:.1f}s")
+            with obs_trace.span("compile", "compile.fused"):
+                state, trace = fused(state, arr, 1.0)  # compile+chunk iters
+                np.asarray(trace.conv)
+            t_first_dispatch = time.time() - t0
+            log(f"fused chunk={chunk} compile: {t_first_dispatch:.1f}s")
             n_chunks = max(1, n_iters // chunk)
             t0 = time.time()
             with obs_metrics.window() as mwin, hostsync.track() as sync_tr:
@@ -766,10 +821,17 @@ def workload():
             measured = n_chunks * chunk
             sweeps = float(trace.iters.mean())
             out = sharded.PHStepOut(*(np.asarray(a)[-1] for a in trace))
+            # compile_s: first-dispatch wall minus the steady-state
+            # dispatch (the measured window's per-chunk mean) — XLA
+            # compile time isolated from the chunk's real iterations
+            compile_s = max(0.0, t_first_dispatch - wall / n_chunks)
         else:  # segmentation-regime shapes: per-step dispatches
-            state, out, factors = refresh(state, arr, 1.0)
-            state, out = frozen(state, arr, 1.0, factors)
-            np.asarray(out.conv)  # compile the frozen program too
+            t0 = time.time()
+            with obs_trace.span("compile", "compile.steps"):
+                state, out, factors = refresh(state, arr, 1.0)
+                state, out = frozen(state, arr, 1.0, factors)
+                np.asarray(out.conv)  # compile the frozen program too
+            t_first_dispatch = time.time() - t0
             t0 = time.time()
             with obs_metrics.window() as mwin, hostsync.track() as sync_tr:
                 for i in range(n_iters):
@@ -781,6 +843,8 @@ def workload():
             wall = time.time() - t0
             measured = n_iters
             sweeps = float(np.asarray(out.iters))
+            # two warmup dispatches ran inside the compile window
+            compile_s = max(0.0, t_first_dispatch - 2 * wall / n_iters)
         iters_per_sec = measured / wall
         # host-sync accounting, now SOURCED FROM THE METRICS REGISTRY
         # (tpusppy/obs/metrics.py; hostsync feeds it on every fetch): how
@@ -859,6 +923,8 @@ def workload():
             "mfu_note": mfu_note,
             "host_sync_count": host_sync_count,
             "dispatch_overhead_pct": dispatch_overhead_pct,
+            "compile_s": round(compile_s, 2),
+            "compile_iter0_s": round(compile_iter0_s, 2),
             "vs_baseline": round(iters_per_sec / baseline_iters_per_sec, 2),
             "vs_baseline_32rank": round(iters_per_sec / base32, 2),
         }
@@ -879,6 +945,8 @@ def workload():
         "mfu_note": m_primary["mfu_note"],
         "host_sync_count": m_primary["host_sync_count"],
         "dispatch_overhead_pct": m_primary["dispatch_overhead_pct"],
+        "compile_s": m_primary["compile_s"],
+        "compile_iter0_s": m_primary["compile_iter0_s"],
         "vs_baseline": m_primary["vs_baseline"],
         # honest north-star figure: vs IDEAL 32-way scaling of the serial
         # reference architecture (serial/32 accounting, BASELINE.md) —

@@ -175,14 +175,21 @@ def uc_metrics(progress=None, wheel=True):
     refresh, frozen = sharded.make_ph_step_pair(
         batch.tree.nonant_indices, settings, mesh)
     state = sharded.init_state(arr, 1.0, settings)
+    from tpusppy.obs import trace as obs_trace
+
     t0 = time.time()
-    state, out, _ = refresh(state, arr, 0.0)
-    np.asarray(out.conv)
-    log(f"uc compile+iter0: {time.time() - t0:.1f}s "
+    with obs_trace.span("compile", "compile.iter0"):
+        state, out, _ = refresh(state, arr, 0.0)
+        np.asarray(out.conv)
+    compile_iter0_s = time.time() - t0
+    log(f"uc compile+iter0: {compile_iter0_s:.1f}s "
         f"eobj={float(np.asarray(out.eobj)):.2f}")
-    state, out, factors = refresh(state, arr, 1.0)
-    state, out = frozen(state, arr, 1.0, factors)
-    np.asarray(out.conv)
+    t0 = time.time()
+    with obs_trace.span("compile", "compile.steps"):
+        state, out, factors = refresh(state, arr, 1.0)
+        state, out = frozen(state, arr, 1.0, factors)
+        np.asarray(out.conv)
+    t_first_dispatch = time.time() - t0
 
     t0 = time.time()
     for i in range(iters):
@@ -270,6 +277,12 @@ def uc_metrics(progress=None, wheel=True):
     rate_fields = {
         "model": model_name,
         "ph_iters_per_sec": round(iters_per_sec, 4),
+        # cold-start observability (ROADMAP item 3 downpayment): first-
+        # dispatch wall minus the steady-state per-iteration cost, plus
+        # the raw compile+iter0 wall the r5 artifacts quote (~17s UC)
+        "compile_s": round(
+            max(0.0, t_first_dispatch - 2.0 / max(iters_per_sec, 1e-9)), 2),
+        "compile_iter0_s": round(compile_iter0_s, 2),
         "precision": settings.sweep_mode(),
         "plateau_window": plateau_window,
         "sweeps_per_iter": round(sweeps, 1),

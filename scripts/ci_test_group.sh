@@ -9,7 +9,7 @@
 #   solvers
 case "$(basename "$1")" in
   test_admm.py|test_shared.py|test_shared_admm.py|test_sharded.py|\
-  test_segmented.py|test_pipeline.py|\
+  test_segmented.py|test_pipeline.py|test_megastep.py|\
   test_pallas.py|test_sparse_structured.py|test_fused_step.py|\
   test_tune.py|test_precision*.py|test_milp_bound.py|test_bench_smoke.py)
     echo solvers ;;

@@ -1,0 +1,460 @@
+"""Device-resident wheel megakernel (sharded.make_wheel_megastep +
+PHBase megastep windows): N PH wheel iterations per dispatch, ONE packed
+measurement fetch per megastep, bitwise-identical to the serial
+per-iteration dispatch protocol (doc/pipeline.md).
+
+The device-level tests pin BITWISE megakernel==serial parity (same jitted
+sub-programs, one dispatch vs N) on all four engines — dense per-scenario,
+shared-A, SparseA, and structured-KKT — across (N, cadence) combinations,
+including the early-exit mask, the in-scan acceptance test (a rejected
+frozen iterate is discarded exactly as the serial protocol discards it)
+and the divergence-freeze path.  The host-level tests pin the PHBase
+integration: trajectory equivalence to the legacy loop (host-vs-device
+augmented-objective assembly differs in ulps, so the gate is 1e-9-tight,
+not bitwise), the host-sync drop, billing, and the
+``ADMMSettings.megastep = 1`` legacy toggle.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpusppy.ir import ScenarioBatch
+from tpusppy.models import farmer
+from tpusppy.obs import metrics as obs_metrics
+from tpusppy.parallel import sharded
+from tpusppy.solvers import hostsync, segmented
+from tpusppy.solvers.admm import ADMMSettings
+from tpusppy.solvers.sparse import SparseA
+
+
+def make_batch(n, **kw):
+    names = farmer.scenario_names_creator(n)
+    return ScenarioBatch.from_problems(
+        [farmer.scenario_creator(nm, num_scens=n, **kw) for nm in names])
+
+
+def _prep(batch, settings, mesh=None):
+    """(arr, state, factors, idx): Iter0 + one refresh, frozen-ready."""
+    arr = sharded.shard_batch(batch, mesh) if mesh is not None else None
+    if arr is None:
+        mesh = sharded.make_mesh(1)
+        arr = sharded.shard_batch(batch, mesh)
+    idx = batch.tree.nonant_indices
+    refresh, _ = sharded.make_ph_step_pair(idx, settings, mesh)
+    state = sharded.init_state(arr, 1.0, settings)
+    state, _, _ = refresh(state, arr, 0.0)
+    state, _, factors = refresh(state, arr, 1.0)
+    return arr, state, factors, idx, mesh
+
+
+def _serial(idx, settings, mesh, state, arr, factors, n, convthresh=-1.0,
+            tol=np.inf):
+    """Legacy per-iteration dispatch: n single-iteration megasteps (one
+    dispatch + one packed fetch each)."""
+    mega1 = sharded.make_wheel_megastep(idx, settings, mesh, n_iters=1,
+                                        donate=False)
+    stats = []
+    for _ in range(n):
+        state, packed = mega1(state, arr, 1.0, factors, convthresh, 1, tol)
+        S, nv = arr.c.shape
+        K = arr.nid_sk.shape[1]
+        m = sharded.megastep_unpack(np.asarray(packed), 1, S, nv, K)
+        stats.append(m)
+        if m["executed"] == 0 or m["conv"][0] < convthresh:
+            break
+    return state, stats
+
+
+class TestDeviceParity:
+    """megakernel == serial, bitwise, at the pure-device level."""
+
+    @pytest.mark.parametrize("n_iters,check_every", [(3, 4), (5, 3), (8, 7)])
+    def test_dense_bitwise(self, n_iters, check_every):
+        settings = ADMMSettings(max_iter=120, restarts=2,
+                                check_every=check_every)
+        arr, state, factors, idx, mesh = _prep(make_batch(5), settings)
+        s_ref, stats = _serial(idx, settings, mesh, state, arr, factors,
+                               n_iters)
+        mega = sharded.make_wheel_megastep(idx, settings, mesh,
+                                           n_iters=n_iters, donate=False)
+        s_m, packed = mega(state, arr, 1.0, factors, -1.0, n_iters, np.inf)
+        S, nv = arr.c.shape
+        K = arr.nid_sk.shape[1]
+        m = sharded.megastep_unpack(np.asarray(packed), n_iters, S, nv, K)
+        assert m["executed"] == n_iters
+        assert not m["refresh_hit"]
+        np.testing.assert_array_equal(np.asarray(s_m.W), np.asarray(s_ref.W))
+        np.testing.assert_array_equal(np.asarray(s_m.x), np.asarray(s_ref.x))
+        np.testing.assert_array_equal(
+            np.asarray(s_m.xbars), np.asarray(s_ref.xbars))
+        np.testing.assert_array_equal(
+            m["conv"], np.array([s["conv"][0] for s in stats]))
+        np.testing.assert_array_equal(m["pri"], stats[-1]["pri"])
+        # the packed final state equals the returned device state
+        np.testing.assert_array_equal(m["W"], np.asarray(s_m.W))
+
+    def test_shared_bitwise(self):
+        from tpusppy.models import uc_lite
+
+        S = 6
+        names = uc_lite.scenario_names_creator(S)
+        batch = ScenarioBatch.from_problems([
+            uc_lite.scenario_creator(nm, num_scens=S, relax_integers=True)
+            for nm in names])
+        assert batch.A_shared is not None
+        settings = ADMMSettings(max_iter=120, restarts=2)
+        arr, state, factors, idx, mesh = _prep(batch, settings)
+        s_ref, _ = _serial(idx, settings, mesh, state, arr, factors, 4)
+        mega = sharded.make_wheel_megastep(idx, settings, mesh, n_iters=4,
+                                           donate=False)
+        s_m, _ = mega(state, arr, 1.0, factors, -1.0, 4, np.inf)
+        np.testing.assert_array_equal(np.asarray(s_m.W), np.asarray(s_ref.W))
+        np.testing.assert_array_equal(np.asarray(s_m.x), np.asarray(s_ref.x))
+
+    # slow-marked per the tier-1 wall budget (the block/Woodbury scan
+    # programs trace+run ~5-8s each); the dense/shared bitwise tests
+    # keep tier-1 coverage, nightly runs these
+    @pytest.mark.slow
+    @pytest.mark.parametrize("structured", [False, True])
+    def test_sparse_structured_bitwise(self, structured, block_lp_arrays):
+        """SparseA and block/Woodbury structured-KKT engines inside the
+        scan match their own serial dispatch exactly."""
+        arr, settings, idx, mesh = block_lp_arrays(structured)
+        refresh, _ = sharded.make_ph_step_pair(idx, settings, mesh)
+        state = sharded.init_state(arr, 1.0, settings)
+        state, _, factors = refresh(state, arr, 1.0)
+        s_ref, _ = _serial(idx, settings, mesh, state, arr, factors, 4)
+        mega = sharded.make_wheel_megastep(idx, settings, mesh, n_iters=4,
+                                           donate=False)
+        s_m, _ = mega(state, arr, 1.0, factors, -1.0, 4, np.inf)
+        np.testing.assert_array_equal(np.asarray(s_m.W), np.asarray(s_ref.W))
+        np.testing.assert_array_equal(np.asarray(s_m.x), np.asarray(s_ref.x))
+
+    def test_early_exit_mask(self):
+        """conv < convthresh mid-scan freezes the remaining steps; the
+        packed measurement records the true stopping iteration and the
+        state equals the serial loop that broke there."""
+        settings = ADMMSettings(max_iter=120, restarts=2)
+        arr, state, factors, idx, mesh = _prep(make_batch(4), settings)
+        N = 6
+        _, stats = _serial(idx, settings, mesh, state, arr, factors, N)
+        convs = np.array([s["conv"][0] for s in stats])
+        # threshold between the 3rd and 2nd conv values: serial stops at 3
+        th = float(convs[2]) * 1.0000001
+        t = int(np.argmax(convs < th)) + 1
+        assert 1 <= t < N
+        s_ref, _ = _serial(idx, settings, mesh, state, arr, factors, N,
+                           convthresh=th)
+        mega = sharded.make_wheel_megastep(idx, settings, mesh, n_iters=N,
+                                           donate=False)
+        s_m, packed = mega(state, arr, 1.0, factors, th, N, np.inf)
+        S, nv = arr.c.shape
+        K = arr.nid_sk.shape[1]
+        m = sharded.megastep_unpack(np.asarray(packed), N, S, nv, K)
+        assert m["executed"] == t
+        assert np.all(m["conv"][t:] == 0.0)     # masked steps are inert
+        np.testing.assert_array_equal(np.asarray(s_m.W), np.asarray(s_ref.W))
+
+    def test_n_live_budget(self):
+        """One compiled N program serves any executed count via the
+        traced n_live budget."""
+        settings = ADMMSettings(max_iter=120, restarts=2)
+        arr, state, factors, idx, mesh = _prep(make_batch(4), settings)
+        s_ref, _ = _serial(idx, settings, mesh, state, arr, factors, 2)
+        mega = sharded.make_wheel_megastep(idx, settings, mesh, n_iters=6,
+                                           donate=False)
+        s_m, packed = mega(state, arr, 1.0, factors, -1.0, 2, np.inf)
+        S, nv = arr.c.shape
+        K = arr.nid_sk.shape[1]
+        m = sharded.megastep_unpack(np.asarray(packed), 6, S, nv, K)
+        assert m["executed"] == 2
+        np.testing.assert_array_equal(np.asarray(s_m.W), np.asarray(s_ref.W))
+
+    def test_acceptance_mask_discards_rejected_iterate(self):
+        """An iterate failing the in-scan acceptance ladder is DISCARDED
+        (state passes through, refresh_hit set) — exactly the serial
+        protocol's rejected frozen solve."""
+        settings = ADMMSettings(max_iter=120, restarts=2)
+        arr, state, factors, idx, mesh = _prep(make_batch(4), settings)
+        # an absurdly tight ladder rejects the very first iterate
+        mega = sharded.make_wheel_megastep(idx, settings, mesh, n_iters=4,
+                                           donate=False)
+        s_m, packed = mega(state, arr, 1.0, factors, -1.0, 4, 1e-300)
+        S, nv = arr.c.shape
+        K = arr.nid_sk.shape[1]
+        m = sharded.megastep_unpack(np.asarray(packed), 4, S, nv, K)
+        assert m["executed"] == 0 and m["refresh_hit"]
+        np.testing.assert_array_equal(np.asarray(s_m.W), np.asarray(state.W))
+        np.testing.assert_array_equal(np.asarray(s_m.x), np.asarray(state.x))
+
+    def test_divergence_freeze_stop_stats_match_serial(self):
+        """A NaN/diverged scenario frozen mid-scan (the shared engine's
+        in-loop guard reports inf residuals) fails the acceptance test in
+        BOTH protocols: identical stop stats, identical surviving state."""
+        from tpusppy.models import uc_lite
+
+        S = 4
+        names = uc_lite.scenario_names_creator(S)
+        batch = ScenarioBatch.from_problems([
+            uc_lite.scenario_creator(nm, num_scens=S, relax_integers=True)
+            for nm in names])
+        settings = ADMMSettings(max_iter=80, restarts=2)
+        arr, state, factors, idx, mesh = _prep(batch, settings)
+        # poison one scenario's objective so its frozen solve explodes the
+        # refinement (huge dq2 deviation from the refreshed factors —
+        # the test_shared_admm divergence repro, via a large prox rho)
+        rho = np.asarray(state.rho).copy()
+        rho[0, :] = 1e12
+        state = state._replace(rho=jnp.asarray(rho))
+        tol = 1e-4
+        s_ref, stats = _serial(idx, settings, mesh, state, arr, factors, 3,
+                               tol=tol)
+        mega = sharded.make_wheel_megastep(idx, settings, mesh, n_iters=3,
+                                           donate=False)
+        s_m, packed = mega(state, arr, 1.0, factors, -1.0, 3, tol)
+        K = arr.nid_sk.shape[1]
+        m = sharded.megastep_unpack(
+            np.asarray(packed), 3, arr.c.shape[0], arr.c.shape[1], K)
+        # both protocols refuse the poisoned iterate identically
+        assert m["refresh_hit"] and stats[0]["refresh_hit"]
+        assert stats[0]["executed"] == m["executed"] == 0
+        np.testing.assert_array_equal(np.asarray(s_m.W), np.asarray(s_ref.W))
+
+    def test_no_implicit_d2h_inside_megastep(self):
+        """The megastep program performs ZERO implicit device-to-host
+        transfers: the ONLY host read is the explicit packed-measurement
+        fetch (jax.transfer_guard pins the contract)."""
+        settings = ADMMSettings(max_iter=80, restarts=2)
+        arr, state, factors, idx, mesh = _prep(make_batch(4), settings)
+        mega = sharded.make_wheel_megastep(idx, settings, mesh, n_iters=3,
+                                           donate=False)
+        mega(state, arr, 1.0, factors, -1.0, 3, np.inf)   # compile first
+        with jax.transfer_guard_device_to_host("disallow"):
+            state2, packed = mega(state, arr, 1.0, factors, -1.0, 3, np.inf)
+        vec = hostsync.fetch(packed)          # the one explicit fetch
+        assert np.isfinite(vec[: 3 * 6]).all()
+
+    def test_rejects_bad_n(self):
+        with pytest.raises(ValueError):
+            sharded.make_wheel_megastep(np.arange(3), ADMMSettings(),
+                                        n_iters=0)
+
+
+@pytest.fixture
+def block_lp_arrays():
+    """PHArrays over a synthetic block-structured sparse family (the
+    test_sparse_structured fixture shape), SparseA-uploaded with or
+    without the block/Woodbury structure."""
+    def build(structured):
+        rng = np.random.default_rng(42)
+        n_blk, bs, S = 6, 5, 5
+        n = n_blk * bs
+        rows = []
+        for k in range(n_blk):
+            for _ in range(7):
+                r = np.zeros(n)
+                sel = rng.choice(np.arange(k * bs, (k + 1) * bs), 3,
+                                 replace=False)
+                r[sel] = rng.normal(size=3)
+                rows.append(r)
+        for _ in range(3):
+            rows.append(np.where(rng.random(n) < 0.6,
+                                 rng.normal(size=n), 0.0))
+        A = np.array(rows)
+        m = A.shape[0]
+        b = rng.normal(size=(S, n)) @ A.T
+        c = rng.normal(size=(S, n))
+        sp = SparseA.from_dense(A, jnp.float64, structure=structured,
+                                min_blocks=2)
+        assert (sp.structure is not None) == structured
+        K = 5
+        arr = sharded.PHArrays(
+            c=jnp.asarray(c), q2=jnp.zeros((S, n)), A=sp,
+            cl=jnp.asarray(b - 1.0), cu=jnp.asarray(b + 1.0),
+            lb=jnp.full((S, n), -10.0), ub=jnp.full((S, n), 10.0),
+            const=jnp.zeros(S), probs=jnp.full(S, 1.0 / S),
+            onehot=jnp.ones((S, K, 1)),
+            nid_sk=jnp.zeros((S, K), jnp.int32))
+        settings = ADMMSettings(max_iter=200, restarts=2)
+        return arr, settings, np.arange(K), None
+
+    return build
+
+
+class TestHostIntegration:
+    """PHBase megastep windows vs the legacy per-iteration loop."""
+
+    @staticmethod
+    def make_ph(iters, mega, scens=3, **extra_opts):
+        from tpusppy.opt.ph import PH
+
+        options = {"defaultPHrho": 1.0, "PHIterLimit": iters,
+                   "convthresh": -1.0, "display_progress": False,
+                   "solver_options": {"megastep": mega}, **extra_opts}
+        return PH(options, farmer.scenario_names_creator(scens),
+                  farmer.scenario_creator,
+                  scenario_creator_kwargs={"num_scens": scens})
+
+    @pytest.mark.parametrize("iters,refresh_every", [
+        pytest.param(8, 16, marks=pytest.mark.slow),   # first-trace payer
+        (20, 16), (12, 4)])
+    def test_trajectory_matches_legacy(self, iters, refresh_every):
+        """The megastep hub reproduces the legacy trajectory — including
+        the acceptance-rejection refreshes — to host-vs-device
+        objective-assembly ulps (1e-9 relative)."""
+        ph_l = self.make_ph(iters, 1, solver_refresh_every=refresh_every)
+        ph_l.ph_main()
+        ph_m = self.make_ph(iters, 0, solver_refresh_every=refresh_every)
+        with obs_metrics.window() as w:
+            ph_m.ph_main()
+        assert int(w.delta("dispatch.megasteps")) >= 1
+        np.testing.assert_allclose(ph_m.W, ph_l.W, rtol=1e-9, atol=1e-9)
+        np.testing.assert_allclose(ph_m.xbars, ph_l.xbars, rtol=1e-9,
+                                   atol=1e-9)
+        assert ph_m.conv == pytest.approx(ph_l.conv, rel=1e-7, abs=1e-12)
+        assert ph_m._iter == ph_l._iter
+
+    def test_host_sync_drop(self):
+        """One packed fetch per megastep instead of one per iteration:
+        the hub's host-sync count drops by ~N."""
+        iters = 20
+        ph_l = self.make_ph(iters, 1)
+        with hostsync.track() as tl:
+            ph_l.ph_main()
+        ph_m = self.make_ph(iters, 0)
+        with hostsync.track() as tm, obs_metrics.window() as w:
+            ph_m.ph_main()
+        megasteps = int(w.delta("dispatch.megasteps"))
+        mega_iters = int(w.delta("dispatch.mega_iterations"))
+        assert megasteps >= 1 and mega_iters > megasteps
+        # every megastep replaces its iterations' per-iteration fetches
+        # with ONE packed fetch
+        assert tm.count <= tl.count - (mega_iters - megasteps)
+
+    def test_megastep_billing_executed_only(self):
+        """Mega-dispatch billing counts EXECUTED iterations (flops > 0,
+        mega_iterations consistent with the legacy loop's total)."""
+        with obs_metrics.window() as w:
+            ph = self.make_ph(12, 0)
+            ph.ph_main()
+        mega_iters = int(w.delta("dispatch.mega_iterations"))
+        megasteps = int(w.delta("dispatch.megasteps"))
+        assert 0 < mega_iters <= 12
+        assert w.delta("dispatch.flops") > 0
+        assert megasteps <= mega_iters
+
+    def test_forced_n_and_legacy_toggle(self):
+        """megastep=k requests N=k; megastep=1 forces the legacy path."""
+        with obs_metrics.window() as w:
+            ph = self.make_ph(9, 4)
+            ph.ph_main()
+        assert int(w.delta("dispatch.megasteps")) >= 2   # windows of <= 4
+        with obs_metrics.window() as w:
+            ph = self.make_ph(9, 1)
+            ph.ph_main()
+        assert int(w.delta("dispatch.megasteps")) == 0
+
+    def test_convthresh_stops_inside_window(self):
+        """The in-scan early exit honors convthresh: the run stops at the
+        same iteration as legacy."""
+        ph_l = self.make_ph(60, 1, convthresh=1e-1)
+        ph_l.ph_main()
+        ph_m = self.make_ph(60, 0, convthresh=1e-1)
+        ph_m.ph_main()
+        assert ph_m._iter == ph_l._iter
+        assert ph_m.conv == pytest.approx(ph_l.conv, rel=1e-7)
+
+    def test_extensions_force_legacy(self):
+        """Non-trivial extensions cannot run inside the scan: the gate
+        falls back to the legacy loop."""
+        from tpusppy.extensions.extension import Extension
+        from tpusppy.opt.ph import PH
+
+        class Counting(Extension):
+            calls = 0
+
+            def miditer(self):
+                Counting.calls += 1
+
+        options = {"defaultPHrho": 1.0, "PHIterLimit": 6,
+                   "convthresh": -1.0, "display_progress": False}
+        ph = PH(options, farmer.scenario_names_creator(3),
+                farmer.scenario_creator,
+                scenario_creator_kwargs={"num_scens": 3},
+                extensions=Counting)
+        with obs_metrics.window() as w:
+            ph.ph_main()
+        assert int(w.delta("dispatch.megasteps")) == 0
+        assert Counting.calls == 6
+
+    def test_megastep_autotune_hub_option(self):
+        """options['megastep_autotune'] makes the hub's first eligible
+        window run the probe protocol (real iterations, applied
+        normally) and bank a persistent verdict."""
+        from tpusppy import tune
+
+        ph = self.make_ph(20, 0, megastep_autotune=True)
+        with obs_metrics.window() as w:
+            ph.ph_main()
+        b = ph.batch
+        assert tune.megastep_verdict(
+            b.num_scenarios, b.num_vars, b.num_rows) is not None
+        # probes are real work: the run still completed all iterations
+        assert ph._iter == 20
+        assert int(w.delta("dispatch.megasteps")) >= 3   # 3 probe windows
+
+    def test_autotune_megastep_verdict_consulted(self):
+        """A banked autotune verdict bounds the hub's auto N."""
+        from tpusppy import tune
+
+        ph_probe = self.make_ph(1, 1)
+        b = ph_probe.batch
+        shape = (b.num_scenarios, b.num_vars, b.num_rows)
+        calls = []
+
+        def run_window(n):
+            calls.append(n)
+            return n
+
+        res = tune.autotune_megastep(run_window, shape, n_cap=64,
+                                     target_pct=1.0)
+        # three probe windows: compile-absorbing n=1, timed n=1, timed n=8
+        assert calls == [1, 1, 8]
+        assert 1 <= res.n <= 64
+        assert tune.megastep_verdict(*shape) == res.n
+        # the hub resolves auto-N to min(verdict, window, cap)
+        ph = self.make_ph(8, 0)
+        n_req = ph._megastep_request()
+        assert n_req <= max(2, res.n) or n_req == 0
+
+
+class TestWatchdogCap:
+    def test_cap_scales_inversely_with_iteration_cost(self):
+        st = ADMMSettings(max_iter=200)
+        small = segmented.megastep_cap(10, 20, 30, st)
+        big = segmented.megastep_cap(1000, 2000, 3000, st)
+        assert small > big
+        # reference-UC-scale shapes afford no megastep at all
+        assert segmented.megastep_cap(1000, 16008, 12408, st) <= 1
+
+    def test_cap_accounts_for_lowered_precision_refine(self):
+        """A lowered sweep mode's in-dispatch f32 refinement phase makes
+        each iteration's worst case BIGGER, never smaller (watchdog-safe)."""
+        hi = ADMMSettings(max_iter=200)
+        lo = ADMMSettings(max_iter=200, sweep_precision="default")
+        assert segmented.megastep_cap(100, 200, 300, lo) <= \
+            segmented.megastep_cap(100, 200, 300, hi) * 2
+
+    def test_bill_megastep_executed_only(self):
+        """A capped megastep bills only dispatched iterations, and the
+        flop bill scales linearly in them."""
+        with obs_metrics.window() as w:
+            f3 = segmented.bill_megastep(10, 20, 30, 3, 50.0)
+            f6 = segmented.bill_megastep(10, 20, 30, 6, 50.0)
+        assert f6 == pytest.approx(2 * f3)
+        assert int(w.delta("dispatch.mega_iterations")) == 9
+        assert int(w.delta("dispatch.megasteps")) == 2
+        assert w.delta("dispatch.flops") == pytest.approx(f3 + f6)

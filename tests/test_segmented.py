@@ -3,6 +3,7 @@
 behavior (budget, early exit, plateau grace) without device dependence."""
 
 import numpy as np
+import pytest
 
 from tpusppy.solvers import segmented
 
@@ -100,6 +101,59 @@ def test_speculative_waste_bounded_and_billed():
 
     assert flops.speculation_flops(10, 8, 6, 52) == \
         52 * flops.sweep_flops(10, 8, 6)
+
+
+def test_megastep_cap_scales_kill_budget_with_n():
+    """Mega-dispatch watchdog semantics: a megastep is N ITERATIONS of
+    work in one device program, so the per-dispatch kill budget scales
+    with N — the cap is the watchdog target over one iteration's worst
+    case, and shrinks as iteration cost grows."""
+    from tpusppy.solvers.admm import ADMMSettings
+
+    st = ADMMSettings(max_iter=200, restarts=2)
+    cap_small = segmented.megastep_cap(10, 44, 28, st)
+    cap_big = segmented.megastep_cap(1000, 2000, 1500, st)
+    assert cap_small > cap_big >= 0
+    # doubling the per-iteration sweep budget halves the cap (+- floor)
+    st2 = ADMMSettings(max_iter=400, restarts=2)
+    assert segmented.megastep_cap(1000, 2000, 1500, st2) <= cap_big
+    # reference-UC scale (segmentation regime): no megastep fits
+    assert segmented.megastep_cap(1000, 16008, 12408, st) <= 1
+    # explicit eff_flops/target stay authoritative (test monkeypatch slot)
+    assert segmented.megastep_cap(10, 44, 28, st, eff_flops=1e6,
+                                  target_secs=1e-9) == 0
+
+
+def test_megastep_bills_only_dispatched_iterations():
+    """The mega-dispatch billing invariant, extending the
+    discarded <= speculative <= dispatched discipline: a watchdog- or
+    window-capped megastep bills the iterations it actually ran (the
+    packed measurement's executed count), never the requested width,
+    and the flop bill is linear in them."""
+    from tpusppy.obs import metrics as obs_metrics
+    from tpusppy.solvers import flops
+
+    with obs_metrics.window() as w:
+        f2 = segmented.bill_megastep(10, 8, 6, 2, 52.0)
+        f5 = segmented.bill_megastep(10, 8, 6, 5, 52.0)
+    assert int(w.delta("dispatch.mega_iterations")) == 7
+    assert int(w.delta("dispatch.megasteps")) == 2
+    assert f5 == pytest.approx(2.5 * f2)
+    assert w.delta("dispatch.flops") == pytest.approx(f2 + f5)
+    assert flops.megastep_flops(10, 8, 6, 5, 52.0) == pytest.approx(f5)
+    # an early-exited (0-iteration) megastep bills zero flops
+    with obs_metrics.window() as w0:
+        assert segmented.bill_megastep(10, 8, 6, 0, 0.0) == 0
+    assert w0.delta("dispatch.flops") == 0
+    assert int(w0.delta("dispatch.megasteps")) == 1
+    # a REJECTED (refresh_hit) iterate is dispatched-but-discarded work:
+    # billed into flops + its own counter, never into mega_iterations
+    with obs_metrics.window() as wr:
+        fr = segmented.bill_megastep(10, 8, 6, 2, 52.0,
+                                     rejected_sweeps=52.0)
+    assert fr == pytest.approx(1.5 * f2)
+    assert int(wr.delta("dispatch.mega_iterations")) == 2
+    assert int(wr.delta("megastep.rejected_iterations")) == 1
 
 
 def test_dispatch_segments_no_segmentation_for_small():

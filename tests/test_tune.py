@@ -185,3 +185,71 @@ def test_flops_model_fields():
     assert note
     if mfu is not None:
         assert mfu > 0
+
+
+# ---- megastep stage + persistent-store schema v2 -------------------------
+def test_autotune_megastep_pick_and_persist(tmp_path):
+    """The megastep stage picks N from measured dispatch overhead, banks
+    the verdict under the "megastep" kind, and a fresh-store load serves
+    it without re-probing."""
+    import time as _time
+
+    tune.reset_persist()
+    tune.set_cache_path(str(tmp_path / "tune.json"))
+    calls = []
+
+    def run_window(n):
+        calls.append(n)
+        _time.sleep(0.002 + 0.001 * n)   # overhead 2ms + 1ms/iter
+        return n
+
+    res = tune.autotune_megastep(run_window, (7, 11, 13), n_cap=32)
+    assert calls == [1, 1, 8]
+    assert 1 <= res.n <= 32
+    assert res.overhead_secs >= 0 and res.per_iter_secs > 0
+    assert tune.megastep_verdict(7, 11, 13) == res.n
+    # fresh-process posture: the verdict loads from disk, zero probes
+    tune._mega_cache.clear()
+    with tune._persist_lock:
+        tune._persist["megastep"].clear()
+    tune._disk_loaded_from = None
+    calls.clear()
+    res2 = tune.autotune_megastep(run_window, (7, 11, 13), n_cap=32)
+    assert calls == [] and res2.n == res.n
+    tune.reset_persist()
+
+
+def test_persist_schema_v2_drops_foreign_versions():
+    """Tolerant load: a pre-megakernel (v1) store must neither crash nor
+    serve any verdict — its fused/pipeline keys were built without the
+    ADMMSettings.megastep field and could alias current ones."""
+    tune.reset_persist()
+    v1 = {"version": 1, "jax": tune._jax_version(),
+          "fused": {"k": {"chunk": 64}}, "pipeline": {"p": {"enabled": 1}}}
+    tune.import_state(v1)                 # no crash, nothing imported
+    st = tune.export_state()
+    assert st["version"] == tune._PERSIST_VERSION == 2
+    assert st["fused"] == {} and st["pipeline"] == {}
+    assert st["megastep"] == {}
+    # current-version state round-trips, megastep kind included
+    tune._persist_put("megastep", "(1, 2, 3)",
+                      {"n": 5, "per_iter_secs": 0.1, "overhead_secs": 0.2,
+                       "overhead_pct_at_n": 1.0})
+    st2 = tune.export_state()
+    tune.reset_persist()
+    tune.import_state(st2)
+    assert tune._persist_get("megastep", "(1, 2, 3)")["n"] == 5
+    tune.reset_persist()
+
+
+def test_fused_keys_carry_megastep_field():
+    """The fused/pipeline verdict keys include the megastep knob (via the
+    settings repr), so a verdict measured under one dispatch protocol can
+    never serve another."""
+    batch, mesh, settings, arr, idx, *_ = _setup()
+    k0 = tune._tune_key(arr, settings, mesh, "scen", 1.0, (8,), 64, 30.0,
+                        0.5, None, 1.5)
+    k1 = tune._tune_key(arr, dataclasses.replace(settings, megastep=1),
+                        mesh, "scen", 1.0, (8,), 64, 30.0, 0.5, None, 1.5)
+    assert repr(k0) != repr(k1)
+    assert "megastep" in repr(k0)

@@ -572,6 +572,184 @@ def make_ph_fused_step(nonant_idx: np.ndarray, settings: ADMMSettings,
     return fused
 
 
+def megastep_measure_len(n_iters: int, S: int, n: int, K: int) -> int:
+    """Length of the packed megastep measurement vector."""
+    return 6 * n_iters + 2 + 3 * S + S * n + 2 * S * K
+
+
+def megastep_unpack(vec, n_iters: int, S: int, n: int, K: int) -> dict:
+    """Split a fetched :func:`make_wheel_megastep` measurement.
+
+    Returns per-iteration arrays (length ``n_iters``; entries past
+    ``executed`` are inert zeros — the early-exit mask froze those steps):
+    ``conv``, ``eobj``, ``pri_max``, ``dua_max``, ``iters``, ``all_done``;
+    the ``executed`` iteration count; the ``refresh_hit`` flag (an
+    iterate failed the in-scan acceptance test — its update was masked
+    out, exactly as the serial protocol discards a rejected frozen
+    solve, and the host must refresh; index ``executed`` of the per-
+    iteration arrays then holds the REJECTED iterate's stats so its
+    dispatched sweeps can be billed); and the FINAL executed iterate's
+    ``pri``/``dua``/``done`` (S,), ``x`` (S, n), ``W``/``xbars`` (S, K) —
+    everything the host wheel reads between termination checks, from ONE
+    fetch."""
+    vec = np.asarray(vec)
+    N = n_iters
+    per = vec[:6 * N].reshape(6, N)
+    off = 6 * N
+    executed = int(vec[off])
+    refresh_hit = bool(vec[off + 1])
+    off += 2
+    out = {
+        "conv": per[0], "eobj": per[1], "pri_max": per[2],
+        "dua_max": per[3], "iters": per[4], "all_done": per[5] != 0.0,
+        "executed": executed, "refresh_hit": refresh_hit,
+        "pri": vec[off:off + S], "dua": vec[off + S:off + 2 * S],
+        "done": vec[off + 2 * S:off + 3 * S] != 0.0,
+    }
+    off += 3 * S
+    out["x"] = vec[off:off + S * n].reshape(S, n)
+    off += S * n
+    out["W"] = vec[off:off + S * K].reshape(S, K)
+    off += S * K
+    out["xbars"] = vec[off:off + S * K].reshape(S, K)
+    return out
+
+
+def make_wheel_megastep(nonant_idx: np.ndarray, settings: ADMMSettings,
+                        mesh: Mesh | None = None, axis: str = "scen",
+                        n_iters: int = 8, donate: bool = True):
+    """ONE jitted program running up to ``n_iters`` FROZEN wheel iterations
+    — the device-resident wheel megakernel (ROADMAP item 4).
+
+    Each scan step is a full PH wheel iteration: augmented objective from
+    the carried (W, xbars, rho), the frozen factor-reusing subproblem
+    sweep (dense, shared-A, or SparseA/structured — picked per trace from
+    ``arr.A``), and the PH outer update (``Compute_Xbar``/``Update_W``/
+    convergence, :mod:`tpusppy.phbase` ported to the pure device form
+    ``_ph_finish`` — under a mesh its scenario-axis contractions lower to
+    psum trees, so N iterations cost ZERO per-iteration host traffic).
+    The program returns the new device state plus ONE packed measurement
+    vector (:func:`megastep_unpack`): per-iteration stats, the executed
+    count, and the final iterate — the host fetches once per megastep
+    instead of once per iteration.
+
+    In-scan early exit: the scan always runs ``n_iters`` steps, but once
+    the PH convergence test fires (``conv < convthresh``, evaluated after
+    each iteration exactly like the serial loop's break) — or the step
+    index reaches the traced ``n_live`` budget — the remaining steps take
+    the dead ``lax.cond`` branch: no sweeps, state passes through
+    untouched.  The packed measurement records the true stopping
+    iteration, so results are identical to the serial per-iteration
+    protocol that broke at the same iteration, and a single compiled
+    program serves any executed count <= ``n_iters``.
+
+    In-scan ACCEPTANCE (the serial frozen protocol's per-iteration test,
+    ``spopt._solve_amortized``): an iterate that is neither eps-converged
+    nor within the traced ``accept_tol`` residual ladder is DISCARDED —
+    its state update is masked out and the window stops with
+    ``refresh_hit`` set, exactly as the serial path throws away a
+    rejected frozen solve and re-solves adaptively.  The host then runs
+    that iteration through the legacy refresh path, so trajectories stay
+    identical to serial even when factor aging degrades the frozen
+    residuals mid-window.  Pass ``accept_tol=inf`` to disable (raw
+    N-iteration fusion).
+
+    Callers must size ``n_iters`` within
+    :func:`tpusppy.solvers.segmented.megastep_cap` (a megastep is N
+    iterations of work against the worker watchdog's per-execution kill)
+    and bill executed iterations via
+    :func:`~tpusppy.solvers.segmented.bill_megastep`.  SINGLE-CONTROLLER
+    fetch contract: the packed measurement is fetched by the host, which
+    needs addressable shards (same restriction as the segmented
+    stop-stats protocol).
+
+    ``donate=True`` donates the incoming :class:`PHState` (the caller
+    rebinds); pass False for A/B comparisons re-entering one state.
+
+    Returns ``mega(state, arr, prox_on, factors, convthresh, n_live,
+    accept_tol) -> (state, packed)``.
+    """
+    if n_iters < 1:
+        raise ValueError(f"n_iters ({n_iters}) must be >= 1")
+    idx = jnp.asarray(nonant_idx)
+    _, shared_frozen, _, frozen_solve = _solver_fns_for(settings, mesh, axis)
+
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def mega(state: PHState, arr: PHArrays, prox_on, factors, convthresh,
+             n_live, accept_tol):
+        dt = settings.jdtype()
+        S = arr.c.shape[0]
+        n_live_t = jnp.asarray(n_live, jnp.int32)
+        thresh = jnp.asarray(convthresh, dt)
+        tol = jnp.asarray(accept_tol, dt)
+
+        def body(carry, k):
+            st, pri, dua, done_s, executed, stopped, refresh = carry
+            live = (~stopped) & (k < n_live_t)
+
+            def live_fn(op):
+                st, pri, dua, done_s, executed, stopped, refresh = op
+                q, q2, W, rho = _ph_objective(arr, st, prox_on, idx,
+                                              settings)
+                fsolve = (shared_frozen if arr.A.ndim == 2
+                          else frozen_solve)
+                sol = fsolve(q, q2, arr.A, arr.cl, arr.cu, arr.lb,
+                             arr.ub, st.x, st.z, st.y, st.yx, factors)
+                # the serial acceptance test (NaN/inf residuals — e.g. a
+                # divergence-frozen scenario — fail it too, so a rejected
+                # iterate can never poison the carried state)
+                ok = jnp.all(sol.done) | jnp.all(
+                    (sol.pri_res <= tol) & (sol.dua_res <= tol))
+                new_st, out = _ph_finish(arr, st, sol, W, rho, idx)
+                stats = jnp.stack([
+                    out.conv.astype(dt), out.eobj.astype(dt),
+                    jnp.max(sol.pri_res).astype(dt),
+                    jnp.max(sol.dua_res).astype(dt),
+                    jnp.max(sol.iters).astype(dt),
+                    jnp.all(sol.done).astype(dt)])
+                # rejected iterate: mask the whole STATE update (the
+                # serial protocol discards the failed frozen solve and
+                # re-solves adaptively — the host's refresh does that).
+                # Its stats row stays recorded at index ``executed`` so
+                # the host can BILL the dispatched-but-discarded sweeps.
+                sel = lambda a, b: jnp.where(ok, a, b)
+                new_st = jax.tree.map(sel, new_st, st)
+                # the serial loop breaks AFTER the iteration whose conv
+                # crossed the threshold: this iteration's state is kept,
+                # later ones are masked
+                return ((new_st, sel(sol.pri_res, pri),
+                         sel(sol.dua_res, dua), sel(sol.done, done_s),
+                         executed + ok.astype(jnp.int32),
+                         stopped | (ok & (out.conv < thresh)) | ~ok,
+                         refresh | ~ok),
+                        stats)
+
+            def dead_fn(op):
+                return op, jnp.zeros((6,), dt)
+
+            return jax.lax.cond(
+                live, live_fn, dead_fn,
+                (st, pri, dua, done_s, executed, stopped, refresh))
+
+        inf = jnp.full((S,), jnp.inf, dt)
+        carry0 = (state, inf, inf, jnp.zeros((S,), bool),
+                  jnp.zeros((), jnp.int32), jnp.zeros((), bool),
+                  jnp.zeros((), bool))
+        (st, pri, dua, done_s, executed, _, refresh), stats = jax.lax.scan(
+            body, carry0, jnp.arange(n_iters, dtype=jnp.int32))
+        packed = jnp.concatenate([
+            stats.T.reshape(-1),          # [conv|eobj|pri|dua|iters|done]xN
+            executed.astype(dt)[None], refresh.astype(dt)[None],
+            pri.astype(dt), dua.astype(dt), done_s.astype(dt),
+            st.x.astype(dt).reshape(-1),
+            st.W.astype(dt).reshape(-1),
+            st.xbars.astype(dt).reshape(-1),
+        ])
+        return st, packed
+
+    return mega
+
+
 def collect_traces(fused, state, arr, prox_on, n_chunks: int):
     """Drive ``n_chunks`` fused dispatches, DOUBLE-BUFFERING each chunk's
     trace D2H against the next chunk's device compute.

@@ -92,19 +92,23 @@ class PHBase(SPOpt):
     def _bump_state_version(self):
         self._state_version = getattr(self, "_state_version", 0) + 1
 
-    def Compute_Xbar(self, verbose=False):
-        """Per-node weighted averages of nonants (phbase.py:27-107)."""
-        xk = self._nonants_cached()                              # (S, K)
+    def _node_avgs(self, xk):
+        """(xbars, xsqbars) as scenario-indexed (S, K): per-node
+        probability-weighted E[x] and E[x^2] gathered back through
+        ``nid_sk`` (the Compute_Xbar core)."""
         p = self.probs[:, None]                                  # (S, 1)
         num = np.einsum("skn,sk->nk", self._onehot, p * xk)      # (N, K)
         sqnum = np.einsum("skn,sk->nk", self._onehot, p * xk * xk)
         den = np.einsum("skn,sk->nk", self._onehot, np.broadcast_to(p, xk.shape))
         den = np.maximum(den, 1e-300)
-        xbar_nk = num / den
-        xsqbar_nk = sqnum / den
         kidx = np.arange(self.nonant_length)[None, :]
-        self.xbars = xbar_nk[self.nid_sk, kidx]
-        self.xsqbars = xsqbar_nk[self.nid_sk, kidx]
+        return ((num / den)[self.nid_sk, kidx],
+                (sqnum / den)[self.nid_sk, kidx])
+
+    def Compute_Xbar(self, verbose=False):
+        """Per-node weighted averages of nonants (phbase.py:27-107)."""
+        xk = self._nonants_cached()                              # (S, K)
+        self.xbars, self.xsqbars = self._node_avgs(xk)
         if verbose:
             global_toc(f"xbar[:8]={self.xbars[0][:8]}")
 
@@ -128,13 +132,21 @@ class PHBase(SPOpt):
         b = self.batch
         idx = self.tree.nonant_indices
         q = np.array(b.c, copy=True)
-        q2 = np.array(b.q2, copy=True)
         if self.W_on:
             q[:, idx] += self.W
         if self.prox_on:
             q[:, idx] += -self.rho * self.xbars
-            q2[:, idx] += self.rho
-        return q, q2
+        return q, self._augmented_q2()
+
+    def _augmented_q2(self):
+        """q2 alone — the Factors-signature input (:meth:`_solve_sig`
+        never reads q).  Skips the W/xbars q assembly ``_augmented_q``
+        pays, which matters on the megastep hot loop where this runs
+        once per window as a pure staleness check."""
+        q2 = np.array(self.batch.q2, copy=True)
+        if self.prox_on:
+            q2[:, self.tree.nonant_indices] += self.rho
+        return q2
 
     def solve_ph_subproblems(self):
         self.extobject.pre_solve_loop()
@@ -237,46 +249,260 @@ class PHBase(SPOpt):
         _ckpt.restore_ph(self, ck)
         self._resume_ckpt = None
 
+    # ---- wheel megakernel (N iterations per dispatch) -----------------------
+    def _megastep_request(self) -> int:
+        """Resolved megakernel width N (>= 2) when the device-resident
+        wheel megastep may drive this hub's iterations, else 0 (legacy
+        per-iteration dispatch).
+
+        Gates (each falls back to legacy, never errors): the
+        ``ADMMSettings.megastep`` knob (1 = forced legacy); homogeneous
+        batch; trivial extensions and no ph_converger (their per-
+        iteration callouts cannot run inside the scan); no nonant fixing
+        overlay; W/prox on (the iterk posture); a frozen-amortized
+        refresh cadence; and shapes that fit ONE dispatch (megasteps
+        never segment).  N is the autotuner's banked verdict when one
+        exists (:func:`tpusppy.tune.megastep_verdict`), else the refresh
+        window (``refresh_every - 1``: one legacy refresh dispatch + one
+        megastep per cadence block), clamped by the watchdog cap
+        (:func:`~tpusppy.solvers.segmented.megastep_cap` — a megastep is
+        N iterations of work against the worker's per-execution kill).
+        """
+        from .extensions.extension import Extension
+        from .ir import BucketedBatch
+        from .solvers import segmented
+        from .solvers.sparse import SparseA
+
+        st = self.admm_settings
+        req = int(getattr(st, "megastep", 0) or 0)
+        if req == 1:
+            return 0
+        b = self.batch
+        if isinstance(b, BucketedBatch):
+            return 0
+        if type(self.extobject) is not Extension \
+                or self.ph_converger is not None:
+            return 0
+        if self._fixed_lb is not None or self._fixed_ub is not None:
+            return 0
+        if not (self.W_on and self.prox_on):
+            return 0
+        refresh_every = self._refresh_every()
+        if refresh_every <= 2:
+            return 0
+        S, n, m = b.num_scenarios, b.num_vars, b.num_rows
+        shared = getattr(b, "A_shared", None) is not None
+        sf = (segmented.SPARSE_DISPATCH_FACTOR if isinstance(
+            self._device_consts(st.jdtype())[0], SparseA) else 1.0)
+        fb = 1 if shared else S
+        _, seg_f = segmented.dispatch_segments(S, n, m, st, factor_batch=fb,
+                                               sparse_factor=sf)
+        if seg_f < st.max_iter:
+            return 0          # segmentation regime: the step pair owns it
+        cap = segmented.megastep_cap(S, n, m, st, factor_batch=fb,
+                                     sparse_factor=sf)
+        if req > 1:
+            n_sel = req
+        else:
+            from . import tune
+
+            n_sel = tune.megastep_verdict(S, n, m) or (refresh_every - 1)
+        n_sel = min(n_sel, refresh_every - 1, cap)
+        return n_sel if n_sel >= 2 else 0
+
+    def _megastep_window(self, k, max_iters, convthresh, n_req):
+        """One megastep window starting at iteration ``k``: returns
+        ``(executed, conv_hit)`` — ``executed == 0`` means the slot was
+        not megastep-ready (stale/aged factors, a dirty previous
+        measurement) and the caller must run a legacy iteration, which
+        refreshes/rescues and restores readiness."""
+        refresh_every = self._refresh_every()
+        if self._factors is None or self._warm is None:
+            return 0, False
+        if self._factors_age >= refresh_every:
+            return 0, False
+        # previous measurement must be clean — the serial frozen path's
+        # acceptance test; a dirty iterate routes through the legacy
+        # iteration (adaptive refresh + straggler rescue)
+        pri, dua = self.pri_res, self.dua_res
+        if pri is None or dua is None:
+            return 0, False
+        _, tol_qp = self._straggler_tols()
+        if not bool(np.all((pri <= tol_qp) & (dua <= tol_qp))):
+            # mirror the in-scan acceptance's all-done escape: an
+            # eps-converged batch is clean regardless of the residual
+            # ladder, and a window accepted that way may carry
+            # non-finite residuals on divergence-frozen scenarios —
+            # without the escape one frozen scenario would disable the
+            # megakernel for the rest of the run
+            if not getattr(self, "_last_all_done", False):
+                return 0, False
+        b = self.batch
+        if self._solve_sig(self._augmented_q2(), b.lb, b.ub) \
+                != self._factors_sig:
+            return 0, False
+        n_live = min(n_req, refresh_every - self._factors_age,
+                     max_iters - k + 1)
+        if n_live < 1:
+            return 0, False
+        # opt-in measured N (the tune.py megastep stage): the first
+        # eligible window runs the three probe windows through the normal
+        # machinery — real iterations, applied normally — and banks the
+        # verdict (persistent via TPUSPPY_TUNE_CACHE) for SUBSEQUENT runs
+        # of this shape; without the knob, auto-N stays cadence-derived
+        if (self.options.get("megastep_autotune")
+                and not getattr(self, "_mega_tuned", False)
+                and n_live >= 10):
+            self._mega_tuned = True
+            from . import tune
+
+            if tune.megastep_verdict(b.num_scenarios, b.num_vars,
+                                     b.num_rows) is None:
+                prog = {"k": k, "executed": 0}
+
+                def run_window(nl):
+                    # a probe must never run past convergence: once the
+                    # threshold fired, later windows do nothing (the
+                    # serial protocol would have broken the loop)
+                    if self.conv is not None and self.conv < convthresh:
+                        return 0
+                    # a rejected probe exhausts the factors (refresh_hit
+                    # ages them out); a further timed window from the
+                    # same state would deterministically re-reject — bail
+                    # like the normal window's readiness gate does
+                    if self._factors_age >= refresh_every:
+                        return 0
+                    m = self._megastep_solve(n_req, nl, convthresh,
+                                             self.W, self.xbars, self.rho)
+                    ex = m["executed"]
+                    if ex:
+                        self._apply_megastep_meas(prog["k"], m)
+                        prog["k"] += ex
+                        prog["executed"] += ex
+                    return ex
+
+                tune.autotune_megastep(
+                    run_window, (b.num_scenarios, b.num_vars, b.num_rows),
+                    n_cap=n_req)
+                return prog["executed"], bool(self.conv < convthresh)
+        meas = self._megastep_solve(n_req, n_live, convthresh,
+                                    self.W, self.xbars, self.rho)
+        executed = meas["executed"]
+        if executed == 0:
+            # the window's FIRST iterate failed the in-scan acceptance
+            # test (discarded; _megastep_solve exhausted the factors age)
+            # — the caller's legacy iteration refreshes, as serial would
+            return 0, False
+        self._apply_megastep_meas(k, meas)
+        # a short window is NOT convergence when the in-scan acceptance
+        # test ended it (refresh_hit): the loop continues through the
+        # legacy refresh instead
+        conv_hit = bool(self.conv < convthresh)
+        return executed, conv_hit
+
+    def _apply_megastep_meas(self, k, meas):
+        """Install one megastep window's packed measurement as the host PH
+        state (copies: the unpack returns views into one fetched vector)."""
+        executed = meas["executed"]
+        self.W = np.array(meas["W"], dtype=float)
+        self.xbars = np.array(meas["xbars"], dtype=float)
+        self.local_x = np.array(meas["x"], dtype=float)
+        self.pri_res = np.array(meas["pri"], dtype=float)
+        self.dua_res = np.array(meas["dua"], dtype=float)
+        self._last_all_done = bool(np.all(meas["done"]))
+        # xsqbars is not packed (no in-scan consumer): recompute the
+        # second moment host-side from the window's final x so PH state
+        # stays internally consistent — checkpoints capture it, and
+        # heuristics read it between windows (xbars comes off the device;
+        # the redundant E[x] half costs one einsum per WINDOW)
+        _, self.xsqbars = self._node_avgs(self._nonants_cached())
+        self.conv = float(meas["conv"][executed - 1])
+        self._iter = k + executed - 1
+        self._bump_state_version()
+        global_toc(
+            f"PH megastep {k}..{self._iter} conv {self.conv:.6e}",
+            self.options.get("display_progress", False),
+        )
+
     def iterk_loop(self):
-        """Main PH loop (phbase.py:875-979)."""
+        """Main PH loop (phbase.py:875-979).
+
+        When the device-resident wheel megakernel is eligible
+        (:meth:`_megastep_request`), iterations run in megastep WINDOWS:
+        one donated N-iteration device dispatch + ONE packed fetch per
+        window (doc/pipeline.md), with hub/spoke sync, termination checks
+        and checkpoint capture at window boundaries.  The legacy
+        per-iteration body below remains the refresh/rescue path (and the
+        whole path, under ``ADMMSettings.megastep = 1``).
+        """
         convthresh = self.options.get("convthresh", 0.0)
         max_iters = self.options["PHIterLimit"]
         # resumed runs continue the ITERATION COUNT from the checkpoint:
         # the limit stays the total-budget knob it always was
         start = int(getattr(self, "_iter_base", 0)) + 1
-        for k in range(start, max_iters + 1):
-            self._iter = k
-            # one span per PH iteration on the cylinder's own track
-            # (the wheel spinner names cylinder threads; solo runs land
-            # on "main") — the hub/spoke timeline rows of the trace
-            with _trace.span(None, "ph_iter") as _sp:
-                self.extobject.miditer()
-                self.solve_ph_subproblems()
-                self.Compute_Xbar()
-                self.Update_W()
-                self.conv = self.convergence_diff()
-                if _trace.enabled():   # payload dicts only when tracing
-                    _sp.add(iter=k, conv=self.conv)
-                self.extobject.enditer()
-            if self.spcomm is not None:
-                self.spcomm.sync()
-                self.extobject.enditer_after_sync()
-                if self.spcomm.is_converged():
-                    global_toc("Cylinder termination", True)
-                    break
+        mega_n = self._megastep_request()
+        k = start
+        while k <= max_iters:
+            if mega_n:
+                executed, conv_hit = self._megastep_window(
+                    k, max_iters, convthresh, mega_n)
+                if executed:
+                    k += executed
+                    if self.spcomm is not None:
+                        self.spcomm.sync()
+                        self.extobject.enditer_after_sync()
+                        if self.spcomm.is_converged():
+                            global_toc("Cylinder termination", True)
+                            break
+                    if conv_hit:
+                        global_toc(
+                            f"Convergence threshold {convthresh} reached "
+                            f"at iter {self._iter}",
+                            self.options.get("display_progress", False),
+                        )
+                        break
+                    continue
+            k = self._iterk_one(k, convthresh)
+            if k is None:
+                break
+            k += 1
+
+    def _iterk_one(self, k, convthresh):
+        """One legacy PH iteration (the pre-megakernel loop body).
+        Returns ``k`` to continue, or None to terminate the loop."""
+        self._iter = k
+        # one span per PH iteration on the cylinder's own track
+        # (the wheel spinner names cylinder threads; solo runs land
+        # on "main") — the hub/spoke timeline rows of the trace
+        with _trace.span(None, "ph_iter") as _sp:
+            self.extobject.miditer()
+            self.solve_ph_subproblems()
+            self.Compute_Xbar()
+            self.Update_W()
+            self.conv = self.convergence_diff()
+            if _trace.enabled():   # payload dicts only when tracing
+                _sp.add(iter=k, conv=self.conv)
+            self.extobject.enditer()
+        if self.spcomm is not None:
+            self.spcomm.sync()
+            self.extobject.enditer_after_sync()
+            if self.spcomm.is_converged():
+                global_toc("Cylinder termination", True)
+                return None
+        global_toc(
+            f"PH iter {k} conv {self.conv:.6e} Eobj {self.Eobjective():.4f}",
+            self.options.get("display_progress", False),
+        )
+        if self.conv is not None and self.conv < convthresh:
             global_toc(
-                f"PH iter {k} conv {self.conv:.6e} Eobj {self.Eobjective():.4f}",
+                f"Convergence threshold {convthresh} reached at iter {k}",
                 self.options.get("display_progress", False),
             )
-            if self.conv is not None and self.conv < convthresh:
-                global_toc(
-                    f"Convergence threshold {convthresh} reached at iter {k}",
-                    self.options.get("display_progress", False),
-                )
-                break
-            if self.ph_converger is not None and self.ph_converger.is_converged():
-                global_toc(f"User converger triggered at iter {k}", True)
-                break
+            return None
+        if self.ph_converger is not None and self.ph_converger.is_converged():
+            global_toc(f"User converger triggered at iter {k}", True)
+            return None
+        return k
 
     def post_loops(self) -> float:
         """Final expected objective (phbase.py:982-1037)."""

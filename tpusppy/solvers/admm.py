@@ -133,6 +133,17 @@ class ADMMSettings:
     # ``admm_pipeline`` config flag).  Host-dispatch-only: the traced
     # programs are unchanged.
     pipeline: bool = True
+    # Device-resident wheel megakernel (doc/pipeline.md): the PH hub runs
+    # N wheel iterations (frozen solve + xbar/W outer update) in ONE
+    # donated lax.scan dispatch and fetches ONE packed measurement per
+    # megastep instead of one per iteration.  0 = auto (the hub picks N
+    # from the autotuner's banked verdict when one exists, else from the
+    # refresh cadence clamped by the watchdog cap —
+    # ``segmented.megastep_cap``); 1 forces the legacy per-iteration
+    # dispatch everywhere (the ``admm_megastep`` config flag); k > 1
+    # requests that N (still watchdog-clamped).  Host-dispatch-only for
+    # the legacy toggle: the per-iteration traced programs are unchanged.
+    megastep: int = 0
 
     def jdtype(self):
         return jnp.dtype(self.dtype)

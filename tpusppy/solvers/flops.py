@@ -98,6 +98,23 @@ def speculation_flops(S, n, m, seg_f, overlap=1, sparse_factor=1.0):
         * sweep_flops(S, n, m, sparse_factor)
 
 
+def megastep_flops(S, n, m, n_iters, sweeps, sparse_factor=1.0):
+    """Model flops of ONE wheel megastep dispatch: ``n_iters`` frozen PH
+    iterations (sweep work only — the refresh rides its own dispatch at
+    the cadence boundary) of ``sweeps`` ADMM sweeps each.
+
+    This is the mega-dispatch billing unit: a megastep is N iterations of
+    work in one device program, so its dispatch accounting — watchdog
+    sizing (``segmented.megastep_cap``), FLOP billing
+    (``segmented.bill_megastep``) and the bench MFU denominator — must
+    scale with N, and a watchdog- or budget-capped megastep bills only
+    the iterations actually dispatched (callers pass the executed count,
+    never the requested one).
+    """
+    return max(0, int(n_iters)) * sweep_flops(S, n, m, sparse_factor) \
+        * max(float(sweeps), 1.0)
+
+
 def ph_iteration_flops(S, n, m, sweeps, refresh_every=16, restarts=1,
                        factor_batch=1, sparse_factor=1.0):
     """Model flops of one PH iteration, refresh cost amortized over the

@@ -60,6 +60,17 @@ def _frozen_refine_iters(st):
     return max(0, int(st.precision_refine_iters))
 
 
+def _frozen_iter_secs(st, t_sweep):
+    """Worst-case seconds of ONE frozen iteration: the full ``max_iter``
+    sweep budget at the (possibly lowered) sweep precision, plus the
+    in-dispatch f32 refinement phase a lowered mode appends.  The ONE
+    expression the fused-iteration budget and the megastep watchdog cap
+    must share — they are two views of the same worker-kill worst case."""
+    return (st.max_iter * t_sweep
+            / flops_model.sweep_speedup(st.sweep_precision)
+            + _frozen_refine_iters(st) * t_sweep)
+
+
 def seg_settings(settings, seg_iter):
     """Per-dispatch settings for one segment: the sweep cap, plus — for
     lowered sweep modes — the in-dispatch f32 refinement budget clamped
@@ -166,16 +177,63 @@ def fused_iteration_budget(S, n, m, st, refresh_every, factor_batch=1,
     t_factor = flops_model.factor_flops(n, m, factor_batch,
                                         sparse_factor) / eff
     rst = max(1, st.restarts)
-    # frozen iterations sweep at the (possibly lowered) sweep precision,
-    # plus the worst-case in-dispatch f32 refinement phase each carries
-    t_frozen_iter = (st.max_iter * t_sweep / flops_model.sweep_speedup(
-        st.sweep_precision) + _frozen_refine_iters(st) * t_sweep)
+    t_frozen_iter = _frozen_iter_secs(st, t_sweep)
     # the adaptive solve factorizes once PER RESTART (admm._solve_scaled's
     # restart scan calls _factor each round), matching dispatch_segments'
     # per-restart budget accounting
     t_refresh_iter = rst * (st.max_iter * t_sweep + t_factor)
     t_block = t_refresh_iter + (refresh_every - 1) * t_frozen_iter
     return int(target / max(t_block, 1e-12)) * refresh_every
+
+
+def megastep_cap(S, n, m, st, eff_flops=None, target_secs=None,
+                 factor_batch=1, sparse_factor=1.0):
+    """Max wheel iterations ONE megastep dispatch may carry for these
+    shapes under the worker watchdog (0 or 1 = don't megastep: the shape
+    is in the segmentation regime, or barely fits one iteration).
+
+    A megastep is N iterations of work inside a single device program, so
+    the per-dispatch kill budget must scale with N: the cap is sized on
+    the same worst-case flop model as :func:`dispatch_segments` — every
+    frozen iteration billed at its full ``max_iter`` sweep budget, plus
+    the in-dispatch f32 refinement phase a lowered sweep mode appends —
+    against the same ``target_secs`` watchdog budget.  The in-scan
+    early-exit mask never shrinks the worst case (a masked iteration does
+    no sweeps, but the cap must hold when nothing converges).
+    """
+    eff = _dense_clamped_eff(eff_flops, factor_batch)
+    target = _DISPATCH_TARGET_SECS if target_secs is None else target_secs
+    t_sweep = flops_model.sweep_flops(S, n, m, sparse_factor) / eff
+    return int(target / max(_frozen_iter_secs(st, t_sweep), 1e-12))
+
+
+def bill_megastep(S, n, m, n_iters, sweeps, sparse_factor=1.0,
+                  rejected_sweeps=None):
+    """Bill one EXECUTED megastep into the metrics registry.
+
+    ``n_iters`` is the number of wheel iterations the dispatch ACCEPTED
+    (the packed measurement's stop counter — iterations the early-exit
+    mask skipped did no sweeps and are NOT billed; a watchdog- or
+    window-capped megastep likewise bills only what was dispatched);
+    ``sweeps`` is the mean measured ADMM sweep count per iteration.
+    ``rejected_sweeps``: the sweep count of an iterate the in-scan
+    acceptance test DISCARDED (refresh_hit) — real dispatched work whose
+    result was dropped, billed into ``dispatch.flops`` and counted under
+    ``megastep.rejected_iterations`` but never into
+    ``dispatch.mega_iterations`` (it is not a fused PH iteration)."""
+    _metrics.inc("dispatch.megasteps")
+    _metrics.inc("dispatch.mega_iterations", int(n_iters))
+    fl = flops_model.megastep_flops(S, n, m, n_iters, sweeps, sparse_factor)
+    if rejected_sweeps is not None:
+        _metrics.inc("megastep.rejected_iterations")
+        fl += flops_model.megastep_flops(S, n, m, 1, rejected_sweeps,
+                                         sparse_factor)
+    if fl:
+        _metrics.inc("dispatch.flops", fl)
+    if _trace.enabled():
+        _trace.instant("dispatch", "megastep", S=S, n=n, m=m,
+                       iters=int(n_iters), sweeps=float(sweeps))
+    return fl
 
 
 # measured 2-4x cheaper sweeps on the SparseA/block-Woodbury path vs the

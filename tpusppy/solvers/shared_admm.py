@@ -253,13 +253,38 @@ def _core(q, q2s, q2ref, A, cl, cu, lb, ub, state, Kinv, K, rho_a, rho_x,
     # check_every block runs with A/Kinv/K VMEM-resident and genuine MXU
     # dot_generals at the sweep precision — see pallas_kernels
     from . import pallas_kernels
-    from .structured_kkt import BlockWoodbury
+    from .structured_kkt import BlockWoodbury, kinv_apply
     bs_sh = None
     if (allow_pallas and not adaptive and not sparse and K is not None
             and not isinstance(Kinv, BlockWoodbury)
             and st.use_pallas is not False):
         S_all, n_all = q.shape
         bs_sh = pallas_kernels.usable_shared(S_all, A.shape[0], n_all)
+    # sparse/structured engines: fused ELL sweep kernel (frozen path).
+    # The structured BlockWoodbury operator participates via a densified
+    # (n, n) K^-1 built ONCE per program — at kernel-eligible sizes the
+    # shared matrices must fit VMEM anyway, so the structured memory
+    # saving is moot and one kernel covers both engines.
+    bs_sp = None
+    Kinv_dense = diagK_sp = None
+    if (allow_pallas and not adaptive and sparse
+            and st.use_pallas is not False
+            and getattr(A, "ell", None) is not None):
+        S_all, n_all = q.shape
+        bs_sp = pallas_kernels.usable_sparse(
+            S_all, A.shape[0], n_all, A.ell.rowcols.shape[1],
+            A.ell.colrows.shape[1])
+        if bs_sp is not None:
+            # NOTE: the densification sits outside the sweep while_loop
+            # but INSIDE the solve program, so it re-runs once per
+            # dispatch (n Woodbury applies) even though Kinv only changes
+            # at refresh — acceptable while the kernel is the
+            # TPUSPPY_PALLAS_SPARSE opt-in (n is VMEM-small there);
+            # promoting the dense twin into SharedFactors is the fix if
+            # this path graduates to default-on.
+            Kinv_dense = (kinv_apply(Kinv, jnp.eye(n_all, dtype=q.dtype))
+                          if isinstance(Kinv, BlockWoodbury) else Kinv)
+            diagK_sp = (q2ref + rho_x + st.sigma)[None, :]
     kernel_prec = "highest" if prec is None else prec
 
     def block(x, z, zx, y, yx, Ax, gamma):
@@ -268,6 +293,18 @@ def _core(q, q2s, q2ref, A, cl, cu, lb, ub, state, Kinv, K, rho_a, rho_x,
         rho_a_s = g * rho_a[None, :]     # (S, m)
         rho_x_s = g * rho_x[None, :]     # (S, n)
         dq2 = q2s - g * q2ref[None, :]
+
+        if bs_sp is not None:
+            has = jnp.any(dq2 != 0).astype(x.dtype).reshape(1, 1)
+            return pallas_kernels.fused_sweeps_sparse(
+                q, A.ell.rowcols, A.ell.rowvals, A.ell.colrows,
+                A.ell.colvals, Kinv_dense, diagK_sp, cl, cu, lb, ub,
+                rho_a[None, :], rho_x[None, :], dq2, has, g,
+                x, z, zx, y, yx, Ax,
+                n_sweeps=max(1, st.check_every),
+                n_refine=st.solve_refine, n_extra=2,
+                sigma=float(st.sigma), alpha=float(alpha), bs=bs_sp,
+                precision=kernel_prec)
 
         if bs_sh is not None:
             has = jnp.any(dq2 != 0).astype(x.dtype).reshape(1, 1)

@@ -337,6 +337,7 @@ class SPOpt(SPBase):
         self.local_x = meas["x"]
         self.pri_res = meas["pri"]
         self.dua_res = meas["dua"]
+        self._last_all_done = bool(meas["all_done"])
         if ext is not None:
             ext.post_solve()
         return self.local_x
@@ -381,7 +382,7 @@ class SPOpt(SPBase):
         else:
             frozen_fn = admm.solve_batch_frozen
             factored_fn = admm.solve_batch_factored
-        refresh_every = int(self.options.get("solver_refresh_every", 16) or 0)
+        refresh_every = self._refresh_every()
         sig = (self._solve_sig(args[1], args[5], args[6])
                if refresh_every > 1 else None)
         sol = meas = None
@@ -507,10 +508,17 @@ class SPOpt(SPBase):
             dua[idx] = meas["dua"]
         self._warm = None          # homogeneous-path caches do not apply
         self._factors = None
+        self._last_all_done = False
         self.local_x = x_out
         self.pri_res = pri
         self.dua_res = dua
         return x_out
+
+    def _refresh_every(self) -> int:
+        """Frozen-factor refresh cadence — the ONE knob every consumer
+        (amortized solve slot, megastep window sizing/eligibility, age
+        exhaustion) must read identically."""
+        return int(self.options.get("solver_refresh_every", 16) or 0)
 
     def _straggler_tols(self):
         """(tol_lp, tol_qp) rescue-tolerance ladder.
@@ -672,6 +680,148 @@ class SPOpt(SPBase):
         meas = dict(meas, x=x, pri=pri, dua=dua, all_done=bool(done.all()))
         return (sol._replace(x=x, z=z, y=y, yx=yx, pri_res=pri, dua_res=dua,
                              done=done, raw=(x, z, y, yx)), meas)
+
+    # ---- wheel megakernel (device-resident N-iteration dispatch) ------------
+    def _mega_arrays(self, dt):
+        """Device-resident :class:`~tpusppy.parallel.sharded.PHArrays` for
+        the wheel megakernel (single-controller host path), cached on
+        batch identity/version like ``_device_consts`` (whose A/cl/cu it
+        shares — one device copy across cylinders).  Requires the PH-layer
+        attributes (``_onehot``/``nid_sk``/``probs``) the megastep's
+        device outer update contracts over; only :class:`PHBase` callers
+        reach here (the eligibility gate)."""
+        import jax.numpy as jnp
+
+        from .parallel import sharded
+
+        b = self.batch
+        key = (_batch_token(b), getattr(b, "version", 0), str(dt))
+        cached = getattr(self, "_mega_arr_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        A_d, cl_d, cu_d = self._device_consts(dt)
+        S = b.num_scenarios
+        arr = sharded.PHArrays(
+            c=jnp.asarray(b.c, dt), q2=jnp.asarray(b.q2, dt), A=A_d,
+            cl=cl_d, cu=cu_d,
+            lb=jnp.asarray(b.lb, dt), ub=jnp.asarray(b.ub, dt),
+            const=jnp.asarray(np.broadcast_to(b.const, (S,)), dt),
+            probs=jnp.asarray(self.probs, dt),
+            onehot=jnp.asarray(self._onehot, dt),
+            nid_sk=jnp.asarray(self.nid_sk, jnp.int32))
+        self._mega_arr_cache = (key, arr)
+        return arr
+
+    def _megastep_fn(self, n_req: int):
+        """The jitted megakernel for this instance at width ``n_req``
+        (one compile per distinct N; the traced ``n_live`` budget serves
+        every executed count below it)."""
+        cache = getattr(self, "_mega_fn_cache", None)
+        if cache is None:
+            cache = self._mega_fn_cache = {}
+        fn = cache.get(n_req)
+        if fn is None:
+            from .parallel import sharded
+
+            fn = sharded.make_wheel_megastep(
+                self.tree.nonant_indices, self.admm_settings, None,
+                n_iters=n_req, donate=True)
+            cache[n_req] = fn
+        return fn
+
+    def _megastep_solve(self, n_req: int, n_live: int, convthresh: float,
+                        W, xbars, rho):
+        """Dispatch ONE wheel megastep window and fetch its packed
+        measurement — the megakernel twin of ``n_live`` frozen
+        ``_solve_amortized`` iterations, sharing the same amortization
+        slot: warm state stays device-resident (the returned
+        :class:`~tpusppy.parallel.sharded.PHState` buffers become
+        ``self._warm``), the factors age advances by the executed count,
+        and the mega-dispatch is billed
+        (:func:`~tpusppy.solvers.segmented.bill_megastep`).  ONE host
+        fetch per window; the divergence / mixed-precision-guard
+        bookkeeping runs on the fetched measurement, and an unclean
+        final iterate forces the NEXT solve onto the legacy refresh path
+        (``_factors_age`` maxed) — the serial acceptance test at window
+        granularity.
+        """
+        import jax.numpy as jnp
+
+        from .parallel import sharded
+        from .solvers import segmented
+        from .solvers.sparse import SparseA
+
+        st = self.admm_settings
+        dt = st.jdtype()
+        arr = self._mega_arrays(dt)
+        b = self.batch
+        S, n, m = b.num_scenarios, b.num_vars, b.num_rows
+        K = self.nonant_length
+        warm = self._warm
+        state = sharded.PHState(
+            W=jnp.asarray(W, dt), xbars=jnp.asarray(xbars, dt),
+            rho=jnp.asarray(rho, dt),
+            x=jnp.asarray(warm[0], dt), z=jnp.asarray(warm[1], dt),
+            y=jnp.asarray(warm[2], dt), yx=jnp.asarray(warm[3], dt))
+        # in-scan acceptance at the serial ladder: the megastep solves
+        # the PH prox objective, so every scenario is QP
+        _, tol_qp = self._straggler_tols()
+        with _trace.span(None, "solve.megastep") as _sp:
+            state, packed = self._megastep_fn(n_req)(
+                state, arr, 1.0, self._factors, convthresh, n_live,
+                tol_qp)
+            # rebind the warm slot BEFORE the blocking fetch: the old
+            # buffers were donated into the dispatch, so a fetch failure
+            # (remote-tunnel error, fault injection) must not leave
+            # self._warm pointing at deleted device memory
+            self._warm = (state.x, state.z, state.y, state.yx)
+            meas = sharded.megastep_unpack(
+                hostsync.fetch(packed), n_req, S, n, K)
+            if _trace.enabled():
+                _sp.add(n_live=n_live, executed=meas["executed"],
+                        refresh_hit=meas["refresh_hit"])
+        executed = meas["executed"]
+        self._factors_age += executed
+        sf = (segmented.SPARSE_DISPATCH_FACTOR
+              if isinstance(arr.A, SparseA) else 1.0)
+        sweeps = float(np.mean(meas["iters"][:executed])) if executed else 0.0
+        # a rejected iterate (refresh_hit) is dispatched-but-discarded
+        # work; its stats sit at index ``executed`` of the packed arrays
+        rej = (float(meas["iters"][executed])
+               if meas["refresh_hit"] and executed < n_req else None)
+        segmented.bill_megastep(S, n, m, executed, sweeps, sparse_factor=sf,
+                                rejected_sweeps=rej)
+
+        refresh_every = self._refresh_every()
+        guard = False
+        if executed:
+            # mixed-precision residual guard on EVERY accepted iterate
+            # (the serial path runs it per frozen solve — a mid-window
+            # iterate parked above the precision floor must force the
+            # refresh even when the final iterate dips back under): the
+            # packed measurement's per-iteration worst residuals make
+            # this free of extra fetches.  The in-scan program cannot
+            # re-run at full precision, so a trip routes the NEXT solve
+            # through the legacy refresh (full precision by design).
+            ref = getattr(self, "_factors_ref_worst", None)
+            worsts = np.maximum(meas["pri_max"][:executed],
+                                meas["dua_max"][:executed])
+            guard = any(
+                admm.precision_guard_trips(
+                    None, st, ref,
+                    stats=(float(worsts[i]), bool(meas["all_done"][i])))
+                for i in range(executed))
+            if guard:
+                _metrics.inc("precision.guard_trips")
+        if meas["refresh_hit"] or guard:
+            # an in-scan iterate failed the serial acceptance test and
+            # was discarded (or the guard tripped): exhaust the factors
+            # age so the next iteration runs the legacy adaptive refresh
+            # + straggler rescue — exactly where the serial protocol
+            # lands, minus the already-discarded frozen attempt
+            self._factors_age = max(self._factors_age, refresh_every)
+            _metrics.inc("megastep.refresh_hits")
+        return meas
 
     # ---- expectations (Allreduce analogues) ---------------------------------
     def Eobjective(self, x=None) -> float:

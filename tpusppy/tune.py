@@ -96,8 +96,15 @@ _cache: dict = {}
 # are last-writer-wins per save — acceptable for a cache whose entries
 # are independently recomputable.
 # ---------------------------------------------------------------------------
-_PERSIST_VERSION = 1
-_persist: dict = {"fused": {}, "pipeline": {}}
+# Schema v2 (the megakernel PR): a "megastep" verdict kind joined the
+# store, and the fused/pipeline KEYS changed — ``ADMMSettings`` grew the
+# ``megastep`` field, which rides every settings repr in a key — so a v1
+# file's verdicts could otherwise never be distinguished from current
+# ones.  ``import_state`` drops foreign-version state wholesale (tolerant
+# load: an old cache file is just a cold cache, never a crash and never a
+# stale cadence/pipeline verdict served to a megakernel-enabled run).
+_PERSIST_VERSION = 2
+_persist: dict = {"fused": {}, "pipeline": {}, "megastep": {}}
 _persist_lock = threading.Lock()
 _disk_loaded_from: str | None = None
 
@@ -136,16 +143,25 @@ def export_state() -> dict:
     with _persist_lock:
         return {"version": _PERSIST_VERSION, "jax": _jax_version(),
                 "fused": dict(_persist["fused"]),
-                "pipeline": dict(_persist["pipeline"])}
+                "pipeline": dict(_persist["pipeline"]),
+                "megastep": dict(_persist["megastep"])}
 
 
 def import_state(state: dict):
     """Merge a snapshot produced by :func:`export_state` (same-jax-version
-    entries only; foreign measurements must not masquerade as local)."""
+    entries only; foreign measurements must not masquerade as local).
+
+    Foreign SCHEMA versions are dropped wholesale (tolerant load): a
+    pre-megakernel (v1) store's fused/pipeline verdicts were keyed
+    without the ``ADMMSettings.megastep`` field and must never be served
+    to a megakernel-enabled run — an old file is just a cold cache."""
     if not state or state.get("jax") not in (None, _jax_version()):
         return
+    if state.get("version") != _PERSIST_VERSION:
+        _metrics.inc("tune.disk_version_skips")
+        return
     with _persist_lock:
-        for kind in ("fused", "pipeline"):
+        for kind in ("fused", "pipeline", "megastep"):
             _persist[kind].update(state.get(kind) or {})
 
 
@@ -174,7 +190,8 @@ def load_cache(path: str | None = None) -> int:
         return 0                 # a torn/foreign file is just a cold cache
     import_state(state)
     with _persist_lock:
-        return len(_persist["fused"]) + len(_persist["pipeline"])
+        return (len(_persist["fused"]) + len(_persist["pipeline"])
+                + len(_persist["megastep"]))
 
 
 def _maybe_load_disk():
@@ -214,6 +231,8 @@ def reset_persist():
     with _persist_lock:
         _persist["fused"].clear()
         _persist["pipeline"].clear()
+        _persist["megastep"].clear()
+    _mega_cache.clear()
     _disk_loaded_from = None
     _cache_path_override = None
 
@@ -620,4 +639,122 @@ def autotune_pipeline(run_segment, sol, shape, seg_f, pay_factor=1.0,
             "enabled": bool(enabled), "seg_secs": float(seg_secs),
             "fetch_secs": float(fetch_secs),
             "waste_flops": float(res.waste_flops)})
+    return res
+
+
+# ---------------------------------------------------------------------------
+# Megastep stage: pick the wheel-megakernel width N per shape from MEASURED
+# dispatch overhead (ROADMAP item 4's "use obs dispatch-overhead data to
+# pick N").  Verdicts persist under the "megastep" kind, keyed like the
+# cadence/precision/pipeline verdicts, and the PH hub's auto path
+# (PHBase._megastep_request) consults them via :func:`megastep_verdict`.
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class MegastepTune:
+    n: int                    # picked megastep width (iterations/dispatch)
+    per_iter_secs: float      # marginal device cost per fused iteration
+    overhead_secs: float      # dispatch + packed-fetch overhead per window
+    overhead_pct_at_n: float  # modeled dispatch_overhead_pct at the pick
+
+
+_mega_cache: dict = {}
+
+
+def _mega_key(S, n, m):
+    return (int(S), int(n), int(m))
+
+
+def _mega_disk_lookup(key):
+    """Rehydrate a banked megastep verdict from the persistent store into
+    ``_mega_cache`` (None when the store holds none for ``key``)."""
+    dk = _persist_get("megastep", repr(key))
+    if dk is None:
+        return None
+    _metrics.inc("tune.disk_hits")
+    res = MegastepTune(
+        n=int(dk["n"]), per_iter_secs=float(dk["per_iter_secs"]),
+        overhead_secs=float(dk["overhead_secs"]),
+        overhead_pct_at_n=float(dk["overhead_pct_at_n"]))
+    _mega_cache[key] = res
+    return res
+
+
+def megastep_verdict(S, n, m) -> int | None:
+    """Banked autotuned megastep width for a shape (None = no verdict —
+    the hub then falls back to the refresh-window default)."""
+    key = _mega_key(S, n, m)
+    hit = _mega_cache.get(key) or _mega_disk_lookup(key)
+    return hit.n if hit is not None else None
+
+
+def autotune_megastep(run_window, shape, n_cap, target_pct: float = 1.0,
+                      n_probe: int | None = None, cache: bool = True):
+    """Measure the per-window dispatch+fetch overhead of the wheel
+    megakernel and pick the smallest N that amortizes it below
+    ``target_pct`` percent of the window wall (the farmer-m1
+    ``dispatch_overhead_pct < 1%`` target), clamped to ``n_cap`` (the
+    watchdog cap — :func:`segmented.megastep_cap` — and/or the refresh
+    window).
+
+    ``run_window(n)`` executes ONE megastep window of up to ``n`` wheel
+    iterations end to end (dispatch + packed measurement fetch) and
+    returns the executed iteration count.  Probe windows are REAL wheel
+    iterations — callers apply each window's measurement normally, so
+    warmup work is never wasted (the autotune_fused posture).  Three
+    windows run: a compile-absorbing n=1 warmup, a timed n=1 window (the
+    overhead + one iteration), and a timed ``n_probe`` window (the
+    marginal per-iteration cost).  The verdict is banked under the
+    "megastep" persist kind, so repeated runs (and resumed wheels) skip
+    the probes.
+    """
+    S, n, m = (int(v) for v in shape)
+    key = _mega_key(S, n, m)
+    if cache:
+        hit = _mega_cache.get(key) or _mega_disk_lookup(key)
+        if hit is not None:
+            return hit
+
+    n_cap = max(1, int(n_cap))
+    if n_probe is None:
+        n_probe = max(2, min(n_cap, 8))
+    n_probe = max(2, min(int(n_probe), max(2, n_cap)))
+    run_window(1)                       # compile-absorbing warmup window
+    t0 = time.time()
+    run_window(1)
+    t1 = time.time() - t0               # overhead + 1 iteration
+    t0 = time.time()
+    ex = int(run_window(n_probe))
+    tN = time.time() - t0               # overhead + ex iterations
+    if ex <= 1:
+        # degenerate probe (the window converged, or its first iterate
+        # failed the in-scan acceptance test): (tN - t1) measures noise,
+        # and a verdict derived from it would permanently steer this
+        # shape via the persistent store — return the conservative
+        # "don't megastep" answer WITHOUT banking, so the next run
+        # re-probes under normal conditions
+        _probe_event("megastep", {"S": S, "n": n, "m": m,
+                                  "skipped": "degenerate probe",
+                                  "executed": ex})
+        return MegastepTune(n=1, per_iter_secs=max(tN, 1e-9),
+                            overhead_secs=max(t1, 0.0),
+                            overhead_pct_at_n=100.0)
+    per_iter = max((tN - t1) / max(ex - 1, 1), 1e-9)
+    overhead = max(t1 - per_iter, 0.0)
+    f = max(target_pct, 1e-3) / 100.0
+    # overhead_pct(N) = o / (o + N*per_iter) <= f  =>  N >= o(1-f)/(f*p)
+    n_pick = int(np.ceil(overhead * (1.0 - f) / (f * per_iter)))
+    n_pick = max(1, min(n_pick, n_cap))
+    pct = 100.0 * overhead / (overhead + n_pick * per_iter)
+    res = MegastepTune(n=n_pick, per_iter_secs=per_iter,
+                       overhead_secs=overhead, overhead_pct_at_n=pct)
+    _probe_event("megastep", {"S": S, "n": n, "m": m, "pick": n_pick,
+                              "per_iter_secs": per_iter,
+                              "overhead_secs": overhead,
+                              "overhead_pct_at_n": pct})
+    if cache:
+        _mega_cache[key] = res
+        _persist_put("megastep", repr(key), {
+            "n": int(n_pick), "per_iter_secs": float(per_iter),
+            "overhead_secs": float(overhead),
+            "overhead_pct_at_n": float(pct)})
     return res

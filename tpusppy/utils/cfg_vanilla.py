@@ -56,6 +56,8 @@ def _admm_solver_options(cfg) -> dict:
         so.setdefault("sweep_precision", cfg.admm_sweep_precision)
     if _hasit(cfg, "admm_pipeline"):
         so.setdefault("pipeline", bool(cfg.admm_pipeline))
+    if _hasit(cfg, "admm_megastep"):
+        so.setdefault("megastep", int(cfg.admm_megastep))
     return so
 
 

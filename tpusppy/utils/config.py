@@ -516,6 +516,16 @@ class Config:
             "segment stop-stats RPC with device compute; identical "
             "results, bounded+billed waste.  False forces the legacy "
             "serial fetch-then-dispatch protocol", bool, True)
+        add("admm_megastep",
+            "device-resident wheel megakernel (doc/pipeline.md): the PH "
+            "hub runs N wheel iterations per dispatch and fetches ONE "
+            "packed measurement per megastep.  0 = auto: a banked "
+            "autotune verdict when one exists (the hub option "
+            "'megastep_autotune' measures and banks one on the first "
+            "eligible window; persisted via TPUSPPY_TUNE_CACHE), else "
+            "the refresh-cadence window, both under the watchdog cap.  "
+            "1 = force the legacy per-iteration dispatch; k > 1 = "
+            "request N=k", int, 0)
 
 
 def global_config() -> Config:
