@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""Cold-vs-warm smoke: the AOT executable cache must kill the cold start.
+
+The nightly CI acceptance for the executable cache
+(tpusppy/solvers/aot.py; doc/autotuner.md "Cold start"), runnable
+locally too::
+
+    JAX_PLATFORMS=cpu python scripts/cold_warm_smoke.py
+
+Two legs, each a REAL OS process (fresh interpreter, fresh jax — the
+posture the cache exists for), sharing ONE fresh cache directory created
+by this parent (both the executable cache and the jax persistent
+compilation cache live inside it, so NOTHING ambient can pre-warm the
+cold leg):
+
+1. **cold** — empty cache: every program lowers + compiles; serializable
+   executables (frozen sweeps, wheel megastep, packed measurements) are
+   persisted, factorization programs fall to the jax-cache tier.
+2. **warm** — same directory, second identical-shape run: must reach its
+   FIRST PH ITERATION (program build + Iter0 + the first frozen
+   iteration, the step-pair path every bench segment starts with) at
+   least ``SMOKE_SPEEDUP``x faster than the cold leg, with
+   ``aot.hits > 0`` and the warm leg's ``compile_iter0_s`` at most
+   ``SMOKE_ITER0_FRAC`` of the cold leg's.  The warm leg runs TWICE and
+   the faster run counts: warmness is not degraded by repetition, and
+   the co-tenant noise on shared CI/container hosts is the dominant
+   wobble on a ~3 s measurement.
+
+Threshold honesty: measured best-case on this container is ~8x
+first-iter speedup with warm iter0 at ~0.12x cold (banked in
+BENCH_r07.json), but the cold leg's compile wall wobbles 2-3x with box
+load, and on CPU the adaptive/refresh programs can never serialize
+(their LAPACK custom calls are by-pointer — see
+``aot.SAFE_CUSTOM_CALLS``), leaving a retrace+cached-compile floor of
+~2-3 s on the warm side.  The DEFAULT assertions are therefore set
+where they hold under noise (3x / 0.5x); on TPU, where cholesky lowers
+natively and the refresh programs persist too, tighten via
+``SMOKE_SPEEDUP`` / ``SMOKE_ITER0_FRAC``.
+
+Each leg reports ``t_first_iter_s`` (wall from "batch on host" to the
+first PH iteration's fetched result), ``compile_iter0_s``, and the
+``aot.*`` counters.  Exit code 0 = pass.  The worker leg is this same
+file with ``--worker`` (config via SMOKE_* env), so the smoke has no
+test-harness dependencies.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SPEEDUP = float(os.environ.get("SMOKE_SPEEDUP", "3.0"))
+ITER0_FRAC = float(os.environ.get("SMOKE_ITER0_FRAC", "0.5"))
+
+
+def log(msg):
+    print(f"cold-warm-smoke: {msg}", file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Worker leg (child process)
+# ---------------------------------------------------------------------------
+def worker():
+    import time
+
+    sys.path.insert(0, REPO)
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import numpy as np
+
+    from tpusppy import tune as tuner
+    from tpusppy.ir import ScenarioBatch
+    from tpusppy.models import farmer
+    from tpusppy.obs import metrics
+    from tpusppy.parallel import sharded
+    from tpusppy.solvers.admm import ADMMSettings
+
+    leg = os.environ["SMOKE_MODE"]
+    S = int(os.environ.get("SMOKE_SCENS", "24"))
+    mult = int(os.environ.get("SMOKE_CROPS_MULT", "2"))
+    chunk = int(os.environ.get("SMOKE_CHUNK", "8"))
+
+    names = farmer.scenario_names_creator(S)
+    batch = ScenarioBatch.from_problems([
+        farmer.scenario_creator(nm, num_scens=S, crops_multiplier=mult)
+        for nm in names])
+    st = ADMMSettings(dtype="float64", eps_abs=1e-5, eps_rel=1e-5,
+                      max_iter=200, restarts=2, scaling_iters=6,
+                      polish_passes=1)
+    mesh = sharded.make_mesh(1)
+    arr = sharded.shard_batch(batch, mesh)
+    idx = batch.tree.nonant_indices
+
+    # ---- the measured window: everything between "batch is on the
+    # host" and "the first PH iteration's result is in host hands" —
+    # program construction, compiles/deserializes, Iter0 (adaptive
+    # refresh: falls to the jax-cache tier on CPU, where its LAPACK
+    # custom calls bar executable serialization), then the first REAL PH
+    # iteration on the frozen steady-state program (fully AOT-cached:
+    # the warm leg deserializes it instead of compiling).  This is the
+    # step-pair path bench.py's flagship segment starts every run with.
+    t0 = time.perf_counter()
+    tuner.prewarm_aot()
+    refresh, frozen = sharded.make_ph_step_pair(idx, st, mesh)
+    state = sharded.init_state(arr, 1.0, st)
+    t_i0 = time.perf_counter()
+    state, out, factors = refresh(state, arr, 0.0)  # Iter0 (compiles here)
+    np.asarray(out.conv)
+    compile_iter0_s = time.perf_counter() - t_i0
+    state, out = frozen(state, arr, 1.0, factors)   # first PH iteration
+    conv1 = float(np.asarray(out.conv))
+    t_first_iter_s = time.perf_counter() - t0
+    # the fused multi-iteration program rides the same caches (jax-cache
+    # tier on CPU — its refresh blocks carry the LAPACK calls; full AOT
+    # on TPU); build + run one window so the smoke exercises it too,
+    # OUTSIDE the first-iteration clock
+    fused = sharded.make_ph_fused_step(idx, st, mesh, chunk=chunk,
+                                       refresh_every=chunk)
+    state, out = fused(state, arr, 1.0)
+    np.asarray(out.conv)
+
+    res = {
+        "leg": leg,
+        "t_first_iter_s": t_first_iter_s,
+        "compile_iter0_s": compile_iter0_s,
+        "conv1": conv1,
+        "aot": {k: metrics.value(f"aot.{k}")
+                for k in ("hits", "misses", "unserializable", "compile_s",
+                          "deserialize_s", "serialize_errors",
+                          "load_errors")},
+    }
+    with open(os.path.join(os.environ["SMOKE_DIR"],
+                           f"result_{leg}.json"), "w") as f:
+        json.dump(res, f)
+    print(json.dumps(res), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Orchestration (parent)
+# ---------------------------------------------------------------------------
+def _run_leg(mode, base, timeout=900):
+    env = dict(os.environ, SMOKE_MODE=mode, SMOKE_DIR=base,
+               PYTHONPATH=REPO,
+               TPUSPPY_AOT_CACHE=os.path.join(base, "aot"),
+               TPUSPPY_TUNE_CACHE=os.path.join(base, "tune.json"),
+               # hermetic: the cold leg must not warm-start from an
+               # ambient jax cache
+               JAX_COMPILATION_CACHE_DIR=os.path.join(base, "xla"))
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("JAX_ENABLE_X64", "1")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker"], env=env)
+    try:
+        rc = proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        raise SystemExit(f"{mode} leg timed out after {timeout}s")
+    if rc != 0:
+        raise SystemExit(f"{mode} leg failed rc={rc}")
+    with open(os.path.join(base, f"result_{mode}.json")) as f:
+        return json.load(f)
+
+
+def main():
+    import tempfile
+
+    base = tempfile.mkdtemp(prefix="cold_warm_smoke_")
+    log(f"workdir {base}")
+
+    cold = _run_leg("cold", base)
+    log(f"cold: first-iter {cold['t_first_iter_s']:.2f}s "
+        f"iter0 {cold['compile_iter0_s']:.2f}s aot={cold['aot']}")
+    assert cold["aot"]["misses"] > 0, "cold leg compiled nothing?"
+    assert cold["aot"]["serialize_errors"] == 0, cold["aot"]
+
+    # two warm runs, fastest counts (see the module docstring): each is
+    # a REAL fresh process; repetition cannot fake warmness, it only
+    # sheds co-tenant noise from the small measurement
+    warm_runs = [_run_leg("warm", base) for _ in range(2)]
+    warm = min(warm_runs, key=lambda w: w["t_first_iter_s"])
+    for w in warm_runs:
+        log(f"warm: first-iter {w['t_first_iter_s']:.2f}s "
+            f"iter0 {w['compile_iter0_s']:.2f}s aot={w['aot']}")
+
+    speedup = cold["t_first_iter_s"] / max(warm["t_first_iter_s"], 1e-9)
+    iter0_frac = (warm["compile_iter0_s"]
+                  / max(cold["compile_iter0_s"], 1e-9))
+    log(f"first-iter speedup {speedup:.1f}x "
+        f"(need >= {SPEEDUP}x), warm iter0 at {iter0_frac:.2f}x cold "
+        f"(need <= {ITER0_FRAC}x)")
+
+    assert warm["aot"]["hits"] > 0, \
+        f"warm leg hit nothing: {warm['aot']}"
+    assert warm["aot"]["load_errors"] == 0, warm["aot"]
+    # identical trajectory, cold or warm — the cache must never change
+    # the math
+    assert abs(warm["conv1"] - cold["conv1"]) < 1e-9, \
+        f"warm conv {warm['conv1']} != cold conv {cold['conv1']}"
+    assert speedup >= SPEEDUP, \
+        f"warm first-iter only {speedup:.1f}x faster (need {SPEEDUP}x)"
+    assert iter0_frac <= ITER0_FRAC, \
+        f"warm iter0 at {iter0_frac:.2f}x cold (need <= {ITER0_FRAC}x)"
+    print(json.dumps({"cold": cold, "warm": warm,
+                      "speedup": round(speedup, 2),
+                      "iter0_frac": round(iter0_frac, 3)}))
+    log("PASS")
+
+
+if __name__ == "__main__":
+    if "--worker" in sys.argv[1:]:
+        worker()
+    else:
+        main()

@@ -94,19 +94,34 @@ def worker():
     ws = WheelSpinner(hub, spokes, resume=resume).spin()
     gap = ((ws.BestInnerBound - ws.BestOuterBound)
            / abs(ws.BestOuterBound))
+    # AOT executable-cache evidence (tpusppy/solvers/aot.py): the victim
+    # compiles cold and serializes; the RESUME leg re-arms the cache from
+    # the checkpoint's carried pointer (no env knob of its own) and must
+    # restart warm — checkpoint + cache compose
+    from tpusppy.obs import metrics
+
+    aot = {k: metrics.value(f"aot.{k}")
+           for k in ("hits", "misses", "compile_s", "deserialize_s",
+                     "load_errors")}
     with open(os.path.join(ckdir, f"result_{mode}.json"), "w") as f:
         json.dump({"inner": ws.BestInnerBound, "outer": ws.BestOuterBound,
-                   "rel_gap": gap,
+                   "rel_gap": gap, "aot": aot,
                    "resumed_from": ws.resumed_from}, f)
-    print(json.dumps({"mode": mode, "rel_gap": gap}), flush=True)
+    print(json.dumps({"mode": mode, "rel_gap": gap, "aot": aot}),
+          flush=True)
 
 
 # ---------------------------------------------------------------------------
 # Orchestration (parent)
 # ---------------------------------------------------------------------------
-def _run_leg(mode, ckdir, timeout=900):
+def _run_leg(mode, ckdir, timeout=900, env_extra=None):
     env = dict(os.environ, SMOKE_MODE=mode, SMOKE_DIR=ckdir,
                PYTHONPATH=REPO)
+    # the legs control the executable cache EXPLICITLY (env_extra): the
+    # victim arms it, the resume leg must inherit it from the checkpoint
+    # pointer alone — an ambient knob would fake the composition proof
+    env.pop("TPUSPPY_AOT_CACHE", None)
+    env.update(env_extra or {})
     env.setdefault("JAX_PLATFORMS", "cpu")
     return subprocess.Popen([sys.executable, os.path.abspath(__file__),
                              "--worker"], env=env), timeout
@@ -140,7 +155,14 @@ def main():
 
     victim_dir = os.path.join(base, "victim")
     os.makedirs(victim_dir)
-    proc, _ = _run_leg("victim", victim_dir)
+    # the victim runs with the AOT executable cache armed and its own
+    # FRESH jax compile cache (the golden leg must not pre-warm it):
+    # its checkpoints carry the cache pointer, and the resume leg —
+    # which gets NEITHER knob — must restart warm from that pointer
+    aot_dir = os.path.join(base, "aot")
+    victim_env = {"TPUSPPY_AOT_CACHE": aot_dir,
+                  "JAX_COMPILATION_CACHE_DIR": os.path.join(base, "xla")}
+    proc, _ = _run_leg("victim", victim_dir, env_extra=victim_env)
     def _banked_iteration():
         """Newest checkpointed iteration (0 when none yet) — iteration,
         not file count: the manager prunes to keep=3 files, so counting
@@ -152,8 +174,14 @@ def main():
             return 0
 
     t0 = time.time()
+    t_first_ckpt = None
     try:
         while _banked_iteration() < KILL_AFTER:
+            if t_first_ckpt is None and _banked_iteration() >= 1:
+                # cold-start anchor: everything the victim compiled plus
+                # its first iterations fits in this window — the resumed
+                # process must spend far less than this in compiles
+                t_first_ckpt = time.time() - t0
             if proc.poll() is not None:
                 raise SystemExit(
                     f"victim exited early rc={proc.returncode} — cannot "
@@ -161,6 +189,8 @@ def main():
             if time.time() - t0 > 600:
                 raise SystemExit("victim produced no checkpoints in 600s")
             time.sleep(0.2)
+        if t_first_ckpt is None:
+            t_first_ckpt = time.time() - t0
         os.kill(proc.pid, signal.SIGKILL)    # the preemption, for real
         proc.wait(timeout=60)
     finally:
@@ -174,11 +204,14 @@ def main():
     # the resumed wheel must RUN, not just reload: give it a real
     # iteration budget past the snapshot whatever speed the box killed at
     os.environ["SMOKE_RESUME_ITERS"] = str(max(40, ck.iteration + 20))
-    proc, t = _run_leg("resume", victim_dir)
+    # resume gets the victim's jax-cache tier but NOT the aot knob — the
+    # executable cache must re-arm from the checkpoint's pointer alone
+    proc, t = _run_leg("resume", victim_dir, env_extra={
+        "JAX_COMPILATION_CACHE_DIR": os.path.join(base, "xla")})
     _wait(proc, t, "resume")
     res = json.load(open(os.path.join(victim_dir, "result_resume.json")))
     log(f"resumed rel_gap={res['rel_gap']:.3e} "
-        f"(golden {golden['rel_gap']:.3e})")
+        f"(golden {golden['rel_gap']:.3e}) aot={res.get('aot')}")
 
     assert res["resumed_from"] == ck.iteration, \
         f"resume did not pick up the snapshot: {res['resumed_from']}"
@@ -188,6 +221,21 @@ def main():
     # certified no worse than the uninterrupted golden
     assert res["rel_gap"] <= max(golden["rel_gap"], 1e-3) + 1e-9, \
         f"resumed gap {res['rel_gap']} worse than golden {golden['rel_gap']}"
+    # warm restart (checkpoint + AOT executable cache compose): the
+    # resume leg was launched WITHOUT the cache env knob — its hits can
+    # only come from the checkpoint's carried pointer — and its total
+    # explicit compile seconds must be a small fraction of the window
+    # the cold victim needed to even reach its first snapshot
+    aot = res.get("aot") or {}
+    assert aot.get("hits", 0) > 0, \
+        f"resume did not restart warm from the checkpoint pointer: {aot}"
+    assert aot.get("load_errors", 0) == 0, aot
+    assert aot.get("compile_s", 1e9) <= 0.5 * t_first_ckpt, \
+        (f"resume compiled {aot.get('compile_s'):.1f}s vs victim "
+         f"cold-start window {t_first_ckpt:.1f}s — not a warm restart")
+    log(f"warm restart ok: {aot.get('hits'):.0f} executable hits, "
+        f"{aot.get('compile_s'):.1f}s compiles vs {t_first_ckpt:.1f}s "
+        "cold window")
     log("PASS")
 
 

@@ -46,7 +46,7 @@ def _obs_isolation():
     from tpusppy import tune
     from tpusppy.obs import metrics, trace
     from tpusppy.resilience import faults
-    from tpusppy.solvers import hostsync
+    from tpusppy.solvers import aot, hostsync
 
     hostsync.reset()
     trace.disable()
@@ -54,6 +54,7 @@ def _obs_isolation():
     metrics.reset()
     faults.disarm()
     tune.reset_persist()
+    aot.reset()
     yield
     hostsync.reset()
     trace.disable()
@@ -61,6 +62,7 @@ def _obs_isolation():
     metrics.reset()
     faults.disarm()
     tune.reset_persist()
+    aot.reset()
 
 
 def pytest_collection_finish(session):

@@ -249,7 +249,14 @@ def capture_ph(opt, hub=None) -> WheelCheckpoint | None:
         },
     )
     from .. import tune as _tune
+    from ..solvers import aot as _aot
 
+    # the executable-cache POINTER rides the snapshot: a resumed process
+    # (possibly launched without the env knob) re-arms the same cache and
+    # reaches its first PH iteration warm — checkpoint + cache compose
+    # (WheelSpinner._prewarm_executables consumes this)
+    if _aot.cache_path():
+        ck.meta["aot_cache"] = os.path.abspath(_aot.cache_path())
     ck.tune_state = _tune.export_state()
     if hub is not None:
         ck.best_inner = float(getattr(hub, "BestInnerBound", float("inf")))

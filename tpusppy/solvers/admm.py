@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import aot as _aot
+
 BIG = 1e20  # stand-in for +inf inside kernels (keeps arithmetic finite)
 
 
@@ -921,6 +923,15 @@ def solve_batch(c, q2, A, cl, cu, lb, ub, settings: ADMMSettings = ADMMSettings(
         return _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm, P)
 
 
+# AOT executable cache (tpusppy/solvers/aot.py): the batch-solve entry
+# points are what spopt's amortized solve loop dispatches every wheel
+# iteration — persisting their executables is the wheel's warm start.
+# Strict passthrough when TPUSPPY_AOT_CACHE is disarmed, and nested
+# (in-trace) calls inline exactly like the plain jit.
+solve_batch = _aot.cached_program(solve_batch, "admm.solve_batch",
+                                  static_names=("settings",))
+
+
 def _prep(c, q2, A, cl, cu, lb, ub, settings, P, want_masks=True):
     """Dtype casting, bound cleaning, finiteness masks — shared by the
     adaptive and frozen entry points.  ``want_masks=False`` skips the mask
@@ -1107,6 +1118,11 @@ def solve_batch_frozen(c, q2, A, cl, cu, lb, ub, factors: Factors,
                                   settings, P, polish=polish)
 
 
+solve_batch_frozen = _aot.cached_program(
+    solve_batch_frozen, "admm.solve_batch_frozen",
+    static_names=("settings", "polish"))
+
+
 @jax.jit
 def stop_stats(sol: BatchSolution):
     """[max iters, max pri_res, max dua_res, all_done] as ONE device array.
@@ -1125,6 +1141,9 @@ def stop_stats(sol: BatchSolution):
                       sol.pri_res.max().astype(dt),
                       sol.dua_res.max().astype(dt),
                       jnp.all(sol.done).astype(dt)])
+
+
+stop_stats = _aot.cached_program(stop_stats, "admm.stop_stats")
 
 
 def precision_guard_trips(sol: BatchSolution, settings: ADMMSettings,
@@ -1190,6 +1209,9 @@ def measure_pack(sol: BatchSolution):
         jnp.all(sol.done).astype(dt)[None],
         sol.x.astype(dt).reshape(-1),
     ])
+
+
+measure_pack = _aot.cached_program(measure_pack, "admm.measure_pack")
 
 
 def measure_unpack(vec, S, n):
@@ -1295,10 +1317,21 @@ def dual_objective_margin(c, q2, A, cl, cu, lb, ub, y, x_hint,
     return jnp.sum(per, axis=1)
 
 
-@_highest_precision
 @jax.jit
-def dual_objective_with_margin(c, q2, A, cl, cu, lb, ub, y, x_hint,
-                               margin_scale=100.0):
+def _dual_objective_with_margin_jit(c, q2, A, cl, cu, lb, ub, y, x_hint,
+                                    margin_scale=100.0):
+    base = dual_objective(c, q2, A, cl, cu, lb, ub, y, x_hint,
+                          margin_scale)
+    marg = dual_objective_margin(c, q2, A, cl, cu, lb, ub, y, x_hint,
+                                 margin_scale)
+    return jnp.stack([base, marg])
+
+
+# _highest_precision OUTSIDE the executable cache so an AOT lower+compile
+# still traces under the pinned full-precision matmul context
+dual_objective_with_margin = _highest_precision(_aot.cached_program(
+    _dual_objective_with_margin_jit, "admm.dual_objective_with_margin"))
+dual_objective_with_margin.__doc__ = \
     """(2, S): :func:`dual_objective` stacked with
     :func:`dual_objective_margin` in ONE device program.
 
@@ -1307,11 +1340,6 @@ def dual_objective_with_margin(c, q2, A, cl, cu, lb, ub, y, x_hint,
     remote tunnel — this packs them into a single dispatch + fetch (the
     single-fetch wheel-iteration discipline, doc/pipeline.md).
     """
-    base = dual_objective(c, q2, A, cl, cu, lb, ub, y, x_hint,
-                          margin_scale)
-    marg = dual_objective_margin(c, q2, A, cl, cu, lb, ub, y, x_hint,
-                                 margin_scale)
-    return jnp.stack([base, marg])
 
 
 @_highest_precision
@@ -1369,6 +1397,11 @@ def solve_batch_factored(c, q2, A, cl, cu, lb, ub,
     with jax.default_matmul_precision(settings.matmul_precision):
         return _solve_impl(c, q2, A, cl, cu, lb, ub, settings, warm, P,
                            want_factors=True)
+
+
+solve_batch_factored = _aot.cached_program(
+    solve_batch_factored, "admm.solve_batch_factored",
+    static_names=("settings",))
 
 
 class SingleSolution(NamedTuple):

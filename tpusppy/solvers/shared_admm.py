@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from ..obs import metrics as _metrics
+from . import aot as _aot
 from .admm import (ADMMSettings, BatchSolution, BIG, _clean_bounds,
                    _done_mask, _explicit_inverse, _frozen_sweep_phases,
                    _plateau_update)
@@ -699,6 +700,12 @@ def solve_shared(c, q2, A, cl, cu, lb, ub,
         return _solve_shared_impl(c, q2, A, cl, cu, lb, ub, settings, warm)
 
 
+# AOT executable cache (tpusppy/solvers/aot.py): same warm-start wrapping
+# as the dense entry points in admm.py — passthrough when disarmed
+solve_shared = _aot.cached_program(solve_shared, "shared.solve",
+                                   static_names=("settings",))
+
+
 @functools.partial(jax.jit, static_argnames=("settings",))
 def solve_shared_factored(c, q2, A, cl, cu, lb, ub,
                           settings: ADMMSettings = ADMMSettings(),
@@ -707,6 +714,11 @@ def solve_shared_factored(c, q2, A, cl, cu, lb, ub,
     with jax.default_matmul_precision(settings.matmul_precision):
         return _solve_shared_impl(c, q2, A, cl, cu, lb, ub, settings, warm,
                                   want_factors=True)
+
+
+solve_shared_factored = _aot.cached_program(
+    solve_shared_factored, "shared.solve_factored",
+    static_names=("settings",))
 
 
 @functools.partial(jax.jit, static_argnames=("settings",))
@@ -718,3 +730,8 @@ def solve_shared_frozen(c, q2, A, cl, cu, lb, ub, factors: SharedFactors,
     with jax.default_matmul_precision(settings.matmul_precision):
         return _solve_shared_frozen_impl(c, q2, A, cl, cu, lb, ub, factors,
                                          warm, settings, allow_pallas=True)
+
+
+solve_shared_frozen = _aot.cached_program(
+    solve_shared_frozen, "shared.solve_frozen",
+    static_names=("settings",))

@@ -16,6 +16,7 @@ spokes finalize, hub_finalize (spin_the_wheel.py:119-144).
 from __future__ import annotations
 
 import csv
+import os
 import threading
 import time
 
@@ -100,7 +101,32 @@ class WheelSpinner:
         mgr = self._make_checkpointer(fresh_start=ckpt is None)
         if mgr is not None:
             hub_comm.attach_checkpointer(mgr)
+        self._prewarm_executables(ckpt)
         return mgr
+
+    def _prewarm_executables(self, ckpt):
+        """Warm start for the COMPILES, not just the math: arm the AOT
+        executable cache from a resume checkpoint's carried pointer
+        (checkpoint + cache compose — the resumed process reaches its
+        first PH iteration warm even when its own env never named the
+        cache), then deserialize the cached programs NOW, before the
+        cylinder threads start: this jaxlib's executable loader races
+        in-flight XLA compiles (see tpusppy/solvers/aot.py), so the bulk
+        load must happen while this thread is the only one touching the
+        backend."""
+        from .solvers import aot as _aot
+
+        if ckpt is not None and not _aot.cache_path():
+            src = (ckpt.meta or {}).get("aot_cache")
+            if src and os.path.isdir(src):
+                _aot.set_cache_path(src)
+                global_toc(
+                    f"resume: AOT executable cache armed from the "
+                    f"checkpoint pointer ({src})", True)
+        if _aot.enabled():
+            n = _aot.prewarm()
+            if n:
+                global_toc(f"AOT cache: {n} executable(s) prewarmed", True)
 
     @staticmethod
     def _warn_unconsumed_resume(hub_opt):

@@ -49,6 +49,7 @@ import numpy as np
 from .obs import metrics as _metrics
 from .obs import trace as _trace
 from .parallel import sharded
+from .solvers import aot as _aot
 from .solvers import segmented as segmented_solvers
 
 
@@ -104,7 +105,14 @@ _cache: dict = {}
 # load: an old cache file is just a cold cache, never a crash and never a
 # stale cadence/pipeline verdict served to a megakernel-enabled run).
 _PERSIST_VERSION = 2
-_persist: dict = {"fused": {}, "pipeline": {}, "megastep": {}}
+# "aot" (the executable-cache PR): per-fused-key list of AOT executable
+# cache keys compiled/loaded while that verdict was measured — a disk hit
+# on the fused verdict then PRE-WARMS those executables in a background
+# thread before iter0 (tpusppy/solvers/aot.py).  Absent in older v2
+# files, tolerated (just no prewarm) — no schema bump needed: fused/
+# pipeline/megastep keys are unchanged.
+_PERSIST_KINDS = ("fused", "pipeline", "megastep", "aot")
+_persist: dict = {k: {} for k in _PERSIST_KINDS}
 _persist_lock = threading.Lock()
 _disk_loaded_from: str | None = None
 
@@ -141,10 +149,9 @@ def export_state() -> dict:
     """JSON-able snapshot of every banked verdict (fused + pipeline) —
     what wheel checkpoints carry so a resume skips warmup probes."""
     with _persist_lock:
-        return {"version": _PERSIST_VERSION, "jax": _jax_version(),
-                "fused": dict(_persist["fused"]),
-                "pipeline": dict(_persist["pipeline"]),
-                "megastep": dict(_persist["megastep"])}
+        out = {"version": _PERSIST_VERSION, "jax": _jax_version()}
+        out.update({k: dict(_persist[k]) for k in _PERSIST_KINDS})
+        return out
 
 
 def import_state(state: dict):
@@ -161,7 +168,7 @@ def import_state(state: dict):
         _metrics.inc("tune.disk_version_skips")
         return
     with _persist_lock:
-        for kind in ("fused", "pipeline", "megastep"):
+        for kind in _PERSIST_KINDS:
             _persist[kind].update(state.get(kind) or {})
 
 
@@ -190,8 +197,7 @@ def load_cache(path: str | None = None) -> int:
         return 0                 # a torn/foreign file is just a cold cache
     import_state(state)
     with _persist_lock:
-        return (len(_persist["fused"]) + len(_persist["pipeline"])
-                + len(_persist["megastep"]))
+        return sum(len(_persist[k]) for k in _PERSIST_KINDS)
 
 
 def _maybe_load_disk():
@@ -229,12 +235,49 @@ def reset_persist():
     """Drop banked verdicts (test isolation)."""
     global _disk_loaded_from, _cache_path_override
     with _persist_lock:
-        _persist["fused"].clear()
-        _persist["pipeline"].clear()
-        _persist["megastep"].clear()
+        for kind in _PERSIST_KINDS:
+            _persist[kind].clear()
     _mega_cache.clear()
     _disk_loaded_from = None
     _cache_path_override = None
+
+
+def prewarm_aot(background: bool = False) -> int:
+    """Pre-warm the AOT executable cache from every banked "aot" verdict
+    (plus anything else in the cache dir): call before iter0/the first
+    program build.  SYNCHRONOUS by default — on this toolchain the
+    executable loader is only reliable in a clean XLA state (a big
+    compile first can leave deserialization refusing entries with
+    "Symbols not found"), so front-loading the deserializes beats
+    overlapping them.  ``background=True`` restores the overlapped
+    daemon-thread load (what a tune-cache disk hit uses mid-flow, where
+    the fused-program load is the first XLA work anyway).  Returns the
+    number of banked keys (0 = nothing armed/banked)."""
+    _maybe_load_disk()
+    with _persist_lock:
+        keys = [k for entry in _persist["aot"].values()
+                for k in (entry.get("keys") or [])]
+    if not _aot.enabled():
+        return 0
+    # banked keys load first, then the directory sweep picks up programs
+    # no tune verdict recorded (already-loaded keys are skipped).  The
+    # banked list is capped like the sweep: a many-rung ladder cache can
+    # bank far more shapes than this process will ever call, and every
+    # load costs pre-iter0 wall + resident memory.
+    want = list(dict.fromkeys(keys))[:_aot.PREWARM_MAX_FILES] or None
+    if background:
+        def _load():
+            if want:
+                _aot.prewarm(want)
+            _aot.prewarm(None)
+
+        threading.Thread(target=_load, name="aot-prewarm",
+                         daemon=True).start()
+    else:
+        if want:
+            _aot.prewarm(want)
+        _aot.prewarm(None)
+    return len(keys)
 
 
 def _fetch(x):
@@ -263,11 +306,12 @@ def time_jitted(fn, *args, reps=20):
 def _tune_key(arr, settings, mesh, axis, prox_on, refresh_candidates,
               max_chunk, target_secs, margin, precision_candidates,
               certify_factor):
-    ndev = 1 if mesh is None else len(mesh.devices.flat)
-    return (arr.c.shape, arr.cl.shape, arr.A.ndim if hasattr(arr.A, "ndim")
-            else "sparse", settings, ndev, axis, float(prox_on),
-            tuple(refresh_candidates), max_chunk, target_secs, margin,
-            tuple(precision_candidates or ()), certify_factor)
+    # the shape+settings+mesh prefix is THE shared key builder
+    # (aot.family_parts): the executable cache keys embed the same tuple,
+    # so tune-cache keys and AOT-cache keys cannot silently drift
+    return _aot.family_parts(arr, settings, mesh, axis) + (
+        float(prox_on), tuple(refresh_candidates), max_chunk, target_secs,
+        margin, tuple(precision_candidates or ()), certify_factor)
 
 
 def autotune_fused(nonant_idx, settings, arr, state, mesh=None,
@@ -326,6 +370,15 @@ def autotune_fused(nonant_idx, settings, arr, state, mesh=None,
         dk = _persist_get("fused", repr(key))
         if dk is not None:
             _metrics.inc("tune.disk_hits")
+            # pre-warm THIS verdict's banked executables, synchronously:
+            # a background load here would race the caller's imminent
+            # plain-jit compiles, which is exactly the deserialize-vs-
+            # compile crash aot._xla_work_lock documents (the lock only
+            # covers aot's own work).  The list is a handful of keys and
+            # each load is ~ms against the compile it replaces.
+            ak = _persist_get("aot", repr(key))
+            if ak and ak.get("keys"):
+                _aot.prewarm(ak["keys"][:_aot.PREWARM_MAX_FILES])
             res = TuneResult(
                 chunk=int(dk["chunk"]), refresh_every=int(dk["refresh_every"]),
                 iters_per_sec=float(dk["iters_per_sec"]),
@@ -338,6 +391,7 @@ def autotune_fused(nonant_idx, settings, arr, state, mesh=None,
             return res
 
     t_start = time.time()
+    aot_mark = _aot.session_mark()
     table = []
     best = None
     out = None
@@ -499,6 +553,12 @@ def autotune_fused(nonant_idx, settings, arr, state, mesh=None,
             "iters_per_sec": float(rate), "secs_per_iter": float(1.0 / rate),
             "sweeps_per_iter": float(sweeps), "precision": str(precision),
             "table": _json_safe(table)})
+        # bank the AOT executable-cache keys the probe programs resolved
+        # under (the "aot" persist kind): a future run's disk hit on this
+        # verdict prewarms exactly those executables before iter0
+        aot_keys = _aot.session_keys_since(aot_mark)
+        if aot_keys:
+            _persist_put("aot", repr(key), {"keys": aot_keys})
     return res
 
 
