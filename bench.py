@@ -373,6 +373,16 @@ def _aot_stats_mark() -> dict:
                       "deserialize_s")}
 
 
+def _mem_fields() -> dict:
+    """{peak_rss_mb, device_peak_mb} for a segment line — refreshes the
+    ``mem.host_peak`` / ``mem.device_peak`` gauges (tpusppy.obs.sysmem).
+    Host peak is a process HIGH-WATER mark (monotone across segments);
+    device peak reads 0.0 on XLA:CPU, which reports no memory stats."""
+    from tpusppy.obs import sysmem
+
+    return sysmem.sample()
+
+
 def _tracing_on():
     """Flight recorder armed for this child?  --trace / BENCH_TRACE are
     the bench knobs; a recorder already enabled some other way (the
@@ -526,6 +536,7 @@ def traced_farmer_wheel():
         # executable-cache evidence for the wheel segment (the same
         # counters land in the flight-recorder report's counter dump)
         "aot": _aot_segment_stats(aot_base),
+        **_mem_fields(),
     }
     # bank the megakernel wheel's trace BEFORE the legacy comparison run:
     # the artifact's gap-vs-wall series must end at THIS entry's gap, and
@@ -568,8 +579,14 @@ def ladder_workload():
     ``BENCH_LADDER_RATE_ONLY=1`` skips the wheels (smoke posture).
     """
     rungs = [int(s) for s in os.environ.get(
-        "BENCH_LADDER_SCENS", "3,50,100,250,500,1000").split(",")]
+        "BENCH_LADDER_SCENS",
+        "3,50,100,250,500,1000,2500,10000").split(",")]
     wheel = os.environ.get("BENCH_LADDER_RATE_ONLY", "0") == "0"
+    # certified-gap budget ceiling: rungs above it run RATE-ONLY — a
+    # 10k-scenario certified wheel would eat the whole deadline on one
+    # rung, and the scale-out signal there is rate + memory watermarks
+    # (doc/scaling.md), not another gap certificate
+    cert_max = int(os.environ.get("BENCH_LADDER_CERT_MAX", "1000"))
     deadline = float(os.environ.get("BENCH_CHILD_DEADLINE", "0") or 0)
     if not deadline:
         deadline = time.time() + 3600.0
@@ -677,19 +694,23 @@ def ladder_workload():
             min(rung_budget, max(30.0, 0.7 * rung_budget)))
         log(f"ladder rung S={S}: budget {rung_budget:.0f}s "
             f"({len(rungs) - i} rungs left)")
+        rung_wheel = wheel and S <= cert_max
         try:
             m = bench_uc.uc_metrics(
                 progress=lambda p, S=S: emit_partial(
                     dict(line, running=dict(p, S=S))),
-                wheel=wheel)
+                wheel=rung_wheel)
+            if wheel and not rung_wheel:
+                m["rate_only"] = f"S > BENCH_LADDER_CERT_MAX ({cert_max})"
             # keep uc_metrics' ACTUAL scenario count (dataset-truncated
             # rungs must not report the requested S as measured)
             m.setdefault("S", S)
             if m["S"] != S:
                 m["S_requested"] = S
+            m.update(_mem_fields())
         except Exception as e:   # a failed rung never loses earlier rungs
             log(f"ladder rung S={S} failed: {e!r}")
-            m = {"S": S, "error": repr(e)}
+            m = {"S": S, "error": repr(e), **_mem_fields()}
         # per-rung flight-recorder artifact (no-op when tracing is off;
         # also resets ring + counter window so rungs never bleed)
         d = trace_segment_dump(f"ladder_S{S}")
@@ -1015,6 +1036,7 @@ def workload():
             "aot": _aot_segment_stats(aot_base),
             "vs_baseline": round(iters_per_sec / baseline_iters_per_sec, 2),
             "vs_baseline_32rank": round(iters_per_sec / base32, 2),
+            **_mem_fields(),
         }
 
     mult = int(os.environ.get("BENCH_CROPS_MULT", "4"))
@@ -1042,6 +1064,8 @@ def workload():
         # reference architecture (serial/32 accounting, BASELINE.md) —
         # extrapolated, not a measured 32-rank run
         "vs_baseline_32rank": m_primary["vs_baseline_32rank"],
+        "peak_rss_mb": m_primary["peak_rss_mb"],
+        "device_peak_mb": m_primary["device_peak_mb"],
     }
     dump = trace_segment_dump(f"farmer{S}_m{mult}")
     if dump is not None:

@@ -171,6 +171,7 @@ def uc_metrics(progress=None, wheel=True):
 
     # ---- metric 1: hub PH iteration rate ---------------------------------
     from bench import _aot_segment_stats, _aot_stats_mark, _compile_span_secs
+    from tpusppy.obs.sysmem import sample as _mem_sample
 
     from tpusppy import tune as tuner
 
@@ -315,6 +316,10 @@ def uc_metrics(progress=None, wheel=True):
         "vs_baseline": round(iters_per_sec / base_ips, 2),
         "vs_baseline_32rank": round(iters_per_sec / base32, 2),
         "S": S, "degraded_cpu_run": degraded,
+        # memory watermarks (tpusppy.obs.sysmem; doc/scaling.md): host
+        # peak RSS is a process high-water mark, device peak reads 0 on
+        # XLA:CPU (no backend memory stats)
+        **_mem_sample(),
     }
     if progress is not None:
         # bank the rate/MFU segment NOW: the wheel below can run for
@@ -592,6 +597,7 @@ def uc_metrics(progress=None, wheel=True):
         certified=bool(np.isfinite(ib) and np.isfinite(ob)
                        and not crossed and gap <= gap_target + 1e-9),
         **({"crossed_bounds": True} if crossed else {}),
+        **_mem_sample(),        # wheel-phase memory high-water
     )
 
 

@@ -40,10 +40,12 @@ def main():
     # mesh) and the it_base continuation are exercised on the real
     # multi-controller topology
     ckpt_dir = os.environ.get("DIST_CKPT_DIR")
+    sharded_ck = os.environ.get("DIST_CKPT_SHARDED") == "1"
     options = dict(base_options)
     if ckpt_dir:
         options.update(checkpoint_dir=ckpt_dir, checkpoint_every_iters=1,
-                       checkpoint_every_secs=None)
+                       checkpoint_every_secs=None,
+                       checkpoint_sharded=sharded_ck)
     res = distributed_wheel_hub(
         names, farmer.scenario_creator,
         scenario_creator_kwargs={"num_scens": n},
@@ -51,6 +53,13 @@ def main():
     out = {"pid": pid, "outer": res.BestOuterBound, "conv": res.conv,
            "eobj": res.eobj, "iters": res.iters}
     if ckpt_dir:
+        from tpusppy.obs import metrics as _metrics
+
+        # the zero-extra-fetch pin: every capture ran under the D2H
+        # transfer guard and billed its explicit fetches here (sharded
+        # captures slice the already-fetched consensus — pinned ZERO)
+        out["capture_fetches"] = _metrics.value("checkpoint.capture_fetches")
+        out["captures"] = _metrics.value("checkpoint.captures")
         # BARRIER before the resume leg: controller 0's writer thread must
         # land the file before controller 1 looks for it (divergent
         # it_base would desynchronize the collectives)
@@ -59,7 +68,8 @@ def main():
         res2 = distributed_wheel_hub(
             names, farmer.scenario_creator,
             scenario_creator_kwargs={"num_scens": n},
-            options=dict(base_options, PHIterLimit=5, resume=ckpt_dir),
+            options=dict(base_options, PHIterLimit=5, resume=ckpt_dir,
+                         checkpoint_sharded=sharded_ck),
             fabric=None, spoke_roles=[])
         out.update(iters2=res2.iters, outer2=res2.BestOuterBound,
                    conv2=res2.conv)

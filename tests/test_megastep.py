@@ -401,7 +401,8 @@ class TestHostIntegration:
             ph.ph_main()
         b = ph.batch
         assert tune.megastep_verdict(
-            b.num_scenarios, b.num_vars, b.num_rows) is not None
+            b.num_scenarios, b.num_vars, b.num_rows,
+            settings=ph.admm_settings) is not None
         # probes are real work: the run still completed all iterations
         assert ph._iter == 20
         assert int(w.delta("dispatch.megasteps")) >= 3   # 3 probe windows
@@ -420,11 +421,13 @@ class TestHostIntegration:
             return n
 
         res = tune.autotune_megastep(run_window, shape, n_cap=64,
-                                     target_pct=1.0)
+                                     target_pct=1.0,
+                                     settings=ph_probe.admm_settings)
         # three probe windows: compile-absorbing n=1, timed n=1, timed n=8
         assert calls == [1, 1, 8]
         assert 1 <= res.n <= 64
-        assert tune.megastep_verdict(*shape) == res.n
+        assert tune.megastep_verdict(
+            shape, settings=ph_probe.admm_settings) == res.n
         # the hub resolves auto-N to min(verdict, window, cap)
         ph = self.make_ph(8, 0)
         n_req = ph._megastep_request()

@@ -86,6 +86,14 @@ class Hub(SPCommunicator):
                 self.InnerBoundUpdate(b, idx if same else None, char='R')
         self.resumed_from_iteration = int(ckpt.iteration)
 
+    def checkpoint_due(self, iteration) -> bool:
+        """Whether the next :meth:`sync` will capture a checkpoint at
+        ``iteration`` — the device-resident wheel posture asks BEFORE
+        syncing so it can refresh the host mirrors the capture reads
+        (the capture itself stays pinned zero-fetch)."""
+        return (self._ckpt_mgr is not None
+                and self._ckpt_mgr._due(int(iteration)))
+
     def _resilience_tick(self):
         """Per-sync health + checkpoint pass: observe spoke liveness and
         capture a snapshot when the cadence is due.  The snapshot reads
@@ -98,6 +106,17 @@ class Hub(SPCommunicator):
             from ..resilience import supervisor as _sup
 
             _sup.heartbeat("hub")
+            if getattr(self.opt, "_host_state_stale", False):
+                # device-resident posture (doc/scaling.md): the host
+                # mirrors are stale mid-window.  The boundary pre-sync
+                # (PHBase._spcomm_needs_host_state) refreshes them when
+                # checkpoint_due() fires, but a WALL-CLOCK cadence can
+                # cross its threshold between that check and this tick —
+                # capturing here would stamp one-window-old W/xbars with
+                # the current iteration.  Skip without advancing the
+                # cadence: the next boundary's due check pre-syncs and
+                # the capture lands fresh.
+                return
             try:
                 self._ckpt_mgr.maybe_capture(
                     self.current_iteration(),

@@ -1,0 +1,72 @@
+"""Host/device memory watermarks as obs gauges.
+
+The scenario scale-out acceptance (doc/scaling.md) is phrased in memory:
+the wheel must be O(1) in HOST memory with respect to S, and the device
+high-water tells whether a rung actually fit the mesh.  Two gauges:
+
+* ``mem.host_peak`` — peak RSS of this process in MB (``ru_maxrss``; a
+  HIGH-WATER mark: it never decreases within a process, so per-segment
+  deltas mean "this segment raised the peak by X", not "used X").
+* ``mem.device_peak`` — max over local devices of the backend's
+  ``peak_bytes_in_use`` in MB.  The XLA:CPU backend reports no memory
+  stats; the gauge then reads 0.0 and callers label it unavailable —
+  same CPU-caveat posture as the host-sync table in the README.
+
+:func:`sample` refreshes both gauges and returns the values, so bench
+segment lines (`peak_rss_mb`, `device_peak_mb`) and smoke-script budget
+asserts read one source.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from . import metrics as _metrics
+
+_G_HOST = _metrics.gauge("mem.host_peak")
+_G_DEV = _metrics.gauge("mem.device_peak")
+
+
+def peak_rss_mb() -> float:
+    """Peak resident set size of this process, in MB (0.0 when the
+    platform offers no ``getrusage``)."""
+    try:
+        import resource
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    except (ImportError, OSError):
+        return 0.0
+    # ru_maxrss is KB on Linux, bytes on macOS
+    scale = 1e-6 if sys.platform == "darwin" else 1e-3
+    return float(peak) * scale
+
+
+def device_peak_mb() -> float:
+    """Max per-device peak bytes in use across local devices, in MB
+    (0.0 when the backend reports no memory stats — XLA:CPU)."""
+    try:
+        import jax
+
+        peaks = []
+        for d in jax.local_devices():
+            stats = None
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                pass
+            if stats:
+                peaks.append(stats.get("peak_bytes_in_use",
+                                       stats.get("bytes_in_use", 0)))
+        return max(peaks) / 1e6 if peaks else 0.0
+    except Exception:
+        return 0.0
+
+
+def sample() -> dict:
+    """Refresh the ``mem.*`` gauges; returns
+    ``{"peak_rss_mb": ..., "device_peak_mb": ...}`` (rounded to 0.1 MB)."""
+    host = round(peak_rss_mb(), 1)
+    dev = round(device_peak_mb(), 1)
+    _G_HOST.set(host)
+    _G_DEV.set(dev)
+    return {"peak_rss_mb": host, "device_peak_mb": dev}

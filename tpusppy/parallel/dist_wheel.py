@@ -27,6 +27,7 @@ voting on the termination decision itself.
 
 from __future__ import annotations
 
+import os
 import time
 from math import inf
 from typing import NamedTuple
@@ -177,8 +178,52 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
     from ..resilience import checkpoint as _ckpt
 
     it_base = 0
+
+    def _merge_resume_scalars(iteration, best_inner, best_outer,
+                              tune_state):
+        """The one scalar-restore path both resume forms share: bounds
+        merge monotonically, the iteration base continues the TOTAL
+        count, banked tune verdicts skip warmup probes."""
+        nonlocal BestInner, BestOuter, it_base
+        if np.isfinite(best_inner) and better_inner(best_inner, BestInner):
+            BestInner = float(best_inner)
+        if np.isfinite(best_outer) and better_outer(best_outer, BestOuter):
+            BestOuter = float(best_outer)
+        it_base = int(iteration)
+        if tune_state:
+            from .. import tune as _tune
+
+            _tune.import_state(tune_state)
+
     resume_src = options.get("resume")
-    ck0 = _ckpt.load_latest(resume_src) if resume_src else None
+    ck0 = ck0_reader = None
+    if resume_src:
+        p0 = resume_src if not os.path.isdir(str(resume_src)) \
+            else _ckpt.latest(str(resume_src))
+        if p0 and _ckpt._SHARD_RE.match(os.path.basename(p0)):
+            # SHARDED resume: scalars come from shard 0's meta; the W
+            # restore reads only this process's row shards, via
+            # make_array_from_callback — the full (S, K) state never
+            # materializes on one host
+            ck0_reader = _ckpt.ShardedCheckpointReader(p0)
+            md = ck0_reader.meta
+            sh = md.get("meta", {}).get("shard", {})
+            # K from the shard META (stored alongside rows/S): answering
+            # the shape check must not decompress shard 0's whole array
+            # block on every process at 10^5-scenario scale
+            K_ck = ck0_reader.K if ck0_reader.K is not None \
+                else ck0_reader.read_rows("W", 0, 1).shape[1]
+            if int(sh.get("S", -1)) != S or K_ck != state.W.shape[1]:
+                raise RuntimeError(
+                    f"sharded checkpoint ({sh.get('S')} scenarios, "
+                    f"K={K_ck}) does not match this wheel ({S} "
+                    f"scenarios, K={state.W.shape[1]}) — resuming a "
+                    f"different family?")
+            _merge_resume_scalars(
+                ck0_reader.iteration, md.get("best_inner", inf),
+                md.get("best_outer", -inf), md.get("tune_state"))
+        elif p0:
+            ck0 = _ckpt.load(p0)
     if ck0 is not None:
         # exact-S match (snapshots carry exactly S rows): the PADDED
         # state row count would silently accept a different scenario
@@ -189,17 +234,8 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
                 f"checkpoint W {getattr(ck0.W, 'shape', None)} does not "
                 f"match this wheel ({S} scenarios, K="
                 f"{state.W.shape[1]}) — resuming a different family?")
-        if np.isfinite(ck0.best_inner) and better_inner(ck0.best_inner,
-                                                        BestInner):
-            BestInner = float(ck0.best_inner)
-        if np.isfinite(ck0.best_outer) and better_outer(ck0.best_outer,
-                                                        BestOuter):
-            BestOuter = float(ck0.best_outer)
-        it_base = int(ck0.iteration)
-        if ck0.tune_state:
-            from .. import tune as _tune
-
-            _tune.import_state(ck0.tune_state)   # skip warmup probes
+        _merge_resume_scalars(ck0.iteration, ck0.best_inner,
+                              ck0.best_outer, ck0.tune_state)
 
     def _restore_W(state):
         """Re-seat the checkpointed W AFTER Iter0 (the phbase seam):
@@ -208,6 +244,17 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
         while eobj prices plain c), and the wholesale replacement also
         discards Iter0's W-update so the loop continues from exactly the
         snapshot's duals."""
+        if ck0_reader is not None:
+            # shard-read restore: each process's callback reads ONLY the
+            # shard files overlapping its addressable rows (ghost/pad
+            # rows past S come back zero) — state's own dtype, as below
+            W_dev = _ckpt.restore_sharded_array(
+                ck0_reader, "W", state.W.sharding,
+                state.W.shape, dtype=state.W.dtype)
+            # the reader stays alive in this closure for the run: free
+            # its cached row blocks now that the restore consumed them
+            ck0_reader.drop_cache()
+            return state._replace(W=W_dev)
         # state's own dtype, not the npz's (always f64): an f32 wheel
         # must not have a mixed-dtype carry swapped into its compiled
         # state pytree
@@ -216,14 +263,53 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
         W_dev = jax.make_array_from_callback(
             W_full.shape, state.W.sharding, lambda idx: W_full[idx])
         return state._replace(W=W_dev)
+
+    def _local_rows(Wd):
+        """Contiguous global row range this process's addressable shards
+        cover (the scenario axis is the leading dim; device order on the
+        1-D mesh makes per-process rows contiguous)."""
+        los, his = [], []
+        for s in Wd.addressable_shards:
+            r = s.index[0]
+            los.append(0 if r.start is None else r.start)
+            his.append(Wd.shape[0] if r.stop is None else r.stop)
+        return min(los), max(his)
+
     ckpt_mgr = None
-    if writer and options.get("checkpoint_dir"):
+    ckpt_sharded = bool(options.get("checkpoint_sharded"))
+    shard_rows = None
+    if options.get("checkpoint_dir") and (writer or ckpt_sharded):
+        shard = None
+        every_secs = options.get("checkpoint_every_secs", 60.0)
+        every_iters = options.get("checkpoint_every_iters")
+        if ckpt_sharded:
+            lo, hi = _local_rows(state.W)
+            # clip to the REAL scenario count: ghost/pad rows (uneven S
+            # over the mesh) never checkpoint
+            shard_rows = (min(lo, S), min(hi, S))
+            shard = (jax.process_index(), jax.process_count(),
+                     shard_rows, S)
+            if every_iters is None:
+                # a WALL-CLOCK cadence is per-process: controllers can
+                # disagree on which iterations are due (and each
+                # writer thread coalesces independently), so per-shard
+                # managers could persist DISJOINT iteration sets and the
+                # keep-window prune would eventually leave no COMPLETE
+                # set at all — a resume would silently cold-start.  A
+                # deterministic iteration cadence keeps every process's
+                # shard files aligned by construction.
+                every_iters = max(1, refresh_every)
+                every_secs = None
+                _log.warning(
+                    "checkpoint_sharded without checkpoint_every_iters: "
+                    "forcing the deterministic iteration cadence "
+                    "(every %d iterations) — wall-clock cadences "
+                    "desynchronize per-process shard sets", every_iters)
         ckpt_mgr = _ckpt.CheckpointManager(
             options["checkpoint_dir"],
-            every_secs=options.get("checkpoint_every_secs", 60.0),
-            every_iters=options.get("checkpoint_every_iters"),
+            every_secs=every_secs, every_iters=every_iters,
             keep=options.get("checkpoint_keep", 3), tag="dist_wheel",
-            fresh_start=ck0 is None)
+            fresh_start=ck0 is None and ck0_reader is None, shard=shard)
 
     def gap():
         ag = (BestInner - BestOuter) if is_minimizing \
@@ -308,7 +394,7 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
     state, out, factors, trivial = robust_collective(_iter0)
     if better_outer(trivial, BestOuter):
         BestOuter = trivial
-    if ck0 is not None:
+    if ck0 is not None or ck0_reader is not None:
         state = _restore_W(state)
 
     conv = eobj = inf
@@ -336,8 +422,17 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
 
         W_host, _ = consensus
         K = W_host.size // max(1, S)
+        W_full = np.asarray(W_host).reshape(S, K)
+        if shard_rows is not None:
+            # sharded capture: ONLY this process's rows ride its snapshot
+            # (sliced from the already-fetched consensus — zero extra
+            # fetches, zero collectives; at true scale the consensus
+            # itself would be shard-local, this keeps the I/O contract)
+            W_out = W_full[shard_rows[0]:shard_rows[1]].copy()
+        else:
+            W_out = W_full.copy()
         return _ckpt.WheelCheckpoint(
-            iteration=it, W=np.asarray(W_host).reshape(S, K).copy(),
+            iteration=it, W=W_out,
             best_inner=BestInner, best_outer=BestOuter,
             tune_state=_tune.export_state(),
             meta={"S": S, "K": K, "kind": "dist_wheel"})

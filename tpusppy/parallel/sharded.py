@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import re
 from typing import NamedTuple
 
 import jax
@@ -123,6 +124,98 @@ class PHStepOut(NamedTuple):
     dua_res: jax.Array    # (S,)
     iters: jax.Array      # scalar: ADMM sweeps the subproblem solve used
     # (batch max; feeds the FLOP-model MFU accounting — solvers/flops.py)
+
+
+# ---------------------------------------------------------------------------
+# Rule-driven placement (ROADMAP item 1; the match_partition_rules /
+# shard-and-gather pattern of SNIPPETS [3] under the pjit/GSPMD mesh
+# semantics of [1]).  One declarative table maps EVERY PHArrays / PHState
+# leaf — and therefore every megastep scan carry, which is a PHState — to
+# its PartitionSpec by leaf-path regex, instead of per-field ad-hoc
+# device_put calls scattered through shard_batch/init_state.  Adding a
+# field to either NamedTuple without a matching rule is a loud error, not
+# a silently-replicated (S, ...) array: at S=10^4-10^5 one unsharded
+# per-scenario leaf is the difference between O(S/ndev) and O(S) HBM.
+# ---------------------------------------------------------------------------
+def ph_partition_rules(axis: str = "scen", row_axis: str | None = None,
+                       shared: bool = False) -> list:
+    """[(leaf-path regex, PartitionSpec)] for one mesh posture.
+
+    ``shared``: the batch carries one (m, n) ``A_shared`` — A is replicated
+    (or row-sharded over ``row_axis`` on a 2-D mesh, with the (S, m)
+    row-state leaves sharded on both axes); dense per-scenario batches
+    shard A's leading scenario axis like every other leaf.  First match
+    wins, so the specific rows precede the catch-all scenario rule.
+    """
+    scen = P(axis)
+    if shared:
+        A_spec = P(row_axis, None) if row_axis else P()
+        row = P(axis, row_axis) if row_axis else scen
+    else:
+        A_spec, row = scen, scen
+    return [
+        # constraint matrix: the one leaf whose layout depends on the
+        # engine (dense stack / replicated shared / SparseA sub-leaves)
+        (r"(^|/)A(/|$)", A_spec),
+        # (S, m) row-state: constraint bounds + ADMM row iterates
+        (r"(^|/)(cl|cu|z|y)$", row),
+        # every remaining per-scenario leaf: (S, n), (S, K), (S, K, N), (S,)
+        (r"(^|/)(c|q2|lb|ub|const|probs|onehot|nid_sk)$", scen),
+        (r"(^|/)(W|xbars|rho|x|yx)$", scen),
+    ]
+
+
+def _leaf_path(path) -> str:
+    parts = []
+    for p in path:
+        name = getattr(p, "name", None)
+        if name is None:
+            name = getattr(p, "key", getattr(p, "idx", ""))
+        parts.append(str(name))
+    return "/".join(parts)
+
+
+def match_partition_rules(rules, tree):
+    """Pytree of PartitionSpec matching each leaf of ``tree`` against
+    ``rules`` by its slash-joined path (the SNIPPETS [3] idiom).  Scalars
+    never partition; a leaf no rule matches raises — an unplaced leaf is
+    a placement-table bug, not a default."""
+    from jax.tree_util import tree_flatten_with_path, tree_unflatten
+
+    leaves, treedef = tree_flatten_with_path(tree)
+
+    def pick(path, leaf):
+        if np.ndim(leaf) == 0 or np.size(leaf) == 1:
+            return P()
+        name = _leaf_path(path)
+        for rule, spec in rules:
+            if re.search(rule, name) is not None:
+                return spec
+        raise ValueError(f"no partition rule matches leaf {name!r}")
+
+    return tree_unflatten(treedef, [pick(p, l) for p, l in leaves])
+
+
+def ph_shardings(mesh: Mesh, tree, axis: str = "scen",
+                 row_axis: str | None = None, shared: bool = False):
+    """Pytree of :class:`NamedSharding` for ``tree`` (a PHArrays, a
+    PHState, or any sub-pytree of their leaves) under the placement
+    table.  THE single source of wheel-state placement: shard_batch,
+    init_state and the shard-read checkpoint restore all derive their
+    shardings here, so they cannot drift."""
+    specs = match_partition_rules(
+        ph_partition_rules(axis, row_axis, shared), tree)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def num_ghosts(S: int, mesh: Mesh, axis: str = "scen") -> int:
+    """Ghost scenarios appended so S fills the mesh axis evenly (0 when S
+    already divides).  Ghosts are zero-probability copies of scenario 0
+    with ZERO node membership: inert in every psum-lowered reduction
+    (xbar/xsqbar numerators AND denominators, conv, eobj), so an uneven
+    S=7 on a 4-device mesh is exact, not approximately padded."""
+    return (-int(S)) % int(mesh.shape[axis])
 
 
 def _node_xbar(onehot, probs, xk):
@@ -595,12 +688,24 @@ def make_ph_fused_step(nonant_idx: np.ndarray, settings: ADMMSettings,
                    aot_cache.array_digest(nonant_idx)))
 
 
-def megastep_measure_len(n_iters: int, S: int, n: int, K: int) -> int:
-    """Length of the packed megastep measurement vector."""
-    return 6 * n_iters + 2 + 3 * S + S * n + 2 * S * K
+def megastep_measure_len(n_iters: int, S: int, n: int, K: int,
+                         pack: str = "full") -> int:
+    """Length of the packed megastep measurement vector.
+
+    ``pack="lean"`` is the O(1)-host-traffic wheel posture (ROADMAP item
+    1): the fetch carries the per-iteration stats plus per-scenario
+    residual/done diagnostics ONLY — the (S, n) iterate and the (S, K)
+    W/xbars stay device-resident in the returned :class:`PHState`, to be
+    fetched explicitly (and billed) at checkpoint/termination boundaries
+    instead of every window."""
+    base = 6 * n_iters + 2 + 3 * S
+    if pack == "lean":
+        return base
+    return base + S * n + 2 * S * K
 
 
-def megastep_unpack(vec, n_iters: int, S: int, n: int, K: int) -> dict:
+def megastep_unpack(vec, n_iters: int, S: int, n: int, K: int,
+                    pack: str = "full") -> dict:
     """Split a fetched :func:`make_wheel_megastep` measurement.
 
     Returns per-iteration arrays (length ``n_iters``; entries past
@@ -614,7 +719,9 @@ def megastep_unpack(vec, n_iters: int, S: int, n: int, K: int) -> dict:
     dispatched sweeps can be billed); and the FINAL executed iterate's
     ``pri``/``dua``/``done`` (S,), ``x`` (S, n), ``W``/``xbars`` (S, K) —
     everything the host wheel reads between termination checks, from ONE
-    fetch."""
+    fetch.  With ``pack="lean"`` the x/W/xbars blocks are absent (device-
+    resident state; see :func:`megastep_measure_len`) and those keys are
+    not in the dict."""
     vec = np.asarray(vec)
     N = n_iters
     per = vec[:6 * N].reshape(6, N)
@@ -630,6 +737,8 @@ def megastep_unpack(vec, n_iters: int, S: int, n: int, K: int) -> dict:
         "done": vec[off + 2 * S:off + 3 * S] != 0.0,
     }
     off += 3 * S
+    if pack == "lean":
+        return out
     out["x"] = vec[off:off + S * n].reshape(S, n)
     off += S * n
     out["W"] = vec[off:off + S * K].reshape(S, K)
@@ -640,7 +749,8 @@ def megastep_unpack(vec, n_iters: int, S: int, n: int, K: int) -> dict:
 
 def make_wheel_megastep(nonant_idx: np.ndarray, settings: ADMMSettings,
                         mesh: Mesh | None = None, axis: str = "scen",
-                        n_iters: int = 8, donate: bool = True):
+                        n_iters: int = 8, donate: bool = True,
+                        pack: str = "full"):
     """ONE jitted program running up to ``n_iters`` FROZEN wheel iterations
     — the device-resident wheel megakernel (ROADMAP item 4).
 
@@ -689,11 +799,20 @@ def make_wheel_megastep(nonant_idx: np.ndarray, settings: ADMMSettings,
     ``donate=True`` donates the incoming :class:`PHState` (the caller
     rebinds); pass False for A/B comparisons re-entering one state.
 
+    ``pack="lean"`` drops the final iterate's x/W/xbars from the packed
+    measurement (:func:`megastep_measure_len`): those leaves live on in
+    the RETURNED device state, making the per-window host traffic O(S)
+    diagnostics instead of O(S·n) state — the big-S wheel fetches full
+    state only at checkpoint/termination boundaries
+    (:meth:`tpusppy.phbase.PHBase._sync_host_state`).
+
     Returns ``mega(state, arr, prox_on, factors, convthresh, n_live,
     accept_tol) -> (state, packed)``.
     """
     if n_iters < 1:
         raise ValueError(f"n_iters ({n_iters}) must be >= 1")
+    if pack not in ("full", "lean"):
+        raise ValueError(f"pack must be 'full' or 'lean': {pack!r}")
     idx = jnp.asarray(nonant_idx)
     _, shared_frozen, _, frozen_solve = _solver_fns_for(settings, mesh, axis)
 
@@ -760,23 +879,243 @@ def make_wheel_megastep(nonant_idx: np.ndarray, settings: ADMMSettings,
                   jnp.zeros((), bool))
         (st, pri, dua, done_s, executed, _, refresh), stats = jax.lax.scan(
             body, carry0, jnp.arange(n_iters, dtype=jnp.int32))
-        packed = jnp.concatenate([
+        parts = [
             stats.T.reshape(-1),          # [conv|eobj|pri|dua|iters|done]xN
             executed.astype(dt)[None], refresh.astype(dt)[None],
             pri.astype(dt), dua.astype(dt), done_s.astype(dt),
-            st.x.astype(dt).reshape(-1),
-            st.W.astype(dt).reshape(-1),
-            st.xbars.astype(dt).reshape(-1),
-        ])
-        return st, packed
+        ]
+        if pack == "full":
+            parts += [st.x.astype(dt).reshape(-1),
+                      st.W.astype(dt).reshape(-1),
+                      st.xbars.astype(dt).reshape(-1)]
+        return st, jnp.concatenate(parts)
 
     # AOT executable cache: one megakernel compile per width N — resumed
     # and repeated wheels load the serialized executable instead
     # (tpusppy/solvers/aot.py; passthrough when disarmed)
     return aot_cache.cached_program(
         mega, "wheel_megastep",
-        key_extra=(settings, n_iters, bool(donate), axis,
+        key_extra=(settings, n_iters, bool(donate), axis, pack,
                    aot_cache.mesh_fingerprint(mesh),
+                   aot_cache.array_digest(nonant_idx)))
+
+
+def bucketed_megastep_measure_len(n_iters: int, shapes, K: int) -> int:
+    """Length of the bucketed packed measurement (``shapes`` =
+    ``[(S_b, n_b), ...]`` per bucket, concatenated in bucket order)."""
+    S = sum(s for s, _ in shapes)
+    return (6 * n_iters + 2 + 3 * S
+            + sum(s * n for s, n in shapes) + 2 * S * K)
+
+
+def bucketed_megastep_unpack(vec, n_iters: int, shapes, K: int) -> dict:
+    """Split a fetched :func:`make_bucketed_wheel_megastep` measurement.
+
+    Global per-iteration stats exactly as :func:`megastep_unpack`; the
+    per-scenario blocks come back PER BUCKET (``shapes`` order): ``pri``/
+    ``dua``/``done`` are lists of (S_b,) arrays, ``x`` a list of
+    (S_b, n_b), ``W``/``xbars`` lists of (S_b, K) — the host scatters
+    them through each bucket's scenario-index array."""
+    vec = np.asarray(vec)
+    N = n_iters
+    per = vec[:6 * N].reshape(6, N)
+    off = 6 * N
+    out = {
+        "conv": per[0], "eobj": per[1], "pri_max": per[2],
+        "dua_max": per[3], "iters": per[4], "all_done": per[5] != 0.0,
+        "executed": int(vec[off]), "refresh_hit": bool(vec[off + 1]),
+    }
+    off += 2
+    pri, dua, done = [], [], []
+    for S_b, _ in shapes:
+        pri.append(vec[off:off + S_b])
+        dua.append(vec[off + S_b:off + 2 * S_b])
+        done.append(vec[off + 2 * S_b:off + 3 * S_b] != 0.0)
+        off += 3 * S_b
+    out.update(pri=pri, dua=dua, done=done)
+    xs = []
+    for S_b, n_b in shapes:
+        xs.append(vec[off:off + S_b * n_b].reshape(S_b, n_b))
+        off += S_b * n_b
+    Ws, xbs = [], []
+    for S_b, _ in shapes:
+        Ws.append(vec[off:off + S_b * K].reshape(S_b, K))
+        off += S_b * K
+    for S_b, _ in shapes:
+        xbs.append(vec[off:off + S_b * K].reshape(S_b, K))
+        off += S_b * K
+    out.update(x=xs, W=Ws, xbars=xbs)
+    return out
+
+
+def _bucketed_finish(arrs, states, sols, Ws, rhos, idx, dt):
+    """The cross-bucket PH outer update as pure device contractions: each
+    bucket contributes its node-membership partial sums (its ``onehot``/
+    ``probs`` are GLOBAL-tree slices), the per-node averages form once
+    globally, and each bucket gathers its scenarios' rows back — under a
+    mesh every cross-bucket sum is the same psum tree the homogeneous
+    :func:`_node_xbar` lowers to.  Returns (new_states, conv, eobj).
+
+    The bucketed kernel packs FULL measurements only: the lean
+    (device-resident, O(1)-host) posture is homogeneous-only today —
+    ``_megastep_solve_bucketed`` says so loudly when ``ph_device_state``
+    is set on a bucketed family."""
+    num = den = None
+    xks = []
+    for arr, sol in zip(arrs, sols):
+        xk = sol.x[:, idx]
+        xks.append(xk)
+        p = arr.probs[:, None]
+        nm = jnp.einsum("skn,sk->nk", arr.onehot, p * xk)
+        dn = jnp.einsum("skn,sk->nk", arr.onehot,
+                        jnp.broadcast_to(p, xk.shape))
+        num = nm if num is None else num + nm
+        den = dn if den is None else den + dn
+    xbar_nk = num / jnp.maximum(den, 1e-300)
+    new_states = []
+    conv = jnp.zeros((), dt)
+    eobj = jnp.zeros((), dt)
+    for arr, st, sol, W, rho, xk in zip(arrs, states, sols, Ws, rhos, xks):
+        new_xbars = _gather_per_scenario(xbar_nk, arr.nid_sk)
+        new_W = W + rho * (xk - new_xbars)
+        dev = jnp.abs(xk - new_xbars).mean(axis=1)
+        conv = conv + (arr.probs @ dev).astype(dt)
+        lin = jnp.einsum("sn,sn->s", arr.c, sol.x)
+        quad = 0.5 * jnp.einsum("sn,sn->s", arr.q2, sol.x * sol.x)
+        eobj = eobj + (arr.probs @ (lin + quad + arr.const)).astype(dt)
+        new_states.append(PHState(
+            W=new_W, xbars=new_xbars, rho=rho,
+            x=sol.x, z=sol.z, y=sol.y, yx=sol.yx))
+    return tuple(new_states), conv, eobj
+
+
+def make_bucketed_wheel_megastep(nonant_idx: np.ndarray,
+                                 settings: ADMMSettings,
+                                 n_iters: int = 8, donate: bool = True,
+                                 axis: str = "scen"):
+    """ONE jitted program running up to ``n_iters`` frozen wheel
+    iterations over a BUCKETED (ragged) family — the shape-bucketed twin
+    of :func:`make_wheel_megastep`.
+
+    Each scan step runs EVERY bucket's frozen factor-reusing sweep on its
+    own compact shapes (one ragged bucket no longer pads the others), then
+    the PH outer update couples them: per-node sums accumulate across
+    buckets (each bucket's ``onehot``/``probs`` slice the GLOBAL tree),
+    the node averages form once, and every bucket gathers its own rows
+    back — the scattered host path's Compute_Xbar/Update_W, device-side.
+    The early-exit / acceptance masks are GLOBAL (the serial protocol
+    evaluates convergence and acceptance on the whole family), and one
+    packed measurement (:func:`bucketed_megastep_unpack`) serves the
+    window.
+
+    ``nonant_idx`` is the GLOBAL nonant column index array — valid in
+    every bucket's column space, exactly as the host path applies its
+    globally-assembled augmented objective bucket-sliced.  Callers size
+    ``n_iters`` within :func:`~tpusppy.solvers.segmented.megastep_cap_multi`
+    (one scan step is the SUM of all buckets' sweeps against the worker
+    watchdog).
+
+    Returns ``mega(states, arrs, prox_on, factors, convthresh, n_live,
+    accept_tol) -> (states, packed)`` over tuples of per-bucket
+    :class:`PHState` / :class:`PHArrays` / factors.
+    """
+    if n_iters < 1:
+        raise ValueError(f"n_iters ({n_iters}) must be >= 1")
+    idx = jnp.asarray(nonant_idx)
+    shared_refresh, shared_frozen, _, frozen_solve = _solver_fns_for(
+        settings, None, axis)
+    del shared_refresh
+
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def mega(states, arrs, prox_on, factors, convthresh, n_live,
+             accept_tol):
+        dt = settings.jdtype()
+        n_live_t = jnp.asarray(n_live, jnp.int32)
+        thresh = jnp.asarray(convthresh, dt)
+        tol = jnp.asarray(accept_tol, dt)
+
+        def body(carry, k):
+            sts, pris, duas, dones, executed, stopped, refresh = carry
+            live = (~stopped) & (k < n_live_t)
+
+            def live_fn(op):
+                sts, pris, duas, dones, executed, stopped, refresh = op
+                sols = []
+                for bi, (arr, st) in enumerate(zip(arrs, sts)):
+                    q, q2, _, _ = _ph_objective(arr, st, prox_on, idx,
+                                                settings)
+                    fsolve = (shared_frozen if arr.A.ndim == 2
+                              else frozen_solve)
+                    sols.append(fsolve(
+                        q, q2, arr.A, arr.cl, arr.cu, arr.lb, arr.ub,
+                        st.x, st.z, st.y, st.yx, factors[bi]))
+                Ws = [st.W.astype(dt) for st in sts]
+                rhos = [st.rho.astype(dt) for st in sts]
+                # GLOBAL acceptance: the serial protocol accepts/rejects
+                # the whole family's iterate, never a single bucket's
+                all_done = jnp.array(True)
+                lad = jnp.array(True)
+                for sol in sols:
+                    all_done = all_done & jnp.all(sol.done)
+                    lad = lad & jnp.all(
+                        (sol.pri_res <= tol) & (sol.dua_res <= tol))
+                ok = all_done | lad
+                new_sts, conv, eobj = _bucketed_finish(
+                    arrs, sts, sols, Ws, rhos, idx, dt)
+                stats = jnp.stack([
+                    conv, eobj,
+                    jnp.max(jnp.stack(
+                        [jnp.max(s.pri_res) for s in sols])).astype(dt),
+                    jnp.max(jnp.stack(
+                        [jnp.max(s.dua_res) for s in sols])).astype(dt),
+                    jnp.max(jnp.stack(
+                        [jnp.max(s.iters) for s in sols])).astype(dt),
+                    all_done.astype(dt)])
+                sel = lambda a, b: jnp.where(ok, a, b)
+                new_sts = jax.tree.map(sel, new_sts, sts)
+                new_pris = tuple(sel(s.pri_res, p)
+                                 for s, p in zip(sols, pris))
+                new_duas = tuple(sel(s.dua_res, d)
+                                 for s, d in zip(sols, duas))
+                new_dones = tuple(sel(s.done, d)
+                                  for s, d in zip(sols, dones))
+                return ((new_sts, new_pris, new_duas, new_dones,
+                         executed + ok.astype(jnp.int32),
+                         stopped | (ok & (conv < thresh)) | ~ok,
+                         refresh | ~ok),
+                        stats)
+
+            def dead_fn(op):
+                return op, jnp.zeros((6,), dt)
+
+            return jax.lax.cond(
+                live, live_fn, dead_fn,
+                (sts, pris, duas, dones, executed, stopped, refresh))
+
+        infs = tuple(jnp.full((arr.c.shape[0],), jnp.inf, dt)
+                     for arr in arrs)
+        falses = tuple(jnp.zeros((arr.c.shape[0],), bool) for arr in arrs)
+        carry0 = (states, infs, infs, falses,
+                  jnp.zeros((), jnp.int32), jnp.zeros((), bool),
+                  jnp.zeros((), bool))
+        (sts, pris, duas, dones, executed, _, refresh), stats = \
+            jax.lax.scan(body, carry0,
+                         jnp.arange(n_iters, dtype=jnp.int32))
+        parts = [stats.T.reshape(-1),
+                 executed.astype(dt)[None], refresh.astype(dt)[None]]
+        for p, d, dn in zip(pris, duas, dones):
+            parts += [p.astype(dt), d.astype(dt), dn.astype(dt)]
+        parts += [st.x.astype(dt).reshape(-1) for st in sts]
+        parts += [st.W.astype(dt).reshape(-1) for st in sts]
+        parts += [st.xbars.astype(dt).reshape(-1) for st in sts]
+        return sts, jnp.concatenate(parts)
+
+    # AOT executable cache: keyed on the bucket count via the call
+    # signature (per-bucket shapes ride the avals); cadence and constants
+    # ride key_extra like the homogeneous megakernel
+    return aot_cache.cached_program(
+        mega, "bucketed_megastep",
+        key_extra=(settings, n_iters, bool(donate), axis,
                    aot_cache.array_digest(nonant_idx)))
 
 
@@ -879,8 +1218,7 @@ def shard_batch(batch, mesh: Mesh, axis: str = "scen",
     needs the dense layout).
     """
     S = batch.num_scenarios
-    nsh = mesh.shape[axis]
-    pad = (-S) % nsh
+    pad = num_ghosts(S, mesh, axis)
     K = batch.tree.num_nonants
     N = batch.tree.num_nodes
     nid_sk = batch.tree.nid_sk()
@@ -895,18 +1233,14 @@ def shard_batch(batch, mesh: Mesh, axis: str = "scen",
     nid_p = padded(nid_sk)
     onehot = batch.tree.onehot_sk_n()
     if pad:
-        # padded scenarios get zero membership so they never perturb reductions
+        # ghost scenarios get zero membership so they never perturb reductions
         onehot = np.concatenate([onehot, np.zeros((pad, K, N))], axis=0)
 
-    shard = NamedSharding(mesh, P(axis))
     A_shared = getattr(batch, "A_shared", None)
     # any second mesh axis (beyond the scenario axis) is the row axis —
     # make_mesh_2d's row_axis name passes through automatically
     extra = [ax for ax in mesh.axis_names if ax != axis]
     row_axis = (extra[0] if (extra and A_shared is not None) else None)
-
-    def put(a, spec=shard):
-        return jax.device_put(jnp.asarray(a), spec)
 
     def pad_rows(a, row_dim):
         """Pad dim ``row_dim`` to a multiple of the row-axis size (inert
@@ -933,14 +1267,11 @@ def shard_batch(batch, mesh: Mesh, axis: str = "scen",
                 "the row axis needs the dense (m, n) layout — use the 1-D "
                 "mesh for the SparseA engine or sparse='auto'")
         if row_axis is not None:
-            A_dev = put(pad_rows(An, 0),
-                        NamedSharding(mesh, P(row_axis, None)))
+            A_host = pad_rows(An, 0)
         elif use_sparse:
-            sp = SparseA.from_dense(An, structure=True)
-            A_dev = jax.device_put(sp, NamedSharding(mesh, P()))
+            A_host = SparseA.from_dense(An, structure=True)
         else:
-            A_dev = put(A_shared, NamedSharding(mesh, P()))
-        row_spec = NamedSharding(mesh, P(axis, row_axis))
+            A_host = An
         cl_p = pad_rows(padded(batch.cl), 1)
         cu_p = pad_rows(padded(batch.cu), 1)
         m0 = batch.cl.shape[1]
@@ -948,25 +1279,22 @@ def shard_batch(batch, mesh: Mesh, axis: str = "scen",
             # inert padded rows: -inf <= (zero row) x <= +inf
             cl_p[:, m0:] = -np.inf
             cu_p[:, m0:] = np.inf
-        cl_dev = put(cl_p, row_spec)
-        cu_dev = put(cu_p, row_spec)
     else:
-        A_dev = put(padded(batch.A))
-        cl_dev = put(padded(batch.cl))
-        cu_dev = put(padded(batch.cu))
-    return PHArrays(
-        c=put(padded(batch.c)),
-        q2=put(padded(batch.q2)),
-        A=A_dev,
-        cl=cl_dev,
-        cu=cu_dev,
-        lb=put(padded(batch.lb)),
-        ub=put(padded(batch.ub)),
-        const=put(padded(batch.const)),
-        probs=put(probs_p),
-        onehot=put(onehot),
-        nid_sk=put(nid_p),
-    )
+        A_host = padded(batch.A)
+        cl_p = padded(batch.cl)
+        cu_p = padded(batch.cu)
+    host = PHArrays(
+        c=padded(batch.c), q2=padded(batch.q2), A=A_host,
+        cl=cl_p, cu=cu_p,
+        lb=padded(batch.lb), ub=padded(batch.ub),
+        const=padded(batch.const), probs=probs_p,
+        onehot=onehot, nid_sk=nid_p)
+    # rule-driven placement: ONE declarative table maps every leaf to its
+    # NamedSharding (ph_partition_rules); an unmatched leaf fails loudly
+    shardings = ph_shardings(mesh, host, axis, row_axis,
+                             shared=A_shared is not None)
+    return jax.tree.map(
+        lambda a, s: jax.device_put(jnp.asarray(a), s), host, shardings)
 
 
 def init_state(arr: PHArrays, default_rho: float, settings: ADMMSettings) -> PHState:
@@ -984,12 +1312,34 @@ def init_state(arr: PHArrays, default_rho: float, settings: ADMMSettings) -> PHS
         y=shardS((S, m)),
         yx=shardS((S, n)),
     )
-    # match the data shardings so the first step doesn't reshard
-    like = jax.tree.map(
-        lambda a: a.sharding,
-        PHState(arr.nid_sk, arr.nid_sk, arr.nid_sk, arr.c, arr.cl, arr.cl, arr.c),
-    )
-    return jax.tree.map(lambda a, s: jax.device_put(a, s), state, like)
+    return jax.tree.map(jax.device_put, state, state_shardings(arr, state))
+
+
+def state_shardings(arr: PHArrays, state: PHState | None = None):
+    """The placement-rule shardings for a :class:`PHState` matching
+    ``arr``'s mesh posture — the data shardings and the state shardings
+    come from ONE table, so the first step never reshards.  Used by
+    :func:`init_state` and the shard-read checkpoint restore.  Falls back
+    to fully-addressable single-device placement when ``arr`` carries no
+    mesh (plain jnp arrays, e.g. the host megastep path)."""
+    sh = getattr(arr.c, "sharding", None)
+    mesh = getattr(sh, "mesh", None)
+    if state is None:
+        K = arr.nid_sk.shape[1]
+        S, n = arr.c.shape
+        m = arr.cl.shape[1]
+        z = np.zeros(())
+        state = PHState(*(np.broadcast_to(z, s) for s in (
+            (S, K), (S, K), (S, K), (S, n), (S, m), (S, m), (S, n))))
+    if mesh is None or getattr(mesh, "empty", False):
+        return jax.tree.map(lambda a: sh, state) if sh is not None else None
+    axis = mesh.axis_names[0]
+    extra = [ax for ax in mesh.axis_names if ax != axis]
+    shared = getattr(arr.A, "ndim", 2) != 3
+    row_axis = extra[0] if (extra and shared) else None
+    # the row-state leaves (z, y) only shard over row_axis when cl does
+    # (2-D shared-A posture) — exactly what the rules table encodes
+    return ph_shardings(mesh, state, axis, row_axis, shared=shared)
 
 
 def run_ph(batch, mesh: Mesh, iters: int, default_rho: float = 1.0,

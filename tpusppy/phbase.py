@@ -278,8 +278,6 @@ class PHBase(SPOpt):
         if req == 1:
             return 0
         b = self.batch
-        if isinstance(b, BucketedBatch):
-            return 0
         if type(self.extobject) is not Extension \
                 or self.ph_converger is not None:
             return 0
@@ -290,6 +288,32 @@ class PHBase(SPOpt):
         refresh_every = self._refresh_every()
         if refresh_every <= 2:
             return 0
+        if isinstance(b, BucketedBatch):
+            # bucketed megakernel: EVERY bucket must fit one dispatch, and
+            # the watchdog cap sums the buckets' per-iteration worst cases
+            # (one scan step sweeps them all) — megastep_cap_multi
+            from .spopt import bucket_shared
+
+            shapes = []
+            for idx, sub in b.buckets:
+                fb = 1 if bucket_shared(sub) else idx.size
+                _, seg_f = segmented.dispatch_segments(
+                    idx.size, sub.num_vars, sub.num_rows, st,
+                    factor_batch=fb)
+                if seg_f < st.max_iter:
+                    return 0
+                shapes.append((idx.size, sub.num_vars, sub.num_rows, fb))
+            cap = segmented.megastep_cap_multi(shapes, st)
+            if req > 1:
+                n_sel = req
+            else:
+                from . import tune
+
+                n_sel = tune.megastep_verdict(
+                    tuple(s[:3] for s in shapes), settings=st) \
+                    or (refresh_every - 1)
+            n_sel = min(n_sel, refresh_every - 1, cap)
+            return n_sel if n_sel >= 2 else 0
         S, n, m = b.num_scenarios, b.num_vars, b.num_rows
         shared = getattr(b, "A_shared", None) is not None
         sf = (segmented.SPARSE_DISPATCH_FACTOR if isinstance(
@@ -306,9 +330,76 @@ class PHBase(SPOpt):
         else:
             from . import tune
 
-            n_sel = tune.megastep_verdict(S, n, m) or (refresh_every - 1)
+            n_sel = tune.megastep_verdict(S, n, m, settings=st) \
+                or (refresh_every - 1)
         n_sel = min(n_sel, refresh_every - 1, cap)
         return n_sel if n_sel >= 2 else 0
+
+    def _mega_age(self) -> int:
+        """Frozen-factor age for the megastep readiness gate: the
+        homogeneous slot's age, or the OLDEST bucket slot's (every bucket
+        sweeps in one scan step, so the stalest factors gate the window)."""
+        from .ir import BucketedBatch
+
+        if isinstance(self.batch, BucketedBatch):
+            slots = getattr(self, "_bucket_slots", None) or []
+            if not slots:
+                return 10 ** 9
+            return max(s.get("age", 0) for s in slots)
+        return self._factors_age
+
+    def _mega_slots_ready(self, refresh_every) -> bool:
+        """Frozen-amortization slots valid for a megastep window: factors
+        + warm present, not aged out, and the validity signature matches
+        (per bucket, for a bucketed batch)."""
+        from .ir import BucketedBatch
+
+        b = self.batch
+        if isinstance(b, BucketedBatch):
+            slots = getattr(self, "_bucket_slots", None)
+            if not slots or len(slots) != len(b.buckets):
+                return False
+            q2_full = self._augmented_q2()
+            lb = np.asarray(b.lb)
+            ub = np.asarray(b.ub)
+            for (idx, sub), slot in zip(b.buckets, slots):
+                if slot.get("warm") is None or slot.get("factors") is None:
+                    return False
+                if slot.get("age", 0) >= refresh_every:
+                    return False
+                n = sub.num_vars
+                if self._solve_sig(q2_full[idx, :n], lb[idx, :n],
+                                   ub[idx, :n]) != slot.get("sig"):
+                    return False
+            return True
+        if self._factors is None or self._warm is None:
+            return False
+        if self._factors_age >= refresh_every:
+            return False
+        return self._solve_sig(self._augmented_q2(), b.lb, b.ub) \
+            == self._factors_sig
+
+    def _megastep_dispatch(self, n_req, n_live, convthresh):
+        """Route one window to the homogeneous or bucketed megakernel."""
+        from .ir import BucketedBatch
+
+        if isinstance(self.batch, BucketedBatch):
+            return self._megastep_solve_bucketed(
+                n_req, n_live, convthresh, self.W, self.xbars, self.rho)
+        return self._megastep_solve(n_req, n_live, convthresh,
+                                    self.W, self.xbars, self.rho)
+
+    def _mega_shape_key(self):
+        """The autotuner shape key: (S, n, m), or the tuple of per-bucket
+        (S_b, n_b, m_b) for a bucketed batch (per-bucket verdict keys —
+        an S=1000 verdict can never serve an S=10000 family)."""
+        from .ir import BucketedBatch
+
+        b = self.batch
+        if isinstance(b, BucketedBatch):
+            return tuple((idx.size, sub.num_vars, sub.num_rows)
+                         for idx, sub in b.buckets)
+        return (b.num_scenarios, b.num_vars, b.num_rows)
 
     def _megastep_window(self, k, max_iters, convthresh, n_req):
         """One megastep window starting at iteration ``k``: returns
@@ -317,9 +408,7 @@ class PHBase(SPOpt):
         measurement) and the caller must run a legacy iteration, which
         refreshes/rescues and restores readiness."""
         refresh_every = self._refresh_every()
-        if self._factors is None or self._warm is None:
-            return 0, False
-        if self._factors_age >= refresh_every:
+        if not self._mega_slots_ready(refresh_every):
             return 0, False
         # previous measurement must be clean — the serial frozen path's
         # acceptance test; a dirty iterate routes through the legacy
@@ -337,11 +426,7 @@ class PHBase(SPOpt):
             # megakernel for the rest of the run
             if not getattr(self, "_last_all_done", False):
                 return 0, False
-        b = self.batch
-        if self._solve_sig(self._augmented_q2(), b.lb, b.ub) \
-                != self._factors_sig:
-            return 0, False
-        n_live = min(n_req, refresh_every - self._factors_age,
+        n_live = min(n_req, refresh_every - self._mega_age(),
                      max_iters - k + 1)
         if n_live < 1:
             return 0, False
@@ -356,8 +441,8 @@ class PHBase(SPOpt):
             self._mega_tuned = True
             from . import tune
 
-            if tune.megastep_verdict(b.num_scenarios, b.num_vars,
-                                     b.num_rows) is None:
+            if tune.megastep_verdict(self._mega_shape_key(),
+                                     settings=self.admm_settings) is None:
                 prog = {"k": k, "executed": 0}
 
                 def run_window(nl):
@@ -370,10 +455,9 @@ class PHBase(SPOpt):
                     # ages them out); a further timed window from the
                     # same state would deterministically re-reject — bail
                     # like the normal window's readiness gate does
-                    if self._factors_age >= refresh_every:
+                    if self._mega_age() >= refresh_every:
                         return 0
-                    m = self._megastep_solve(n_req, nl, convthresh,
-                                             self.W, self.xbars, self.rho)
+                    m = self._megastep_dispatch(n_req, nl, convthresh)
                     ex = m["executed"]
                     if ex:
                         self._apply_megastep_meas(prog["k"], m)
@@ -382,11 +466,10 @@ class PHBase(SPOpt):
                     return ex
 
                 tune.autotune_megastep(
-                    run_window, (b.num_scenarios, b.num_vars, b.num_rows),
-                    n_cap=n_req)
+                    run_window, self._mega_shape_key(), n_cap=n_req,
+                    settings=self.admm_settings)
                 return prog["executed"], bool(self.conv < convthresh)
-        meas = self._megastep_solve(n_req, n_live, convthresh,
-                                    self.W, self.xbars, self.rho)
+        meas = self._megastep_dispatch(n_req, n_live, convthresh)
         executed = meas["executed"]
         if executed == 0:
             # the window's FIRST iterate failed the in-scan acceptance
@@ -402,20 +485,33 @@ class PHBase(SPOpt):
 
     def _apply_megastep_meas(self, k, meas):
         """Install one megastep window's packed measurement as the host PH
-        state (copies: the unpack returns views into one fetched vector)."""
+        state (copies: the unpack returns views into one fetched vector).
+
+        A LEAN measurement (device-resident posture, ``ph_device_state``)
+        carries no x/W/xbars blocks: the (S, K)/(S, n) mirrors stay where
+        they are and are marked STALE — :meth:`_sync_host_state` refreshes
+        them with one explicit billed fetch at the next checkpoint/
+        termination/refresh boundary.  The per-scenario residual
+        diagnostics and the scalar stats install either way, so the
+        readiness gates and the convergence test never read stale data."""
         executed = meas["executed"]
-        self.W = np.array(meas["W"], dtype=float)
-        self.xbars = np.array(meas["xbars"], dtype=float)
-        self.local_x = np.array(meas["x"], dtype=float)
+        if "W" in meas:
+            self.W = np.array(meas["W"], dtype=float)
+            self.xbars = np.array(meas["xbars"], dtype=float)
+            self.local_x = np.array(meas["x"], dtype=float)
+        else:
+            self._host_state_stale = True
         self.pri_res = np.array(meas["pri"], dtype=float)
         self.dua_res = np.array(meas["dua"], dtype=float)
         self._last_all_done = bool(np.all(meas["done"]))
-        # xsqbars is not packed (no in-scan consumer): recompute the
-        # second moment host-side from the window's final x so PH state
-        # stays internally consistent — checkpoints capture it, and
-        # heuristics read it between windows (xbars comes off the device;
-        # the redundant E[x] half costs one einsum per WINDOW)
-        _, self.xsqbars = self._node_avgs(self._nonants_cached())
+        if "W" in meas:
+            # xsqbars is not packed (no in-scan consumer): recompute the
+            # second moment host-side from the window's final x so PH
+            # state stays internally consistent — checkpoints capture it,
+            # and heuristics read it between windows (xbars comes off the
+            # device; the redundant E[x] half costs one einsum per
+            # WINDOW).  The lean posture defers this to the boundary sync
+            _, self.xsqbars = self._node_avgs(self._nonants_cached())
         self.conv = float(meas["conv"][executed - 1])
         self._iter = k + executed - 1
         self._bump_state_version()
@@ -423,6 +519,45 @@ class PHBase(SPOpt):
             f"PH megastep {k}..{self._iter} conv {self.conv:.6e}",
             self.options.get("display_progress", False),
         )
+
+    def _sync_host_state(self):
+        """Refresh the (S, K)/(S, n) host mirrors from the device-resident
+        wheel state — ONE explicit billed fetch (``phstate.boundary_
+        fetches``), called only at window boundaries that actually READ
+        host state: checkpoint capture, hub payloads, the legacy refresh
+        fallback, and loop termination.  No-op when the mirrors are
+        already authoritative, so the legacy (full-pack) path never pays
+        anything here."""
+        st = getattr(self, "_dev_state", None)
+        if st is None or not getattr(self, "_host_state_stale", False):
+            self._host_state_stale = False
+            return
+        from .obs import metrics as _metrics
+        from .solvers import hostsync
+
+        W, xbars, x = hostsync.fetch((st.W, st.xbars, st.x))
+        self.W = np.array(W, dtype=float)
+        self.xbars = np.array(xbars, dtype=float)
+        self.local_x = np.array(x, dtype=float)
+        self._host_state_stale = False
+        _, self.xsqbars = self._node_avgs(self._nonants_cached())
+        self._bump_state_version()
+        _metrics.inc("phstate.boundary_fetches")
+        if _trace.enabled():
+            _trace.instant(None, "phstate_boundary_fetch", iter=self._iter)
+
+    def _spcomm_needs_host_state(self) -> bool:
+        """Whether the imminent ``spcomm.sync()`` will read host PH state:
+        W/nonant spoke payloads, or a due checkpoint capture (which must
+        find fresh mirrors — the capture itself is pinned zero-fetch)."""
+        c = self.spcomm
+        if c is None:
+            return False
+        if getattr(c, "has_w_spokes", False) or \
+                getattr(c, "has_nonant_spokes", False):
+            return True
+        due = getattr(c, "checkpoint_due", None)
+        return bool(due and due(self._iter))
 
     def iterk_loop(self):
         """Main PH loop (phbase.py:875-979).
@@ -449,6 +584,12 @@ class PHBase(SPOpt):
                 if executed:
                     k += executed
                     if self.spcomm is not None:
+                        # device-resident posture: refresh the host
+                        # mirrors BEFORE a sync that reads them (payload
+                        # spokes, a due checkpoint capture) — the capture
+                        # itself stays pinned zero-fetch
+                        if self._spcomm_needs_host_state():
+                            self._sync_host_state()
                         self.spcomm.sync()
                         self.extobject.enditer_after_sync()
                         if self.spcomm.is_converged():
@@ -462,10 +603,18 @@ class PHBase(SPOpt):
                         )
                         break
                     continue
+            # the legacy body assembles the augmented objective from the
+            # host mirrors — they must be authoritative (no-op unless the
+            # device-resident posture left them stale)
+            self._sync_host_state()
             k = self._iterk_one(k, convthresh)
             if k is None:
                 break
             k += 1
+        # loop exit (termination, convergence, iteration limit): whatever
+        # reads follow — post_loops' Eobjective, the final checkpoint
+        # capture, bench metrics — get authoritative host state
+        self._sync_host_state()
 
     def _iterk_one(self, k, convthresh):
         """One legacy PH iteration (the pre-megakernel loop body).

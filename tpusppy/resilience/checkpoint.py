@@ -155,10 +155,14 @@ def save(ckpt: WheelCheckpoint, path: str) -> str:
     return path
 
 
-def load(path: str) -> WheelCheckpoint:
+def load(path: str, _assemble: bool = True) -> WheelCheckpoint:
     """Read one checkpoint file; unknown versions are refused loudly (a
     silent partial restore would corrupt the gap trajectory it exists to
-    preserve)."""
+    preserve).  A member of a SHARDED set (``.s<k>of<n>.npz``) loads the
+    whole set assembled — pass through :func:`load_sharded` explicitly
+    (or :class:`ShardedCheckpointReader` for row reads) to control that."""
+    if _assemble and _SHARD_RE.match(os.path.basename(path)):
+        return load_sharded(path)
     with np.load(path, allow_pickle=False) as z:
         meta = json.loads(str(z["meta"][()]))
         if int(meta.get("version", -1)) > CHECKPOINT_VERSION:
@@ -180,6 +184,7 @@ def load(path: str) -> WheelCheckpoint:
 
 
 _CKPT_RE = re.compile(r"^ckpt_.*_(\d+)\.npz$")
+_SHARD_RE = re.compile(r"^ckpt_.*_(\d+)\.s(\d+)of(\d+)\.npz$")
 
 
 def checkpoint_path(directory: str, iteration: int,
@@ -187,9 +192,27 @@ def checkpoint_path(directory: str, iteration: int,
     return os.path.join(directory, f"ckpt_{tag}_{int(iteration):08d}.npz")
 
 
+def shard_checkpoint_path(directory: str, iteration: int, shard: int,
+                          num_shards: int, tag: str = "wheel") -> str:
+    """Per-shard file of one sharded checkpoint: ``ckpt_<tag>_<iter>.
+    s<k>of<n>.npz`` — each process writes ONLY its scenario-row slice, so
+    a 100k-scenario snapshot never materializes on one host."""
+    return os.path.join(
+        directory,
+        f"ckpt_{tag}_{int(iteration):08d}"
+        f".s{int(shard):03d}of{int(num_shards):03d}.npz")
+
+
 def list_checkpoints(directory: str) -> list:
-    """[(iteration, path)] ascending; tolerates foreign files."""
+    """[(iteration, path)] ascending; tolerates foreign files.
+
+    A SHARDED checkpoint (``.s<k>of<n>.npz`` siblings) appears once, as
+    its shard-0 path, and only when the set is COMPLETE — per-shard
+    writes are individually atomic but the set is not, so a kill between
+    shard renames must leave the previous complete checkpoint as
+    ``latest``, never a torn set."""
     out = []
+    shard_sets: dict = {}
     try:
         names = os.listdir(directory)
     except OSError:
@@ -198,6 +221,15 @@ def list_checkpoints(directory: str) -> list:
         m = _CKPT_RE.match(nm)
         if m:
             out.append((int(m.group(1)), os.path.join(directory, nm)))
+            continue
+        m = _SHARD_RE.match(nm)
+        if m:
+            it, k, n = (int(m.group(i)) for i in (1, 2, 3))
+            shard_sets.setdefault((it, n), {})[k] = os.path.join(
+                directory, nm)
+    for (it, n), shards in shard_sets.items():
+        if len(shards) == n and 0 in shards:
+            out.append((it, shards[0]))
     return sorted(out)
 
 
@@ -211,7 +243,9 @@ def load_latest(path: str) -> WheelCheckpoint | None:
     """Load ``path`` directly (a file) or its newest checkpoint (a
     directory).  None when nothing is there — callers treat a missing
     checkpoint as a cold start, which is what ``--resume`` on a first run
-    must mean."""
+    must mean.  A sharded set loads ASSEMBLED (all rows on this host);
+    big-S callers that must never materialize the full state use
+    :class:`ShardedCheckpointReader` / :func:`restore_sharded_array`."""
     if path is None:
         return None
     if os.path.isdir(path):
@@ -220,6 +254,201 @@ def load_latest(path: str) -> WheelCheckpoint | None:
     if os.path.exists(path):
         return load(path)
     return None
+
+
+# ---------------------------------------------------------------------------
+# Sharded checkpoints (scenario scale-out, ROADMAP item 1): the (S, K)
+# wheel state is written as one npz PER SCENARIO-ROW SHARD — each process
+# of a multi-controller mesh saves only its local rows, and a resume
+# rebuilds the device array via ``jax.make_array_from_callback`` reading
+# only the shard files that overlap its addressable rows.  A 100k-scenario
+# snapshot therefore never materializes on one host, on either side.
+# ---------------------------------------------------------------------------
+def save_shard(ckpt: WheelCheckpoint, directory: str, shard: int,
+               num_shards: int, rows, S_total: int,
+               tag: str = "wheel") -> str:
+    """Atomically write ONE shard of a sharded checkpoint.
+
+    ``ckpt``'s arrays hold only this shard's rows; ``rows`` is their
+    (lo, hi) global row range and ``S_total`` the full scenario count.
+    Every shard carries the full scalar meta (iteration, bounds, ...) so
+    any single shard can answer metadata queries without its siblings."""
+    lo, hi = (int(rows[0]), int(rows[1]))
+    sh_meta = {"index": int(shard), "count": int(num_shards),
+               "rows": [lo, hi], "S": int(S_total)}
+    for f in _ARRAY_FIELDS:
+        a = getattr(ckpt, f, None)
+        if a is not None and np.ndim(a) == 2:
+            # column width in the meta so readers can answer shape
+            # queries without decompressing any shard's array block
+            sh_meta["K"] = int(np.shape(a)[1])
+            break
+    ck = dataclasses.replace(
+        ckpt, meta=dict(ckpt.meta or {}, shard=sh_meta))
+    return save(ck, shard_checkpoint_path(directory, ckpt.iteration,
+                                          shard, num_shards, tag))
+
+
+def _shard_sibling_names(path: str) -> list:
+    """Every sibling shard PATH of one set member, derived from the
+    ``.s<k>of<n>`` name pattern alone — no file is opened, and the list
+    is independent of which siblings currently exist."""
+    d, base = os.path.split(os.path.abspath(path))
+    m = _SHARD_RE.match(base)
+    if not m:
+        return []
+    n = int(m.group(3))
+    stem = base[:base.rindex(".s")]
+    return [os.path.join(d, f"{stem}.s{k:03d}of{n:03d}.npz")
+            for k in range(n)]
+
+
+def shard_set_paths(path: str) -> list:
+    """[(lo, hi, path)] for every sibling shard of one sharded-checkpoint
+    member ``path``, ascending by row range; [] when ``path`` is not a
+    shard file or the set is incomplete/unreadable (a sibling vanishing
+    mid-listing — e.g. a concurrent controller's cleanup — reads as
+    incomplete, never as a crash)."""
+    import zipfile
+
+    out = []
+    for p in _shard_sibling_names(path):
+        try:
+            with np.load(p, allow_pickle=False) as z:
+                meta = json.loads(str(z["meta"][()]))
+        except (OSError, KeyError, ValueError, zipfile.BadZipFile):
+            return []
+        lo, hi = meta.get("meta", {}).get("shard", {}).get("rows", (0, 0))
+        out.append((int(lo), int(hi), p))
+    return sorted(out)
+
+
+def remove_checkpoint_files(path: str):
+    """Remove one checkpoint ARTIFACT: the file itself, plus every
+    sibling shard when ``path`` is a member of a sharded set
+    (``list_checkpoints`` names a complete set by its shard-0 path, so a
+    prune that removed only that file would orphan the siblings
+    forever).  Pure name-pattern deletion: nothing is opened, so
+    concurrent cleanup across controllers cannot race a read."""
+    for p in _shard_sibling_names(path) or [path]:
+        with contextlib.suppress(OSError):
+            os.remove(p)
+
+
+def load_sharded(path: str) -> WheelCheckpoint:
+    """Assemble one FULL checkpoint from a sharded set (any member path).
+    Host-side concatenation — the compatibility loader for single-host
+    resumes; the O(1)-host path is :func:`restore_sharded_array`."""
+    parts = shard_set_paths(path)
+    if not parts:
+        raise RuntimeError(f"incomplete or foreign sharded checkpoint "
+                           f"set at {path}")
+    members = [load(p, _assemble=False) for _, _, p in parts]
+    first = members[0]
+    S = int((first.meta or {}).get("shard", {}).get("S", 0)) or \
+        sum(hi - lo for lo, hi, _ in parts)
+    out = dataclasses.replace(first, meta={
+        k: v for k, v in (first.meta or {}).items() if k != "shard"})
+    for f in _ARRAY_FIELDS:
+        if getattr(first, f) is None:
+            continue
+        full = np.zeros((S,) + getattr(first, f).shape[1:])
+        for (lo, hi, _), mem in zip(parts, members):
+            full[lo:hi] = getattr(mem, f)
+        setattr(out, f, full)
+    return out
+
+
+class ShardedCheckpointReader:
+    """Row-range reads over one sharded checkpoint set, opening only the
+    shard files a requested slice overlaps (one npz handle cache per
+    file).  The ``jax.make_array_from_callback`` feeder: each process
+    asks for its addressable rows only, so no host ever reads rows it
+    does not own."""
+
+    def __init__(self, path: str):
+        self.parts = shard_set_paths(path)
+        if not self.parts:
+            raise RuntimeError(
+                f"incomplete or foreign sharded checkpoint set at {path}")
+        with np.load(self.parts[0][2], allow_pickle=False) as z:
+            self.meta = json.loads(str(z["meta"][()]))
+        sh = self.meta.get("meta", {}).get("shard", {})
+        self.S = int(sh.get("S", self.parts[-1][1]))
+        self.K = int(sh["K"]) if "K" in sh else None
+        self.iteration = int(self.meta.get("iteration", 0))
+        self._cache: dict = {}
+
+    def drop_cache(self):
+        """Release the per-shard array cache (call once the restore is
+        done: the reader may be kept alive by closures for the run's
+        lifetime, and cached foreign-row blocks would otherwise dilute
+        the O(1)-per-host contract this API exists for)."""
+        self._cache = {}
+
+    def _shard_arrays(self, p: str) -> dict:
+        got = self._cache.get(p)
+        if got is None:
+            with np.load(p, allow_pickle=False) as z:
+                meta = json.loads(str(z["meta"][()]))
+                got = {f: np.array(z[f])
+                       for f in meta.get("arrays", []) if f in z}
+            self._cache[p] = got
+        return got
+
+    def read_rows(self, field: str, lo: int, hi: int) -> np.ndarray:
+        """Rows [lo, hi) of ``field`` assembled from the overlapping
+        shards.  Rows at/above the stored S (``pad_to`` ghost padding on
+        an uneven mesh) come back zero — ghosts never checkpoint."""
+        lo, hi = int(lo), int(hi)
+        cols = None
+        chunks = []
+        for slo, shi, p in self.parts:
+            if shi <= lo or slo >= hi:
+                continue
+            a = self._shard_arrays(p).get(field)
+            if a is None:
+                raise KeyError(f"field {field!r} absent from shard {p}")
+            cols = a.shape[1:]
+            chunks.append(a[max(lo - slo, 0):max(min(hi, shi) - slo, 0)])
+        if cols is None:
+            # an ALL-ghost request (a device whose rows are entirely mesh
+            # padding): zeros, shaped like the field's columns
+            a0 = self._shard_arrays(self.parts[0][2]).get(field)
+            if a0 is None:
+                raise KeyError(f"field {field!r} absent from shard set")
+            return np.zeros((hi - lo,) + a0.shape[1:])
+        got = np.concatenate(chunks) if len(chunks) > 1 else chunks[0]
+        if got.shape[0] < hi - lo:        # ghost-row tail: zeros
+            pad = np.zeros((hi - lo - got.shape[0],) + cols)
+            got = np.concatenate([got, pad])
+        return got
+
+
+def restore_sharded_array(src, field: str, sharding, shape, dtype=None):
+    """Device array of ``field`` from a sharded checkpoint set, built via
+    ``jax.make_array_from_callback`` so each process reads ONLY the shard
+    files overlapping its addressable rows — the O(1)-per-host restore.
+    ``src`` is a shard-member path or an existing
+    :class:`ShardedCheckpointReader` (reused: building a fresh reader
+    re-opens every shard's meta, which the caller often already paid).
+    ``shape`` is the (possibly ghost-padded) global device shape; rows
+    past the stored S fill with zeros."""
+    import jax
+
+    reader = src if isinstance(src, ShardedCheckpointReader) \
+        else ShardedCheckpointReader(src)
+
+    def cb(idx):
+        r = idx[0]
+        lo = 0 if r.start is None else r.start
+        hi = shape[0] if r.stop is None else r.stop
+        block = reader.read_rows(field, lo, hi)
+        rest = tuple(idx[1:])
+        block = block[(slice(None),) + rest]
+        return block if dtype is None else block.astype(dtype)
+
+    return jax.make_array_from_callback(tuple(shape), sharding, cb)
 
 
 # ---------------------------------------------------------------------------
@@ -343,8 +572,16 @@ class CheckpointManager:
 
     def __init__(self, directory: str, every_secs: float | None = 60.0,
                  every_iters: int | None = None, keep: int = 3,
-                 tag: str = "wheel", fresh_start: bool = False):
+                 tag: str = "wheel", fresh_start: bool = False,
+                 shard=None):
         self.directory = str(directory)
+        # shard = (index, count, (row_lo, row_hi), S_total): this manager
+        # writes ONE scenario-row shard per snapshot (save_shard) — every
+        # process of a multi-controller mesh owns a manager for its rows,
+        # so no host ever serializes the full (S, K) state
+        self.shard = None if shard is None else (
+            int(shard[0]), int(shard[1]),
+            (int(shard[2][0]), int(shard[2][1])), int(shard[3]))
         os.makedirs(self.directory, exist_ok=True)
         if fresh_start:
             # a COLD run pointed at a reused directory: a previous run's
@@ -352,10 +589,10 @@ class CheckpointManager:
             # only, so they would out-prune this run's early snapshots
             # AND hijack a later resume with foreign state) — the
             # spinners pass fresh_start=True whenever no resume loaded
-            stale = list_checkpoints(self.directory)
-            for _, p in stale:
-                with contextlib.suppress(OSError):
-                    os.remove(p)
+            stale = [p for _, p in list_checkpoints(self.directory)]
+            stale += [p for _, p in self._own_shard_files()]
+            for p in dict.fromkeys(stale):
+                remove_checkpoint_files(p)
             if stale:
                 _log.info("cold start: cleared %d stale checkpoint(s) "
                           "from %s", len(stale), self.directory)
@@ -423,6 +660,19 @@ class CheckpointManager:
         snap.iteration = int(iteration)
         self._last_t = time.monotonic()
         self._last_iter = int(iteration)
+        if self.shard is not None:
+            # SHARDED managers write SYNCHRONOUSLY: the async writer
+            # thread coalesces to the newest pending snapshot
+            # independently per process, so two controllers on unevenly
+            # loaded disks would persist DISJOINT iteration sets and the
+            # keep-window prune could leave no COMPLETE set at all.  A
+            # synchronous write keeps every process's shard files
+            # aligned with the (deterministic, iteration-cadence)
+            # capture schedule by construction; the cost is 1/n_shards
+            # of the state per write, on a path that is already
+            # collective-lockstep across controllers.
+            self._write(snap)
+            return True
         with self._cv:
             if self._pending is not None:
                 _CTR_COALESCED.inc(1)     # newest snapshot wins
@@ -455,10 +705,19 @@ class CheckpointManager:
 
     def _write(self, snap: WheelCheckpoint):
         t0 = time.perf_counter()
-        path = checkpoint_path(self.directory, snap.iteration, self.tag)
+        if self.shard is not None:
+            k, n, rows, S = self.shard
+            path = shard_checkpoint_path(self.directory, snap.iteration,
+                                         k, n, self.tag)
+        else:
+            path = checkpoint_path(self.directory, snap.iteration, self.tag)
         try:
             with _trace.span("ckpt", "write", iteration=snap.iteration):
-                save(snap, path)
+                if self.shard is not None:
+                    save_shard(snap, self.directory, k, n, rows, S,
+                               tag=self.tag)
+                else:
+                    save(snap, path)
             _CTR_WRITES.inc(1)
             _HIST_WRITE_SECS.add(time.perf_counter() - t0)
             self._prune()
@@ -468,11 +727,38 @@ class CheckpointManager:
             _CTR_WRITE_ERRORS.inc(1)
             _log.warning("checkpoint write failed (%s): %r", path, e)
 
+    def _own_shard_files(self) -> list:
+        """[(iteration, path)] of THIS manager's shard files, ascending —
+        a sharded manager prunes only the rows it owns (siblings belong
+        to their own processes' managers)."""
+        if self.shard is None:
+            return []
+        k, n, _, _ = self.shard
+        suffix = f".s{k:03d}of{n:03d}.npz"
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        for nm in names:
+            m = _SHARD_RE.match(nm)
+            if m and nm.endswith(suffix):
+                out.append((int(m.group(1)),
+                            os.path.join(self.directory, nm)))
+        return sorted(out)
+
     def _prune(self):
-        cks = list_checkpoints(self.directory)
-        for _, p in cks[:-self.keep]:
-            with contextlib.suppress(OSError):
-                os.remove(p)
+        if self.shard is not None:
+            # a sharded manager prunes ONLY its own shard files — the
+            # siblings belong to their processes' managers
+            for _, p in self._own_shard_files()[:-self.keep]:
+                with contextlib.suppress(OSError):
+                    os.remove(p)
+            return
+        for _, p in list_checkpoints(self.directory)[:-self.keep]:
+            # a complete sharded set is listed by its shard-0 path:
+            # removing that alone would orphan the sibling shards
+            remove_checkpoint_files(p)
 
     # ---- teardown ---------------------------------------------------------
     def flush(self, timeout: float = 30.0) -> bool:

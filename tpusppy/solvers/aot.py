@@ -198,6 +198,18 @@ def family_parts(arr, settings, mesh, axis) -> tuple:
             settings, ndev, axis)
 
 
+def shape_family_parts(S, n, m, settings=None, a_kind="?", ndev=1,
+                       axis="scen") -> tuple:
+    """:func:`family_parts` for callers that know only the (S, n, m)
+    shape — SAME tuple structure and field order, so keys built from a
+    bare shape (the tune megastep verdicts) can never silently drift
+    from keys built from real arrays (drift guard in tests/test_tune).
+    ``a_kind`` stays the wildcard ``"?"`` when the engine is not part of
+    the caller's identity."""
+    return ((int(S), int(n)), (int(S), int(m)), a_kind, settings,
+            int(ndev), axis)
+
+
 def _versions() -> tuple:
     """Toolchain fingerprint every key embeds: executable serialization is
     where jax/jaxlib drift bites first, and a deserialized program must

@@ -207,8 +207,26 @@ def megastep_cap(S, n, m, st, eff_flops=None, target_secs=None,
     return int(target / max(_frozen_iter_secs(st, t_sweep), 1e-12))
 
 
+def megastep_cap_multi(shapes, st, eff_flops=None, target_secs=None):
+    """Watchdog cap for a BUCKETED megastep: one scan step runs EVERY
+    bucket's frozen sweep back to back inside the same program, so the
+    per-iteration worst case is the SUM over buckets of the homogeneous
+    :func:`megastep_cap` accounting.  ``shapes`` is
+    ``[(S_b, n_b, m_b[, factor_batch_b[, sparse_factor_b]]), ...]``."""
+    target = _DISPATCH_TARGET_SECS if target_secs is None else target_secs
+    total = 0.0
+    for shp in shapes:
+        S, n, m = shp[0], shp[1], shp[2]
+        fb = shp[3] if len(shp) > 3 else 1
+        sf = shp[4] if len(shp) > 4 else 1.0
+        eff = _dense_clamped_eff(eff_flops, fb)
+        t_sweep = flops_model.sweep_flops(S, n, m, sf) / eff
+        total += _frozen_iter_secs(st, t_sweep)
+    return int(target / max(total, 1e-12))
+
+
 def bill_megastep(S, n, m, n_iters, sweeps, sparse_factor=1.0,
-                  rejected_sweeps=None):
+                  rejected_sweeps=None, count_dispatch=True):
     """Bill one EXECUTED megastep into the metrics registry.
 
     ``n_iters`` is the number of wheel iterations the dispatch ACCEPTED
@@ -220,12 +238,19 @@ def bill_megastep(S, n, m, n_iters, sweeps, sparse_factor=1.0,
     acceptance test DISCARDED (refresh_hit) — real dispatched work whose
     result was dropped, billed into ``dispatch.flops`` and counted under
     ``megastep.rejected_iterations`` but never into
-    ``dispatch.mega_iterations`` (it is not a fused PH iteration)."""
-    _metrics.inc("dispatch.megasteps")
-    _metrics.inc("dispatch.mega_iterations", int(n_iters))
+    ``dispatch.mega_iterations`` (it is not a fused PH iteration).
+
+    ``count_dispatch=False``: bill the FLOPS only — the bucketed
+    megakernel calls this once per bucket (each bucket's own shapes) but
+    the window is ONE dispatch of ``n_iters`` fused PH iterations, so
+    only the first bucket's call counts toward the dispatch counters."""
+    if count_dispatch:
+        _metrics.inc("dispatch.megasteps")
+        _metrics.inc("dispatch.mega_iterations", int(n_iters))
     fl = flops_model.megastep_flops(S, n, m, n_iters, sweeps, sparse_factor)
     if rejected_sweeps is not None:
-        _metrics.inc("megastep.rejected_iterations")
+        if count_dispatch:
+            _metrics.inc("megastep.rejected_iterations")
         fl += flops_model.megastep_flops(S, n, m, 1, rejected_sweeps,
                                          sparse_factor)
     if fl:

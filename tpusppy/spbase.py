@@ -137,7 +137,12 @@ class SPBase:
         # ragged families (e.g. uneven bundles): shape-bucket instead of
         # padding everything to the max (SURVEY §7 hard part 2)
         quantum = int(self.options.get("shape_bucket_quantum", 16))
-        shapes = {(p.num_vars, p.num_rows) for p in problems}
+        # the integer pattern is part of the shape key: same-(n, m)
+        # scenarios with DIFFERENT is_int patterns cannot share one
+        # ScenarioBatch (it requires one pattern) but bucket cleanly —
+        # BucketedBatch subgroups by padded pattern anyway
+        shapes = {(p.num_vars, p.num_rows, p.is_int.tobytes())
+                  for p in problems}
         bucketed = None
         # opt-in: bucketing trades the features needing a global A tensor
         # or a shared integer pattern (cut injection, integer diving,

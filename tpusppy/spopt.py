@@ -169,6 +169,18 @@ def dispatch_A(b):
     return b.A if A_shared is None else A_shared
 
 
+def bucket_shared(sub) -> bool:
+    """Whether a bucket's sub-batch runs the SHARED-A engine.  Sharing
+    must be real: a singleton sub-batch trivially detects identity-shared
+    A (``all(p.A is A0)`` over one member), but dense is equally cheap at
+    S_b=1 and the shared engine's batch-level rho adaptation/termination
+    semantics converge differently on some families — the observed case
+    is a 3-merge farmer bundle whose shared solve stalls where the dense
+    solve converges."""
+    return getattr(sub, "A_shared", None) is not None \
+        and sub.num_scenarios > 1
+
+
 def _certified_dual_eval(args):
     """(dvals, margin) — the weak-duality bound with its X-cap hardening
     margin (admm.dual_objective_margin: extends the certificate's validity
@@ -237,6 +249,8 @@ class SPOpt(SPBase):
         self._factors = None         # admm.Factors of the last refresh solve
         self._factors_sig = None
         self._factors_age = 0
+        self._dev_state = None       # device-resident PHState (lean megasteps)
+        self._host_state_stale = False
 
     def _device_consts(self, dt):
         """Device-resident (A, cl, cu) cached on batch.version: the (S, m, n)
@@ -300,6 +314,11 @@ class SPOpt(SPBase):
         ext = getattr(self, "extobject", None)
         if ext is not None:
             ext.pre_solve()
+        # any host-path solve supersedes the device-resident wheel state
+        # (callers synced the mirrors first — PHBase.iterk_loop's
+        # boundary protocol); keeping a stale _dev_state here would let a
+        # later megastep window resume from pre-refresh duals
+        self._dev_state = None
         b = self.batch
         q = b.c if q is None else q
         q2 = b.q2 if q2 is None else q2
@@ -489,26 +508,37 @@ class SPOpt(SPBase):
         compiled program per shape bucket), scattered back into the
         (S, n_max) bookkeeping layout.  Per-bucket warm states chain like
         the homogeneous path's; factors amortization is per-bucket too.
+
+        Device-lifted (ROADMAP item 1): each bucket's (A, cl, cu) is
+        device-resident (:meth:`_bucket_device_consts` — no re-upload per
+        solve), and a bucket whose sub-batch carries ``A_shared``
+        dispatches the shared-A engine on the single (m, n) matrix
+        instead of materializing the (S_b, m, n) broadcast.
         """
         S, n_max = b.c.shape
         x_out = np.zeros((S, n_max))
         pri = np.zeros(S)
         dua = np.zeros(S)
+        all_done = True
         slots = getattr(self, "_bucket_slots", None)
         if slots is None or len(slots) != len(b.buckets):
             slots = self._bucket_slots = [dict() for _ in b.buckets]
+        consts = self._bucket_device_consts(self.admm_settings.jdtype())
         for k, (idx, sub) in enumerate(b.buckets):
             n, m = sub.num_vars, sub.num_rows
+            A_d, cl_d, cu_d = consts[k]
             args = (np.asarray(q)[idx, :n], np.asarray(q2)[idx, :n],
-                    sub.A, sub.cl, sub.cu,
+                    A_d, cl_d, cu_d,
                     np.asarray(lb)[idx, :n], np.asarray(ub)[idx, :n])
-            _, meas = self._solve_amortized(args, slots[k], warm, sub)
+            _, meas = self._solve_amortized(
+                args, slots[k], warm, sub, shared=bucket_shared(sub))
             x_out[idx, :n] = meas["x"]
             pri[idx] = meas["pri"]
             dua[idx] = meas["dua"]
+            all_done = all_done and bool(meas["all_done"])
         self._warm = None          # homogeneous-path caches do not apply
         self._factors = None
-        self._last_all_done = False
+        self._last_all_done = all_done
         self.local_x = x_out
         self.pri_res = pri
         self.dua_res = dua
@@ -712,21 +742,36 @@ class SPOpt(SPBase):
         self._mega_arr_cache = (key, arr)
         return arr
 
-    def _megastep_fn(self, n_req: int):
+    def _device_state_on(self) -> bool:
+        """Device-resident PH-state posture (the O(1)-host big-S wheel):
+        megastep windows fetch the LEAN packed measurement only, and the
+        (S, K)/(S, n) host mirrors are refreshed by one explicit billed
+        fetch at checkpoint/termination/refresh boundaries
+        (:meth:`tpusppy.phbase.PHBase._sync_host_state`) instead of every
+        window.  Opt-in: the ``ph_device_state`` hub option or
+        ``TPUSPPY_DEVICE_STATE=1``."""
+        import os
+
+        v = self.options.get("ph_device_state")
+        if v is None:
+            v = os.environ.get("TPUSPPY_DEVICE_STATE", "0") != "0"
+        return bool(v)
+
+    def _megastep_fn(self, n_req: int, pack: str = "full"):
         """The jitted megakernel for this instance at width ``n_req``
-        (one compile per distinct N; the traced ``n_live`` budget serves
-        every executed count below it)."""
+        (one compile per distinct (N, pack); the traced ``n_live`` budget
+        serves every executed count below it)."""
         cache = getattr(self, "_mega_fn_cache", None)
         if cache is None:
             cache = self._mega_fn_cache = {}
-        fn = cache.get(n_req)
+        fn = cache.get((n_req, pack))
         if fn is None:
             from .parallel import sharded
 
             fn = sharded.make_wheel_megastep(
                 self.tree.nonant_indices, self.admm_settings, None,
-                n_iters=n_req, donate=True)
-            cache[n_req] = fn
+                n_iters=n_req, donate=True, pack=pack)
+            cache[(n_req, pack)] = fn
         return fn
 
     def _megastep_solve(self, n_req: int, n_live: int, convthresh: float,
@@ -757,17 +802,20 @@ class SPOpt(SPBase):
         b = self.batch
         S, n, m = b.num_scenarios, b.num_vars, b.num_rows
         K = self.nonant_length
-        warm = self._warm
-        state = sharded.PHState(
-            W=jnp.asarray(W, dt), xbars=jnp.asarray(xbars, dt),
-            rho=jnp.asarray(rho, dt),
-            x=jnp.asarray(warm[0], dt), z=jnp.asarray(warm[1], dt),
-            y=jnp.asarray(warm[2], dt), yx=jnp.asarray(warm[3], dt))
+        pack = "lean" if self._device_state_on() else "full"
+        state = getattr(self, "_dev_state", None)
+        if state is None:
+            warm = self._warm
+            state = sharded.PHState(
+                W=jnp.asarray(W, dt), xbars=jnp.asarray(xbars, dt),
+                rho=jnp.asarray(rho, dt),
+                x=jnp.asarray(warm[0], dt), z=jnp.asarray(warm[1], dt),
+                y=jnp.asarray(warm[2], dt), yx=jnp.asarray(warm[3], dt))
         # in-scan acceptance at the serial ladder: the megastep solves
         # the PH prox objective, so every scenario is QP
         _, tol_qp = self._straggler_tols()
         with _trace.span(None, "solve.megastep") as _sp:
-            state, packed = self._megastep_fn(n_req)(
+            state, packed = self._megastep_fn(n_req, pack)(
                 state, arr, 1.0, self._factors, convthresh, n_live,
                 tol_qp)
             # rebind the warm slot BEFORE the blocking fetch: the old
@@ -775,8 +823,12 @@ class SPOpt(SPBase):
             # (remote-tunnel error, fault injection) must not leave
             # self._warm pointing at deleted device memory
             self._warm = (state.x, state.z, state.y, state.yx)
+            # device-resident posture: the RETURNED state (W/xbars
+            # included) is the authoritative wheel state; host mirrors
+            # go stale until a boundary sync fetches them explicitly
+            self._dev_state = state if pack == "lean" else None
             meas = sharded.megastep_unpack(
-                hostsync.fetch(packed), n_req, S, n, K)
+                hostsync.fetch(packed), n_req, S, n, K, pack=pack)
             if _trace.enabled():
                 _sp.add(n_live=n_live, executed=meas["executed"],
                         refresh_hit=meas["refresh_hit"])
@@ -820,6 +872,170 @@ class SPOpt(SPBase):
             # + straggler rescue — exactly where the serial protocol
             # lands, minus the already-discarded frozen attempt
             self._factors_age = max(self._factors_age, refresh_every)
+            _metrics.inc("megastep.refresh_hits")
+        return meas
+
+    def _mega_arrays_bucketed(self, dt):
+        """Per-bucket :class:`~tpusppy.parallel.sharded.PHArrays` tuple
+        for the bucketed wheel megakernel: each bucket's compact problem
+        data (sharing :meth:`_bucket_device_consts`' device A/cl/cu) plus
+        its GLOBAL-tree slices of probs/onehot/nid_sk — the cross-bucket
+        outer update couples through those, so bucket-local probability
+        normalization never enters the device reductions."""
+        import jax.numpy as jnp
+
+        from .parallel import sharded
+
+        b = self.batch
+        key = (_batch_token(b), getattr(b, "version", 0), str(dt))
+        cached = getattr(self, "_mega_arr_bucket_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        consts = self._bucket_device_consts(dt)
+        arrs = []
+        for (idx, sub), (A_d, cl_d, cu_d) in zip(b.buckets, consts):
+            n = sub.num_vars
+            S_b = idx.size
+            arrs.append(sharded.PHArrays(
+                c=jnp.asarray(sub.c, dt), q2=jnp.asarray(sub.q2, dt),
+                A=A_d, cl=cl_d, cu=cu_d,
+                lb=jnp.asarray(sub.lb, dt), ub=jnp.asarray(sub.ub, dt),
+                const=jnp.asarray(
+                    np.broadcast_to(sub.const, (S_b,)), dt),
+                probs=jnp.asarray(self.probs[idx], dt),
+                onehot=jnp.asarray(self._onehot[idx], dt),
+                nid_sk=jnp.asarray(self.nid_sk[idx], jnp.int32)))
+        arrs = tuple(arrs)
+        self._mega_arr_bucket_cache = (key, arrs)
+        return arrs
+
+    def _bucketed_megastep_fn(self, n_req: int):
+        cache = getattr(self, "_mega_fn_cache", None)
+        if cache is None:
+            cache = self._mega_fn_cache = {}
+        keyb = ("bucketed", n_req)
+        fn = cache.get(keyb)
+        if fn is None:
+            from .parallel import sharded
+
+            fn = sharded.make_bucketed_wheel_megastep(
+                self.tree.nonant_indices, self.admm_settings,
+                n_iters=n_req, donate=True)
+            cache[keyb] = fn
+        return fn
+
+    def _megastep_solve_bucketed(self, n_req: int, n_live: int,
+                                 convthresh: float, W, xbars, rho):
+        """Bucketed twin of :meth:`_megastep_solve`: ONE device dispatch
+        runs ``n_live`` wheel iterations over every bucket's compact
+        shapes, the packed per-bucket blocks scatter back through each
+        bucket's scenario indices into the global bookkeeping layout, and
+        each bucket's amortization slot advances exactly as its scattered
+        host solves would have (warm rebind before the fetch, age +=
+        executed, per-bucket billing)."""
+        import jax.numpy as jnp
+
+        from .parallel import sharded
+        from .solvers import segmented
+
+        st = self.admm_settings
+        dt = st.jdtype()
+        if self._device_state_on() and \
+                not getattr(self, "_bucketed_lean_warned", False):
+            # the lean (device-resident) pack is homogeneous-only today:
+            # a bucketed family silently running full-pack windows would
+            # look like the O(1)-host posture while paying O(S·n) per
+            # window — say so once instead
+            self._bucketed_lean_warned = True
+            global_toc(
+                "ph_device_state: bucketed families run FULL-pack "
+                "megasteps (the lean O(1)-host posture is homogeneous-"
+                "only; doc/scaling.md)", True)
+        arrs = self._mega_arrays_bucketed(dt)
+        b = self.batch
+        slots = self._bucket_slots
+        K = self.nonant_length
+        W = np.asarray(W)
+        xbars = np.asarray(xbars)
+        rho = np.asarray(rho)
+        states = []
+        for (idx, sub), slot in zip(b.buckets, slots):
+            warm = slot["warm"]
+            states.append(sharded.PHState(
+                W=jnp.asarray(W[idx], dt),
+                xbars=jnp.asarray(xbars[idx], dt),
+                rho=jnp.asarray(rho[idx], dt),
+                x=jnp.asarray(warm[0], dt), z=jnp.asarray(warm[1], dt),
+                y=jnp.asarray(warm[2], dt), yx=jnp.asarray(warm[3], dt)))
+        factors = tuple(slot["factors"] for slot in slots)
+        _, tol_qp = self._straggler_tols()
+        shapes = [(idx.size, sub.num_vars) for idx, sub in b.buckets]
+        with _trace.span(None, "solve.megastep") as _sp:
+            states, packed = self._bucketed_megastep_fn(n_req)(
+                tuple(states), arrs, 1.0, factors, convthresh, n_live,
+                tol_qp)
+            # rebind every bucket's warm slot BEFORE the blocking fetch
+            # (the donated buffers are gone — same contract as the
+            # homogeneous path)
+            for slot, stb in zip(slots, states):
+                slot["warm"] = (stb.x, stb.z, stb.y, stb.yx)
+            bmeas = sharded.bucketed_megastep_unpack(
+                hostsync.fetch(packed), n_req, shapes, K)
+            if _trace.enabled():
+                _sp.add(n_live=n_live, executed=bmeas["executed"],
+                        refresh_hit=bmeas["refresh_hit"], buckets=len(arrs))
+        executed = bmeas["executed"]
+        # scatter the per-bucket blocks into the global layout so the
+        # caller's install path (_apply_megastep_meas) is bucket-agnostic
+        S, n_max = b.num_scenarios, b.num_vars
+        meas = {k: bmeas[k] for k in (
+            "conv", "eobj", "pri_max", "dua_max", "iters", "all_done",
+            "executed", "refresh_hit")}
+        pri = np.zeros(S)
+        dua = np.zeros(S)
+        done = np.zeros(S, dtype=bool)
+        x = np.zeros((S, n_max))
+        Wg = np.zeros((S, K))
+        xbg = np.zeros((S, K))
+        for bi, (idx, sub) in enumerate(b.buckets):
+            pri[idx] = bmeas["pri"][bi]
+            dua[idx] = bmeas["dua"][bi]
+            done[idx] = bmeas["done"][bi]
+            x[idx, :sub.num_vars] = bmeas["x"][bi]
+            Wg[idx] = bmeas["W"][bi]
+            xbg[idx] = bmeas["xbars"][bi]
+        meas.update(pri=pri, dua=dua, done=done, x=x, W=Wg, xbars=xbg)
+        refresh_every = self._refresh_every()
+        guard = False
+        if executed:
+            ref = max((slot.get("ref_worst") or 0.0) for slot in slots) \
+                if any(slot.get("ref_worst") is not None
+                       for slot in slots) else None
+            worsts = np.maximum(meas["pri_max"][:executed],
+                                meas["dua_max"][:executed])
+            guard = any(
+                admm.precision_guard_trips(
+                    None, st, ref,
+                    stats=(float(worsts[i]), bool(meas["all_done"][i])))
+                for i in range(executed))
+            if guard:
+                _metrics.inc("precision.guard_trips")
+        sweeps = float(np.mean(meas["iters"][:executed])) if executed \
+            else 0.0
+        rej = (float(meas["iters"][executed])
+               if meas["refresh_hit"] and executed < n_req else None)
+        for bi, (slot, (idx, sub)) in enumerate(zip(slots, b.buckets)):
+            # per-bucket FLOP billing on each bucket's own shapes (the
+            # packed sweep counter is the cross-bucket max —
+            # conservative); the window is ONE dispatch, so only the
+            # first bucket counts toward the dispatch counters
+            segmented.bill_megastep(idx.size, sub.num_vars, sub.num_rows,
+                                    executed, sweeps, rejected_sweeps=rej,
+                                    count_dispatch=bi == 0)
+            slot["age"] = slot.get("age", 0) + executed
+            if meas["refresh_hit"] or guard:
+                slot["age"] = max(slot["age"], refresh_every)
+        if meas["refresh_hit"] or guard:
             _metrics.inc("megastep.refresh_hits")
         return meas
 
@@ -1047,8 +1263,14 @@ class SPOpt(SPBase):
                len(b.buckets))
         cached = getattr(self, "_bucket_dev_consts", None)
         if cached is None or cached[0] != key:
-            consts = [(jnp.asarray(sub.A, dt), jnp.asarray(sub.cl, dt),
-                       jnp.asarray(sub.cu, dt)) for _, sub in b.buckets]
+            # a (really) shared-A bucket uploads its single (m, n) matrix
+            # (the shared engine and the dual-bound programs both accept
+            # the 2-D form), never the (S_b, m, n) broadcast view
+            consts = [
+                (jnp.asarray(
+                    sub.A_shared if bucket_shared(sub) else sub.A, dt),
+                 jnp.asarray(sub.cl, dt),
+                 jnp.asarray(sub.cu, dt)) for _, sub in b.buckets]
             cached = (key, consts)
             self._bucket_dev_consts = cached
         return cached[1]

@@ -720,8 +720,19 @@ class MegastepTune:
 _mega_cache: dict = {}
 
 
-def _mega_key(S, n, m):
-    return (int(S), int(n), int(m))
+def _mega_key(shape, settings=None):
+    """Megastep verdict key: the :func:`tpusppy.solvers.aot.
+    shape_family_parts` family identity per shape — ``shape`` is one
+    (S, n, m) triple or, for a bucketed family, a tuple of per-bucket
+    triples.  S (per bucket) and the settings ride the key, so the
+    ladder's shared ``TPUSPPY_TUNE_CACHE`` can never serve an S=1000
+    verdict to an S=10000 run (the family_parts drift guard in
+    tests/test_tune.py pins the structure against aot's)."""
+    if shape and isinstance(shape[0], (tuple, list, np.ndarray)):
+        return tuple(_aot.shape_family_parts(s, n, m, settings)
+                     for s, n, m in shape)
+    S, n, m = shape
+    return _aot.shape_family_parts(S, n, m, settings)
 
 
 def _mega_disk_lookup(key):
@@ -739,16 +750,20 @@ def _mega_disk_lookup(key):
     return res
 
 
-def megastep_verdict(S, n, m) -> int | None:
+def megastep_verdict(S, n=None, m=None, settings=None) -> int | None:
     """Banked autotuned megastep width for a shape (None = no verdict —
-    the hub then falls back to the refresh-window default)."""
-    key = _mega_key(S, n, m)
+    the hub then falls back to the refresh-window default).  ``S`` may be
+    the full shape key — one (S, n, m) triple or a tuple of per-bucket
+    triples — with ``n``/``m`` omitted."""
+    shape = (S, n, m) if n is not None else S
+    key = _mega_key(shape, settings)
     hit = _mega_cache.get(key) or _mega_disk_lookup(key)
     return hit.n if hit is not None else None
 
 
 def autotune_megastep(run_window, shape, n_cap, target_pct: float = 1.0,
-                      n_probe: int | None = None, cache: bool = True):
+                      n_probe: int | None = None, cache: bool = True,
+                      settings=None):
     """Measure the per-window dispatch+fetch overhead of the wheel
     megakernel and pick the smallest N that amortizes it below
     ``target_pct`` percent of the window wall (the farmer-m1
@@ -767,8 +782,7 @@ def autotune_megastep(run_window, shape, n_cap, target_pct: float = 1.0,
     "megastep" persist kind, so repeated runs (and resumed wheels) skip
     the probes.
     """
-    S, n, m = (int(v) for v in shape)
-    key = _mega_key(S, n, m)
+    key = _mega_key(shape, settings)
     if cache:
         hit = _mega_cache.get(key) or _mega_disk_lookup(key)
         if hit is not None:
@@ -792,7 +806,7 @@ def autotune_megastep(run_window, shape, n_cap, target_pct: float = 1.0,
         # shape via the persistent store — return the conservative
         # "don't megastep" answer WITHOUT banking, so the next run
         # re-probes under normal conditions
-        _probe_event("megastep", {"S": S, "n": n, "m": m,
+        _probe_event("megastep", {"shape": repr(shape),
                                   "skipped": "degenerate probe",
                                   "executed": ex})
         return MegastepTune(n=1, per_iter_secs=max(tN, 1e-9),
@@ -807,7 +821,7 @@ def autotune_megastep(run_window, shape, n_cap, target_pct: float = 1.0,
     pct = 100.0 * overhead / (overhead + n_pick * per_iter)
     res = MegastepTune(n=n_pick, per_iter_secs=per_iter,
                        overhead_secs=overhead, overhead_pct_at_n=pct)
-    _probe_event("megastep", {"S": S, "n": n, "m": m, "pick": n_pick,
+    _probe_event("megastep", {"shape": repr(shape), "pick": n_pick,
                               "per_iter_secs": per_iter,
                               "overhead_secs": overhead,
                               "overhead_pct_at_n": pct})

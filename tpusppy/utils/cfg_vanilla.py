@@ -107,6 +107,9 @@ def shared_options(cfg) -> dict:
         "tee-rank0-solves": cfg.get("tee_rank0_solves", False),
         "trace_prefix": cfg.get("trace_prefix"),
     }
+    if _hasit(cfg, "ph_device_state"):
+        # the O(1)-host wheel posture (doc/scaling.md)
+        shoptions["ph_device_state"] = bool(cfg.ph_device_state)
     return shoptions
 
 

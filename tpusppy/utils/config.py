@@ -526,6 +526,13 @@ class Config:
             "the refresh-cadence window, both under the watchdog cap.  "
             "1 = force the legacy per-iteration dispatch; k > 1 = "
             "request N=k", int, 0)
+        add("ph_device_state",
+            "device-resident PH state (doc/scaling.md): megastep windows "
+            "fetch the LEAN packed measurement only, and the (S, K)/"
+            "(S, n) host mirrors refresh by ONE billed fetch at "
+            "checkpoint/termination/refresh boundaries — the O(1)-host "
+            "posture for S=10^4+ wheels.  Also TPUSPPY_DEVICE_STATE=1",
+            bool, False)
 
 
 def global_config() -> Config:
