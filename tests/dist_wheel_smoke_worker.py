@@ -34,6 +34,38 @@ def main():
                            "eps_rel": 1e-6, "max_iter": 60,
                            "restarts": 1, "scaling_iters": 2,
                            "polish": False}}
+    # SINGLE-LEG mode (elastic re-shard parity, test_distributed_wheel):
+    # one distributed_wheel_hub call whose whole config rides the env —
+    # the parent drives a 3-process checkpoint leg and then a SEPARATE
+    # 2-process resume leg, so the restore really crosses mesh shapes
+    if os.environ.get("DIST_SINGLE_LEG"):
+        opts = dict(base_options,
+                    PHIterLimit=int(os.environ.get("DIST_ITERS", "3")),
+                    record_trajectory=True)
+        opts["solver_options"].update(
+            eps_abs=1e-12, eps_rel=1e-12, max_iter=8000, restarts=3)
+        if os.environ.get("DIST_CKPT_DIR"):
+            opts.update(checkpoint_dir=os.environ["DIST_CKPT_DIR"],
+                        checkpoint_every_iters=1,
+                        checkpoint_every_secs=None,
+                        checkpoint_sharded=True)
+        if os.environ.get("DIST_RESUME") == "1":
+            opts.update(resume=os.environ["DIST_CKPT_DIR"],
+                        elastic_epoch=1)
+        res = distributed_wheel_hub(
+            names, farmer.scenario_creator,
+            scenario_creator_kwargs={"num_scens": n},
+            options=opts, fabric=None, spoke_roles=[])
+        from tpusppy.obs import metrics as _metrics
+
+        print(json.dumps({
+            "pid": pid, "iters": res.iters, "conv": res.conv,
+            "eobj": res.eobj, "outer": res.BestOuterBound,
+            "trajectory": [list(t) for t in res.trajectory],
+            "elastic_restores": _metrics.value(
+                "checkpoint.elastic_restores"),
+        }), flush=True)
+        return
     # resilience smoke (DIST_CKPT_DIR): run 1 checkpoints (controller 0
     # writes), run 2 RESUMES from the snapshot with a larger budget — the
     # sharded-W restore (make_array_from_callback over the 2-process

@@ -170,16 +170,17 @@ def test_two_process_hub_smoke():
     _run_smoke_workers({}, timeout=120)
 
 
-@pytest.mark.slow
 def test_two_process_hub_checkpoint_resume(tmp_path):
     """Resilience on the real 2-process mesh (tpusppy.resilience,
     doc/resilience.md): run 1 checkpoints (controller 0 writes the
     snapshots), then — same jax.distributed job, after a barrier — run 2
     RESUMES with a larger budget, exercising the sharded-W restore
     (make_array_from_callback) and the iteration-base continuation.
-    Slow tier: the two-leg worker doubles the collective lifetime, and
-    under full-suite CPU contention the coordination-service heartbeat
-    window is too easy to starve for a routine tier-1 spot."""
+    Back in tier-1: the PR-5 slow-marking was a full-suite-contention
+    coordination-service heartbeat false positive — initialize_backend
+    now widens the heartbeat window (TPUSPPY_DIST_HB_* envs) and the
+    supervisor's staleness grace is load-adaptive, verified over 20
+    consecutive local repetitions."""
     ckdir = str(tmp_path / "dist_ck")
     r0, r1 = _run_smoke_workers({"DIST_CKPT_DIR": ckdir}, timeout=300)
     # the resumed run continued the TOTAL iteration count (3 banked + 2
@@ -223,6 +224,93 @@ def test_two_process_hub_sharded_checkpoint_resume(tmp_path):
     ck = _ckpt.load_latest(ckdir)
     assert ck is not None and ck.iteration >= 3
     assert ck.W is not None and ck.W.shape[0] == 8
+
+
+# ---------------------------------------------------------------------------
+# elastic re-shard parity on REAL meshes: checkpoint on 3 controllers,
+# restore onto 2 (doc/resilience.md "Elastic recovery")
+# ---------------------------------------------------------------------------
+
+def _run_single_leg(nproc, extra_env, timeout, devices_per_proc=1):
+    port = _free_port()
+    script = os.path.join(REPO, "tests", "dist_wheel_smoke_worker.py")
+    common = {
+        "DIST_COORD": f"127.0.0.1:{port}",
+        "DIST_NPROC": nproc,
+        "DIST_SCENS": 7,
+        "DIST_SINGLE_LEG": 1,
+        "XLA_FLAGS":
+            f"--xla_force_host_platform_device_count={devices_per_proc}",
+        **extra_env,
+    }
+    procs = [
+        subprocess.Popen([sys.executable, script],
+                         env=_env(common | {"DIST_PID": pid}),
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True)
+        for pid in range(nproc)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            assert p.returncode == 0, \
+                f"worker rc={p.returncode}\n{err[-3000:]}"
+            outs.append(json.loads(
+                [ln for ln in out.splitlines() if ln.startswith("{")][-1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
+@pytest.mark.slow
+def test_elastic_reshard_parity_3_to_2_controllers(tmp_path):
+    """The satellite contract end to end on REAL meshes: an S=7 wheel
+    checkpointed (shard-per-process) on a 3-controller Gloo mesh is
+    restored onto a SURVIVING 2-controller mesh — different process
+    count, different device count, different ghost padding — and its
+    post-resume trajectory must match an uninterrupted single-process
+    golden at 1e-9, bit-identically across the two survivors."""
+    from tpusppy.models import farmer
+    from tpusppy.parallel.dist_wheel import distributed_wheel_hub
+    from tpusppy.resilience import checkpoint as _ckpt
+
+    ckdir = str(tmp_path / "elastic_ck")
+    # leg 1: 3 controllers bank sharded snapshots for iterations 1..3
+    outs3 = _run_single_leg(3, {"DIST_CKPT_DIR": ckdir, "DIST_ITERS": 3},
+                            timeout=300)
+    assert all(o["iters"] == 3 for o in outs3)
+    p = _ckpt.latest(ckdir)
+    assert p is not None and ".s000of003.npz" in p
+    # leg 2: the two SURVIVORS resume onto their smaller mesh (rows
+    # re-cut by the row-range reader: the old 3-shard layout never
+    # matches the new per-process rows)
+    outs2 = _run_single_leg(2, {"DIST_CKPT_DIR": ckdir, "DIST_ITERS": 5,
+                                "DIST_RESUME": "1"}, timeout=300)
+    r0, r1 = outs2
+    assert r0["iters"] == r1["iters"] == 5
+    assert r0["trajectory"] == r1["trajectory"]   # determinism contract
+    assert r0["elastic_restores"] == 1 and r1["elastic_restores"] == 1
+    assert [t[0] for t in r0["trajectory"]] == [4, 5]
+
+    # golden: uninterrupted single-process wheel, same math
+    golden = distributed_wheel_hub(
+        farmer.scenario_names_creator(7), farmer.scenario_creator,
+        scenario_creator_kwargs={"num_scens": 7},
+        options={"defaultPHrho": 1.0, "PHIterLimit": 5,
+                 "record_trajectory": True, "linger_secs": 0.0,
+                 "solver_options": {"dtype": "float64", "eps_abs": 1e-12,
+                                    "eps_rel": 1e-12, "max_iter": 8000,
+                                    "restarts": 3, "scaling_iters": 2,
+                                    "polish": False}},
+        fabric=None, spoke_roles=[])
+    tail = {t[0]: t for t in golden.trajectory[3:]}
+    for it, conv, eobj in r0["trajectory"]:
+        _g_it, g_conv, g_eobj = tail[it]
+        assert conv == pytest.approx(g_conv, rel=1e-9, abs=5e-9)
+        assert eobj == pytest.approx(g_eobj, rel=1e-9)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,441 @@
+"""Elastic mesh recovery (tpusppy.parallel.elastic, doc/resilience.md
+"Elastic recovery"): the collective watchdog, the TCP liveness
+side-channel, survivor agreement + the majority-loss typed failure,
+controller-grade fault injection, and elastic re-shard restore parity.
+
+The real-SIGKILL end-to-end (3 controllers, one killed mid-wheel,
+survivors re-exec onto a 2-controller mesh and certify) is
+scripts/chaos_smoke.py (nightly); these tests prove each layer
+deterministically and keep the re-shard restore parity in tier-1 via a
+single-process wheel resumed from a checkpoint re-sharded into a
+FOREIGN (3-controller) layout.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpusppy.parallel import elastic
+from tpusppy.resilience import faults
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# Watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_passthrough_and_result():
+    wd = elastic.Watchdog(timeout=5.0, first_grace=1.0)
+    try:
+        assert wd.call(lambda: 41 + 1, "ok") == 42
+    finally:
+        wd.close()
+
+
+def test_watchdog_disabled_runs_inline():
+    wd = elastic.Watchdog(timeout=0.0)
+    tid = {"v": None}
+
+    def fn():
+        tid["v"] = threading.get_ident()
+        return "x"
+
+    assert wd.call(fn, "inline") == "x"
+    # no worker-thread hop when disarmed: deterministic legacy path
+    assert tid["v"] == threading.get_ident()
+    assert not wd.armed
+
+
+def test_watchdog_timeout_raises_controller_lost():
+    from tpusppy.obs import metrics
+
+    wd = elastic.Watchdog(timeout=0.3, first_grace=1.0)
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(elastic.ControllerLost) as ei:
+            wd.call(lambda: time.sleep(10), "hang")
+    finally:
+        wd.close()
+    assert time.monotonic() - t0 < 5.0          # detected, not waited out
+    assert ei.value.what == "hang" and ei.value.elapsed >= 0.3
+    assert metrics.value("mesh.collective_timeouts") >= 1
+    assert metrics.value("mesh.controller_lost") >= 1
+
+
+def test_watchdog_first_call_grace():
+    """Iter0 folds in compiles + rendezvous: the FIRST call gets
+    first_grace x the timeout; steady state falls back to the
+    (load-adaptive) deadline."""
+    wd = elastic.Watchdog(timeout=0.2, first_grace=5.0)
+    try:
+        assert wd.call(lambda: time.sleep(0.4) or "slow0", "iter0") == "slow0"
+        # the grace call's latency is NOT learned (compile+rendezvous is
+        # no cadence sample): steady state reverts to the operator knob
+        assert wd.deadline() == 0.2
+        with pytest.raises(elastic.ControllerLost):
+            wd.call(lambda: time.sleep(30), "iter1")
+    finally:
+        wd.close()
+
+
+def test_watchdog_load_adaptive_deadline():
+    """The supervisor-grace policy applied to collectives: healthy calls
+    slower than the configured timeout WIDEN the deadline (no spurious
+    loss on a legitimately slow wheel), and fast cadences keep the
+    operator's timeout."""
+    wd = elastic.Watchdog(timeout=0.5, first_grace=4.0,
+                          adaptive_grace=8.0)
+    try:
+        wd.call(lambda: None, "iter0")       # grace call: never learned
+        wd.call(lambda: time.sleep(0.3), "slow_but_healthy_0")
+        assert wd.deadline() >= 8.0 * 0.3 - 1e-3
+        # a call at the run's own demonstrated cadence is NOT a loss,
+        # even as the cadence drifts past what the knob alone would allow
+        assert wd.call(lambda: time.sleep(0.6) or "ok", "slow1") == "ok"
+        # fast steady state decays the deadline back toward the knob
+        for _ in range(25):
+            wd.call(lambda: None, "fast")
+        assert wd.deadline() == 0.5
+    finally:
+        wd.close()
+
+
+def test_watchdog_converts_dead_peer_errors():
+    def boom():
+        raise RuntimeError("Gloo connectFullMesh: Connection refused")
+
+    wd = elastic.Watchdog(timeout=5.0, first_grace=1.0)
+    try:
+        wd.call(lambda: 1, "warm")
+        with pytest.raises(elastic.ControllerLost):
+            wd.call(boom, "gloo")
+    finally:
+        wd.close()
+
+
+def test_watchdog_foreign_errors_propagate_untyped():
+    wd = elastic.Watchdog(timeout=5.0, first_grace=1.0)
+    try:
+        with pytest.raises(ValueError):
+            wd.call(lambda: (_ for _ in ()).throw(ValueError("math bug")),
+                    "step")
+    finally:
+        wd.close()
+
+
+# ---------------------------------------------------------------------------
+# Controller-grade fault injection
+# ---------------------------------------------------------------------------
+
+def test_kill_controller_fires_at_exact_iteration(monkeypatch):
+    killed = []
+    monkeypatch.setattr(faults, "_SELF_KILL", lambda: killed.append(1))
+    with faults.inject(faults.FaultPlan(kill_controller={0: 3})) as stats:
+        for it in range(1, 6):
+            if not killed:
+                faults.on_controller_iter(0, it)
+        assert stats["controller_kills"] == 1
+    assert killed == [1]
+
+
+def test_kill_controller_other_rank_untouched(monkeypatch):
+    monkeypatch.setattr(faults, "_SELF_KILL",
+                        lambda: pytest.fail("wrong rank killed"))
+    with faults.inject(faults.FaultPlan(kill_controller={1: 2})):
+        for it in range(1, 6):
+            faults.on_controller_iter(0, it)
+
+
+def test_kill_controller_disarmed_is_noop():
+    faults.on_controller_iter(0, 10**6)      # no plan armed: must no-op
+
+
+def test_partition_tcp_is_permanent():
+    n = 0
+    with faults.inject(faults.FaultPlan(partition_tcp={"boxA": True})) \
+            as stats:
+        for _ in range(5):
+            with pytest.raises(faults.InjectedFault):
+                faults.on_tcp_io("boxA")
+            n += 1
+        faults.on_tcp_io("boxB")             # other channels unaffected
+        assert stats["partitioned_ops"] == n == 5
+
+
+def test_collective_delay_under_timeout_absorbed_over_timeout_trips():
+    wd = elastic.Watchdog(timeout=0.6, first_grace=1.0)
+    try:
+        with faults.inject(faults.FaultPlan(delay_collectives=0.1)):
+            assert wd.call(lambda: "ok", "fast") == "ok"
+        wd2 = elastic.Watchdog(timeout=0.2, first_grace=1.0)
+        try:
+            with faults.inject(faults.FaultPlan(delay_collectives=0.05)):
+                # the delay itself runs BEFORE the guarded call (hook on
+                # the caller side); the slow COLLECTIVE is what trips
+                with pytest.raises(elastic.ControllerLost):
+                    wd2.call(lambda: time.sleep(1.0), "slow")
+        finally:
+            wd2.close()
+    finally:
+        wd.close()
+
+
+# ---------------------------------------------------------------------------
+# Liveness + survivor agreement
+# ---------------------------------------------------------------------------
+
+def _mesh(n, stale=0.9, interval=0.1):
+    base = elastic.free_port_block(n)
+    return [elastic.MeshLiveness(rank=r, members=list(range(n)),
+                                 n_original=n, port_base=base, secret=77,
+                                 stale_after=stale, interval=interval
+                                 ).start()
+            for r in range(n)]
+
+
+def test_liveness_full_mesh_and_death_detection():
+    lvs = _mesh(3)
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if all(lv.alive_ranks() == [0, 1, 2] for lv in lvs):
+                break
+            time.sleep(0.05)
+        assert all(lv.alive_ranks() == [0, 1, 2] for lv in lvs)
+        lvs[2].close()                       # rank 2 dies
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if all(lv.alive_ranks() == [0, 1] for lv in lvs[:2]):
+                break
+            time.sleep(0.05)
+        assert lvs[0].alive_ranks() == [0, 1]
+        assert lvs[1].alive_ranks() == [0, 1]
+    finally:
+        for lv in lvs:
+            lv.close()
+
+
+def test_survivor_agreement_converges_and_matches():
+    lvs = _mesh(3)
+    try:
+        time.sleep(0.4)                      # everyone says hello
+        lvs[1].close()                       # rank 1 dies
+        time.sleep(1.2)                      # staleness crosses the window
+        res = {}
+
+        def agree(i):
+            try:
+                res[i] = elastic.agree_survivors(lvs[i], deadline_secs=15)
+            except Exception as e:           # surfaced by the assert below
+                res[i] = repr(e)
+
+        ts = [threading.Thread(target=agree, args=(i,)) for i in (0, 2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=20)
+        assert res.get(0) == res.get(2) == [0, 2], res
+    finally:
+        for lv in lvs:
+            lv.close()
+
+
+def test_majority_loss_is_typed_not_a_hang():
+    """The forced NON-recoverable case: 1 survivor of 3 original
+    controllers is below quorum — a typed MeshMajorityLost, quickly."""
+    base = _free_port()
+    lv = elastic.MeshLiveness(rank=0, members=[0, 1, 2], n_original=3,
+                              port_base=base, secret=5, stale_after=0.3,
+                              interval=0.05).start()
+    try:
+        time.sleep(0.5)                      # peers never said hello
+        t0 = time.monotonic()
+        with pytest.raises(elastic.MeshMajorityLost) as ei:
+            elastic.agree_survivors(lv, deadline_secs=30)
+        assert time.monotonic() - t0 < 5.0
+        assert ei.value.survivors == [0] and ei.value.n_original == 3
+        assert isinstance(ei.value, elastic.ControllerLost)
+    finally:
+        lv.close()
+
+
+def test_partitioned_peer_reads_as_dead():
+    """A TCP fabric partition (fault-injected, no process dies): rank 1's
+    beats to rank 0 fail permanently, so rank 0's view loses rank 1
+    within the stale window — the wedged-but-alive presentation."""
+    lvs = _mesh(2, stale=0.8, interval=0.1)
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if all(lv.alive_ranks() == [0, 1] for lv in lvs):
+                break
+            time.sleep(0.05)
+        assert lvs[0].alive_ranks() == [0, 1]
+        # injection is process-local: this arms BOTH instances' beats,
+        # but only the r0-bound channel is named
+        with faults.inject(faults.FaultPlan(
+                partition_tcp={"liveness->r0": True})):
+            deadline = time.monotonic() + 6.0
+            while time.monotonic() < deadline:
+                if lvs[0].alive_ranks() == [0]:
+                    break
+                time.sleep(0.05)
+            assert lvs[0].alive_ranks() == [0]
+            # the reverse channel was not partitioned: rank 1 still sees 0
+            assert 0 in lvs[1].alive_ranks()
+    finally:
+        for lv in lvs:
+            lv.close()
+
+
+# ---------------------------------------------------------------------------
+# ElasticSpec env contract
+# ---------------------------------------------------------------------------
+
+def test_elastic_spec_env_roundtrip(monkeypatch):
+    spec = elastic.ElasticSpec(rank=2, n_original=3, checkpoint_dir="/ck",
+                               coord_port_base=9000,
+                               liveness_port_base=9100)
+    assert spec.members == [0, 1, 2] and spec.process_id == 2
+    assert spec.coordinator == "127.0.0.1:9000"
+    monkeypatch.setenv(elastic.ENV_EPOCH, "1")
+    monkeypatch.setenv(elastic.ENV_SURVIVORS, "0,2")
+    s1 = spec.with_env()
+    assert s1.epoch == 1 and s1.members == [0, 2]
+    assert s1.process_id == 1                # rank 2 is pid 1 of epoch 1
+    assert s1.coordinator == "127.0.0.1:9001"  # fresh port per epoch
+
+
+def test_bits_words_exact_for_high_ranks():
+    """The agreement bitmask rides two <2^27 f64 words: ranks past 53
+    (where a single float64 word would round) stay exact, and meshes
+    beyond the representable range are refused at construction."""
+    bits = elastic._bits([0, 26, 27, 53])
+    lo, hi = elastic._bits_words(bits)
+    assert int(lo) | (int(hi) << elastic._BITS_WORD) == bits
+    assert float(lo) == lo and float(hi) == hi      # exact transport
+    with pytest.raises(ValueError, match="up to 54"):
+        elastic.MeshLiveness(rank=0, members=range(60), n_original=60,
+                             port_base=1, secret=0)
+
+
+def test_counter_reseed_from_env(monkeypatch):
+    from tpusppy.obs import metrics
+
+    monkeypatch.setenv(elastic.ENV_LOST_TOTAL, "2")
+    monkeypatch.setenv(elastic.ENV_REMESH_TOTAL, "1")
+    elastic._reseed_counters_from_env()
+    assert metrics.value("mesh.controller_lost") == 2
+    assert metrics.value("mesh.remesh") == 1
+
+
+# ---------------------------------------------------------------------------
+# Elastic re-shard restore parity (tier-1, single process)
+# ---------------------------------------------------------------------------
+
+def _wheel(names, n, options):
+    from tpusppy.models import farmer
+    from tpusppy.parallel.dist_wheel import distributed_wheel_hub
+
+    return distributed_wheel_hub(
+        names, farmer.scenario_creator,
+        scenario_creator_kwargs={"num_scens": n},
+        options=options, fabric=None, spoke_roles=[])
+
+
+def test_elastic_reshard_restore_parity(tmp_path):
+    """The S=7 elastic re-shard contract, single-process edition: a
+    wheel checkpointed at iteration 3 has its snapshot re-cut into a
+    FOREIGN 3-shard (3-controller) layout; a fresh wheel on this
+    process's own (8-virtual-device) mesh restores it through the
+    row-range ShardedCheckpointReader path and must continue iterations
+    4..5 matching an uninterrupted golden run at 1e-9, with bounds
+    carried and checkpoint.elastic_restores ticking.  (The real 3-proc →
+    2-proc mesh version is the slow leg in test_distributed_wheel /
+    scripts/chaos_smoke.py.)"""
+    import dataclasses
+
+    from tpusppy.models import farmer
+    from tpusppy.obs import metrics
+    from tpusppy.resilience import checkpoint as ck
+
+    n = 7
+    names = farmer.scenario_names_creator(n)
+    # TIGHT subproblem eps: the snapshot restores W + xbars exactly, but
+    # x/z/y warm starts legitimately differ across the restart (they are
+    # not consensus state) — the subproblems being strongly convex, the
+    # CONVERGED iterates are unique, so trajectory parity holds to the
+    # solve tolerance, which must therefore sit well under the 1e-9 pin
+    so = {"dtype": "float64", "eps_abs": 1e-11, "eps_rel": 1e-11,
+          "max_iter": 4000, "restarts": 3, "scaling_iters": 2,
+          "polish": False}
+    base = {"defaultPHrho": 1.0, "solver_options": so,
+            "record_trajectory": True, "linger_secs": 0.0}
+
+    golden = _wheel(names, n, dict(base, PHIterLimit=5))
+    assert [t[0] for t in golden.trajectory] == [1, 2, 3, 4, 5]
+
+    ckdir = str(tmp_path / "ck")
+    first = _wheel(names, n, dict(base, PHIterLimit=3,
+                                  checkpoint_dir=ckdir,
+                                  checkpoint_every_iters=1,
+                                  checkpoint_every_secs=None))
+    # re-cut the banked snapshot into the 3-controller shard layout a
+    # 3-process mesh would have written (uneven rows: 3/2/2)
+    full = ck.load_latest(ckdir)
+    assert full is not None and full.iteration == 3
+    assert full.xbars is not None        # snapshots carry the prox center
+    rows = [(0, 3), (3, 5), (5, 7)]
+    for _it, p in ck.list_checkpoints(ckdir):
+        ck.remove_checkpoint_files(p)
+    for k, (lo, hi) in enumerate(rows):
+        shard = dataclasses.replace(full, W=full.W[lo:hi].copy(),
+                                    xbars=full.xbars[lo:hi].copy(),
+                                    xsqbars=None, rho=None)
+        ck.save_shard(shard, ckdir, k, len(rows), (lo, hi), n)
+    assert ".s000of003" in ck.latest(ckdir)
+
+    before = metrics.value("checkpoint.elastic_restores")
+    resumed = _wheel(names, n, dict(base, PHIterLimit=5, resume=ckdir,
+                                    elastic_epoch=1))
+    assert metrics.value("checkpoint.elastic_restores") == before + 1
+    # total-iteration semantics: only 4..5 ran
+    assert [t[0] for t in resumed.trajectory] == [4, 5]
+    tail = {t[0]: t for t in golden.trajectory[3:]}
+    for it, conv, eobj in resumed.trajectory:
+        g_it, g_conv, g_eobj = tail[it]
+        assert conv == pytest.approx(g_conv, rel=1e-9, abs=5e-9)
+        assert eobj == pytest.approx(g_eobj, rel=1e-9)
+    # bounds monotone across the elastic restart (same trivial bound)
+    assert resumed.BestOuterBound == pytest.approx(
+        golden.BestOuterBound, rel=1e-9)
+    assert first.iters == 3 and resumed.iters == 5
+
+
+def test_nonrecoverable_shard_row_loss_fails_loud(tmp_path):
+    """Loss of ALL copies of a shard row (the filesystem ate the dead
+    controller's shard files): the set is INCOMPLETE, so the resume
+    falls back to the previous complete set — and when there is none,
+    cold-starts (dist resume treats missing as cold) rather than
+    restoring a hole-ridden state."""
+    from tpusppy.resilience import checkpoint as ck
+
+    W = np.arange(14.0).reshape(7, 2)
+    for k, (lo, hi) in enumerate([(0, 3), (3, 5), (5, 7)]):
+        c = ck.WheelCheckpoint(iteration=4, W=W[lo:hi].copy())
+        ck.save_shard(c, str(tmp_path), k, 3, (lo, hi), 7)
+    os.remove(ck.latest(str(tmp_path)).replace(".s000of", ".s001of"))
+    assert ck.latest(str(tmp_path)) is None          # incomplete: no set
+    assert ck.load_latest(str(tmp_path)) is None
+    with pytest.raises(RuntimeError):
+        ck.ShardedCheckpointReader(
+            os.path.join(str(tmp_path), "ckpt_wheel_00000004.s000of003.npz"))

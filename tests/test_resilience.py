@@ -335,8 +335,11 @@ def test_supervisor_marks_dead_and_wedged_spokes():
         def is_alive():
             return True
 
+    # grace_factor=0 pins the FIXED-window semantics this test is about
+    # (the adaptive grace has its own test below)
     sup = supervisor.SpokeSupervisor(
-        fabric, {1: "DeadSpoke", 2: "WedgedSpoke"}, timeout_secs=1e-6)
+        fabric, {1: "DeadSpoke", 2: "WedgedSpoke"}, timeout_secs=1e-6,
+        grace_factor=0.0)
     sup.note_thread(1, DeadThread())
     sup.note_thread(2, LiveThread())
     fabric.to_hub[2].put(np.array([1.0]))   # spoke 2 made progress once
@@ -349,13 +352,47 @@ def test_supervisor_marks_dead_and_wedged_spokes():
     # a heartbeat counts as progress: the same stale-mailbox posture
     # stays alive when the cylinder is provably polling
     sup2 = supervisor.SpokeSupervisor(fabric, {2: "Spoke"},
-                                      timeout_secs=1e-6)
+                                      timeout_secs=1e-6, grace_factor=0.0)
     sup2.note_thread(2, LiveThread())
     supervisor.heartbeat("spoke2")          # after construction: fresh
     sup2.observe()
     assert not sup2.is_lost(2)
     sup2.observe()                          # heartbeat now stale: wedged
     assert sup2.is_lost(2)
+
+
+def test_supervisor_load_adaptive_grace():
+    """The PR-5 heartbeat-flake fix: a starved hub sync loop (observe
+    gaps far above the operator timeout) widens the effective staleness
+    window by grace_factor x the observed loop latency, so a spoke that
+    made no progress during a contention stall is NOT declared wedged —
+    while a genuinely wedged spoke under a healthy loop still is."""
+    import time
+
+    fabric = WindowFabric()
+    fabric.add_spoke(1, 2, 1)
+
+    class LiveThread:
+        @staticmethod
+        def is_alive():
+            return True
+
+    sup = supervisor.SpokeSupervisor(fabric, {1: "Spoke"},
+                                     timeout_secs=0.05, grace_factor=8.0)
+    sup.note_thread(1, LiveThread())
+    fabric.to_hub[1].put(np.array([1.0]))
+    sup.observe()                            # progress pass
+    time.sleep(0.2)                          # loop starved >> timeout
+    sup.observe()                            # grace = 8 x 0.2 covers it
+    assert not sup.is_lost(1)
+    assert sup.effective_timeout() >= 8.0 * 0.2 - 1e-3
+    # healthy fast loop: staleness past the plain timeout IS wedged
+    for _ in range(60):
+        time.sleep(0.005)
+        sup.observe()                        # EWMA decays toward ~5ms
+        if sup.is_lost(1):
+            break
+    assert sup.is_lost(1) and sup.lost()[1][1] == "wedged"
 
 
 def test_supervisor_crash_report():
@@ -414,6 +451,142 @@ def test_tcp_injected_transient_drops_recover():
     finally:
         cli.close()
         fab.close()
+
+
+def _stalled_window_server(secret):
+    """A deliberately WEDGED window service: speaks the handshake, then
+    never replies to any op — the dead-connection retry path cannot see
+    it (the socket stays open), only the op deadline can."""
+    import socket
+    import struct
+    import threading
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+
+    def accept_loop():
+        while True:
+            try:
+                c, _ = srv.accept()
+            except OSError:
+                return
+
+            def handshake_then_stall(c):
+                hello = c.recv(16, socket.MSG_WAITALL)
+                if len(hello) == 16:
+                    magic, s = struct.unpack("<QQ", hello)
+                    if magic == 0x7470757370707931 and s == secret:
+                        c.sendall(struct.pack("<q", 0))
+                import time
+                time.sleep(120)             # wedged: never serve an op
+
+            threading.Thread(target=handshake_then_stall, args=(c,),
+                             daemon=True).start()
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+    return srv, srv.getsockname()[1]
+
+
+def test_tcp_op_timeout_bounds_wedged_server(monkeypatch):
+    """TPUSPPY_TCP_OP_TIMEOUT: an op against a connected-but-wedged
+    server raises within the (retry-bounded) deadline instead of
+    hanging the ack read forever, loudly on tcp_window.op_timeouts."""
+    import time
+
+    from tpusppy.runtime import tcp_window_service as tws
+
+    srv, port = _stalled_window_server(secret=42)
+    monkeypatch.setattr(tws, "_RETRIES", 1)   # bound the probe
+    try:
+        ep = tws.TcpEndpoint(connect=("127.0.0.1", port), secret=42,
+                             op_timeout=0.3)
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="timed out"):
+            tws.TcpMailbox(ep, 0, "stalled")  # length query -> ack stall
+        elapsed = time.monotonic() - t0
+        assert elapsed < 10.0                # bounded, not forever
+        assert metrics.value("tcp_window.op_timeouts") >= 1
+        ep.close()
+    finally:
+        srv.close()
+
+
+def test_tcp_op_timeout_off_by_default():
+    from tpusppy.runtime.tcp_window_service import (TcpWindowFabric,
+                                                    default_op_timeout)
+
+    assert default_op_timeout() == 0.0       # legacy blocking semantics
+    fab = TcpWindowFabric(spoke_lengths=[(2, 2)])
+    cli = TcpWindowFabric(connect=("127.0.0.1", fab.port),
+                          secret=fab.secret, op_timeout=5.0)
+    try:
+        # a HEALTHY server under an armed deadline is unaffected
+        assert cli.to_hub[1].put(np.ones(2)) == 1
+        assert metrics.value("tcp_window.op_timeouts") == 0
+    finally:
+        cli.close()
+        fab.close()
+
+
+# ---------------------------------------------------------------------------
+# Corrupt-checkpoint fallback (doc/resilience.md)
+# ---------------------------------------------------------------------------
+def test_corrupt_shard_falls_back_to_previous_complete_set(tmp_path):
+    """A truncated shard in the LATEST complete set must not raise out
+    of the resume walk: the set is skipped (checkpoint.corrupt_skipped)
+    and the previous complete set serves."""
+    import dataclasses
+
+    W = np.arange(10.0).reshape(5, 2)
+
+    def save_set(it):
+        ck = checkpoint.WheelCheckpoint(iteration=it, W=W)
+        for k, (lo, hi) in enumerate([(0, 3), (3, 5)]):
+            shard = dataclasses.replace(ck, W=W[lo:hi].copy())
+            checkpoint.save_shard(shard, str(tmp_path), k, 2, (lo, hi), 5)
+
+    save_set(3)
+    save_set(7)
+    newest = checkpoint.latest(str(tmp_path))
+    assert "00000007" in newest
+    bad = newest.replace(".s000of", ".s001of")
+    with open(bad, "r+b") as f:              # truncate a shard MID-FILE
+        f.truncate(os.path.getsize(bad) // 2)
+    got = checkpoint.load_latest(str(tmp_path))
+    assert got is not None and got.iteration == 3
+    assert np.array_equal(got.W, W)
+    assert metrics.value("checkpoint.corrupt_skipped") >= 1
+
+
+def test_corrupt_single_file_checkpoint_skipped(tmp_path):
+    ck = checkpoint.WheelCheckpoint(iteration=1, W=np.ones((3, 2)))
+    checkpoint.save(ck, checkpoint.checkpoint_path(str(tmp_path), 1))
+    ck2 = checkpoint.WheelCheckpoint(iteration=2, W=np.ones((3, 2)))
+    p2 = checkpoint.save(ck2, checkpoint.checkpoint_path(str(tmp_path), 2))
+    with open(p2, "r+b") as f:
+        f.truncate(80)
+    assert checkpoint.load_latest(str(tmp_path)).iteration == 1
+    assert metrics.value("checkpoint.corrupt_skipped") >= 1
+    # an EXPLICITLY named corrupt file still fails loud (caller pinned it)
+    with pytest.raises(Exception):
+        checkpoint.load(p2)
+
+
+def test_verify_accepts_healthy_artifacts(tmp_path):
+    import dataclasses
+
+    ck = checkpoint.WheelCheckpoint(
+        iteration=4, W=np.ones((4, 2)), xbars=np.zeros((4, 2)),
+        rho=np.full((4, 2), 2.0))
+    p = checkpoint.save(ck, checkpoint.checkpoint_path(str(tmp_path), 4))
+    assert checkpoint.verify(p)
+    for k, (lo, hi) in enumerate([(0, 2), (2, 4)]):
+        shard = dataclasses.replace(ck, W=ck.W[lo:hi], xbars=None,
+                                    rho=None)
+        checkpoint.save_shard(shard, str(tmp_path), k, 2, (lo, hi), 4)
+    assert checkpoint.verify(checkpoint.latest(str(tmp_path)))
+    assert metrics.value("checkpoint.corrupt_skipped") == 0
 
 
 # ---------------------------------------------------------------------------

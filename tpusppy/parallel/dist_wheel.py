@@ -37,9 +37,13 @@ import numpy as np
 from ..obs import metrics as _metrics
 from ..obs import trace as _trace
 from ..obs.log import get_logger
+from ..resilience import faults as _faults
 from .distributed import _setup_distributed
+from .elastic import ControllerLost, Watchdog
 
 _log = get_logger("dist_wheel")
+
+_CTR_ELASTIC_RESTORES = _metrics.counter("checkpoint.elastic_restores")
 
 
 def default_allgather():
@@ -102,6 +106,10 @@ class DistWheelResult(NamedTuple):
     eobj: float
     iters: int
     vote_retries: int    # total disagreeing vote rounds (the covered path)
+    # per-iteration (it, conv, eobj) triples, recorded only under
+    # options["record_trajectory"] — the elastic re-shard parity tests
+    # compare post-resume trajectories against an uninterrupted golden
+    trajectory: tuple = ()
 
 
 def distributed_wheel_hub(all_scenario_names, scenario_creator,
@@ -129,6 +137,19 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
     mailboxes and accept via :func:`read_voted`.  Payload layouts match
     :class:`tpusppy.cylinders.hub.PHHub`: ``[W.ravel()|xk.ravel(), OB, IB]``.
 
+    Fault tolerance (doc/resilience.md "Elastic recovery"): every mesh
+    collective — PH steps, consensus fetches, vote allgathers — runs
+    under a :class:`~tpusppy.parallel.elastic.Watchdog`, so a dead or
+    wedged peer raises a typed ``ControllerLost`` within
+    ``options["mesh_timeout"]`` (default ``TPUSPPY_MESH_TIMEOUT``; 0
+    disables) instead of hanging forever.  Drive this function through
+    :func:`tpusppy.parallel.elastic.elastic_wheel_hub` to turn that
+    detection into survivor agreement + re-mesh + sharded-checkpoint
+    resume (``options["elastic_epoch"]`` marks the restore as elastic
+    for the ``checkpoint.elastic_restores`` counter);
+    ``options["record_trajectory"]`` banks per-iteration (it, conv,
+    eobj) on the result for parity tests.
+
     Reference: one multi-rank hub cylinder of ``spin_the_wheel.py:219-237``
     with the acceptance votes of ``hub.py:424-436``.
     """
@@ -139,7 +160,16 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
     spoke_roles = list(spoke_roles or [])
     if allgather is None:
         allgather = default_allgather()
+    # collective watchdog (tpusppy.parallel.elastic, doc/resilience.md):
+    # every mesh barrier, voted-read allgather and consensus fetch runs
+    # under a bounded deadline, so a dead or wedged controller raises a
+    # typed ControllerLost within TPUSPPY_MESH_TIMEOUT instead of
+    # hanging the surviving mesh forever.  options["mesh_timeout"]=0
+    # restores the legacy block-forever collectives.
+    wd = Watchdog.from_options(options)
+    allgather = wd.wrap(allgather, "vote_allgather")
     writer = jax.process_index() == 0
+    my_rank = jax.process_index()
 
     setup = _setup_distributed(all_scenario_names, scenario_creator,
                                scenario_creator_kwargs, options, mesh, axis)
@@ -236,33 +266,61 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
                 f"{state.W.shape[1]}) — resuming a different family?")
         _merge_resume_scalars(ck0.iteration, ck0.best_inner,
                               ck0.best_outer, ck0.tune_state)
+    if (ck0 is not None or ck0_reader is not None) \
+            and int(options.get("elastic_epoch", 0) or 0) > 0:
+        # an ELASTIC restore: this controller is a re-meshed survivor
+        # rebuilding the wheel on a smaller mesh from the shard set the
+        # previous epoch banked (the acceptance-visible signal)
+        _CTR_ELASTIC_RESTORES.inc(1)
+        _log.warning(
+            "elastic restore (mesh epoch %d): resuming iteration %d on "
+            "the re-meshed survivor set", int(options["elastic_epoch"]),
+            it_base)
 
     def _restore_W(state):
-        """Re-seat the checkpointed W AFTER Iter0 (the phbase seam):
-        Iter0 must run with W=0 — its prox-off eobj is only the valid
-        wait-and-see trivial bound at W=0 (the solve minimizes (c+W)x
-        while eobj prices plain c), and the wholesale replacement also
-        discards Iter0's W-update so the loop continues from exactly the
-        snapshot's duals."""
+        """Re-seat the checkpointed W AND xbars AFTER Iter0 (the phbase
+        seam): Iter0 must run with W=0 — its prox-off eobj is only the
+        valid wait-and-see trivial bound at W=0 (the solve minimizes
+        (c+W)x while eobj prices plain c), and the wholesale replacement
+        also discards Iter0's W-update so the loop continues from exactly
+        the snapshot's duals.  xbars matters as much as W: it is the
+        PROX CENTER of the next iterk solve (sharded._ph_objective), so
+        a W-only restore would aim the first resumed iteration at Iter0's
+        consensus instead of the snapshot's — the elastic re-shard parity
+        tests pin the trajectory against an uninterrupted golden.  Old
+        W-only checkpoints still restore (bounds + duals, legacy
+        semantics)."""
+
+        def _dev(field, like):
+            if ck0_reader is not None:
+                # shard-read restore: each process's callback reads ONLY
+                # the shard files overlapping its addressable rows
+                # (ghost/pad rows past S come back zero) — state's own
+                # dtype, as below
+                return _ckpt.restore_sharded_array(
+                    ck0_reader, field, like.sharding,
+                    like.shape, dtype=like.dtype)
+            # state's own dtype, not the npz's (always f64): an f32
+            # wheel must not have a mixed-dtype carry swapped into its
+            # compiled state pytree
+            src = getattr(ck0, field)
+            full = np.zeros(like.shape, dtype=like.dtype)
+            full[:src.shape[0]] = src
+            return jax.make_array_from_callback(
+                full.shape, like.sharding, lambda idx: full[idx])
+
         if ck0_reader is not None:
-            # shard-read restore: each process's callback reads ONLY the
-            # shard files overlapping its addressable rows (ghost/pad
-            # rows past S come back zero) — state's own dtype, as below
-            W_dev = _ckpt.restore_sharded_array(
-                ck0_reader, "W", state.W.sharding,
-                state.W.shape, dtype=state.W.dtype)
+            fields = ck0_reader.meta.get("arrays", ["W"])
+        else:
+            fields = [f for f in ("W", "xbars") if getattr(ck0, f, None)
+                      is not None]
+        rep = {f: _dev(f, getattr(state, f))
+               for f in ("W", "xbars") if f in fields}
+        if ck0_reader is not None:
             # the reader stays alive in this closure for the run: free
             # its cached row blocks now that the restore consumed them
             ck0_reader.drop_cache()
-            return state._replace(W=W_dev)
-        # state's own dtype, not the npz's (always f64): an f32 wheel
-        # must not have a mixed-dtype carry swapped into its compiled
-        # state pytree
-        W_full = np.zeros(state.W.shape, dtype=state.W.dtype)
-        W_full[:ck0.W.shape[0]] = ck0.W
-        W_dev = jax.make_array_from_callback(
-            W_full.shape, state.W.sharding, lambda idx: W_full[idx])
-        return state._replace(W=W_dev)
+        return state._replace(**rep)
 
     def _local_rows(Wd):
         """Contiguous global row range this process's addressable shards
@@ -347,16 +405,41 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
                             _trace.counter("hub", "best_inner", b)
                         BestInner = b
 
-    def fetch_consensus():
+    # checkpointing wheels also fetch xbars: it is the PROX CENTER of
+    # the next iterk solve, so snapshots must carry it for an exact
+    # trajectory continuation (elastic re-shard parity).  Both
+    # conditions derive from the SHARED options dict (+ the iteration
+    # counter, identical by lockstep), so every controller runs the same
+    # collective program — a per-role condition would deadlock the mesh.
+    # With a deterministic iteration cadence the extra (S, K) all-gather
+    # happens only on iterations that can actually capture; a wall-clock
+    # cadence is per-process-unpredictable, so there it rides every
+    # iteration.
+    _ck_armed = bool(options.get("checkpoint_dir"))
+    _ck_every_iters = options.get("checkpoint_every_iters")
+    if _ck_armed and ckpt_sharded and _ck_every_iters is None:
+        _ck_every_iters = max(1, refresh_every)    # mirrors the manager
+
+    def want_xbars(it) -> bool:
+        if not _ck_armed:
+            return False
+        if not _ck_every_iters:
+            return True        # wall-clock cadence: any iteration may be due
+        return (it - it_base) % max(1, int(_ck_every_iters)) == 0
+
+    def _fetch_consensus_raw(include_xbars=False):
         # the replicated fetch is a COLLECTIVE (cross-process all-gather):
         # every controller must join it, even though only controller 0
         # writes the result into the spoke boxes — an early non-writer
         # return here deadlocks the mesh (Gloo rendezvous timeout)
-        return (fetch(state.W).ravel(),
+        base = (fetch(state.W).ravel(),
                 fetch(state.x)[:, nonant_idx].ravel())
+        return base + ((fetch(state.xbars),) if include_xbars else ())
+
+    fetch_consensus = wd.wrap(_fetch_consensus_raw, "consensus_fetch")
 
     def push_state(cached=None):
-        W, xk = fetch_consensus() if cached is None else cached
+        W, xk = (fetch_consensus() if cached is None else cached)[:2]
         if not writer:
             return
         for i, role in enumerate(spoke_roles):
@@ -391,7 +474,8 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
         st, o, f = refresh(state, arr, 0.0)
         return st, o, f, float(np.asarray(o.eobj))
 
-    state, out, factors, trivial = robust_collective(_iter0)
+    state, out, factors, trivial = wd.call(
+        lambda: robust_collective(_iter0), "iter0")
     if better_outer(trivial, BestOuter):
         BestOuter = trivial
     if ck0 is not None or ck0_reader is not None:
@@ -399,6 +483,8 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
 
     conv = eobj = inf
     it = it_base
+    record_traj = bool(options.get("record_trajectory"))
+    trajectory = []
 
     def voted_stop():
         # the termination DECISION is itself voted: identical voted
@@ -420,19 +506,23 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
     def _snap(it, consensus):
         from .. import tune as _tune
 
-        W_host, _ = consensus
+        W_host = consensus[0]
         K = W_host.size // max(1, S)
         W_full = np.asarray(W_host).reshape(S, K)
-        if shard_rows is not None:
-            # sharded capture: ONLY this process's rows ride its snapshot
-            # (sliced from the already-fetched consensus — zero extra
-            # fetches, zero collectives; at true scale the consensus
-            # itself would be shard-local, this keeps the I/O contract)
-            W_out = W_full[shard_rows[0]:shard_rows[1]].copy()
-        else:
-            W_out = W_full.copy()
+        # xbars rides the snapshot when the consensus carried it (every
+        # checkpointing wheel): the prox center of the next solve —
+        # without it a resume re-aims the first iteration at Iter0's
+        # consensus and trajectory parity with the uninterrupted run dies
+        xb_full = (np.asarray(consensus[2])[:S] if len(consensus) > 2
+                   else None)
+        # sharded capture slices ONLY this process's rows from the
+        # already-fetched consensus (zero extra fetches, zero
+        # collectives); the non-sharded writer takes all S rows — one
+        # unconditional slice serves both
+        lo, hi = shard_rows if shard_rows is not None else (0, S)
         return _ckpt.WheelCheckpoint(
-            iteration=it, W=W_out,
+            iteration=it, W=W_full[lo:hi].copy(),
+            xbars=None if xb_full is None else xb_full[lo:hi].copy(),
             best_inner=BestInner, best_outer=BestOuter,
             tune_state=_tune.export_state(),
             meta={"S": S, "K": K, "kind": "dist_wheel"})
@@ -456,19 +546,34 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
             _metrics.inc("checkpoint.capture_errors")
             _log.warning("checkpoint capture failed (run continues): %r", e)
 
+    def _step(it):
+        """One PH iteration: the sharded collective program + its result
+        materialization — THE blocking point a dead peer wedges, so the
+        whole thing runs under the watchdog's deadline."""
+        nonlocal state, out, factors, conv, eobj
+        if (it - it_base - 1) % refresh_every == 0:
+            state, out, factors = refresh(state, arr, 1.0)
+        else:
+            state, out = frozen(state, arr, 1.0, factors)
+        conv = float(np.asarray(out.conv))
+        eobj = float(np.asarray(out.eobj))
+
+    lost_mid_wheel = False
     try:
         for it in range(it_base + 1, iters + 1):
+            # deterministic controller-death injection (faults.py): a
+            # real SIGKILL of THIS process at an exact iteration — one
+            # module-flag check when disarmed
+            if _faults.active():
+                _faults.on_controller_iter(my_rank, it)
             with _trace.span("hub", "wheel_iter"):
-                if (it - it_base - 1) % refresh_every == 0:
-                    state, out, factors = refresh(state, arr, 1.0)
-                else:
-                    state, out = frozen(state, arr, 1.0, factors)
-                conv = float(np.asarray(out.conv))
-                eobj = float(np.asarray(out.eobj))
-                consensus = fetch_consensus()
+                wd.call(lambda: _step(it), f"wheel_iter[{it}]")
+                consensus = fetch_consensus(want_xbars(it))
                 push_state(consensus)
                 pull_bounds()
                 maybe_checkpoint(it, consensus)
+            if record_traj:
+                trajectory.append((it, conv, eobj))
             if voted_stop():
                 break
         else:
@@ -492,8 +597,15 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
                     if voted_stop():
                         break
                     time.sleep(0.5)
+    except Exception as e:
+        lost_mid_wheel = isinstance(e, ControllerLost)
+        raise
     finally:
-        if writer and fabric is not None:
+        # a ControllerLost exit must NOT kill the spokes: the surviving
+        # controllers re-mesh and resume this very wheel (elastic.py),
+        # and the spokes — attached to the fabric, not the mesh — keep
+        # solving right through the outage
+        if writer and fabric is not None and not lost_mid_wheel:
             fabric.send_terminate()
 
     # harvest late spoke bounds posted between our last pull and the kill
@@ -520,5 +632,6 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
             _log.warning("final checkpoint capture failed: %r", e)
         ckpt_mgr.close()
 
+    wd.close()
     return DistWheelResult(BestInner, BestOuter, gap(), conv, eobj, it,
-                           total_retries)
+                           total_retries, tuple(trajectory))

@@ -43,7 +43,7 @@ class DistPHResult(NamedTuple):
 def initialize_backend(coordinator_address, num_processes, process_id,
                        **kwargs):
     """``jax.distributed.initialize`` with the CPU collectives backend
-    enabled first.
+    enabled first, and WIDENED coordination-service heartbeat windows.
 
     Current jaxlib defaults ``jax_cpu_collectives_implementation`` to
     "none", so a multi-controller CPU job initializes fine and then every
@@ -52,6 +52,18 @@ def initialize_backend(coordinator_address, num_processes, process_id,
     BEFORE backend initialization is required.  TPU/GPU jobs ignore the
     setting entirely, so every worker can use this wrapper unconditionally
     (and should: it is the single place the requirement is encoded).
+
+    Heartbeats: the jax coordination client ``LOG(FATAL)``s the whole
+    process on heartbeat-window misses, and under full-suite CPU
+    contention the default window (10s × 10 misses) is starvable — the
+    PR-5 dist checkpoint-resume leg was slow-marked over exactly that.
+    Controller-death DETECTION is now owned by the elastic watchdog
+    (``TPUSPPY_MESH_TIMEOUT``), so the coordination heartbeat can be
+    generous: ``TPUSPPY_DIST_HB_INTERVAL_SECS`` (default 10) ×
+    ``TPUSPPY_DIST_HB_MAX_MISSING`` (default 30 → a 300s window), passed
+    through the private ``State.initialize`` when this jax exposes the
+    knobs (public API falls back silently on drift — the deps-canary
+    covers it).
     """
     import jax
 
@@ -74,6 +86,44 @@ def initialize_backend(coordinator_address, num_processes, process_id,
             "may be unavailable — expect 'Multiprocess computations "
             "aren't implemented on the CPU backend' if so",
             RuntimeWarning, stacklevel=2)
+    import os
+
+    interval = int(os.environ.get("TPUSPPY_DIST_HB_INTERVAL_SECS", "10"))
+    missing = int(os.environ.get("TPUSPPY_DIST_HB_MAX_MISSING", "30"))
+    hb_kwargs = {
+        "service_heartbeat_interval_seconds": interval,
+        "service_max_missing_heartbeats": missing,
+        "client_heartbeat_interval_seconds": interval,
+        "client_max_missing_heartbeats": missing,
+    }
+    try:
+        import inspect
+
+        from jax._src import distributed as _jd
+        from jax._src import xla_bridge as _xb
+
+        sig = inspect.signature(_jd.global_state.initialize)
+        if all(k in sig.parameters for k in hb_kwargs):
+            # the public jax.distributed.initialize guards against
+            # already-initialized backends — the private State does not,
+            # and skipping the check would let the Gloo knob above be a
+            # silent no-op on the already-built backend (first collective
+            # hangs); replicate the guard before taking the private path
+            if _xb.backends_are_initialized():
+                raise RuntimeError(
+                    "initialize_backend must be called before any JAX "
+                    "computations (the backend is already initialized)")
+            # jax.distributed.initialize delegates to this very State
+            # object — only the heartbeat kwargs are private surface;
+            # ALL four must exist (a partial rename would TypeError)
+            _jd.global_state.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id,
+                **hb_kwargs, **kwargs)
+            return
+    except (ImportError, AttributeError):
+        pass    # private surface moved (upstream drift): default
+        #         heartbeat windows via the public API below
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id, **kwargs)
@@ -122,6 +172,35 @@ def process_rows(mesh, S_global, axis: str = "scen"):
         if d.process_index == jax.process_index():
             rows.extend(range(i * per_dev, (i + 1) * per_dev))
     return np.asarray(sorted(rows)), Sp
+
+
+def _shared_A_unanimous(A_shared) -> bool:
+    """Cross-process vote on the shared-A engine: True only when EVERY
+    process detected a shared A (pass None otherwise) and all of them
+    are the same matrix (sha1 over the f64 bytes, exchanged as two
+    exact <2^53 float words).  COLLECTIVE — every process of the job
+    must call it exactly once (at setup), whatever its local verdict:
+    a subset-joined allgather would deadlock the mesh."""
+    import jax
+
+    if jax.process_count() == 1:
+        return A_shared is not None
+    import hashlib
+
+    from jax.experimental import multihost_utils
+
+    if A_shared is None:
+        mine = np.asarray([0.0, 0.0, 0.0])
+    else:
+        h = hashlib.sha1(np.ascontiguousarray(
+            np.asarray(A_shared, np.float64)).tobytes()).hexdigest()
+        mine = np.asarray([1.0, float(int(h[:12], 16)),
+                           float(int(h[12:24], 16))])
+    votes = np.asarray(
+        multihost_utils.process_allgather(mine)).reshape(-1, 3)
+    return bool((votes[:, 0] == 1.0).all()
+                and (votes[:, 1] == votes[0, 1]).all()
+                and (votes[:, 2] == votes[0, 2]).all())
 
 
 def _global_scen_arrays(batch_local, S_global, owned_rows, mesh, axis,
@@ -176,6 +255,19 @@ def _global_scen_arrays(batch_local, S_global, owned_rows, mesh, axis,
                                   (int(owned.sum()),))
 
     A_shared = getattr(b, "A_shared", None)
+    if not _shared_A_unanimous(A_shared):
+        # a process whose local slice is a SINGLE scenario (uneven S —
+        # exactly the shape an elastic re-mesh produces) detects a
+        # "shared" A trivially and would compile the 2-D shared-A
+        # engine while its peers compile the 3-D per-scenario one: the
+        # two programs post different collectives and Gloo ABORTS the
+        # whole job with a size mismatch (measured: 3 controllers, S=7,
+        # "op.preamble.length <= op.nbytes. 16 vs 8").  The engine
+        # choice is therefore VOTED across processes (the vote itself
+        # is collective — every process joins whatever its local
+        # verdict): shared only when all hold the same shared A;
+        # otherwise the per-scenario branch (b.A is the broadcast view).
+        A_shared = None
     if A_shared is not None:
         from ..solvers.sparse import SparseA, should_sparsify
 
@@ -315,6 +407,8 @@ def distributed_ph(all_scenario_names, scenario_creator,
     """
     import jax
 
+    from .elastic import Watchdog
+
     options = dict(options or {})
     setup = _setup_distributed(all_scenario_names, scenario_creator,
                                scenario_creator_kwargs, options, mesh, axis)
@@ -324,18 +418,29 @@ def distributed_ph(all_scenario_names, scenario_creator,
     iters = int(options.get("PHIterLimit", 10))
     refresh_every = max(1, int(options.get("solver_refresh_every", 16)))
     convthresh = float(options.get("convthresh", -1.0))
-    state, out, factors = refresh(state, arr, 0.0)   # iter0: plain objective
+    # bounded-timeout mesh barriers (doc/resilience.md): a dead peer
+    # raises ControllerLost within options["mesh_timeout"] /
+    # TPUSPPY_MESH_TIMEOUT instead of wedging every process forever
+    wd = Watchdog.from_options(options)
+    state, out, factors = wd.call(
+        lambda: refresh(state, arr, 0.0), "iter0")   # plain objective
     conv = eobj = np.inf
     it = 0
-    for it in range(1, iters + 1):
+
+    def _step(it):
+        nonlocal state, out, factors, conv, eobj
         if (it - 1) % refresh_every == 0:
             state, out, factors = refresh(state, arr, 1.0)
         else:
             state, out = frozen(state, arr, 1.0, factors)
         conv = float(np.asarray(out.conv))
         eobj = float(np.asarray(out.eobj))
+
+    for it in range(1, iters + 1):
+        wd.call(lambda: _step(it), f"ph_iter[{it}]")
         if 0 <= convthresh and conv < convthresh:
             break
+    wd.close()
 
     # consensus nonants: replicated per-node xbar, gathered host-side from
     # the addressable shard (identical across processes post-psum)

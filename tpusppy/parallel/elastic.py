@@ -1,0 +1,847 @@
+"""Elastic mesh recovery: distributed wheels survive controller loss.
+
+The paper's hub-and-spoke architecture tolerates dead SPOKES (asynchronous
+bounds, PAPER.md §1; the in-process supervisor of
+:mod:`tpusppy.resilience.supervisor` reproduces that).  A dead CONTROLLER
+of the multi-controller wheel was a different story: Gloo collectives
+block (or error unpredictably) on a dead peer, and the jax coordination
+service goes further — its error-polling thread ``LOG(FATAL)``s surviving
+processes once a peer death propagates, and ``jax.distributed.shutdown``
+with a dead peer aborts on the shutdown barrier (both measured on this
+toolchain).  In-process "re-initialize on the smaller mesh" is therefore
+impossible; the recovery shape that works is the one elastic training
+systems use: DETECT fast, AGREE on the survivor set, and RESTART the
+surviving processes onto a fresh, smaller mesh, restoring state from the
+shard-written checkpoints (doc/scaling.md) whose row-range reads are
+layout-agnostic by construction.
+
+Three pieces:
+
+- :class:`Watchdog` — bounded-timeout execution of every mesh collective
+  (PH steps, consensus fetches, write-id vote allgathers).  A dead or
+  wedged controller turns an infinite hang into a typed
+  :class:`ControllerLost` within ``TPUSPPY_MESH_TIMEOUT`` seconds; fast
+  Gloo connection errors (the common CPU observation: a SIGKILLed peer
+  refuses connections) convert to the same type.
+- :class:`MeshLiveness` — a side-channel liveness protocol over the TCP
+  window runtime (:mod:`tpusppy.runtime.tcp_window_service`): every
+  controller serves a tiny heartbeat box set and beats into every peer's
+  boxes, so each controller has a LOCAL view of who is alive that does
+  not depend on any collective (or on controller 0 — there is no
+  distinguished server).
+- :func:`elastic_wheel_hub` — the driver: runs
+  :func:`~tpusppy.parallel.dist_wheel.distributed_wheel_hub` under the
+  watchdog; on :class:`ControllerLost` the survivors agree on the
+  survivor set through the liveness channel (:func:`agree_survivors`),
+  check the quorum (losing a MAJORITY of the original controllers raises
+  :class:`MeshMajorityLost` — loudly, not a hang), and **re-exec**
+  themselves (``os.execve`` of the same argv) with the next mesh epoch's
+  topology in the environment.  The re-exec'd processes re-run
+  ``initialize_backend`` on the smaller mesh (fresh coordinator port per
+  epoch), re-derive placement from the partition rules with ghost
+  padding absorbing the new uneven S split, restore wheel state from the
+  latest COMPLETE sharded checkpoint set via per-process row-range
+  reads, re-seed bounds through the resume seam, and continue with
+  total-iteration semantics intact.
+
+What is NOT survivable (typed errors, never hangs): loss of a majority
+of the original controllers (:class:`MeshMajorityLost`), and loss of all
+copies of a shard row — which with shard-per-process checkpoints on a
+shared filesystem only happens when the filesystem lost the dead
+controller's shard files (the resume then falls back to the previous
+complete set, or cold-starts loudly).
+
+See doc/resilience.md ("Elastic recovery") and scripts/chaos_smoke.py
+(the real-SIGKILL acceptance).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import queue
+import random
+import socket
+import sys
+import threading
+import time
+
+from ..obs import metrics as _metrics
+from ..obs import trace as _trace
+from ..obs.log import get_logger
+from ..resilience import faults as _faults
+
+_log = get_logger("elastic")
+
+_CTR_LOST = _metrics.counter("mesh.controller_lost")
+_CTR_TIMEOUTS = _metrics.counter("mesh.collective_timeouts")
+_CTR_ERRORS = _metrics.counter("mesh.collective_errors")
+_CTR_REMESH = _metrics.counter("mesh.remesh")
+_CTR_BEATS = _metrics.counter("mesh.heartbeats")
+_CTR_BEAT_FAILS = _metrics.counter("mesh.heartbeat_fails")
+_GAUGE_LIVE = _metrics.gauge("mesh.live_controllers")
+
+#: env knobs (read at call time so tests and the chaos smoke can set them
+#: per process): detection deadline + the epoch/survivor topology the
+#: re-exec hands to the next incarnation
+ENV_TIMEOUT = "TPUSPPY_MESH_TIMEOUT"
+ENV_EPOCH = "TPUSPPY_ELASTIC_EPOCH"
+ENV_SURVIVORS = "TPUSPPY_ELASTIC_SURVIVORS"
+ENV_LOST_TOTAL = "TPUSPPY_ELASTIC_LOST_TOTAL"
+ENV_REMESH_TOTAL = "TPUSPPY_ELASTIC_REMESH_TOTAL"
+ENV_DETECT_SECS = "TPUSPPY_ELASTIC_DETECT_SECS"
+
+# Conservative default: far above any healthy steady-state iteration or
+# contention stall (the same reasoning that widened the jax coordination
+# heartbeat window to 300s), so plain dist wheels never flake on a slow
+# box — arming still turns an INFINITE hang into a bounded typed error.
+# Elastic deployments that want fast recovery set a tight value
+# explicitly (the chaos smoke runs at 20s).
+DEFAULT_MESH_TIMEOUT = 300.0
+
+
+def mesh_timeout() -> float:
+    """The detection deadline in seconds (``TPUSPPY_MESH_TIMEOUT``;
+    0 disables the watchdog — legacy block-forever collectives)."""
+    return float(os.environ.get(ENV_TIMEOUT, DEFAULT_MESH_TIMEOUT) or 0.0)
+
+
+class ControllerLost(RuntimeError):
+    """A mesh peer is dead or unreachable: a guarded collective timed out
+    or failed with a dead-peer error.  Carries ``what`` (the operation)
+    and ``elapsed`` (seconds until detection)."""
+
+    def __init__(self, what: str, elapsed: float, cause: str = "timeout"):
+        self.what = str(what)
+        self.elapsed = float(elapsed)
+        self.cause = str(cause)
+        super().__init__(
+            f"controller lost: mesh collective {what!r} {cause} after "
+            f"{elapsed:.1f}s (TPUSPPY_MESH_TIMEOUT={mesh_timeout():g})")
+
+
+class MeshMajorityLost(ControllerLost):
+    """The NON-recoverable case: fewer than a strict majority of the
+    ORIGINAL controllers survive, so no quorum can agree on a survivor
+    set (split-brain hazard) — fail loudly instead of re-meshing."""
+
+    def __init__(self, survivors, n_original):
+        self.survivors = sorted(int(s) for s in survivors)
+        self.n_original = int(n_original)
+        RuntimeError.__init__(
+            self,
+            f"mesh majority lost: only {len(self.survivors)} of "
+            f"{self.n_original} original controllers survive "
+            f"({self.survivors}) — below quorum, refusing to re-mesh")
+
+
+# dead-peer signatures this toolchain's Gloo/coordination stack surfaces
+# when a SIGKILLed peer's sockets vanish (measured; a plain hang is the
+# other presentation, covered by the timeout)
+_DEAD_PEER_MARKS = (
+    "Connection refused", "Connection reset", "Broken pipe",
+    "Socket closed", "UNAVAILABLE", "DEADLINE_EXCEEDED", "Gloo",
+    "connection lost", "Transport endpoint",
+)
+
+
+def _is_dead_peer_error(exc: BaseException) -> bool:
+    msg = repr(exc)
+    return any(m in msg for m in _DEAD_PEER_MARKS)
+
+
+class Watchdog:
+    """Bounded-timeout execution of mesh collectives.
+
+    Guarded calls run serialized on ONE dedicated worker thread (order
+    preserved); the caller waits with a deadline.  On timeout the worker
+    is abandoned mid-call (the process is about to re-mesh via exec — a
+    wedged Gloo op cannot be cancelled anyway) and :class:`ControllerLost`
+    raises on the calling thread.  Exceptions matching dead-peer
+    signatures convert to :class:`ControllerLost` too; everything else
+    propagates untouched.  ``timeout=0`` disables the thread hop entirely
+    (deterministic passthrough — the legacy path).
+
+    The FIRST guarded call gets ``first_grace`` × the timeout: it folds
+    in XLA compiles and the Gloo rendezvous window, which are not
+    liveness signals.  Steady state is LOAD-ADAPTIVE (the same policy as
+    the spoke supervisor's staleness grace): the effective deadline is
+    ``max(timeout, adaptive_grace × observed call latency)`` (latency =
+    max of the EWMA and the latest completed call), so a wheel whose
+    healthy steps legitimately approach or exceed the configured timeout
+    — a big-S consensus fetch, a contention stall — widens its own
+    window instead of tripping a spurious loss, while a genuine hang
+    (unbounded) still fires within a small multiple of the run's own
+    demonstrated cadence.
+    """
+
+    def __init__(self, timeout: float | None = None,
+                 first_grace: float = 10.0, adaptive_grace: float = 8.0):
+        self.timeout = mesh_timeout() if timeout is None else float(timeout)
+        self.first_grace = float(first_grace)
+        self.adaptive_grace = float(adaptive_grace)
+        self._first = True
+        self._lat_ewma = 0.0
+        self._lat_last = 0.0
+        self._q: queue.Queue | None = None
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_options(cls, options) -> "Watchdog":
+        t = (options or {}).get("mesh_timeout")
+        return cls(timeout=None if t is None else float(t))
+
+    @property
+    def armed(self) -> bool:
+        return self.timeout > 0
+
+    def _submit(self, fn):
+        # DAEMON worker, not a ThreadPoolExecutor: concurrent.futures
+        # joins its (non-daemon) workers at interpreter exit, so an
+        # abandoned wedged collective would hang the process at shutdown
+        # — the exact hang this class exists to remove (the typed
+        # majority-loss failure must EXIT, not park in atexit)
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._q = queue.Queue()
+                self._thread = threading.Thread(
+                    target=self._worker_loop, name="mesh-watchdog",
+                    daemon=True)
+                self._thread.start()
+            box: queue.Queue = queue.Queue(maxsize=1)
+            self._q.put((fn, box))
+            return box
+
+    def _worker_loop(self):
+        q = self._q
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            fn, box = item
+            try:
+                box.put((True, fn()))
+            except BaseException as e:      # delivered to the caller
+                box.put((False, e))
+
+    def deadline(self) -> float:
+        """The budget the NEXT guarded call gets."""
+        if self._first:
+            return self.timeout * self.first_grace
+        return max(self.timeout,
+                   self.adaptive_grace * max(self._lat_ewma,
+                                             self._lat_last))
+
+    def call(self, fn, what: str):
+        _faults.on_collective(what)
+        if not self.armed:
+            return fn()
+        budget = self.deadline()
+        t0 = time.monotonic()
+        box = self._submit(fn)
+        try:
+            ok, out = box.get(timeout=budget)
+        except queue.Empty:
+            _CTR_TIMEOUTS.inc(1)
+            self._lost(what, time.monotonic() - t0, "timed out")
+        if not ok:
+            if isinstance(out, ControllerLost):
+                raise out
+            if _is_dead_peer_error(out):
+                _CTR_ERRORS.inc(1)
+                self._lost(what, time.monotonic() - t0,
+                           f"failed ({type(out).__name__})")
+            raise out
+        if not self._first:
+            # the FIRST (grace) call is compile + rendezvous, not a
+            # cadence sample: learning it would inflate the adaptive
+            # deadline ~grace-fold for the whole run and stall detection
+            dt = time.monotonic() - t0
+            self._lat_last = dt
+            self._lat_ewma = (dt if self._lat_ewma == 0.0
+                              else 0.8 * self._lat_ewma + 0.2 * dt)
+        self._first = False
+        return out
+
+    def _lost(self, what, elapsed, cause):
+        _CTR_LOST.inc(1)
+        if _trace.enabled():
+            _trace.instant("hub", "controller_lost", what=what,
+                           elapsed=elapsed, cause=cause)
+        _log.warning("mesh collective %r %s after %.1fs — controller "
+                     "presumed lost", what, cause, elapsed)
+        raise ControllerLost(what, elapsed, cause)
+
+    def wrap(self, fn, what: str):
+        """A guarded version of ``fn`` (for injecting into callers that
+        take a collective function, e.g. the write-id vote's
+        allgather)."""
+        def guarded(*args, **kwargs):
+            return self.call(lambda: fn(*args, **kwargs), what)
+        return guarded
+
+    def close(self):
+        with self._lock:
+            q, self._q, self._thread = self._q, None, None
+        if q is not None:
+            q.put(None)         # idle worker exits; a wedged one is
+            # abandoned — daemonized, it cannot block process exit
+
+
+# ---------------------------------------------------------------------------
+# Liveness side-channel
+# ---------------------------------------------------------------------------
+# payload: [epoch, beat counter, view bits lo, view bits hi, phase] —
+# the survivor-set bitmask rides as TWO <2^27 words so every value is
+# exact in float64 (one word would silently round past 53 ranks and the
+# exact-compare agreement could never converge); _MAX_RANKS guards the
+# representable range at construction
+_HB_LEN = 5
+_BITS_WORD = 27
+_MAX_RANKS = 2 * _BITS_WORD
+_PHASE_RUNNING = 0.0
+_PHASE_PROPOSING = 1.0
+
+
+def _bits(ranks) -> int:
+    return sum(1 << int(r) for r in ranks)
+
+
+def _bits_words(bits: int):
+    return (float(bits & ((1 << _BITS_WORD) - 1)),
+            float(bits >> _BITS_WORD))
+
+
+def free_port_block(n: int, tries: int = 64) -> int:
+    """Base of ``n`` CONSECUTIVE currently-free TCP ports.
+
+    The liveness servers bind ``base + original_rank`` and the per-epoch
+    jax coordinators ``base + epoch`` — single ``bind(0)`` reservations
+    only vouch for the base, and an unreserved offset colliding with a
+    busy port would kill a controller for reasons unrelated to recovery.
+    Probes a random high-range base until the whole block binds (the
+    usual TOCTOU caveat applies; the block is outside the kernel's
+    ephemeral range to keep collisions rare)."""
+    for _ in range(tries):
+        base = random.randint(20000, 29000)
+        socks = []
+        try:
+            for k in range(n):
+                s = socket.socket()
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", base + k))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError(f"no free block of {n} consecutive ports found")
+
+
+class MeshLiveness:
+    """All-to-all controller heartbeats over the TCP window runtime.
+
+    Every controller SERVES one tiny box set (``n_original`` boxes of 4
+    doubles) on ``port_base + its ORIGINAL rank`` and beats
+    ``[epoch, counter, view_bits, phase]`` into box ``rank`` on every
+    peer's server (plus its own, locally).  Liveness of peer ``r`` is
+    judged from the LOCAL server alone: box ``r``'s write-id advanced
+    within ``stale_after`` seconds.  No collective, no distinguished
+    process — the channel survives any subset of deaths, which is the
+    property the post-loss survivor agreement needs.
+
+    Ports are stable across mesh epochs (original ranks never change);
+    all sockets are close-on-exec, so a re-exec'd survivor re-serves its
+    port immediately.  The shared ``secret`` gates the handshake exactly
+    as the wheel fabric's does.
+    """
+
+    def __init__(self, rank: int, members, n_original: int,
+                 port_base: int, hosts=None, secret: int = 0,
+                 epoch: int = 0, stale_after: float | None = None,
+                 interval: float | None = None):
+        from ..runtime.tcp_window_service import TcpEndpoint
+
+        self.rank = int(rank)
+        self.members = sorted(int(m) for m in members)
+        self.n_original = int(n_original)
+        if self.n_original > _MAX_RANKS:
+            raise ValueError(
+                f"MeshLiveness supports up to {_MAX_RANKS} original "
+                f"controllers (the agreement bitmask rides two exact "
+                f"f64 words), got {self.n_original}")
+        self.port_base = int(port_base)
+        self.hosts = list(hosts) if hosts else \
+            ["127.0.0.1"] * self.n_original
+        self.secret = int(secret)
+        self.epoch = int(epoch)
+        self.stale_after = float(stale_after if stale_after is not None
+                                 else max(mesh_timeout(), 1.0))
+        self.interval = float(interval if interval is not None
+                              else min(1.0, max(0.05,
+                                                self.stale_after / 8.0)))
+        self._ep_cls = TcpEndpoint
+        self._srv = TcpEndpoint(lengths=[_HB_LEN] * self.n_original,
+                                port=self.port_base + self.rank,
+                                bind="0.0.0.0" if any(
+                                    h not in ("127.0.0.1", "localhost")
+                                    for h in self.hosts) else "127.0.0.1",
+                                secret=self.secret)
+        self._peers: dict = {}          # rank -> TcpEndpoint | None
+        self._last_dial: dict = {}      # rank -> monotonic of last attempt
+        self._counter = 0
+        self._view_bits = _bits(self.members)
+        self._phase = _PHASE_RUNNING
+        self._state_lock = threading.Lock()
+        # last observed (write_id, change time) per LOCAL box; seeding
+        # with start time gives every peer one stale window to say hello
+        now = time.monotonic()
+        self._seen = {r: (0, now) for r in self.members if r != self.rank}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ---- beating -----------------------------------------------------------
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._beat_loop,
+                                            name="mesh-liveness",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def _payload(self):
+        import numpy as np
+
+        with self._state_lock:
+            self._counter += 1
+            lo, hi = _bits_words(self._view_bits)
+            return np.asarray([float(self.epoch), float(self._counter),
+                               lo, hi, float(self._phase)],
+                              dtype=np.float64)
+
+    def beat(self):
+        """One heartbeat round: put the payload into our own box locally
+        and on every peer's server (dead peers are skipped with a dial
+        cooldown so one corpse cannot stall beats to the living)."""
+        import ctypes
+
+        payload = self._payload()
+        lib = self._srv._lib
+        ptr = payload.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+        lib.tws_put(self._srv._handle, self.rank, ptr, _HB_LEN)
+        _CTR_BEATS.inc(1)
+        for r in self.members:
+            if r == self.rank:
+                continue
+            ep = self._dial(r)
+            if ep is None:
+                continue
+            try:
+                _faults.on_tcp_io(f"liveness->r{r}")
+                rc = lib.tws_put(ep._handle, self.rank, ptr, _HB_LEN)
+                if rc < -1:
+                    raise RuntimeError(f"liveness put rc={rc}")
+            except Exception:
+                _CTR_BEAT_FAILS.inc(1)
+                try:
+                    ep.close()
+                except Exception:
+                    pass
+                self._peers[r] = None
+        # refresh the local view each beat (write_id progression)
+        self._observe()
+
+    def _dial(self, r: int):
+        """Client endpoint to peer ``r``'s liveness server, (re)dialed
+        with a SHORT connect timeout and a cooldown — a down peer must
+        never stall the beat loop for the healthy ones."""
+        ep = self._peers.get(r)
+        if ep is not None:
+            return ep
+        now = time.monotonic()
+        if now - self._last_dial.get(r, -1e9) < max(self.interval * 2, 0.5):
+            return None
+        self._last_dial[r] = now
+        try:
+            ep = self._ep_cls(
+                connect=(self.hosts[r], self.port_base + r),
+                connect_timeout=min(2.0, self.stale_after / 2),
+                secret=self.secret, op_timeout=min(2.0, self.stale_after))
+        except Exception:
+            _CTR_BEAT_FAILS.inc(1)
+            return None
+        self._peers[r] = ep
+        return ep
+
+    def _beat_loop(self):
+        while not self._stop.is_set():
+            try:
+                self.beat()
+            except Exception as e:      # the channel must never crash a run
+                _log.warning("liveness beat failed: %r", e)
+            self._stop.wait(self.interval)
+
+    # ---- observing ---------------------------------------------------------
+    def _observe(self):
+        now = time.monotonic()
+        for r in list(self._seen):
+            try:
+                wid = int(self._srv._lib.tws_write_id(self._srv._handle, r))
+            except Exception:
+                continue
+            last_wid, _t = self._seen[r]
+            if wid != last_wid:
+                self._seen[r] = (wid, now)
+        _GAUGE_LIVE.set(float(len(self._alive_from_seen())))
+
+    def _alive_from_seen(self):
+        now = time.monotonic()
+        return sorted([self.rank] + [
+            r for r, (_wid, t) in self._seen.items()
+            if now - t <= self.stale_after])
+
+    def alive_ranks(self):
+        """Sorted ORIGINAL ranks currently considered alive (self always;
+        peers whose local box advanced within ``stale_after``)."""
+        try:                    # cheap local reads; any thread may call
+            self._observe()
+        except Exception:
+            pass
+        return self._alive_from_seen()
+
+    def peer_states(self) -> dict:
+        """{rank: (epoch, counter, view_bits, phase)} from the LOCAL
+        boxes (self included) — the agreement protocol's read side
+        (``view_bits`` reassembled from the two exact payload words)."""
+        import ctypes
+
+        import numpy as np
+
+        out = {}
+        buf = np.empty(_HB_LEN, dtype=np.float64)
+        ptr = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+        for r in self.members:
+            rc = self._srv._lib.tws_get(self._srv._handle, r, ptr, _HB_LEN)
+            if int(rc) <= 0:
+                continue        # never written (or killed): no state yet
+            bits = int(buf[2]) | (int(buf[3]) << _BITS_WORD)
+            out[r] = (float(buf[0]), float(buf[1]), bits, float(buf[4]))
+        return out
+
+    def set_state(self, view_bits: int | None = None,
+                  phase: float | None = None):
+        with self._state_lock:
+            if view_bits is not None:
+                self._view_bits = int(view_bits)
+            if phase is not None:
+                self._phase = float(phase)
+
+    def close(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval + 1.0)
+            self._thread = None
+        for ep in self._peers.values():
+            if ep is not None:
+                try:
+                    ep.close()
+                except Exception:
+                    pass
+        self._peers = {}
+        try:
+            self._srv.close()
+        except Exception:
+            pass
+
+
+def agree_survivors(liveness: MeshLiveness, deadline_secs: float | None = None):
+    """Post-loss survivor agreement: publish my live view through the
+    heartbeat payload, wait until every member of that view publishes
+    the SAME view (same epoch, PROPOSING phase) — then the set is the
+    agreed survivor roster.  Deterministic: all survivors see the same
+    dead peers (heartbeats stopped for everyone), so the fixed point is
+    the true survivor set; skew while views converge just loops.
+
+    Raises :class:`MeshMajorityLost` the moment my own view drops to a
+    non-strict-majority of the ORIGINAL controllers (no quorum can ever
+    form), and :class:`ControllerLost` if agreement does not converge
+    within the deadline (default 6× the stale window) — a fabric so
+    broken that the survivors cannot even see each other.
+    """
+    deadline = time.monotonic() + (
+        float(deadline_secs) if deadline_secs is not None
+        else 6.0 * liveness.stale_after)
+    n0 = liveness.n_original
+    while True:
+        view = liveness.alive_ranks()
+        if 2 * len(view) <= n0:
+            raise MeshMajorityLost(view, n0)
+        bits = _bits(view)
+        liveness.set_state(view_bits=bits, phase=_PHASE_PROPOSING)
+        liveness.beat()                  # publish NOW, don't wait a tick
+        states = liveness.peer_states()
+        agreed = True
+        for r in view:
+            if r == liveness.rank:
+                continue
+            st = states.get(r)
+            # a peer counts as agreeing when it published PROPOSING with
+            # the same roster at our epoch — or when it ALREADY MOVED ON:
+            # an agreed peer execs immediately, and its epoch+1 heartbeats
+            # (whose view IS the agreed roster) can overwrite the
+            # lingering PROPOSING payload before a slower survivor reads
+            # it; without this acceptance the slow side loops until its
+            # deadline while the fast side waits at the next epoch's
+            # rendezvous (race observed in the chaos smoke)
+            same_roster = st is not None and int(st[2]) == bits
+            proposing_now = (same_roster
+                             and st[0] == float(liveness.epoch)
+                             and st[3] == _PHASE_PROPOSING)
+            already_next_epoch = (same_roster
+                                  and st[0] == float(liveness.epoch) + 1.0)
+            if not (proposing_now or already_next_epoch):
+                agreed = False
+                break
+        if agreed:
+            _log.warning("survivor agreement: %s of %d original "
+                         "controllers (epoch %d)", view, n0,
+                         liveness.epoch)
+            return view
+        if time.monotonic() > deadline:
+            raise ControllerLost("survivor_agreement",
+                                 6.0 * liveness.stale_after,
+                                 "did not converge")
+        time.sleep(liveness.interval / 2)
+
+
+# ---------------------------------------------------------------------------
+# Topology spec + re-exec re-meshing
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class ElasticSpec:
+    """Everything a controller needs to (re)join an elastic mesh.
+
+    ``rank`` is the process's ORIGINAL rank (stable across epochs — it
+    names its liveness port and its identity in survivor sets);
+    ``n_original`` the epoch-0 controller count (the quorum base);
+    ``coord_port_base + epoch`` the jax coordinator port of each epoch
+    (a fresh port per epoch: the previous coordinator socket died with
+    the exec'd process image, and ports linger in TIME_WAIT);
+    ``liveness_port_base + rank`` each controller's heartbeat server.
+    ``survivors`` is None at epoch 0 (all ranks), else the agreed roster.
+    """
+
+    rank: int
+    n_original: int
+    checkpoint_dir: str
+    coord_port_base: int
+    liveness_port_base: int
+    hosts: list | None = None
+    secret: int = 0
+    epoch: int = 0
+    survivors: list | None = None
+    mesh_timeout_secs: float | None = None
+
+    def with_env(self) -> "ElasticSpec":
+        """Fold in the re-exec environment overrides (epoch + survivor
+        roster) — the first thing a (possibly re-exec'd) worker does."""
+        epoch = int(os.environ.get(ENV_EPOCH, self.epoch))
+        surv = os.environ.get(ENV_SURVIVORS)
+        survivors = ([int(x) for x in surv.split(",") if x != ""]
+                     if surv else self.survivors)
+        return dataclasses.replace(self, epoch=epoch, survivors=survivors)
+
+    @property
+    def members(self) -> list:
+        return (sorted(int(s) for s in self.survivors)
+                if self.survivors else list(range(self.n_original)))
+
+    @property
+    def process_id(self) -> int:
+        return self.members.index(self.rank)
+
+    @property
+    def coordinator(self) -> str:
+        host = (self.hosts or ["127.0.0.1"] * self.n_original)[
+            self.members[0]]
+        return f"{host}:{self.coord_port_base + self.epoch}"
+
+    @property
+    def timeout(self) -> float:
+        return (float(self.mesh_timeout_secs)
+                if self.mesh_timeout_secs is not None else mesh_timeout())
+
+
+def _reseed_counters_from_env():
+    """The registry dies with the exec'd image: previous epochs' loss/
+    re-mesh counts ride the environment so the FINAL process's registry
+    still shows the whole recovery (the acceptance contract)."""
+    lost = int(os.environ.get(ENV_LOST_TOTAL, "0") or 0)
+    remesh = int(os.environ.get(ENV_REMESH_TOTAL, "0") or 0)
+    if lost > int(_CTR_LOST.get()):
+        _CTR_LOST.inc(lost - int(_CTR_LOST.get()))
+    if remesh > int(_CTR_REMESH.get()):
+        _CTR_REMESH.inc(remesh - int(_CTR_REMESH.get()))
+
+
+def _await_peers_next_epoch(liveness: MeshLiveness, survivors,
+                            next_epoch: int, deadline_secs: float):
+    """Exec-ordering barrier for the CURRENT epoch's coordinator.
+
+    The jax coordination service lives inside the epoch's rank-min
+    controller; exec'ing that process closes the service socket, and any
+    fellow survivor still running the old epoch is LOG(FATAL)'d the
+    instant its error-poller notices (measured: the chaos smoke's
+    controller_2 post-mortem shows PollForError "Socket closed" →
+    termination whenever controller 0 exec'd first).  So the coordinator
+    holds its exec until every other survivor's liveness payload shows
+    ``epoch >= next_epoch`` — its re-exec'd incarnation is beating and
+    no longer owns an epoch-``e`` coordination client.  Bounded: past
+    the deadline (a peer died instead of re-meshing) the exec proceeds
+    and the next epoch's bounded ``RegisterTask`` window reports the
+    missing peer."""
+    deadline = time.monotonic() + float(deadline_secs)
+    rest = [int(r) for r in survivors if int(r) != liveness.rank]
+    while rest and time.monotonic() < deadline:
+        states = liveness.peer_states()
+        if all(states.get(r) is not None
+               and states[r][0] >= float(next_epoch) for r in rest):
+            return True
+        time.sleep(liveness.interval / 2)
+    if rest:
+        _log.warning(
+            "coordinator exec barrier: peers %s never reached epoch %d "
+            "within %.0fs — exec'ing anyway (the next epoch's register "
+            "window bounds the damage)", rest, next_epoch, deadline_secs)
+    return False
+
+
+def remesh_exec(spec: ElasticSpec, survivors, detect_secs: float):
+    """Replace this process with the next mesh epoch's incarnation:
+    same executable, same argv, environment carrying the new epoch and
+    survivor roster.  Never returns.  ``os.execve`` keeps the PID and
+    the inherited stdio pipes (a parent harness keeps reading the same
+    stream); every runtime socket is close-on-exec, so the liveness and
+    fabric ports rebind cleanly in the new image."""
+    _CTR_REMESH.inc(1)
+    env = dict(os.environ)
+    env[ENV_EPOCH] = str(spec.epoch + 1)
+    env[ENV_SURVIVORS] = ",".join(str(s) for s in sorted(survivors))
+    env[ENV_LOST_TOTAL] = str(int(_CTR_LOST.get()))
+    env[ENV_REMESH_TOTAL] = str(int(_CTR_REMESH.get()))
+    env[ENV_DETECT_SECS] = f"{detect_secs:.3f}"
+    _log.warning("re-meshing: exec epoch %d with survivors %s "
+                 "(detected in %.1fs)", spec.epoch + 1, sorted(survivors),
+                 detect_secs)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    argv = [sys.executable] + sys.argv
+    os.execve(sys.executable, argv, env)
+
+
+def elastic_wheel_hub(spec: ElasticSpec, all_scenario_names,
+                      scenario_creator, scenario_creator_kwargs=None,
+                      options=None, fabric_factory=None, spoke_roles=None,
+                      is_minimizing: bool = True):
+    """Run one controller of an ELASTIC distributed wheel.
+
+    Call from every controller process (a script whose argv can be
+    re-exec'd verbatim).  Epoch 0 runs the full mesh; on
+    :class:`ControllerLost` the survivors agree on the roster and
+    re-exec into epoch ``e+1``, where this function (reached again
+    through the re-run script) initializes the smaller mesh and resumes
+    from ``spec.checkpoint_dir``'s latest complete sharded set.  Returns
+    the :class:`~tpusppy.parallel.dist_wheel.DistWheelResult` of the
+    epoch that completes; raises :class:`MeshMajorityLost` (typed, loud)
+    when no quorum survives.
+
+    ``fabric_factory(spec)`` builds this epoch's spoke fabric view (or
+    None for the spokeless posture).  Serve the boxes OFF-controller (or
+    accept that spokes ride their reconnect path while the serving
+    controller re-execs).
+    """
+    from .dist_wheel import distributed_wheel_hub
+    from .distributed import initialize_backend
+
+    spec = spec.with_env()
+    _reseed_counters_from_env()
+    options = dict(options or {})
+    options.setdefault("mesh_timeout", spec.timeout)
+    options.setdefault("checkpoint_dir", spec.checkpoint_dir)
+    options.setdefault("checkpoint_sharded", True)
+    if spec.epoch > 0:
+        # elastic restore: latest complete sharded set, per-process
+        # row-range reads on the NEW (smaller) mesh; bounds re-seed and
+        # PHIterLimit keeps meaning TOTAL iterations
+        options["resume"] = spec.checkpoint_dir
+        options["elastic_epoch"] = spec.epoch
+    liveness = MeshLiveness(
+        rank=spec.rank, members=spec.members, n_original=spec.n_original,
+        port_base=spec.liveness_port_base, hosts=spec.hosts,
+        secret=spec.secret, epoch=spec.epoch,
+        stale_after=max(spec.timeout, 1.0)).start()
+    t_start = time.monotonic()
+    try:
+        # epoch > 0: a tighter register window — the survivors exec
+        # within seconds of each other (the coordinator last, see
+        # _await_peers_next_epoch), so a peer that fails to appear is
+        # dead and the failure should be bounded, not a 300s wait
+        initialize_backend(
+            spec.coordinator, len(spec.members), spec.process_id,
+            initialization_timeout=120 if spec.epoch > 0 else 300)
+        fabric = fabric_factory(spec) if fabric_factory else None
+        return distributed_wheel_hub(
+            all_scenario_names, scenario_creator,
+            scenario_creator_kwargs=scenario_creator_kwargs,
+            options=options, fabric=fabric, spoke_roles=spoke_roles,
+            is_minimizing=is_minimizing)
+    except ControllerLost as e:
+        if isinstance(e, MeshMajorityLost):
+            _die_typed(e)
+        detect = getattr(e, "elapsed", time.monotonic() - t_start)
+        _log.warning("epoch %d: %s", spec.epoch, e)
+        try:
+            survivors = agree_survivors(liveness)
+        except ControllerLost as e2:     # majority lost / no convergence
+            _die_typed(e2)
+        if spec.rank == spec.members[0]:
+            # THIS process hosts the epoch's coordination service: its
+            # exec must come LAST or it kills the other survivors
+            _await_peers_next_epoch(liveness, survivors, spec.epoch + 1,
+                                    4.0 * liveness.stale_after)
+        # never jax.distributed.shutdown() here: with a dead peer the
+        # shutdown barrier LOG(FATAL)s the process (measured) — the exec
+        # replaces the image, which is the only clean teardown there is
+        remesh_exec(spec, survivors, detect)
+        raise AssertionError("unreachable: execve returned")  # pragma: no cover
+    finally:
+        liveness.close()
+
+
+#: process exit code of a NON-RECOVERABLE elastic failure (majority
+#: loss, survivor agreement not converging): the typed error is printed,
+#: then the process exits WITHOUT running C++ destructors — with a dead
+#: peer, the jax coordination client's destructor aborts the process
+#: through its shutdown barrier (LOG(FATAL), rc=-6, measured on this
+#: toolchain), which would bury the typed diagnosis under a crash
+ELASTIC_FATAL_EXIT = 13
+
+
+def _die_typed(exc: ControllerLost):
+    """Fail LOUDLY with the typed error, not a hang and not an abort:
+    print the diagnosis, flush, and exit with :data:`ELASTIC_FATAL_EXIT`
+    before interpreter teardown can reach the coordination client's
+    aborting destructor.  Raises instead when no distributed backend is
+    initialized (nothing to abort — normal exception semantics)."""
+    import jax
+
+    if jax._src.distributed.global_state.client is None:
+        raise exc
+    _log.warning("NON-RECOVERABLE elastic failure: %s", exc)
+    print(f"ELASTIC-FATAL {type(exc).__name__}: {exc}",
+          file=sys.stderr, flush=True)
+    sys.stdout.flush()
+    os._exit(ELASTIC_FATAL_EXIT)

@@ -64,6 +64,7 @@ _CTR_WRITES = _metrics.counter("checkpoint.writes")
 _CTR_WRITE_ERRORS = _metrics.counter("checkpoint.write_errors")
 _CTR_COALESCED = _metrics.counter("checkpoint.coalesced")
 _CTR_RESTORES = _metrics.counter("checkpoint.restores")
+_CTR_CORRUPT_SKIPPED = _metrics.counter("checkpoint.corrupt_skipped")
 _HIST_WRITE_SECS = _metrics.histogram("checkpoint.write_secs")
 
 
@@ -233,19 +234,108 @@ def list_checkpoints(directory: str) -> list:
     return sorted(out)
 
 
-def latest(directory: str) -> str | None:
-    """Path of the newest checkpoint in ``directory`` (None when empty)."""
-    cks = list_checkpoints(directory)
-    return cks[-1][1] if cks else None
+def _read_npy_header(f):
+    """(shape, dtype) of one npy stream — public numpy surface first,
+    the private helper only as the fallback (upstream drift must not be
+    able to fail verification, see :func:`verify`)."""
+    from numpy.lib import format as _npf
+
+    version = _npf.read_magic(f)
+    if version == (1, 0):
+        shape, _fortran, dtype = _npf.read_array_header_1_0(f)
+    elif version == (2, 0):
+        shape, _fortran, dtype = _npf.read_array_header_2_0(f)
+    else:
+        shape, _fortran, dtype = _npf._read_array_header(f, version)
+    return shape, dtype
+
+
+def _verify_member(path: str):
+    """Integrity check of ONE npz checkpoint file without reading array
+    data: the zip central directory must be present (truncation chops it
+    off — it lives at the END of the file), ``meta`` must parse, and
+    every array the meta declares must have a parseable npy header whose
+    payload size matches its (stored, uncompressed) zip entry.  Raises
+    on any mismatch."""
+    import zipfile
+
+    with zipfile.ZipFile(path) as zf:
+        names = set(zf.namelist())
+        if "meta.npy" not in names:
+            raise ValueError(f"{path}: no meta member")
+        with zf.open("meta.npy") as f:
+            meta = json.loads(str(np.lib.format.read_array(
+                f, allow_pickle=False)[()]))
+        for fld in meta.get("arrays", []):
+            nm = fld + ".npy"
+            if nm not in names:
+                raise ValueError(f"{path}: declared array {fld!r} missing")
+            info = zf.getinfo(nm)
+            with zf.open(nm) as f:
+                shape, dtype = _read_npy_header(f)
+                expect = f.tell() + int(np.prod(shape)) * dtype.itemsize
+            if info.file_size != expect:
+                raise ValueError(
+                    f"{path}: array {fld!r} is {info.file_size} bytes, "
+                    f"header promises {expect} — truncated/corrupt")
+
+
+#: the exception classes that MEAN "this file is corrupt" — everything
+#: else (NFS blips, numpy API drift, ...) must NOT be read as corruption
+_CORRUPT_ERRORS = (ValueError, KeyError, EOFError)
+
+
+def verify(path: str) -> bool:
+    """True when the checkpoint ARTIFACT at ``path`` (every shard sibling
+    when it names a sharded-set member) passes the size + per-array
+    header check — cheap enough for the resume walk, strong enough to
+    catch a truncated/torn file before it raises out of a restore.
+
+    FAIL-OPEN on unexpected errors: only genuine corruption signatures
+    (bad zip, unparsable meta/header, size mismatch) report False.  An
+    environmental or drift failure (transient IO, a numpy rename) says
+    True and lets :func:`load` fail loud instead — a blanket "corrupt"
+    verdict here would make ``latest()`` skip EVERY set, the resume
+    silently cold-start, and the manager's ``fresh_start`` then DELETE
+    the healthy snapshots."""
+    import zipfile
+
+    for p in (_shard_sibling_names(path) or [path]):
+        try:
+            _verify_member(p)
+        except (zipfile.BadZipFile, *_CORRUPT_ERRORS):
+            return False
+        except Exception as e:      # fail open, loudly
+            _log.warning("checkpoint verification of %s errored (%r) — "
+                         "treating as valid; the load will decide", p, e)
+    return True
+
+
+def latest(directory: str, verify_integrity: bool = True) -> str | None:
+    """Path of the newest VALID checkpoint in ``directory`` (None when
+    empty).  A corrupt/truncated newest set — e.g. filesystem damage
+    after the atomic rename — is skipped loudly
+    (``checkpoint.corrupt_skipped``) and the previous complete set
+    serves instead of the resume crashing out of ``load``."""
+    for _it, p in reversed(list_checkpoints(directory)):
+        if not verify_integrity or verify(p):
+            return p
+        _CTR_CORRUPT_SKIPPED.inc(1)
+        _log.warning("checkpoint %s failed integrity verification — "
+                     "falling back to the previous complete set", p)
+    return None
 
 
 def load_latest(path: str) -> WheelCheckpoint | None:
-    """Load ``path`` directly (a file) or its newest checkpoint (a
-    directory).  None when nothing is there — callers treat a missing
-    checkpoint as a cold start, which is what ``--resume`` on a first run
-    must mean.  A sharded set loads ASSEMBLED (all rows on this host);
-    big-S callers that must never materialize the full state use
-    :class:`ShardedCheckpointReader` / :func:`restore_sharded_array`."""
+    """Load ``path`` directly (a file) or its newest VALID checkpoint (a
+    directory — corrupt sets are skipped with a
+    ``checkpoint.corrupt_skipped`` count; an explicitly named FILE still
+    fails loud, the caller pinned it).  None when nothing is there —
+    callers treat a missing checkpoint as a cold start, which is what
+    ``--resume`` on a first run must mean.  A sharded set loads
+    ASSEMBLED (all rows on this host); big-S callers that must never
+    materialize the full state use :class:`ShardedCheckpointReader` /
+    :func:`restore_sharded_array`."""
     if path is None:
         return None
     if os.path.isdir(path):

@@ -1,11 +1,16 @@
-"""Deterministic fault injection: dead spokes, dropped TCP reads, stale ids.
+"""Deterministic fault injection: dead spokes, dropped TCP reads, stale ids,
+dead controllers, fabric partitions, slow collectives.
 
 Recovery paths that are only exercised by real outages rot silently.
-This harness injects the three failure classes the resilience layer
+This harness injects the failure classes the resilience layer
 handles — a spoke dying mid-run, a transient TCP window-service IO
-failure, and a mailbox serving stale write-ids — at DETERMINISTIC points
-(payload counts, read counts), so tests prove the degradation and
-retry/reconnect machinery instead of hoping for it.
+failure, a mailbox serving stale write-ids, and (controller-grade, for
+the elastic mesh of :mod:`tpusppy.parallel.elastic`) a CONTROLLER
+process dying at an exact wheel iteration, a permanent TCP fabric
+partition, and delayed collectives — at DETERMINISTIC points
+(payload counts, read counts, iteration numbers), so tests prove the
+degradation and retry/reconnect/re-mesh machinery instead of hoping
+for it.
 
 Usage (tests/test_resilience.py is the living example)::
 
@@ -29,12 +34,21 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import os
+import signal
 import threading
 import time
 
 from ..obs import metrics as _metrics
 
 KILL_ID = -1
+
+
+def _self_sigkill():          # module hook so unit tests can observe the
+    os.kill(os.getpid(), signal.SIGKILL)    # decision without dying
+
+
+_SELF_KILL = _self_sigkill
 
 
 class InjectedFault(RuntimeError):
@@ -63,12 +77,30 @@ class FaultPlan:
       :mod:`tpusppy.runtime.tcp_window_service`).
     delay_reads: {mailbox name or "*": secs} — sleep before each read
       (slow-network emulation; bounded by the caller's own timeouts).
+    kill_controller: {process index (int) or "*": iteration} — SIGKILL
+      THIS process (for real — no cleanup, no atexit) the moment the
+      distributed wheel reaches that iteration, via the
+      ``on_controller_iter`` hook in ``dist_wheel``.  The deterministic
+      sibling of the chaos smoke's external SIGKILL; drives the elastic
+      detection/re-mesh path (:mod:`tpusppy.parallel.elastic`) in tests.
+    partition_tcp: {mailbox/channel name or "*": True} — EVERY op on
+      that channel fails with connection-lost from now on (a network
+      partition, not a transient blip): the retry budget exhausts and
+      the error propagates, which is how a wedged-but-reachable peer
+      looks to the liveness protocol.
+    delay_collectives: secs — sleep before each watchdog-guarded mesh
+      collective (slow-fabric emulation; a delay under
+      ``TPUSPPY_MESH_TIMEOUT`` must NOT trip the watchdog, over it
+      must).
     """
 
     kill_spoke: dict = dataclasses.field(default_factory=dict)
     stale_mailbox: dict = dataclasses.field(default_factory=dict)
     drop_tcp: dict = dataclasses.field(default_factory=dict)
     delay_reads: dict = dataclasses.field(default_factory=dict)
+    kill_controller: dict = dataclasses.field(default_factory=dict)
+    partition_tcp: dict = dataclasses.field(default_factory=dict)
+    delay_collectives: float = 0.0
 
 
 _PLAN: FaultPlan | None = None
@@ -95,7 +127,8 @@ def arm(plan: FaultPlan):
     # can be reused across tests without carrying decremented state
     plan = dataclasses.replace(
         plan, stale_mailbox=dict(plan.stale_mailbox),
-        drop_tcp=dict(plan.drop_tcp))
+        drop_tcp=dict(plan.drop_tcp),
+        partition_tcp=dict(plan.partition_tcp))
     _PLAN = plan
     return plan
 
@@ -163,8 +196,9 @@ def _budget(table: dict, name: str) -> bool:
 
 def on_tcp_io(name: str):
     """Called inside each TCP window op attempt: sleeps (delay plan) and
-    raises a transient connection-lost error (drop plan) so the bounded
-    retry/backoff/reconnect path is exercised on demand."""
+    raises a transient connection-lost error (drop plan) or a PERMANENT
+    one (partition plan) so the bounded retry/backoff/reconnect path —
+    and its exhaustion — is exercised on demand."""
     plan = _PLAN
     if plan is None:
         return
@@ -173,10 +207,46 @@ def on_tcp_io(name: str):
         if secs:
             _record("delayed_reads")
             time.sleep(float(secs))
+    if plan.partition_tcp and (plan.partition_tcp.get(name)
+                               or plan.partition_tcp.get("*")):
+        # a partition is not a budgeted blip: every op fails until the
+        # plan is disarmed — retries exhaust, the error propagates, and
+        # the peer looks DEAD to liveness without any process dying
+        _record("partitioned_ops")
+        raise InjectedFault(
+            f"TCP window service connection lost (injected partition, "
+            f"box {name})")
     if plan.drop_tcp and _budget(plan.drop_tcp, name):
         _record("tcp_drops")
         raise InjectedFault(
             f"TCP window service connection lost (injected, box {name})")
+
+
+def on_controller_iter(process_index: int, iteration: int):
+    """Called by the distributed wheel loop at the top of every
+    iteration: SIGKILLs THIS controller process when the plan schedules
+    its death at (or before) ``iteration`` — a real uncatchable kill,
+    exactly what the elastic recovery path must survive on the OTHER
+    controllers."""
+    plan = _PLAN
+    if plan is None or not plan.kill_controller:
+        return
+    k = plan.kill_controller.get(int(process_index),
+                                 plan.kill_controller.get("*"))
+    if k is not None and iteration >= int(k):
+        _record("controller_kills")
+        _SELF_KILL()
+
+
+def on_collective(what: str = ""):
+    """Called before each watchdog-guarded mesh collective: injects the
+    configured delay (slow-fabric emulation — under the mesh timeout it
+    must be absorbed, over it the watchdog must fire)."""
+    plan = _PLAN
+    if plan is None or not plan.delay_collectives:
+        return
+    _record("delayed_collectives")
+    time.sleep(float(plan.delay_collectives))
 
 
 def active() -> bool:

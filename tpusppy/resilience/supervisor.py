@@ -78,12 +78,31 @@ class SpokeSupervisor:
     (death-based loss is always on): a spoke legitimately deep in a host
     MILP makes no mailbox progress for minutes, so the timeout is an
     operator knob, not a default.
+
+    Staleness is judged on the MONOTONIC clock with a LOAD-ADAPTIVE
+    grace: while the sync loop is healthy (inter-``observe`` latency
+    within ``timeout_secs``) the operator's window applies UNCHANGED;
+    only when the loop itself stalls PAST the window — meaning no valid
+    observation could have happened inside it, so any verdict would be
+    about the machine, not the spoke — does the effective timeout widen
+    to ``grace_factor × observed latency`` (latency = max of the EWMA
+    and the latest gap).  Under full-suite CPU contention the hub's own
+    loop stalls for seconds at a time — if the observer was starved,
+    the spokes were starved too, and a fixed window read that as
+    "wedged" (the PR-5 heartbeat false positive that slow-marked the
+    dist resume leg).  A wheel whose ROUTINE cadence merely approaches
+    the window keeps the configured semantics.
     """
 
-    def __init__(self, fabric, spoke_names: dict, timeout_secs=None):
+    def __init__(self, fabric, spoke_names: dict, timeout_secs=None,
+                 grace_factor: float = 8.0):
         self.fabric = fabric
         self.timeout_secs = (None if timeout_secs in (None, 0)
                              else float(timeout_secs))
+        self.grace_factor = float(grace_factor)
+        self._last_observe = None
+        self._latency_ewma = 0.0
+        self._latency_last = 0.0
         self._lock = threading.Lock()
         self._watch = {int(i): _Watch(str(nm))
                        for i, nm in (spoke_names or {}).items()}
@@ -108,12 +127,30 @@ class SpokeSupervisor:
         self._mark_lost(idx, "crashed")
 
     # ---- observation (hub sync cadence) ------------------------------------
+    def effective_timeout(self):
+        """The staleness window actually applied this pass: the operator
+        knob, widened ONLY when the observe loop itself stalled past it
+        (None = staleness loss disabled)."""
+        if self.timeout_secs is None:
+            return None
+        lat = max(self._latency_ewma, self._latency_last)
+        if self.grace_factor <= 0 or lat <= self.timeout_secs:
+            return self.timeout_secs
+        return self.grace_factor * lat
+
     def observe(self):
         """One health pass over every non-lost spoke; called by the hub
         each sync.  Reads are mailbox write-ids and gauges — never a
         device or network round-trip beyond what the fabric's write_id
         accessor costs."""
         now = time.monotonic()
+        if self._last_observe is not None:
+            dt = now - self._last_observe
+            self._latency_last = dt
+            self._latency_ewma = (dt if self._latency_ewma == 0.0
+                                  else 0.8 * self._latency_ewma + 0.2 * dt)
+        self._last_observe = now
+        eff_timeout = self.effective_timeout()
         for idx, w in list(self._watch.items()):
             if w.lost:
                 continue
@@ -135,8 +172,8 @@ class SpokeSupervisor:
                    (w.proc is not None and w.proc.exitcode is not None)
             if dead:
                 self._mark_lost(idx, "died")
-            elif (self.timeout_secs is not None
-                    and now - w.last_progress > self.timeout_secs):
+            elif (eff_timeout is not None
+                    and now - w.last_progress > eff_timeout):
                 self._mark_lost(idx, "wedged")
 
     def _mark_lost(self, idx: int, reason: str):

@@ -29,6 +29,7 @@
 // the hub side — same mutexes, no sockets.
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -50,7 +51,15 @@ namespace {
 
 constexpr int64_t kKillId = -1;
 constexpr int64_t kLenErr = -2;
+constexpr int64_t kIoErr = -4;
+constexpr int64_t kTimeoutErr = -5;
 constexpr uint64_t kMagic = 0x7470757370707931ULL;  // "tpusppy1"
+
+// Every socket is close-on-exec: an elastic re-mesh replaces the process
+// image with execve (tpusppy/parallel/elastic.py), and a leaked listen fd
+// would keep the port bound forever — the re-exec'd process could never
+// re-serve its liveness/fabric endpoint.
+void set_cloexec(int fd) { fcntl(fd, F_SETFD, FD_CLOEXEC); }
 
 struct Request {
   uint8_t op;
@@ -94,6 +103,7 @@ struct Handle {
   Server* server = nullptr;  // set for the hub-side handle
   int sock = -1;             // set for client handles
   std::mutex io_mu;          // one request in flight per client
+  int64_t op_timeout_ms = 0;  // 0 = block forever (legacy behavior)
 };
 
 bool read_full(int fd, void* buf, size_t n) {
@@ -240,6 +250,7 @@ void accept_loop(Server* s) {
       if (errno == EINTR) continue;
       return;  // listener closed
     }
+    set_cloexec(fd);
     std::lock_guard<std::mutex> lock(s->conn_mu);
     // reap finished connections before tracking the new one
     for (auto it = s->conns.begin(); it != s->conns.end();) {
@@ -269,6 +280,7 @@ void* tws_serve(const char* bind_addr, int port, int n_boxes,
                 const int64_t* lengths, uint64_t secret) {
   int fd = socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return nullptr;
+  set_cloexec(fd);
   int one = 1;
   setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
@@ -317,6 +329,7 @@ void* tws_connect(const char* host, int port, int64_t timeout_ms,
     addrinfo* res = nullptr;
     if (getaddrinfo(host, portstr, &hints, &res) == 0 && res != nullptr) {
       int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      if (fd >= 0) set_cloexec(fd);
       if (fd >= 0 &&
           connect(fd, res->ai_addr, static_cast<socklen_t>(res->ai_addrlen))
               == 0) {
@@ -359,22 +372,56 @@ int tws_port(void* handle) {
   return h->server ? h->server->port : -1;
 }
 
+// Per-op deadline for CLIENT handles (ms; 0 restores blocking forever).
+// After the deadline an op returns kTimeoutErr and the connection is
+// closed (frame desync) — the caller must reconnect.  Server handles are
+// local mutexed memory: the deadline is meaningless there (no-op).
+int tws_set_op_timeout(void* handle, int64_t timeout_ms) {
+  auto* h = static_cast<Handle*>(handle);
+  if (h->server) return 0;
+  h->op_timeout_ms = timeout_ms < 0 ? 0 : timeout_ms;
+  if (h->sock < 0) return -1;
+  timeval tv{static_cast<time_t>(h->op_timeout_ms / 1000),
+             static_cast<suseconds_t>((h->op_timeout_ms % 1000) * 1000)};
+  setsockopt(h->sock, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(h->sock, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  return 0;
+}
+
+// One client op failed mid-frame: the connection is out of sync (a late
+// reply to the timed-out request would be parsed as the NEXT op's reply),
+// so it is closed and invalidated here, never reused.  EAGAIN/EWOULDBLOCK
+// means the op deadline (tws_set_op_timeout) expired on a connected-but-
+// unresponsive server — the wedged-server case a plain IO error can't
+// name — and is reported distinctly as kTimeoutErr.
+static int64_t client_fail(Handle* h) {
+  // gate the timeout classification on an ARMED deadline: an orderly
+  // server close (recv()==0) leaves errno untouched, so a stale EAGAIN
+  // from unrelated earlier I/O must not masquerade as "op timed out"
+  const bool timed_out = h->op_timeout_ms > 0 &&
+                         (errno == EAGAIN || errno == EWOULDBLOCK);
+  close(h->sock);
+  h->sock = -1;
+  return timed_out ? kTimeoutErr : kIoErr;
+}
+
 static int64_t request_reply(Handle* h, uint8_t op, int box, int64_t n,
                              const double* in, double* out) {
   std::lock_guard<std::mutex> lock(h->io_mu);
+  if (h->sock < 0) return kIoErr;
   Request req{};
   req.op = op;
   req.box = box;
   req.n = n;
-  if (!write_full(h->sock, &req, sizeof(req))) return -4;
+  if (!write_full(h->sock, &req, sizeof(req))) return client_fail(h);
   if (op == 1 && n > 0 &&
       !write_full(h->sock, in, n * sizeof(double)))
-    return -4;
+    return client_fail(h);
   int64_t id;
-  if (!read_full(h->sock, &id, sizeof(id))) return -4;
+  if (!read_full(h->sock, &id, sizeof(id))) return client_fail(h);
   if (op == 2 && id != kLenErr &&
       !read_full(h->sock, out, n * sizeof(double)))
-    return -4;
+    return client_fail(h);
   return id;
 }
 
@@ -382,14 +429,15 @@ static int64_t request_reply(Handle* h, uint8_t op, int box, int64_t n,
 // which must be fully drained to keep the connection framed.
 static int64_t client_info(Handle* h, std::vector<int64_t>* lens_out) {
   std::lock_guard<std::mutex> lock(h->io_mu);
+  if (h->sock < 0) return kIoErr;
   Request req{};
   req.op = 5;
-  if (!write_full(h->sock, &req, sizeof(req))) return -4;
+  if (!write_full(h->sock, &req, sizeof(req))) return client_fail(h);
   int64_t nb;
-  if (!read_full(h->sock, &nb, sizeof(nb))) return -4;
+  if (!read_full(h->sock, &nb, sizeof(nb))) return client_fail(h);
   std::vector<int64_t> lens(static_cast<size_t>(nb));
   if (!read_full(h->sock, lens.data(), lens.size() * sizeof(int64_t)))
-    return -4;
+    return client_fail(h);
   if (lens_out) *lens_out = std::move(lens);
   return nb;
 }

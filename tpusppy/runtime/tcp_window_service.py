@@ -30,6 +30,7 @@ import time
 import numpy as np
 
 from ..obs import metrics as _obs_metrics
+from ..obs.log import get_logger
 from ..resilience import faults as _faults
 
 _SRC = os.path.join(os.path.dirname(__file__), "csrc",
@@ -38,10 +39,12 @@ _LIB_PATH = os.path.join(os.path.dirname(__file__), "csrc",
                          "libtcp_window_service.so")
 _lib = None
 _lib_lock = threading.Lock()
+_log = get_logger("tcp_window")
 
 KILL_ID = -1
 _LEN_ERR = -2
 _IO_ERR = -4
+_TIMEOUT_ERR = -5
 
 # mid-run fault tolerance knobs (doc/resilience.md): a CLIENT endpoint
 # retries a failed op with bounded exponential backoff, reconnecting
@@ -56,6 +59,18 @@ _BACKOFF_CAP = float(os.environ.get("TPUSPPY_TCP_BACKOFF_CAP", "5.0"))
 _CTR_IO_ERRORS = _obs_metrics.counter("tcp_window.io_errors")
 _CTR_RETRIES = _obs_metrics.counter("tcp_window.retries")
 _CTR_RECONNECTS = _obs_metrics.counter("tcp_window.reconnects")
+_CTR_OP_TIMEOUTS = _obs_metrics.counter("tcp_window.op_timeouts")
+
+
+def default_op_timeout() -> float:
+    """Per-op client deadline in seconds (``TPUSPPY_TCP_OP_TIMEOUT``;
+    0 = block forever, the legacy behavior).  Read at endpoint
+    construction, not import, so tests and the elastic wheel can arm it
+    per run.  Bounds the wedged-yet-connected-server hang the plain IO
+    retry path cannot see: a dead connection errors, a wedged server
+    simply never replies (runtime/csrc/tcp_window_service.cpp keeps the
+    server-side analogue note)."""
+    return float(os.environ.get("TPUSPPY_TCP_OP_TIMEOUT", "0") or 0.0)
 
 
 def load_library() -> ctypes.CDLL:
@@ -96,6 +111,8 @@ def load_library() -> ctypes.CDLL:
         lib.tws_get.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                 ctypes.POINTER(ctypes.c_double),
                                 ctypes.c_int64]
+        lib.tws_set_op_timeout.restype = ctypes.c_int
+        lib.tws_set_op_timeout.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.tws_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
@@ -112,8 +129,10 @@ class TcpEndpoint:
 
     def __init__(self, lengths=None, port: int = 0, connect=None,
                  connect_timeout: float = 60.0, bind: str = "127.0.0.1",
-                 secret: int | None = None):
+                 secret: int | None = None, op_timeout: float | None = None):
         self._lib = load_library()
+        self.op_timeout = (default_op_timeout() if op_timeout is None
+                           else float(op_timeout))
         if connect is not None:
             host, prt = connect
             self.secret = int(secret or 0)
@@ -128,6 +147,9 @@ class TcpEndpoint:
                     f"(down, or shared secret rejected)")
             self.port = int(prt)
             self.is_server = False
+            self._handle = ctypes.c_void_p(handle)
+            self._apply_op_timeout()
+            return
         else:
             if secret is None:
                 import secrets as _secrets
@@ -144,8 +166,14 @@ class TcpEndpoint:
             self.is_server = True
             self._handle = ctypes.c_void_p(handle)
             self.port = int(self._lib.tws_port(self._handle))
-            return
-        self._handle = ctypes.c_void_p(handle)
+
+    def _apply_op_timeout(self):
+        """Install the per-op deadline on the live client socket (called
+        after every connect/reconnect — the C side stores it per handle,
+        and a fresh handle starts blocking)."""
+        if self.op_timeout and getattr(self, "_handle", None):
+            self._lib.tws_set_op_timeout(
+                self._handle, int(self.op_timeout * 1000))
 
     @property
     def num_boxes(self) -> int:
@@ -155,6 +183,18 @@ class TcpEndpoint:
         return self._check(self._lib.tws_length(self._handle, box))
 
     def _check(self, rc: int) -> int:
+        if rc == _TIMEOUT_ERR:
+            # connected but unresponsive: the op deadline expired and the
+            # C side closed the (desynced) connection — loud by contract
+            _CTR_OP_TIMEOUTS.inc(1)
+            _CTR_IO_ERRORS.inc(1)
+            _log.warning("window-service op timed out after %.1fs "
+                         "(TPUSPPY_TCP_OP_TIMEOUT) — connection dropped",
+                         self.op_timeout)
+            raise RuntimeError(
+                f"TCP window service op timed out after "
+                f"{self.op_timeout:.1f}s (server wedged?); "
+                "connection lost")
         if rc == _IO_ERR:
             _CTR_IO_ERRORS.inc(1)
             raise RuntimeError("TCP window service connection lost")
@@ -180,6 +220,7 @@ class TcpEndpoint:
             raise RuntimeError(
                 f"reconnect to window service at {host}:{prt} failed")
         self._handle = ctypes.c_void_p(handle)
+        self._apply_op_timeout()
         _CTR_RECONNECTS.inc(1)
 
     def drop_for_test(self):
@@ -301,18 +342,18 @@ class TcpWindowFabric:
 
     def __init__(self, spoke_lengths=None, port: int = 0, connect=None,
                  connect_timeout: float = 60.0, bind: str = "127.0.0.1",
-                 secret: int | None = None):
+                 secret: int | None = None, op_timeout: float | None = None):
         if connect is not None:
             self.ep = TcpEndpoint(connect=connect,
                                   connect_timeout=connect_timeout,
-                                  secret=secret)
+                                  secret=secret, op_timeout=op_timeout)
             n = self.ep.num_boxes // 2
         else:
             lengths = []
             for (h2s, s2h) in spoke_lengths:
                 lengths.extend([h2s, s2h])
             self.ep = TcpEndpoint(lengths=lengths, port=port, bind=bind,
-                                  secret=secret)
+                                  secret=secret, op_timeout=op_timeout)
             n = len(spoke_lengths)
         self.port = self.ep.port
         self.secret = self.ep.secret

@@ -210,7 +210,9 @@ class WheelSpinner:
         sup = _supervisor.SpokeSupervisor(
             fabric,
             {i + 1: c.__class__.__name__ for i, c in enumerate(spoke_comms)},
-            timeout_secs=self._hub_options().get("spoke_timeout_secs"))
+            timeout_secs=self._hub_options().get("spoke_timeout_secs"),
+            grace_factor=float(self._hub_options().get(
+                "spoke_timeout_grace", 8.0)))
         if spoke_comms:
             hub_comm.attach_supervisor(sup)
         global_toc(
