@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Serving smoke: the wheel-as-a-service warm path, end to end.
+
+Nightly CI acceptance for ``tpusppy/service`` (doc/serving.md), runnable
+locally::
+
+    JAX_PLATFORMS=cpu python scripts/serving_smoke.py
+
+One long-lived :class:`~tpusppy.service.SolveServer` receives FOUR
+concurrent requests forming two isomorphic pairs across two model
+families (farmer + uc-lite).  Asserts the serving contract:
+
+- every request completes CERTIFIED (rel_gap <= target) with a full SLO
+  record (queue wait / ttfi / compile_s / iters/s / gap / wall);
+- the SECOND member of each pair binds warm: ``aot.misses`` delta == 0
+  and zero compile seconds — the executables compiled for the first
+  member serve the isomorphic repeat;
+- the warm farmer request reaches iter-1 at least ``SMOKE_SPEEDUP``x
+  (default 3, the PR-7 nightly bar) faster than its cold twin did;
+- concurrency is real: with a sub-second quantum at least one
+  preempt-park-resume cycle fires, and bounds stay monotone across it;
+- shutdown is clean: queue drained, executor joined, no tenant left
+  running, the content-keyed device caches released (no orphan device
+  state).
+
+Prints one JSON line with the measured figures.  Exit 0 = pass.  A hard
+deadline (``SMOKE_DEADLINE_SECS``, default 900) ``os._exit(2)``s a
+wedged run so CI never hangs.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SPEEDUP = float(os.environ.get("SMOKE_SPEEDUP", "3.0"))
+DEADLINE = float(os.environ.get("SMOKE_DEADLINE_SECS", "900"))
+
+
+def _arm_watchdog():
+    def _bomb():
+        time.sleep(DEADLINE)
+        print(json.dumps({"ok": False, "error": "deadline exceeded"}),
+              flush=True)
+        os._exit(2)
+
+    threading.Thread(target=_bomb, daemon=True).start()
+
+
+def main():
+    _arm_watchdog()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import tempfile
+
+    from tpusppy.service import SolveRequest, SolveServer
+
+    work = tempfile.mkdtemp(prefix="serving_smoke_")
+    reqs = [
+        SolveRequest(model="farmer", num_scens=4,
+                     creator_kwargs={"seedoffset": 0},
+                     options={"PHIterLimit": 80}),
+        SolveRequest(model="uc_lite", num_scens=3,
+                     creator_kwargs={"num_gens": 2, "horizon": 4,
+                                     "relax_integers": True,
+                                     "seedoffset": 0},
+                     options={"PHIterLimit": 300, "rel_gap": 5e-3}),
+        SolveRequest(model="farmer", num_scens=4,
+                     creator_kwargs={"seedoffset": 901},
+                     options={"PHIterLimit": 80}),
+        SolveRequest(model="uc_lite", num_scens=3,
+                     creator_kwargs={"num_gens": 2, "horizon": 4,
+                                     "relax_integers": True,
+                                     "seedoffset": 44},
+                     options={"PHIterLimit": 300, "rel_gap": 5e-3}),
+    ]
+    srv = SolveServer(work_dir=work, quantum_secs=1.5, linger_secs=45.0)
+    t0 = time.time()
+    rids = [srv.submit(r) for r in reqs]
+    recs = [srv.result(r, timeout=DEADLINE - 60) for r in rids]
+    wall = time.time() - t0
+
+    failures = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    slo_keys = ("queue_wait_s", "ttfi_s", "compile_s", "iters_per_sec",
+                "rel_gap", "wall_s", "aot_misses", "slices")
+    for rec in recs:
+        check(rec["status"] == "done", f"{rec['request_id']}: {rec['status']}")
+        check(rec["certified"],
+              f"{rec['request_id']} uncertified (gap {rec['rel_gap']})")
+        check(rec["bounds_monotone"],
+              f"{rec['request_id']} bounds regressed across a resume")
+        check(all(rec.get(k) is not None for k in slo_keys),
+              f"{rec['request_id']} SLO record incomplete: {rec}")
+    # pair warmness: zero recompiles after the first of each family
+    for cold, warmr in ((recs[0], recs[2]), (recs[1], recs[3])):
+        check(cold["aot_misses"] > 0,
+              f"cold {cold['request_id']} compiled nothing?")
+        check(warmr["warm_hit"], f"{warmr['request_id']} not warm")
+        check(warmr["aot_misses"] == 0,
+              f"{warmr['request_id']} recompiled "
+              f"({warmr['aot_misses']} misses)")
+        check(warmr["compile_s"] == 0.0,
+              f"{warmr['request_id']} spent {warmr['compile_s']}s compiling")
+    # the PR-7 bar, through the serving path: warm time-to-iter-1
+    ttfi_cold, ttfi_warm = recs[0]["ttfi_s"], recs[2]["ttfi_s"]
+    if ttfi_cold is None or ttfi_warm is None:
+        check(False, f"ttfi missing (cold={ttfi_cold}, warm={ttfi_warm})")
+        ttfi_cold, ttfi_warm = float("nan"), float("nan")
+    else:
+        check(ttfi_warm * SPEEDUP <= ttfi_cold,
+              f"warm ttfi {ttfi_warm:.3f}s not {SPEEDUP}x faster than "
+              f"cold {ttfi_cold:.3f}s")
+    # real time-slicing under a sub-second quantum
+    preempts = sum(r["preemptions"] for r in recs)
+    check(preempts >= 1, "no preempt-park-resume cycle fired")
+    summary = srv.slo_summary()
+    check(summary["completed"] == 4, f"summary: {summary}")
+    check(summary["p95_latency_s"] is not None, "no latency percentiles")
+
+    srv.shutdown()
+    from tpusppy import spopt
+
+    check(not srv._executor.is_alive(), "executor still alive after shutdown")
+    check(all(t.status == "done" for t in srv._tenants.values()),
+          "tenant left unfinished at shutdown")
+    check(len(spopt._DEV_A_CACHE) == 0,
+          "device-A cache not released at shutdown")
+
+    out = {
+        "ok": not failures, "failures": failures, "wall_s": round(wall, 2),
+        "ttfi_cold_s": round(ttfi_cold, 3), "ttfi_warm_s": round(ttfi_warm, 4),
+        "warm_speedup": round(ttfi_cold / max(ttfi_warm, 1e-9), 1),
+        "preemptions": preempts,
+        "gaps": [None if r["rel_gap"] is None else round(r["rel_gap"], 6)
+                 for r in recs],
+        "p50_latency_s": summary["p50_latency_s"],
+        "p95_latency_s": summary["p95_latency_s"],
+        "warm_hit_rate": summary["warm_hit_rate"],
+    }
+    print(json.dumps(out), flush=True)
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -82,8 +82,14 @@ def main():
         names, farmer.scenario_creator,
         scenario_creator_kwargs={"num_scens": n},
         options=options, fabric=None, spoke_roles=[])
+    from tpusppy.obs import metrics as _m
+
     out = {"pid": pid, "outer": res.BestOuterBound, "conv": res.conv,
-           "eobj": res.eobj, "iters": res.iters}
+           "eobj": res.eobj, "iters": res.iters,
+           # shard-local consensus routing pin (ROADMAP item 1): this
+           # controller's device->host consensus traffic, O(S/nproc)
+           "consensus_doubles": _m.value(
+               "dist_wheel.consensus_local_doubles")}
     if ckpt_dir:
         from tpusppy.obs import metrics as _metrics
 

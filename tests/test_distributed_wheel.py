@@ -167,7 +167,21 @@ def test_two_process_hub_smoke():
     must report identical fully-reduced results.  This path found two
     deadlock classes and previously had no routine (non-slow) coverage —
     the full TCP-fabric wheel stays in the slow tier."""
-    _run_smoke_workers({}, timeout=120)
+    r0, r1 = _run_smoke_workers({}, timeout=120)
+    # shard-local consensus routing (ROADMAP item 1): each controller's
+    # device->host consensus traffic is EXACTLY its own row slice —
+    # per iteration, (S/nproc) rows of W (K cols) + (S/nproc) rows of x
+    # (n cols), never the full replicated (S, K)/(S, n) state.
+    from tpusppy.models import farmer
+
+    p0 = farmer.scenario_creator("scen0", num_scens=8)
+    n_vars = p0.num_vars
+    K = len(p0.nodes[0].nonant_indices)
+    rows_pp = 8 // 2                       # S=8 over 2 controllers
+    per_iter = rows_pp * (K + n_vars)
+    for r in (r0, r1):
+        assert r["consensus_doubles"] == r["iters"] * per_iter, \
+            (r["consensus_doubles"], r["iters"], per_iter)
 
 
 def test_two_process_hub_checkpoint_resume(tmp_path):
@@ -319,6 +333,23 @@ def test_elastic_reshard_parity_3_to_2_controllers(tmp_path):
 
 @pytest.mark.slow
 def test_two_controller_hub_wheel_certifies():
+    """POST-MORTEM (the PR-12 fix; this test aborted deterministically
+    before it): the consensus fetch used to be two back-to-back
+    separately-jitted single-collective programs — replicate(W) then
+    replicate(x).  Separately lowered single-collective programs get the
+    SAME collective channel id, and XLA:CPU's Gloo adapter derives its
+    op slots from the channel — so when one controller lagged inside the
+    W all-gather while its peer (having finished W locally) dispatched
+    the x all-gather, the peer's x payload (4 local rows x 11 vars = 44
+    doubles) landed against the W gather's posted 12-double (4 x K=3)
+    receive and Gloo aborted the whole job: "op.preamble.length <=
+    op.nbytes. 44 vs 12".  The abort needed receiver-side lag, so it
+    fired only in the busiest posture (2 controllers x 4 devices + live
+    TCP spokes + bound traffic) and always a few iterations in.  Fix:
+    ONE fused gather per fetch (shard-local row blocks concatenated into
+    a single host vector, one process_allgather) — no same-channel
+    adjacent programs left in the loop.  This test is the regression
+    gate; the fetch-size pin lives in test_two_process_hub_smoke."""
     coord_port, fabric_port = _free_port(), _free_port()
     secret = 0x5EC0DE5EC0DE
     ready = os.path.join(tempfile.gettempdir(),

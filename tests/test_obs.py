@@ -219,7 +219,7 @@ def test_histogram_and_gauge():
     for v in (1.0, 3.0, 2.0):
         h.add(v)
     assert h.summary() == {"count": 3, "total": 6.0, "min": 1.0,
-                           "max": 3.0}
+                           "max": 3.0, "p50": 2.0, "p95": 3.0, "p99": 3.0}
     metrics.gauge("g.test").set(4.5)
     d = metrics.dump()
     assert d["g.test"] == 4.5 and d["h.test"]["count"] == 3
@@ -227,6 +227,32 @@ def test_histogram_and_gauge():
     with metrics.window() as win:
         h.add(5.0)
     assert win.delta("h.test") == 5.0
+
+
+def test_histogram_quantiles_reservoir():
+    """Latency percentiles (serving SLOs): exact nearest-rank while the
+    stream fits the reservoir, sampled (still order-of-magnitude right)
+    past it, and reset restores determinism."""
+    h = metrics.histogram("h.quant")
+    for v in range(1, 101):
+        h.add(float(v))
+    s = h.summary()
+    assert s["p50"] == pytest.approx(50.0, abs=1.0)
+    assert s["p95"] == pytest.approx(95.0, abs=1.0)
+    assert s["p99"] == pytest.approx(99.0, abs=1.0)
+    # overflow the reservoir: quantiles stay sane under sampling
+    for v in range(101, 5001):
+        h.add(float(v))
+    s = h.summary()
+    assert len(h._samples) == metrics.Histogram.RESERVOIR_CAP
+    assert 1500.0 < s["p50"] < 3500.0
+    assert s["p99"] > 4000.0
+    # deterministic across identical insert streams
+    h.reset()
+    for v in range(1, 101):
+        h.add(float(v))
+    assert h.summary()["p50"] == pytest.approx(50.0, abs=1.0)
+    assert h.summary()["count"] == 100
 
 
 def test_span_open_across_disable_is_dropped():

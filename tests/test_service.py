@@ -1,0 +1,231 @@
+"""Wheel-as-a-service: canonicalization, warm binding, scheduling, SLOs.
+
+The serving contract (doc/serving.md, ROADMAP item 2):
+
+- shape-family canonicalization: structurally-isomorphic models (same
+  (S, n, m, int-pattern, bucketing), different coefficients) share a
+  family key and bind BITWISE-identical programs; a shape mismatch never
+  serves a cached executable;
+- warm path: the second request of a family pays ZERO compiles
+  (``aot.misses`` delta == 0) and reaches iter-1 fast;
+- scheduling: concurrent requests complete with correct certified gaps,
+  and preemption parks/resumes a wheel at a window boundary with bounds
+  monotone across the cycle (the PR-5 checkpoint seam as tenant
+  preemption).
+"""
+
+import numpy as np
+import pytest
+
+from tpusppy.models import farmer, uc_lite
+from tpusppy.service import SolveRequest, SolveServer, family_key, ingest
+from tpusppy.solvers import aot
+
+EF3 = -108390.0          # farmer 3-scenario EF optimum
+EF6 = -110628.90487928   # farmer 6-scenario EF optimum (HiGHS)
+
+
+def _farmer_canon(n, seedoffset=0, crops=1, options=None):
+    return ingest(
+        farmer.scenario_names_creator(n), farmer.scenario_creator,
+        {"num_scens": n, "seedoffset": seedoffset,
+         "crops_multiplier": crops},
+        options=options or {})
+
+
+# ---------------------------------------------------------------------------
+# canonicalization (no wheels — pure key algebra)
+# ---------------------------------------------------------------------------
+
+def test_family_key_isomorphic_models_match():
+    """Different coefficient values, same (S, n, m, int-pattern,
+    bucketing) => the SAME family key, different content fingerprint
+    (seedoffset perturbs yields for scennum >= 3)."""
+    a = _farmer_canon(6, seedoffset=0)
+    b = _farmer_canon(6, seedoffset=1234)
+    assert a.family == b.family
+    assert a.family_digest == b.family_digest
+    assert a.fingerprint != b.fingerprint   # genuinely different numbers
+    assert not np.array_equal(a.batch.A, b.batch.A)
+
+
+def test_family_key_shape_mismatch_differs():
+    base = _farmer_canon(6)
+    assert _farmer_canon(4).family != base.family          # different S
+    assert _farmer_canon(6, crops=2).family != base.family  # different n/m
+    # a different model family can never alias
+    uc = ingest(uc_lite.scenario_names_creator(6), uc_lite.scenario_creator,
+                {"num_scens": 6, "num_gens": 2, "horizon": 4,
+                 "relax_integers": True})
+    assert uc.family != base.family
+
+
+def test_family_key_settings_and_int_pattern_enter():
+    """Solver settings and the integer pattern are program identity: a
+    family key that ignored them could warm-bind a differently-compiled
+    program."""
+    base = _farmer_canon(6)
+    eps = _farmer_canon(6, options={"solver_options": {"eps_abs": 1e-9}})
+    assert eps.family != base.family
+    integer = ingest(
+        farmer.scenario_names_creator(6), farmer.scenario_creator,
+        {"num_scens": 6, "use_integer": True})
+    assert integer.family != base.family
+
+
+def test_family_key_prefix_is_shape_family_parts():
+    """Drift guard: the canonical family key starts with EXACTLY the
+    shared aot/tune key prefix (aot.shape_family_parts) — the three key
+    builders can never silently diverge."""
+    from tpusppy.spbase import make_admm_settings
+
+    c = _farmer_canon(6)
+    S, n = c.batch.c.shape
+    m = c.batch.cl.shape[1]
+    st = make_admm_settings({})
+    expect = aot.shape_family_parts(S, n, m, settings=st,
+                                    a_kind=c.batch.A.ndim)
+    assert c.family[:len(expect)] == expect
+
+
+def test_canonical_model_binds_spbase():
+    """options["canonical_model"] short-circuits ingest inside SPBase:
+    the opt runs on the SAME batch object (shared), and in-place writers
+    copy first (the batch-cache discipline)."""
+    from tpusppy.spopt import SPOpt
+
+    c = _farmer_canon(3)
+    opt = SPOpt({"canonical_model": c, "solver_options": {"max_iter": 50}},
+                farmer.scenario_names_creator(3), farmer.scenario_creator,
+                scenario_creator_kwargs={"num_scens": 3})
+    assert opt.batch is c.batch
+    assert opt._batch_shared
+    opt._ensure_private_batch()
+    assert opt.batch is not c.batch        # writers get their own copy
+
+
+# ---------------------------------------------------------------------------
+# the TCP payload codec
+# ---------------------------------------------------------------------------
+
+def test_tcp_payload_roundtrip():
+    from tpusppy.service.net import decode_payload, encode_payload
+
+    obj = {"model": "farmer", "num_scens": 7,
+           "options": {"rel_gap": 1e-3},
+           "creator_kwargs": {"seedoffset": 3}}
+    assert decode_payload(encode_payload(obj, 256)) == obj
+    with pytest.raises(ValueError):
+        encode_payload({"x": "y" * 4096}, 16)
+    assert decode_payload(np.zeros(16)) is None
+
+
+# ---------------------------------------------------------------------------
+# the serving warm path + scheduler (real wheels, tiny farmer)
+# ---------------------------------------------------------------------------
+
+def _req(n=3, seed=0, iters=150, **opts):
+    return SolveRequest(model="farmer", num_scens=n,
+                        creator_kwargs={"seedoffset": seed},
+                        options=dict({"PHIterLimit": iters}, **opts))
+
+
+def test_warm_repeat_zero_misses_and_no_new_bindings(tmp_path):
+    """THE warm-path contract: request 2 of an isomorphic family pays
+    zero compiles (aot.misses delta == 0), creates zero new program
+    bindings (bitwise-identical keys), and reaches iter-1 much faster;
+    a third request with a DIFFERENT shape is cold again — a cached
+    executable is never served across a shape mismatch."""
+    with SolveServer(work_dir=str(tmp_path), quantum_secs=60.0,
+                     linger_secs=30.0) as srv:
+        r1 = srv.result(srv.submit(_req(seed=0)), timeout=300)
+        assert r1["status"] == "done" and r1["certified"]
+        assert r1["aot_misses"] > 0 and not r1["warm_hit"]
+
+        mark = aot.session_mark()
+        r2 = srv.result(srv.submit(_req(seed=4321)), timeout=300)
+        assert r2["status"] == "done" and r2["certified"]
+        assert r2["warm_hit"]
+        assert r2["aot_misses"] == 0           # ZERO recompiles
+        assert aot.session_keys_since(mark) == []   # identical bindings
+        assert r2["compile_s"] == 0.0
+        assert r2["ttfi_s"] < r1["ttfi_s"]
+
+        # shape mismatch: different family, fresh compiles, never a
+        # cached executable
+        r3 = srv.result(srv.submit(_req(n=4)), timeout=300)
+        assert r3["status"] == "done"
+        assert not r3["warm_hit"] and r3["aot_misses"] > 0
+        assert len(aot.session_keys_since(mark)) > 0
+
+        summary = srv.slo_summary()
+        assert summary["completed"] == 3 and summary["families"] == 2
+        assert summary["p50_latency_s"] is not None
+
+
+def test_preempt_park_resume_bounds_monotone(tmp_path):
+    """Deterministic preemption: a park request lands at the next window
+    boundary, the tenant's state rides the checkpoint seam, and the
+    resumed slice continues to certification with bounds monotone."""
+    with SolveServer(work_dir=str(tmp_path), quantum_secs=600.0,
+                     linger_secs=30.0) as srv:
+        req = _req(iters=80)
+        srv.preempt(req.request_id)            # park before it even starts
+        rid = srv.submit(req)
+        rec = srv.result(rid, timeout=300)
+        assert rec["status"] == "done" and rec["certified"]
+        assert rec["preemptions"] >= 1 and rec["slices"] >= 2
+        assert rec["bounds_monotone"]
+        assert rec["inner"] == pytest.approx(EF3, rel=2e-3)
+        assert rec["outer"] <= rec["inner"] + 1e-6
+
+
+def test_concurrent_requests_certify_with_time_slicing(tmp_path):
+    """The concurrency proof: 4 requests (two isomorphic pairs across
+    two shape families) submitted together, time-sliced on one device,
+    all certified with gaps matching their solo goldens, at least one
+    preempt-park-resume cycle exercised, bounds monotone throughout."""
+    with SolveServer(work_dir=str(tmp_path), quantum_secs=0.75,
+                     linger_secs=30.0) as srv:
+        rids = [srv.submit(r) for r in (
+            _req(n=3, seed=0), _req(n=6, seed=0, iters=120),
+            _req(n=3, seed=77), _req(n=6, seed=77, iters=120))]
+        recs = [srv.result(r, timeout=600) for r in rids]
+        for rec in recs:
+            assert rec["status"] == "done", rec
+            assert rec["certified"], rec
+            assert rec["bounds_monotone"], rec
+            assert rec["outer"] <= rec["inner"] + 1e-6
+        # solo-golden gaps: scenarios 0-2 are the classic deterministic
+        # triple, so both n=3 requests share EF3; both n=6 share EF6 up
+        # to the seeded perturbation of scens 3-5 (loose rel tolerance)
+        assert recs[0]["inner"] == pytest.approx(EF3, rel=2e-3)
+        assert recs[2]["inner"] == pytest.approx(EF3, rel=2e-3)
+        assert recs[1]["inner"] == pytest.approx(EF6, rel=2e-2)
+        assert recs[3]["inner"] == pytest.approx(EF6, rel=2e-2)
+        # the second member of each pair bound warm
+        assert recs[2]["warm_hit"] and recs[3]["warm_hit"]
+        # time-slicing really happened: somebody parked and resumed
+        assert sum(r["preemptions"] for r in recs) >= 1
+        assert sum(r["slices"] for r in recs) > 4
+        s = srv.slo_summary()
+        assert s["completed"] == 4 and s["warm_hit_rate"] == 0.5
+
+
+def test_tcp_request_roundtrip(tmp_path):
+    """Remote ingest over the TCP window runtime: a client submits a
+    request dict on its slot and reads back the SLO record."""
+    from tpusppy.service.net import SolveClient, TcpServiceFrontend
+
+    with SolveServer(work_dir=str(tmp_path), quantum_secs=60.0,
+                     linger_secs=30.0) as srv:
+        front = TcpServiceFrontend(srv, slots=2)
+        try:
+            cli = SolveClient("127.0.0.1", front.port, front.secret, slot=1)
+            rec = cli.solve({"model": "farmer", "num_scens": 3,
+                             "options": {"PHIterLimit": 50}}, timeout=300)
+            assert rec["status"] == "done" and rec["certified"]
+            assert rec["rel_gap"] <= 1e-3 + 1e-12
+            cli.close()
+        finally:
+            front.close()

@@ -42,6 +42,11 @@ class Hub(SPCommunicator):
         self._ckpt_mgr = None
         self.latest_spoke_bounds = {}        # idx -> last bound read (meta)
         self.resumed_from_iteration = None
+        # tenant preemption (tpusppy.service, doc/serving.md): True once
+        # options["preempt_check"] asked this wheel to park — the run
+        # terminated at a window boundary WITHOUT certifying, and its
+        # final checkpoint is the parked state a later resume continues
+        self.preempted = False
 
     # ---- resilience (tpusppy.resilience) ------------------------------------
     def attach_supervisor(self, sup):
@@ -197,11 +202,36 @@ class Hub(SPCommunicator):
             _trace.counter("hub", "abs_gap", abs_gap)
         return abs_gap, rel_gap
 
+    def _check_preempt(self) -> bool:
+        """Tenant preemption (doc/serving.md): the scheduler's
+        ``options["preempt_check"]`` fires between iterations — at
+        exactly the window boundaries checkpoint capture already owns —
+        and a True verdict means PARK: the wheel tears down normally,
+        the final checkpoint banks (W, xbars, rho, bounds), and the
+        resumed run continues with bounds monotone by the
+        ``seed_resume`` contract."""
+        # getattr: unit tests build bare hubs via __new__ (no __init__)
+        if not hasattr(self, "preempted"):
+            self.preempted = False
+        pc = self.options.get("preempt_check")
+        if pc is not None and not self.preempted and pc():
+            self.preempted = True
+            _metrics.inc("service.preemptions")
+            global_toc("Hub preempted: parking wheel at window boundary",
+                       True)
+            if _trace.enabled():
+                _trace.instant("hub", "preempt",
+                               iter=self.current_iteration(),
+                               best_outer=self.BestOuterBound,
+                               best_inner=self.BestInnerBound)
+        return self.preempted
+
     def determine_termination(self) -> bool:
         opts = self.options
         if not any(k in opts for k in ("rel_gap", "abs_gap",
                                        "max_stalled_iters")):
-            return False
+            # no gap targets: preemption is the only possible verdict
+            return self._check_preempt()
         abs_gap, rel_gap = self.compute_gaps()
         rel_ok = "rel_gap" in opts and rel_gap <= opts["rel_gap"]
         abs_ok = "abs_gap" in opts and abs_gap <= opts["abs_gap"]
@@ -229,7 +259,12 @@ class Hub(SPCommunicator):
                 best_outer=self.BestOuterBound,
                 best_inner=self.BestInnerBound,
                 stalled_iters=self.stalled_iter_cnt)
-        return abs_ok or rel_ok or stalled
+        if abs_ok or rel_ok or stalled:
+            # certification outranks preemption: a wheel whose gap just
+            # closed must COMPLETE, not pay a park/resume cycle for a
+            # quantum that expired in the same window
+            return True
+        return self._check_preempt()
 
     # ---- screen trace (hub.py:111-123) --------------------------------------
     def _update_string(self):
@@ -394,7 +429,11 @@ class PHHub(Hub):
         self.screen_trace()
         if not self.has_innerbound_spokes and not np.isfinite(
                 self.BestInnerBound):
-            return False
+            # a park request must still land: preemption is the ONE
+            # termination that needs no bounds at all (gap termination
+            # stays blocked — the stall counter must not advance while
+            # no incumbent exists)
+            return self._check_preempt()
         return self.determine_termination()
 
     def current_iteration(self):

@@ -70,17 +70,28 @@ class Gauge:
 
 
 class Histogram:
-    """Streaming summary (count/total/min/max) — enough for span totals
-    and latency accounting without bucket bookkeeping."""
+    """Streaming summary (count/total/min/max) plus QUANTILES from a
+    bounded reservoir — serving SLOs need latency percentiles, not just
+    sums (doc/serving.md).  The reservoir is classic Algorithm-R
+    sampling (uniform over the stream) capped at :data:`RESERVOIR_CAP`
+    samples, seeded deterministically so identical insert streams yield
+    identical summaries."""
 
-    __slots__ = ("_lock", "count", "total", "min", "max")
+    RESERVOIR_CAP = 512
+
+    __slots__ = ("_lock", "count", "total", "min", "max", "_samples",
+                 "_rng")
 
     def __init__(self):
+        import random
+
         self._lock = threading.Lock()
         self.count = 0
         self.total = 0.0
         self.min = None
         self.max = None
+        self._samples: list = []
+        self._rng = random.Random(0x5EED)
 
     def add(self, v):
         v = float(v)
@@ -89,11 +100,30 @@ class Histogram:
             self.total += v
             self.min = v if self.min is None else min(self.min, v)
             self.max = v if self.max is None else max(self.max, v)
+            if len(self._samples) < self.RESERVOIR_CAP:
+                self._samples.append(v)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < self.RESERVOIR_CAP:
+                    self._samples[j] = v
+
+    def quantile(self, q: float):
+        """Reservoir quantile (nearest-rank on sorted samples); None when
+        empty.  Exact while count <= RESERVOIR_CAP, sampled past it."""
+        with self._lock:
+            samples = sorted(self._samples)
+        if not samples:
+            return None
+        q = min(max(float(q), 0.0), 1.0)
+        idx = min(len(samples) - 1, int(round(q * (len(samples) - 1))))
+        return samples[idx]
 
     def summary(self) -> dict:
+        p50, p95, p99 = (self.quantile(q) for q in (0.50, 0.95, 0.99))
         with self._lock:
             return {"count": self.count, "total": self.total,
-                    "min": self.min, "max": self.max}
+                    "min": self.min, "max": self.max,
+                    "p50": p50, "p95": p95, "p99": p99}
 
     def reset(self):
         with self._lock:
@@ -101,6 +131,10 @@ class Histogram:
             self.total = 0.0
             self.min = None
             self.max = None
+            self._samples = []
+            import random
+
+            self._rng = random.Random(0x5EED)
 
 
 class Registry:

@@ -14,7 +14,11 @@ arrays): :func:`build_report` reduces the event ring to
   the wall went, cylinder by cylinder;
 - ``instants``: per-track per-name instant counts (speculation discards,
   guard trips, terminations, ...);
-- ``counters``: the full metrics-registry dump;
+- ``counters``: the full metrics-registry dump — histogram entries carry
+  ``p50``/``p95``/``p99`` next to count/total/min/max (bounded-reservoir
+  quantiles, :class:`tpusppy.obs.metrics.Histogram`), which is where
+  serving SLO latency percentiles (``service.*``) land in per-run
+  reports;
 - ``dropped_events``: ring-overflow count (0 means the timeline is
   complete).
 

@@ -44,6 +44,11 @@ from .elastic import ControllerLost, Watchdog
 _log = get_logger("dist_wheel")
 
 _CTR_ELASTIC_RESTORES = _metrics.counter("checkpoint.elastic_restores")
+#: Device->host doubles each controller fetched for consensus assembly —
+#: the shard-local routing contract (ROADMAP item 1): O(S/n_proc) per
+#: controller per iteration, never the full replicated (S, K) state.
+_CTR_CONSENSUS_DOUBLES = _metrics.counter(
+    "dist_wheel.consensus_local_doubles")
 
 
 def default_allgather():
@@ -154,7 +159,6 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
     with the acceptance votes of ``hub.py:424-436``.
     """
     import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
     options = dict(options or {})
     spoke_roles = list(spoke_roles or [])
@@ -178,15 +182,39 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
     S = setup.S
     nonant_idx = setup.batch_local.tree.nonant_indices
 
-    # replicated fetch: consensus state is identical across controllers by
-    # construction (post-psum); reshard-to-replicated makes it addressable
-    # everywhere so controller 0 can Put it and every controller can reason
-    # about it without point-to-point traffic
-    rep = jax.jit(lambda a: a,
-                  out_shardings=NamedSharding(setup.mesh, P()))
+    # ---- shard-local consensus fetch (ROADMAP item 1 remaining) ----------
+    # Each controller pulls ONLY its own scenario-row slice off the device
+    # (O(S/n_proc) doubles per fetch, billed to
+    # ``dist_wheel.consensus_local_doubles``); the full consensus the spoke
+    # payloads need is then assembled by ONE host-level all-gather per
+    # fetch.  The old path resharded the whole state to replicated and
+    # materialized the full (S, K) array on EVERY controller — O(S) D2H
+    # apiece — as two/three back-to-back single-collective jitted programs.
+    # That shape was also the root cause of the two-controller wheel abort
+    # ("op.preamble.length <= op.nbytes. 44 vs 12"): separately jitted
+    # single-collective programs are lowered with the same collective
+    # channel id, so a controller still draining the W gather could
+    # receive its peer's already-dispatched x-gather payload on the same
+    # Gloo slot — the 44-double x rows landing in a 12-double W buffer
+    # aborts the whole job.  One fused gather per fetch removes the
+    # same-channel adjacency entirely (post-mortem in
+    # tests/test_distributed_wheel.py::test_two_controller_hub_wheel_certifies).
+    nproc = jax.process_count()
+    nonant_idx_np = np.asarray(nonant_idx)
 
-    def fetch(a):
-        return np.asarray(rep(a))[:S]
+    def _local_block(arr2d):
+        """(lo, rows) — this controller's contiguous row block of one
+        (Sp, ·) scenario-sharded array, fetched shard by shard (the only
+        D2H this loop ever does on consensus state) and billed."""
+        seen = {}
+        for sh in arr2d.addressable_shards:
+            seen.setdefault(sh.index[0].start or 0, sh)
+        starts = sorted(seen)
+        lo = starts[0]
+        block = np.concatenate(
+            [np.asarray(seen[s].data) for s in starts], axis=0)
+        _CTR_CONSENSUS_DOUBLES.inc(block.size)
+        return int(lo), block
 
     iters = int(options.get("PHIterLimit", 10))
     refresh_every = max(1, int(options.get("solver_refresh_every", 16)))
@@ -428,13 +456,41 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
         return (it - it_base) % max(1, int(_ck_every_iters)) == 0
 
     def _fetch_consensus_raw(include_xbars=False):
-        # the replicated fetch is a COLLECTIVE (cross-process all-gather):
-        # every controller must join it, even though only controller 0
-        # writes the result into the spoke boxes — an early non-writer
-        # return here deadlocks the mesh (Gloo rendezvous timeout)
-        base = (fetch(state.W).ravel(),
-                fetch(state.x)[:, nonant_idx].ravel())
-        return base + ((fetch(state.xbars),) if include_xbars else ())
+        # the assembly all-gather is a COLLECTIVE (every controller must
+        # join it, even though only controller 0 writes the result into
+        # the spoke boxes — an early non-writer return here deadlocks the
+        # mesh), and it is ONE fused gather: W rows, nonant-sliced x rows
+        # and (when a capture may be due) xbars rows ride a single host
+        # vector, so there is exactly one collective program per fetch
+        # and no same-channel adjacent-program hazard
+        lo, W_loc = _local_block(state.W)
+        _, x_loc = _local_block(state.x)
+        xk_loc = x_loc[:, nonant_idx_np]
+        blocks = [W_loc, xk_loc]
+        if include_xbars:
+            blocks.append(_local_block(state.xbars)[1])
+        rows_pp = W_loc.shape[0]
+        widths = [b.shape[1] for b in blocks]
+        if nproc == 1:
+            full = [np.asarray(b, np.float64) for b in blocks]
+        else:
+            from jax.experimental import multihost_utils
+
+            vec = np.concatenate(
+                [np.asarray([float(lo)])]
+                + [np.asarray(b, np.float64).ravel() for b in blocks])
+            allv = np.asarray(multihost_utils.process_allgather(vec))
+            Sp = rows_pp * nproc
+            full = [np.zeros((Sp, w)) for w in widths]
+            for p in range(nproc):
+                v, off, lo_p = allv[p], 1, int(allv[p][0])
+                for fi, w in enumerate(widths):
+                    sz = rows_pp * w
+                    full[fi][lo_p:lo_p + rows_pp] = \
+                        v[off:off + sz].reshape(rows_pp, w)
+                    off += sz
+        base = (full[0][:S].ravel(), full[1][:S].ravel())
+        return base + ((full[2][:S],) if include_xbars else ())
 
     fetch_consensus = wd.wrap(_fetch_consensus_raw, "consensus_fetch")
 

@@ -229,6 +229,15 @@ class PHBase(SPOpt):
             f"Iter0 trivial bound {self.trivial_bound:.4f} conv {self.conv:.3e}",
             self.options.get("display_progress", False),
         )
+        # serving SLO seam (doc/serving.md): the solve server records
+        # time-to-iter-1 per request here — the warm-path acceptance
+        # metric (a warm family reaches this point without compiling)
+        cb = self.options.get("on_iter0_done")
+        if cb is not None:
+            try:
+                cb()
+            except Exception:   # a telemetry hook must never cost the run
+                pass
         return self.trivial_bound
 
     def _apply_resume(self):

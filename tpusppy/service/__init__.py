@@ -1,0 +1,16 @@
+"""Wheel-as-a-service: the persistent warm-path solve server.
+
+ROADMAP item 2; doc/serving.md.  ``canonical`` splits model ingest from
+wheel execution and fingerprints shape families; ``server`` keeps
+compiled programs + tune verdicts + warm device state resident across
+requests and time-slices concurrent wheels with checkpoint-seam
+preemption; ``net`` serves requests over the TCP window runtime.
+"""
+
+from .canonical import CanonicalModel, content_fingerprint, family_key, ingest
+from .server import SolveRequest, SolveServer
+
+__all__ = [
+    "CanonicalModel", "SolveRequest", "SolveServer",
+    "content_fingerprint", "family_key", "ingest",
+]

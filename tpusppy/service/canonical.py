@@ -1,0 +1,165 @@
+"""Request canonicalization: model ingest -> batched arrays + family key.
+
+The enabling refactor of the wheel-as-a-service path (ROADMAP item 2,
+doc/serving.md): "model ingest -> canonical batched arrays" is split out
+of the opt classes (:func:`tpusppy.spbase.build_batch`) so a solve
+request is ingested EXACTLY ONCE — the resulting
+:class:`CanonicalModel` is handed to every cylinder of the wheel via
+``options["canonical_model"]`` — and fingerprinted into a SHAPE FAMILY
+key before anything compiles.
+
+The family key is built on :func:`tpusppy.solvers.aot.shape_family_parts`
+— the same tuple prefix every executable-cache and autotuner-verdict key
+in the engine starts from — plus the structural identity the shapes
+alone do not show (integer pattern, nonant layout, bucket structure,
+engine kind).  Two requests with the SAME family key are isomorphic:
+their wheels lower and compile IDENTICAL programs, so the second request
+binds the already-compiled executables resident in-process (and the
+AOT/tune caches on disk) and pays ZERO compiles — ``aot.misses`` delta
+is 0 by construction, which tests/test_service.py pins.  Two requests
+with different shapes can never share a key (the shapes sit at the front
+of the tuple), so a cached executable is never served across a shape
+mismatch.
+
+Coefficient VALUES are deliberately absent from the family key — they
+are runtime data, not program identity.  The full content fingerprint
+(:attr:`CanonicalModel.fingerprint`) exists separately for exact-request
+deduplication and debugging.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+import numpy as np
+
+from ..solvers import aot as _aot
+from ..spbase import build_batch, make_admm_settings
+
+
+@dataclasses.dataclass
+class CanonicalModel:
+    """One ingested request: the batched arrays + identity.
+
+    ``batch``/``bundling``/``names`` are exactly what
+    :class:`~tpusppy.spbase.SPBase` would have built itself; installing
+    this object under ``options["canonical_model"]`` makes every
+    cylinder bind it (shared — in-place writers copy first, the
+    batch-cache discipline).
+    """
+
+    batch: object
+    bundling: bool
+    names: list
+    family: tuple          # the shape-family key (structural identity)
+    fingerprint: str       # sha1 over the full coefficient content
+
+    @property
+    def tree(self):
+        return self.batch.tree
+
+    @property
+    def family_digest(self) -> str:
+        """Stable short id of the family key (log/SLO-record friendly)."""
+        return hashlib.sha1(repr(self.family).encode()).hexdigest()[:12]
+
+
+def _batch_family_parts(batch, settings, ndev, axis) -> tuple:
+    """Family parts of one homogeneous ScenarioBatch — the
+    ``shape_family_parts`` tuple (drift-guarded against the aot/tune key
+    builders) plus the program identity the bare shapes don't carry."""
+    S, n = batch.c.shape
+    m = batch.cl.shape[1]
+    a_kind = "shared" if getattr(batch, "A_shared", None) is not None \
+        else batch.A.ndim
+    return _aot.shape_family_parts(
+        S, n, m, settings=settings, a_kind=a_kind, ndev=ndev, axis=axis) + (
+        ("int", _aot.array_digest(batch.is_int)),
+        ("nonants", _aot.array_digest(batch.tree.nonant_indices)),
+        ("stages", int(batch.tree.num_stages)),
+    )
+
+
+def _program_options_parts(options) -> tuple:
+    """Options-level knobs that are PROGRAM identity without being
+    ADMMSettings fields: anything here changes which programs a wheel
+    compiles (a lean-pack megastep vs full, a different megastep width,
+    a sparse vs dense device A), so two requests differing in them must
+    never share a family key — a "warm" bind would then compile fresh
+    variants and silently break the zero-recompile contract."""
+    import os
+
+    options = dict(options or {})
+    dev_state = options.get("ph_device_state")
+    if dev_state is None:       # the spopt._device_state_on env fallback
+        dev_state = os.environ.get("TPUSPPY_DEVICE_STATE", "0") != "0"
+    return (("ph_device_state", bool(dev_state)),
+            ("refresh_every",
+             int(options.get("solver_refresh_every", 16) or 0)),
+            ("sparse_device_A",
+             str(options.get("sparse_device_A", "auto"))))
+
+
+def family_key(batch, settings=None, ndev: int = 1,
+               axis: str = "scen", options=None) -> tuple:
+    """Shape-family key of a canonical batch: equal keys <=> the wheels
+    compile identical programs (same shapes, same integer pattern, same
+    nonant layout, same bucketing, same solver settings + program-shaping
+    options, same mesh width).  Coefficient values never enter."""
+    from ..ir import BucketedBatch
+
+    opts = _program_options_parts(options)
+    if isinstance(batch, BucketedBatch):
+        return ("bucketed", opts) + tuple(
+            _batch_family_parts(sub, settings, ndev, axis)
+            + (("rows", int(idx.size)),)
+            for idx, sub in batch.buckets)
+    return _batch_family_parts(batch, settings, ndev, axis) + (opts,)
+
+
+def content_fingerprint(batch) -> str:
+    """sha1 over every coefficient array — exact-content identity (two
+    requests with equal fingerprints are the same problem instance)."""
+    from ..ir import BucketedBatch
+
+    h = hashlib.sha1()
+
+    def _upd(b):
+        from ..spopt import dispatch_A
+
+        for a in (b.c, b.q2, dispatch_A(b), b.cl, b.cu, b.lb, b.ub,
+                  b.const, b.is_int):
+            a = np.ascontiguousarray(np.asarray(a))
+            h.update(repr((a.shape, str(a.dtype))).encode())
+            h.update(a.tobytes())
+
+    if isinstance(batch, BucketedBatch):
+        for _idx, sub in batch.buckets:
+            _upd(sub)
+    else:
+        _upd(batch)
+    return h.hexdigest()
+
+
+def ingest(all_scenario_names, scenario_creator, scenario_creator_kwargs=None,
+           options=None, ndev: int = 1, axis: str = "scen") -> CanonicalModel:
+    """Ingest one request into a :class:`CanonicalModel`.
+
+    Runs the exact :func:`tpusppy.spbase.build_batch` construction the
+    opt classes use (bundling/bucketing knobs honored from ``options``)
+    and fingerprints the result.  ``options["solver_options"]`` feeds
+    the settings half of the family key through the same
+    :func:`~tpusppy.spbase.make_admm_settings` path the wheel will use —
+    a request's key always reflects the programs it will actually run.
+    """
+    options = dict(options or {})
+    batch, bundling, names = build_batch(
+        options, all_scenario_names, scenario_creator,
+        scenario_creator_kwargs, verbose=options.get("verbose", False))
+    settings = make_admm_settings(options, bundling)
+    return CanonicalModel(
+        batch=batch, bundling=bundling, names=names,
+        family=family_key(batch, settings=settings, ndev=ndev, axis=axis,
+                          options=options),
+        fingerprint=content_fingerprint(batch))

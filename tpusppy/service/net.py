@@ -1,0 +1,179 @@
+"""TCP request transport for the solve server — over the existing
+C++ TCP window runtime (:mod:`tpusppy.runtime.tcp_window_service`).
+
+The server owns a :class:`TcpWindowFabric` with one mailbox PAIR per
+request SLOT: clients put a JSON-encoded :class:`~.server.SolveRequest`
+into the slot's inbound box and poll the outbound box for the SLO-record
+response — the exact write-id freshness protocol every wheel spoke
+already speaks, so remote ingest needs no new wire machinery (and rides
+the runtime's retry/reconnect + shared-secret handshake for free).
+
+JSON payloads travel as raw bytes memcpy'd into the box's float64 array:
+``[byte_length, utf-8 bytes padded to 8-byte multiples]``.  A slot
+serves requests SEQUENTIALLY (one in flight per slot); concurrency comes
+from using several slots — see doc/serving.md for the client recipe.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import numpy as np
+
+from ..obs.log import get_logger
+from .server import SolveRequest
+
+_log = get_logger("service")
+
+#: Mailbox sizes in float64 slots (first slot = byte length).
+REQ_SLOTS = 4096          # ~32 KB of JSON per request
+RESP_SLOTS = 4096
+
+
+def encode_payload(obj, length: int) -> np.ndarray:
+    """dict -> float64 mailbox payload (length-prefixed raw JSON bytes)."""
+    raw = json.dumps(obj).encode()
+    if len(raw) > (length - 1) * 8:
+        raise ValueError(f"payload of {len(raw)} bytes exceeds the "
+                         f"{(length - 1) * 8}-byte mailbox")
+    buf = np.zeros(length, dtype=np.float64)
+    buf[0] = float(len(raw))
+    padded = raw + b"\0" * ((-len(raw)) % 8)
+    if padded:
+        buf[1:1 + len(padded) // 8] = np.frombuffer(padded, np.float64)
+    return buf
+
+
+def decode_payload(values: np.ndarray):
+    """Inverse of :func:`encode_payload`."""
+    values = np.asarray(values, np.float64)
+    nbytes = int(values[0])
+    if nbytes <= 0:
+        return None
+    raw = values[1:1 + (nbytes + 7) // 8].tobytes()[:nbytes]
+    return json.loads(raw.decode())
+
+
+class TcpServiceFrontend:
+    """Serve a :class:`~.server.SolveServer` over TCP request slots.
+
+    The listener thread polls every slot's inbound write-id; a fresh put
+    is decoded, submitted, and answered into the outbound box when the
+    request finishes.  Requests on DIFFERENT slots run through the
+    scheduler concurrently (time-sliced), exactly like in-process
+    submits.
+    """
+
+    def __init__(self, server, slots: int = 4, port: int = 0,
+                 bind: str = "127.0.0.1", secret: int | None = None,
+                 poll_secs: float = 0.05):
+        from ..runtime.tcp_window_service import TcpWindowFabric
+
+        self.server = server
+        self.fabric = TcpWindowFabric(
+            spoke_lengths=[(RESP_SLOTS, REQ_SLOTS)] * slots,
+            port=port, bind=bind, secret=secret)
+        self.port = self.fabric.port
+        self.secret = self.fabric.secret
+        self.poll_secs = float(poll_secs)
+        self._last_ids = {i: 0 for i in range(1, slots + 1)}
+        self._pending: dict = {}           # slot -> _Tenant (object ref)
+        self._lock = threading.Lock()
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop,
+                                        name="service-tcp", daemon=True)
+        self._thread.start()
+
+    def _submit_async(self, slot: int, data):
+        """Decode + ingest + submit on a per-request thread: ingest is
+        minutes of single-core numpy at reference scale, and running it
+        on the listener would stall intake AND response delivery for
+        every other slot.  The pending entry holds the TENANT OBJECT
+        (not its id), so a ``retire_finished()`` sweep between
+        completion and the next poll cannot orphan the response."""
+        try:
+            req = SolveRequest.from_dict(decode_payload(data))
+            rid = self.server.submit(req)
+            with self._lock:
+                self._pending[slot] = self.server._tenants[rid]
+        except Exception as e:             # malformed request: answer it
+            _log.warning("slot %d: bad request: %r", slot, e)
+            self._answer(slot, {"status": "failed", "error": repr(e)})
+
+    def _loop(self):
+        while not self._stop:
+            for slot, mb in self.fabric.to_hub.items():
+                try:
+                    data, wid = mb.get()
+                except RuntimeError:
+                    continue               # transient fabric error
+                if wid <= self._last_ids[slot] or wid < 0:
+                    continue
+                self._last_ids[slot] = wid
+                threading.Thread(
+                    target=self._submit_async, args=(slot, data),
+                    name=f"service-ingest-{slot}", daemon=True).start()
+            with self._lock:
+                ready = [(slot, t) for slot, t in self._pending.items()
+                         if t.done.is_set()]
+                for slot, _ in ready:
+                    del self._pending[slot]
+            for slot, t in ready:
+                self._answer(slot, dict(t.record))
+            time.sleep(self.poll_secs)
+
+    def _answer(self, slot: int, payload: dict):
+        """Best-effort response put: a transient fabric error (client
+        mid-reconnect, injected fault) must never kill the listener
+        thread — that would silently wedge EVERY slot forever."""
+        try:
+            self.fabric.to_spoke[slot].put(
+                encode_payload(payload, RESP_SLOTS))
+        except Exception as e:
+            _log.warning("slot %d: response put failed (dropped): %r",
+                         slot, e)
+
+    def close(self):
+        self._stop = True
+        self._thread.join(timeout=10.0)
+        self.fabric.close()
+
+
+class SolveClient:
+    """Remote client for one request slot of a TCP-served solve server."""
+
+    def __init__(self, host: str, port: int, secret: int, slot: int = 1,
+                 connect_timeout: float = 60.0):
+        from ..runtime.tcp_window_service import TcpWindowFabric
+
+        self.fabric = TcpWindowFabric(connect=(host, port), secret=secret,
+                                      connect_timeout=connect_timeout)
+        self.slot = int(slot)
+        self._last_resp = self.fabric.to_spoke[self.slot].write_id
+
+    def submit(self, request: dict):
+        """Send one request dict (model/num_scens/creator_kwargs/options)."""
+        self.fabric.to_hub[self.slot].put(
+            encode_payload(request, REQ_SLOTS))
+
+    def wait(self, timeout: float = 600.0, poll_secs: float = 0.1) -> dict:
+        """Block for this slot's next response; returns the SLO record."""
+        t0 = time.time()
+        mb = self.fabric.to_spoke[self.slot]
+        while time.time() - t0 < timeout:
+            data, wid = mb.get()
+            if wid > self._last_resp:
+                self._last_resp = wid
+                return decode_payload(data)
+            time.sleep(poll_secs)
+        raise TimeoutError(f"no response on slot {self.slot} "
+                           f"after {timeout}s")
+
+    def solve(self, request: dict, timeout: float = 600.0) -> dict:
+        self.submit(request)
+        return self.wait(timeout=timeout)
+
+    def close(self):
+        self.fabric.close()

@@ -1,0 +1,645 @@
+"""SolveServer: a long-lived, multi-tenant, warm-path wheel service.
+
+ROADMAP item 2 ("wheel-as-a-service"), doc/serving.md.  The production
+shape for "millions of users" is a PROCESS THAT NEVER GOES COLD: compiled
+executables (:mod:`tpusppy.solvers.aot`), autotuner verdicts
+(:mod:`tpusppy.tune`) and the content-keyed device constants
+(:mod:`tpusppy.spopt`) stay resident while solve requests come and go.
+
+Request lifecycle (each stage observable in the per-request SLO record):
+
+1. **ingest** — :meth:`SolveServer.submit` resolves the request's model
+   (farmer/uc_lite/sslp-class, or a custom creator) and runs
+   :func:`tpusppy.service.canonical.ingest` ONCE: canonical batched
+   arrays + the shape-family key.
+2. **warm-bind** — the family key is looked up in the server's registry:
+   a previously-seen (isomorphic) family means every program the wheel
+   will dispatch is already compiled in-process — the request runs with
+   ``aot.misses`` delta == 0 and reaches iter-1 without touching XLA.
+3. **schedule** — requests queue FIFO; the executor runs ONE wheel at a
+   time (the mesh is a single shared resource) and TIME-SLICES when
+   others wait: a running wheel is asked to park via the hub's
+   ``preempt_check`` at a window boundary, its state is banked through
+   the PR-5 checkpoint seam (capture is pinned zero-extra-fetch), and the
+   tenant re-queues; the resumed slice continues with bounds monotone.
+4. **SLO record** — queue wait, time-to-iter-1, compile seconds, aot
+   hit/miss deltas, iters/s, certified gap, wall; latency percentiles
+   ride the ``service.*`` histograms (p50/p95/p99 via
+   :mod:`tpusppy.obs.metrics`).
+
+What is shared across tenants: compiled executables, tune verdicts,
+device-resident constant caches (content-keyed — identical A shares one
+device copy).  What is NOT shared: batch coefficient arrays (each
+request's own numbers), wheel state (W/xbars/rho), bounds, checkpoints.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import tempfile
+import threading
+import time
+import uuid
+from math import inf
+
+import numpy as np
+
+from ..obs import metrics as _metrics
+from ..obs.log import get_logger
+from . import canonical as _canonical
+
+_log = get_logger("service")
+
+_CTR_REQUESTS = _metrics.counter("service.requests")
+_CTR_COMPLETED = _metrics.counter("service.completed")
+_CTR_FAILED = _metrics.counter("service.failed")
+_CTR_WARM_HITS = _metrics.counter("service.warm_hits")
+_CTR_COLD_FAMILIES = _metrics.counter("service.cold_families")
+_CTR_SLICES = _metrics.counter("service.slices")
+_HIST_QUEUE_WAIT = _metrics.histogram("service.queue_wait_s")
+_HIST_WALL = _metrics.histogram("service.wall_s")
+_HIST_TTFI = _metrics.histogram("service.ttfi_s")
+
+
+def _model_registry():
+    """Name -> (module, default opt options).  Lazily imported so the
+    server module stays importable without touching every model."""
+    from ..models import farmer, sslp, uc_lite
+
+    return {
+        "farmer": (farmer, {"defaultPHrho": 1.0,
+                            "xhat_looper_options": {"scen_limit": 3}}),
+        # UC runs the bench wheel's rho (bench_uc.py: LP-relaxation-tight
+        # family, rho=500 matches the cost scale)
+        "uc_lite": (uc_lite, {"defaultPHrho": 500.0,
+                              "xhat_looper_options": {"scen_limit": 3}}),
+        "sslp": (sslp, {"defaultPHrho": 5.0,
+                        "xhat_looper_options": {"scen_limit": 3}}),
+    }
+
+
+class SolveRequest:
+    """One solve request.
+
+    Args:
+      model: registry name ("farmer", "uc_lite", "sslp") — or pass
+        ``scenario_creator`` + ``names`` for a custom family (in-process
+        submits only; the TCP transport is name-based).
+      num_scens: scenario count.
+      creator_kwargs: extra scenario-creator kwargs (seedoffset,
+        crops_multiplier, num_gens, ... — routed through the model's
+        ``kw_creator``).
+      options: opt/hub option overrides (PHIterLimit, rel_gap,
+        solver_options, ...).  ``rel_gap`` defaults to the server's.
+      request_id: optional stable id (generated when empty).
+    """
+
+    def __init__(self, model="farmer", num_scens=3, creator_kwargs=None,
+                 options=None, request_id=None, scenario_creator=None,
+                 names=None):
+        self.model = str(model)
+        self.num_scens = int(num_scens)
+        self.creator_kwargs = dict(creator_kwargs or {})
+        self.options = dict(options or {})
+        self.request_id = request_id or f"req-{uuid.uuid4().hex[:10]}"
+        self.scenario_creator = scenario_creator
+        self.names = names
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SolveRequest":
+        return cls(model=d.get("model", "farmer"),
+                   num_scens=d.get("num_scens", 3),
+                   creator_kwargs=d.get("creator_kwargs"),
+                   options=d.get("options"),
+                   request_id=d.get("request_id"))
+
+
+class _Tenant:
+    """Scheduler-side state of one request."""
+
+    def __init__(self, req, canon, opt_options, creator, names, workdir):
+        self.req = req
+        self.canonical = canon             # dropped on completion (the
+        self.family = canon.family         # batched arrays are the bulk
+        self.opt_options = opt_options     # of a tenant's footprint)
+        self.creator = creator
+        self.names = names
+        self.id = req.request_id
+        self.dir = os.path.join(workdir, "tenants", self.id)
+        self.seq = 0                       # submission order (server sets)
+        self.status = "queued"
+        self.slices = 0
+        self.submitted = time.monotonic()
+        self.first_exec = None
+        self.done = threading.Event()
+        self.last_outer = -inf
+        self.last_inner = inf
+        self.record = {
+            "request_id": self.id, "model": req.model,
+            "family": canon.family_digest,
+            "fingerprint": canon.fingerprint[:12],
+            "status": "queued", "warm_hit": False,
+            "queue_wait_s": None, "exec_s": 0.0, "wall_s": None,
+            "ttfi_s": None, "compile_s": 0.0,
+            "aot_hits": 0.0, "aot_misses": 0.0,
+            "slices": 0, "preemptions": 0, "iters": 0,
+            "iters_per_sec": None, "rel_gap": None,
+            "inner": None, "outer": None, "certified": False,
+            "bounds_monotone": True, "error": None,
+        }
+
+
+class SolveServer:
+    """The long-lived solve server (in-process API; TCP transport in
+    :mod:`tpusppy.service.net`).
+
+    Args:
+      work_dir: root for per-tenant checkpoints + the AOT/tune caches
+        (a temp dir when omitted).  Pointing several server LIFETIMES at
+        one ``work_dir`` is the restart-warm path: executables persist.
+      quantum_secs: minimum uninterrupted run time a wheel gets before a
+        waiting tenant may preempt it.
+      rel_gap: default certification target per request.
+      arm_caches: arm the AOT executable cache + persistent tune-verdict
+        store under ``work_dir`` (kept as-is when the process already
+        armed them).
+    """
+
+    def __init__(self, work_dir=None, quantum_secs=5.0, rel_gap=1e-3,
+                 linger_secs=30.0, arm_caches=True):
+        self.work_dir = work_dir or tempfile.mkdtemp(prefix="tpusppy_srv_")
+        os.makedirs(os.path.join(self.work_dir, "tenants"), exist_ok=True)
+        self.quantum_secs = float(quantum_secs)
+        self.rel_gap = float(rel_gap)
+        self.linger_secs = float(linger_secs)
+        self._cv = threading.Condition()
+        self._runq: collections.deque = collections.deque()
+        self._tenants: dict = {}
+        self._families: dict = {}          # family key -> request count
+        self._families_done: set = set()   # families with a COMPLETED run
+        self._family_open: dict = {}       # family -> set of UNFINISHED seqs
+                                           # (affinity checks stay O(open),
+                                           # never O(historical requests))
+        self._force_preempt: set = set()
+        self._stop = False
+        self._drain = True                 # shutdown(wait=True) semantics
+        self._seq = 0
+        if arm_caches:
+            self._arm_caches()
+        self._executor = threading.Thread(
+            target=self._executor_loop, name="solve-server", daemon=True)
+        self._executor.start()
+
+    # ---- lifecycle ----------------------------------------------------------
+    def _arm_caches(self):
+        """Warm-start infrastructure: the AOT executable cache and the
+        persistent autotuner verdict store live under the work dir (so a
+        RESTARTED server re-binds warm from disk), and whatever is
+        already on disk is prewarmed NOW — before any request compiles
+        (the loader must not race in-flight compiles; see aot.py)."""
+        from .. import tune as _tune
+        from ..solvers import aot as _aot
+
+        if not _aot.cache_path():
+            _aot.set_cache_path(os.path.join(self.work_dir, "aot"))
+        if _aot.enabled():
+            _aot.prewarm()
+        try:
+            if _tune.cache_path() is None:
+                _tune.set_cache_path(
+                    os.path.join(self.work_dir, "tune_cache.json"))
+        except Exception:      # tune persistence is an optimization only
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    def shutdown(self, wait: bool = True, timeout: float = 600.0):
+        """Stop the server.  ``wait=True`` (default) drains the queue —
+        every submitted request finishes first; ``wait=False`` preempts
+        the running wheel at its next window boundary and leaves
+        unfinished tenants PARKED on disk (a later server over the same
+        work_dir could resume them)."""
+        with self._cv:
+            self._stop = True
+            self._drain = bool(wait)
+            if not wait:
+                self._force_preempt.update(t.id for t in self._tenants.values()
+                                           if t.status == "running")
+                # queued-but-never-started tenants have no state to park:
+                # CANCEL them loudly so result() waiters unblock instead
+                # of timing out against a dead queue.  Tenants already
+                # PARKED in the queue DO have banked checkpoints — they
+                # stay parked (resumable), exactly like the running one
+                for t in self._runq:
+                    if t.slices > 0:
+                        t.status = "parked"
+                        t.record["status"] = "parked"
+                    else:
+                        t.status = "cancelled"
+                        t.record.update(
+                            status="cancelled",
+                            error="server shut down before start")
+                        t.canonical = None
+                    self._close_tenant_locked(t)
+                    t.done.set()
+                self._runq.clear()
+            self._cv.notify_all()
+        self._executor.join(timeout=timeout)
+        # release shared device memory the serving process held (content-
+        # keyed A caches): a clean shutdown parks no orphan device state
+        from ..spopt import clear_device_caches
+
+        clear_device_caches()
+
+    def _close_tenant_locked(self, t):
+        """Retire a tenant from the affinity index (caller holds _cv)."""
+        open_ = self._family_open.get(t.family)
+        if open_ is not None:
+            open_.discard(t.seq)
+            if not open_:
+                del self._family_open[t.family]
+
+    # ---- submission ---------------------------------------------------------
+    def _resolve(self, req: SolveRequest):
+        """(creator, names, creator_kwargs, opt_options) for one request
+        — opt_options is the FINAL option dict the wheel opts run with,
+        and therefore exactly what the canonicalizer must key on."""
+        if req.scenario_creator is not None:
+            creator = req.scenario_creator
+            names = list(req.names or
+                         [f"scen{i}" for i in range(req.num_scens)])
+            kwargs = dict(req.creator_kwargs)
+            defaults = {"defaultPHrho": 1.0,
+                        "xhat_looper_options": {"scen_limit": 3}}
+        else:
+            registry = _model_registry()
+            if req.model not in registry:
+                raise ValueError(f"unknown model {req.model!r} "
+                                 f"(have {sorted(registry)})")
+            module, defaults = registry[req.model]
+            names = module.scenario_names_creator(req.num_scens)
+            kwargs = module.kw_creator(
+                **dict(req.creator_kwargs, num_scens=req.num_scens))
+            creator = module.scenario_creator
+        opt_options = dict(defaults)
+        opt_options.update({
+            "PHIterLimit": 200, "convthresh": -1.0,
+        })
+        opt_options.update(req.options)
+        # hub-side knobs must not leak into the canonical settings key
+        for k in ("rel_gap", "abs_gap", "linger_secs"):
+            opt_options.pop(k, None)
+        return creator, names, kwargs, opt_options
+
+    def submit(self, req) -> str:
+        """Ingest + canonicalize + enqueue; returns the request id.
+        Ingestion runs on the CALLER's thread (pure numpy — it cannot
+        disturb the executor's device work)."""
+        if isinstance(req, dict):
+            req = SolveRequest.from_dict(req)
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("server is shut down")
+        creator, names, kwargs, opt_options = self._resolve(req)
+        canon = _canonical.ingest(names, creator, kwargs,
+                                  options=opt_options)
+        t = _Tenant(req, canon, opt_options, creator, names, self.work_dir)
+        t.req.creator_kwargs = kwargs
+        with self._cv:
+            if self._stop:
+                # re-check under the SAME lock hold as the enqueue: a
+                # shutdown racing the (slow, unlocked) ingest above must
+                # not slip a tenant into a queue nobody will ever drain
+                raise RuntimeError("server is shut down")
+            if t.id in self._tenants:
+                # a duplicate id would silently shadow the first run's
+                # record and strand its result() waiters — reject loudly
+                # (retries should make a fresh SolveRequest)
+                raise ValueError(f"request id {t.id!r} already submitted")
+            self._families[canon.family] = \
+                self._families.get(canon.family, 0) + 1
+            t.seq = self._seq
+            self._seq += 1
+            self._family_open.setdefault(canon.family, set()).add(t.seq)
+            self._tenants[t.id] = t
+            self._runq.append(t)
+            # counted only once ACCEPTED (rejected duplicates/shutdown
+            # races must not leave phantom requests on the dashboards)
+            _CTR_REQUESTS.inc(1)
+            self._cv.notify_all()
+        # warm_hit is decided at FIRST EXECUTION, not here: only a family
+        # whose compile leader actually COMPLETED has executables to bind
+        # (family affinity guarantees the leader finishes first; a failed
+        # leader must not mark its followers warm)
+        _log.info("request %s (%s, family %s) queued", t.id, req.model,
+                  canon.family_digest)
+        return t.id
+
+    def preempt(self, request_id: str):
+        """Ask a running request to park at its next window boundary
+        (deterministic preemption for tests/operators; the scheduler's
+        own quantum preemption needs no call)."""
+        with self._cv:
+            self._force_preempt.add(request_id)
+
+    # ---- results ------------------------------------------------------------
+    def result(self, request_id: str, timeout: float | None = None) -> dict:
+        """Block until the request finishes; returns its SLO record."""
+        t = self._tenants.get(request_id)
+        if t is None:
+            raise KeyError(f"unknown (or retired) request id "
+                           f"{request_id!r}")
+        if not t.done.wait(timeout):
+            raise TimeoutError(f"request {request_id} still "
+                               f"{t.status} after {timeout}s")
+        return dict(t.record)
+
+    def retire_finished(self, keep: int = 0) -> int:
+        """Drop finished tenants' bookkeeping (all but the newest
+        ``keep``), returning how many were retired.  Completed tenants
+        already released their batched arrays; this sheds the residual
+        _Tenant + SLO-record dicts so a genuinely long-lived server's
+        memory and ``slo_records`` cost stay bounded — call it (or wire
+        it on a cadence) after harvesting the records you need."""
+        with self._cv:
+            finished = [t for t in self._tenants.values()
+                        if t.status in ("done", "failed", "cancelled")]
+            finished.sort(key=lambda t: t.seq)
+            drop = finished[:max(0, len(finished) - int(keep))]
+            for t in drop:
+                del self._tenants[t.id]
+        return len(drop)
+
+    def slo_records(self) -> list:
+        with self._cv:              # submit() inserts under this lock
+            tenants = list(self._tenants.values())
+        return [dict(t.record) for t in tenants]
+
+    @staticmethod
+    def _pct(values, q):
+        """Nearest-rank percentile over this SERVER's own samples."""
+        vals = sorted(v for v in values if v is not None)
+        if not vals:
+            return None
+        return vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
+
+    def slo_summary(self) -> dict:
+        """Aggregate serving SLOs over this instance's RETAINED records
+        (``retire_finished`` narrows the window).  Percentiles are
+        computed from the records themselves — the ``service.*``
+        registry histograms carry the same samples for obs/report
+        consumers, but they are process-global and would conflate
+        several server lifetimes in one process."""
+        with self._cv:
+            tenants = list(self._tenants.values())
+        recs = [t.record for t in tenants]
+        done = [r for r in recs if r["status"] == "done"]
+        n_warm = sum(1 for r in done if r["warm_hit"])
+        walls = [r["wall_s"] for r in done]
+        return {
+            "requests": len(tenants),
+            "completed": len(done),
+            "failed": sum(1 for r in recs if r["status"] == "failed"),
+            "warm_hit_rate": (n_warm / len(done)) if done else None,
+            "preemptions": sum(r["preemptions"] for r in recs),
+            "p50_latency_s": self._pct(walls, 0.50),
+            "p95_latency_s": self._pct(walls, 0.95),
+            "p99_latency_s": self._pct(walls, 0.99),
+            "p50_queue_wait_s": self._pct(
+                [r["queue_wait_s"] for r in recs], 0.50),
+            "p95_queue_wait_s": self._pct(
+                [r["queue_wait_s"] for r in recs], 0.95),
+            "p50_ttfi_s": self._pct([r["ttfi_s"] for r in recs], 0.50),
+            "families": len(self._families),
+        }
+
+    # ---- the executor -------------------------------------------------------
+    def _pick_next(self):
+        """Next runnable tenant under FAMILY AFFINITY: a tenant never
+        starts while an EARLIER-submitted tenant of the same shape
+        family is still unfinished.  The first request of a family is
+        its compile leader — letting a warm follower race a parked
+        leader would hand the follower whatever program variants the
+        leader had not reached yet (park/resume truncates execution
+        paths), breaking the warm zero-compile contract the follower
+        was promised.  Cross-family requests still time-slice freely.
+        Blocking is answered from the ``_family_open`` index (seq sets
+        of UNFINISHED tenants only — O(open), never O(every request
+        ever served)).  Caller holds the lock; returns None when every
+        queued tenant is blocked (the blocking leader is queued or
+        running, and its park/finish re-notifies)."""
+        for i, t in enumerate(self._runq):
+            open_ = self._family_open.get(t.family)
+            if open_ is None or min(open_) >= t.seq:
+                del self._runq[i]
+                # mark running UNDER THE LOCK: a shutdown(wait=False)
+                # racing the gap between pick and slice start must see
+                # this tenant as preemptable, not miss it entirely
+                t.status = "running"
+                return t
+        return None
+
+    def _executor_loop(self):
+        while True:
+            with self._cv:
+                while True:
+                    if not self._runq and self._stop:
+                        return             # stopped and drained
+                    tenant = self._pick_next() if self._runq else None
+                    if tenant is not None:
+                        break
+                    self._cv.wait()
+            try:
+                self._run_slice(tenant)
+            except Exception as e:         # a tenant failure never kills
+                _CTR_FAILED.inc(1)         # the server
+                _log.warning("request %s failed: %r", tenant.id, e)
+                tenant.status = "failed"
+                tenant.record.update(status="failed", error=repr(e))
+                tenant.canonical = None    # release the batched arrays
+                with self._cv:
+                    self._close_tenant_locked(tenant)
+                tenant.done.set()
+
+    def _want_preempt(self, tenant, slice_start) -> bool:
+        with self._cv:
+            if tenant.id in self._force_preempt:
+                self._force_preempt.discard(tenant.id)
+                return True
+            # preempt only for a tenant that could actually RUN: a
+            # queued same-family follower is blocked behind this very
+            # tenant (family affinity), and parking for it would churn
+            if not any(o.family != tenant.family or o.seq < tenant.seq
+                       for o in self._runq):
+                return False
+        return time.monotonic() - slice_start >= self.quantum_secs
+
+    def _build_wheel(self, t: _Tenant, preempt_check, on_iter0_done):
+        """Hub/spoke dicts for one slice of one tenant — the standard
+        certified-wheel topology (PH hub + Lagrangian outer + XhatShuffle
+        inner), every cylinder binding the SAME canonical model."""
+        from ..cylinders import (LagrangianOuterBound, PHHub,
+                                 XhatShuffleInnerBound)
+        from ..opt.ph import PH
+        from ..phbase import PHBase
+        from ..xhat_eval import Xhat_Eval
+
+        def opt_kwargs(extra=None):
+            options = dict(t.opt_options, canonical_model=t.canonical)
+            options.update(extra or {})
+            return {
+                "options": options,
+                "all_scenario_names": list(t.names),
+                "scenario_creator": t.creator,
+                "scenario_creator_kwargs": dict(t.req.creator_kwargs),
+            }
+
+        hub_options = {
+            "rel_gap": float(t.req.options.get("rel_gap", self.rel_gap)),
+            "linger_secs": float(t.req.options.get("linger_secs",
+                                                   self.linger_secs)),
+            "preempt_check": preempt_check,
+            "checkpoint_dir": t.dir,
+            "resume": t.dir if t.slices else None,
+        }
+        if "abs_gap" in t.req.options:
+            hub_options["abs_gap"] = float(t.req.options["abs_gap"])
+        hub_dict = {
+            "hub_class": PHHub,
+            "hub_kwargs": {"options": hub_options},
+            "opt_class": PH,
+            "opt_kwargs": opt_kwargs({"on_iter0_done": on_iter0_done}),
+        }
+        spokes = [
+            {"spoke_class": LagrangianOuterBound, "spoke_kwargs": {},
+             "opt_class": PHBase, "opt_kwargs": opt_kwargs()},
+            {"spoke_class": XhatShuffleInnerBound, "spoke_kwargs": {},
+             "opt_class": Xhat_Eval, "opt_kwargs": opt_kwargs()},
+        ]
+        return hub_dict, spokes
+
+    def _run_slice(self, t: _Tenant):
+        from ..spin_the_wheel import WheelSpinner
+
+        t.status = "running"
+        t.record["status"] = "running"
+        if t.first_exec is None:
+            t.first_exec = time.monotonic()
+            t.record["queue_wait_s"] = t.first_exec - t.submitted
+            _HIST_QUEUE_WAIT.add(t.record["queue_wait_s"])
+            # warm verdict at first execution: true only when a member
+            # of this family actually COMPLETED (its executables exist);
+            # family affinity made any earlier leader finish (or fail)
+            # before this point
+            with self._cv:
+                warm = t.family in self._families_done
+            t.record["warm_hit"] = warm
+            (_CTR_WARM_HITS if warm else _CTR_COLD_FAMILIES).inc(1)
+            _log.info("request %s starts %s", t.id,
+                      "WARM" if warm else "cold")
+        slice_start = time.monotonic()
+
+        def on_iter0_done():
+            if t.record["ttfi_s"] is None:
+                t.record["ttfi_s"] = time.monotonic() - slice_start
+                _HIST_TTFI.add(t.record["ttfi_s"])
+
+        if t.slices == 0 and not t.record["warm_hit"]:
+            # prewarm-on-ingest for a family THIS lifetime hasn't seen:
+            # a restarted server over a persistent work_dir deserializes
+            # the family's executables from the AOT disk cache instead
+            # of recompiling.  Runs HERE (executor thread, before the
+            # wheel's cylinder threads exist) because the executable
+            # loader must never race an in-flight compile (aot.py).
+            from ..solvers import aot as _aot
+
+            if _aot.enabled():
+                _aot.prewarm()
+        hub_dict, spokes = self._build_wheel(
+            t, lambda: self._want_preempt(t, slice_start), on_iter0_done)
+        _CTR_SLICES.inc(1)
+        # the executor is the ONLY thread doing device work, so registry
+        # window deltas here are this slice's traffic (the wheel's own
+        # cylinder threads are part of the slice)
+        with _metrics.window() as w:
+            ws = WheelSpinner(hub_dict, spokes).run()
+        t.slices += 1
+        wall = time.monotonic() - slice_start
+        hub = ws.spcomm
+        rec = t.record
+        rec["slices"] = t.slices
+        rec["exec_s"] += wall
+        rec["compile_s"] += w.delta("aot.compile_s")
+        rec["aot_hits"] += w.delta("aot.hits")
+        rec["aot_misses"] += w.delta("aot.misses")
+        # bounds must be monotone across every park/resume cycle (the
+        # seed_resume contract) — a violation is a correctness bug the
+        # SLO record surfaces loudly
+        ob, ib = float(hub.BestOuterBound), float(hub.BestInnerBound)
+        tol = 1e-9 * max(1.0, abs(t.last_outer) if
+                         np.isfinite(t.last_outer) else 1.0)
+        if ob < t.last_outer - tol or ib > t.last_inner + tol:
+            rec["bounds_monotone"] = False
+            _log.warning("request %s: bounds regressed across resume "
+                         "(outer %s -> %s, inner %s -> %s)", t.id,
+                         t.last_outer, ob, t.last_inner, ib)
+        t.last_outer = max(t.last_outer, ob)
+        t.last_inner = min(t.last_inner, ib)
+        rec["outer"], rec["inner"] = ob, ib
+        rec["iters"] = int(hub.current_iteration())
+        if rec["exec_s"] > 0:
+            rec["iters_per_sec"] = rec["iters"] / rec["exec_s"]
+        abs_gap, rel_gap = hub.compute_gaps()
+        rec["rel_gap"] = float(rel_gap)
+
+        iter_limit = int(t.opt_options.get("PHIterLimit", 200))
+        if getattr(hub, "preempted", False) and rec["iters"] < iter_limit:
+            t.status = "parked"
+            rec["status"] = "parked"
+            rec["preemptions"] += 1
+            with self._cv:
+                if self._stop and not self._drain:
+                    # shutdown(wait=False): the park WAS the drain — the
+                    # tenant stays parked on disk (resumable by a later
+                    # server over this work_dir), and waiters unblock on
+                    # the parked record instead of timing out
+                    self._close_tenant_locked(t)
+                    t.done.set()
+                    _log.info("request %s left PARKED by shutdown "
+                              "(checkpoint banked at iter %d)", t.id,
+                              rec["iters"])
+                    return
+                self._runq.append(t)       # round-robin: back of the line
+                self._cv.notify_all()
+            _log.info("request %s parked at iter %d (slice %d, %.2fs)",
+                      t.id, rec["iters"], t.slices, wall)
+            return
+        # completion — including a preempt that found the ITERATION
+        # BUDGET already spent: a budget-exhausted wheel can only linger,
+        # and re-parking it would let two never-certifying tenants of
+        # different families alternate {Iter0, quantum of linger, park}
+        # forever (each resume restarting the linger clock) — it
+        # completes UNCERTIFIED instead, and the record says so
+        t.status = "done"
+        rec["status"] = "done"
+        rec["wall_s"] = time.monotonic() - t.submitted
+        rec["certified"] = bool(np.isfinite(rel_gap) and rel_gap <= float(
+            t.req.options.get("rel_gap", self.rel_gap)) + 1e-12)
+        _HIST_WALL.add(rec["wall_s"])
+        _CTR_COMPLETED.inc(1)
+        with self._cv:
+            self._families_done.add(t.family)
+            self._close_tenant_locked(t)
+        t.canonical = None      # release the batched arrays: a long-lived
+        t.opt_options = None    # server must not retain every request's
+        t.creator = None        # coefficient tensors (records stay)
+        _log.info("request %s done: gap %.3e in %.2fs (%d slice(s), "
+                  "%d compiles)", t.id, rel_gap, rec["wall_s"], t.slices,
+                  int(rec["aot_misses"]))
+        t.done.set()

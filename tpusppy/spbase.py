@@ -42,6 +42,86 @@ def _kwargs_key(kwargs: dict) -> tuple:
     return tuple(parts)
 
 
+def build_batch(options, all_scenario_names, scenario_creator,
+                scenario_creator_kwargs=None, verbose=False):
+    """Model ingest -> canonical batched arrays, as a free function.
+
+    The construction half of :class:`SPBase` (problems -> optional
+    bundling -> optional shape-bucketing -> one batched array family),
+    split out so it can run WITHOUT an opt object: the serving
+    canonicalizer (:mod:`tpusppy.service.canonical`) ingests a request
+    once, fingerprints its shape family, and hands the prebuilt batch to
+    every cylinder via ``options["canonical_model"]`` — ingest never
+    re-runs per cylinder, and wheel execution binds to already-compiled
+    programs when the family was seen before (doc/serving.md).
+
+    Returns ``(batch, bundling, names)`` where ``names`` is the
+    (possibly bundled) scenario/bundle name list.
+    """
+    options = dict(options or {})
+    names = list(all_scenario_names)
+    problems = [
+        scenario_creator(name, **dict(scenario_creator_kwargs or {}))
+        for name in names
+    ]
+    # bundling (P6): merge scenario groups into per-bundle EFs before
+    # batching (spbase.py:219-253 + spopt.py:743-836 collapsed); with one
+    # controller, "bundles_per_rank" is the total bundle count
+    nbundles = int(options.get("bundles_per_rank", 0) or 0)
+    bundling = nbundles > 0
+    if bundling:
+        from .bundles import form_bundles
+
+        problems = form_bundles(problems, nbundles)
+        names = [p.name for p in problems]
+    # ragged families (e.g. uneven bundles): shape-bucket instead of
+    # padding everything to the max (SURVEY §7 hard part 2)
+    quantum = int(options.get("shape_bucket_quantum", 16))
+    # the integer pattern is part of the shape key: same-(n, m)
+    # scenarios with DIFFERENT is_int patterns cannot share one
+    # ScenarioBatch (it requires one pattern) but bucket cleanly —
+    # BucketedBatch subgroups by padded pattern anyway
+    shapes = {(p.num_vars, p.num_rows, p.is_int.tobytes())
+              for p in problems}
+    bucketed = None
+    # opt-in: bucketing trades the features needing a global A tensor
+    # or a shared integer pattern (cut injection, integer diving,
+    # device-const caching) for compact per-shape solves; certified
+    # dual bounds work per bucket (_Edualbound_bucketed)
+    if len(shapes) > 1 and options.get("shape_buckets", False):
+        from .ir import BucketedBatch
+
+        bucketed = BucketedBatch.from_problems(problems, quantum)
+        if len(bucketed.buckets) == 1:
+            bucketed = None     # one bucket = plain padding; keep the
+                                # full-featured ScenarioBatch surface
+    if bucketed is not None:
+        batch = bucketed
+        global_toc(
+            "shape-bucketed ragged family: "
+            f"{[(int(i.size), s.num_rows, s.num_vars) for i, s in bucketed.buckets]}",
+            verbose)
+    else:
+        batch = ScenarioBatch.from_problems(problems)
+    return batch, bundling, names
+
+
+def make_admm_settings(options, bundling=False) -> ADMMSettings:
+    """``solver_options`` -> :class:`ADMMSettings`, shared by
+    :class:`SPBase` and the serving canonicalizer (whose family keys must
+    embed EXACTLY the settings the wheel will run, or a warm bind could
+    serve a differently-compiled program)."""
+    so = dict(options.get("solver_options") or {})
+    allowed = {f.name for f in ADMMSettings.__dataclass_fields__.values()}
+    # bundles are fewer but larger/harder subproblems; spend more solver
+    # budget per problem unless the user pinned it (same trade as giving
+    # the external solver more time per bundle EF in the reference)
+    if bundling:
+        so.setdefault("max_iter", 4000)
+        so.setdefault("restarts", 6)
+    return ADMMSettings(**{k: v for k, v in so.items() if k in allowed})
+
+
 class SPBase:
     """Base class for scenario-programming objects.
 
@@ -80,6 +160,23 @@ class SPBase:
         # denouement protocol); signature (rank, scenario_name, scenario)
         self.scenario_denouement = scenario_denouement
         self.spcomm = None  # attached by an SPCommunicator when in a wheel
+
+        # ---- canonical ingest (options["canonical_model"]) ------------------
+        # The serving path (tpusppy/service/, doc/serving.md): a request
+        # was already ingested/canonicalized ONCE into batched arrays by
+        # service.canonical.ingest — every cylinder binds that object
+        # instead of re-running model ingest.  Shared like a cache hit:
+        # in-place writers must call _ensure_private_batch first.
+        cm = self.options.get("canonical_model")
+        if cm is not None:
+            self.batch = cm.batch
+            self.bundling = cm.bundling
+            self.all_scenario_names = list(cm.names)
+            self.tree = self.batch.tree
+            self._batch_shared = True
+            self.nid_sk = self.tree.nid_sk()
+            self.admm_settings = self._make_admm_settings()
+            return
 
         # ---- batch cache (options["batch_cache"]) ---------------------------
         # Every cylinder of a wheel builds the SAME family: at reference
@@ -120,49 +217,11 @@ class SPBase:
                 self.admm_settings = self._make_admm_settings()
                 return
 
-        problems = [
-            scenario_creator(name, **self.scenario_creator_kwargs)
-            for name in self.all_scenario_names
-        ]
-        # bundling (P6): merge scenario groups into per-bundle EFs before
-        # batching (spbase.py:219-253 + spopt.py:743-836 collapsed); with one
-        # controller, "bundles_per_rank" is the total bundle count
-        nbundles = int(self.options.get("bundles_per_rank", 0) or 0)
-        self.bundling = nbundles > 0
-        if self.bundling:
-            from .bundles import form_bundles
-
-            problems = form_bundles(problems, nbundles)
-            self.all_scenario_names = [p.name for p in problems]
-        # ragged families (e.g. uneven bundles): shape-bucket instead of
-        # padding everything to the max (SURVEY §7 hard part 2)
-        quantum = int(self.options.get("shape_bucket_quantum", 16))
-        # the integer pattern is part of the shape key: same-(n, m)
-        # scenarios with DIFFERENT is_int patterns cannot share one
-        # ScenarioBatch (it requires one pattern) but bucket cleanly —
-        # BucketedBatch subgroups by padded pattern anyway
-        shapes = {(p.num_vars, p.num_rows, p.is_int.tobytes())
-                  for p in problems}
-        bucketed = None
-        # opt-in: bucketing trades the features needing a global A tensor
-        # or a shared integer pattern (cut injection, integer diving,
-        # device-const caching) for compact per-shape solves; certified
-        # dual bounds work per bucket (_Edualbound_bucketed)
-        if len(shapes) > 1 and self.options.get("shape_buckets", False):
-            from .ir import BucketedBatch
-
-            bucketed = BucketedBatch.from_problems(problems, quantum)
-            if len(bucketed.buckets) == 1:
-                bucketed = None     # one bucket = plain padding; keep the
-                                    # full-featured ScenarioBatch surface
-        if bucketed is not None:
-            self.batch = bucketed
-            global_toc(
-                "shape-bucketed ragged family: "
-                f"{[(int(i.size), s.num_rows, s.num_vars) for i, s in bucketed.buckets]}",
-                self.verbose)
-        else:
-            self.batch = ScenarioBatch.from_problems(problems)
+        # the ingest itself now lives in the free function (the serving
+        # canonicalizer runs the SAME code without an opt object)
+        self.batch, self.bundling, self.all_scenario_names = build_batch(
+            self.options, self.all_scenario_names, scenario_creator,
+            self.scenario_creator_kwargs, verbose=self.verbose)
         self.tree = self.batch.tree
         global_toc(
             f"Built scenario batch: {self.batch.num_scenarios} scenarios, "
@@ -200,15 +259,8 @@ class SPBase:
 
     # ---- options ------------------------------------------------------------
     def _make_admm_settings(self) -> ADMMSettings:
-        so = dict(self.options.get("solver_options") or {})
-        allowed = {f.name for f in ADMMSettings.__dataclass_fields__.values()}
-        # bundles are fewer but larger/harder subproblems; spend more solver
-        # budget per problem unless the user pinned it (same trade as giving
-        # the external solver more time per bundle EF in the reference)
-        if getattr(self, "bundling", False):
-            so.setdefault("max_iter", 4000)
-            so.setdefault("restarts", 6)
-        return ADMMSettings(**{k: v for k, v in so.items() if k in allowed})
+        return make_admm_settings(self.options,
+                                  getattr(self, "bundling", False))
 
     def _options_check(self, required, options=None):
         """Hard check for required options (spbase.py:524-531)."""
