@@ -588,10 +588,11 @@ def serving_segment():
     S = int(os.environ.get("BENCH_SERVING_SCENS", "4"))
     n_req = int(os.environ.get("BENCH_SERVING_REQUESTS", "4"))
     iters = int(os.environ.get("BENCH_SERVING_ITERS", "80"))
+    work = tempfile.mkdtemp(prefix="bench_srv_")
     # context manager: a wedged request (result timeout) must still shut
     # the executor down, or its daemon thread keeps dispatching queued
     # wheels under every LATER bench segment's measurement
-    with SolveServer(work_dir=tempfile.mkdtemp(prefix="bench_srv_"),
+    with SolveServer(work_dir=work,
                      quantum_secs=1.0, linger_secs=45.0) as srv:
         t0 = time.time()
         rids = [srv.submit(SolveRequest(
@@ -624,6 +625,24 @@ def serving_segment():
     if warm_ttfi and entry["ttfi_cold_s"]:
         entry["warm_ttfi_speedup"] = round(
             entry["ttfi_cold_s"] / max(min(warm_ttfi), 1e-9), 1)
+    # recovery-warm TTFI (doc/serving.md "Durability"): a SECOND server
+    # LIFETIME over the same work dir (recover_from) serves a fresh
+    # isomorphic request — the restart path through journal replay +
+    # re-armed caches.  In-process the executables are still resident,
+    # so this measures the restart machinery's overhead on the warm
+    # path; the cross-process cold/warm truth is the serving-chaos
+    # smoke's job.
+    try:
+        with SolveServer.recover_from(work, quantum_secs=1.0,
+                                      linger_secs=45.0) as srv2:
+            rec = srv2.result(srv2.submit(SolveRequest(
+                model="farmer", num_scens=S,
+                creator_kwargs={"seedoffset": 4242},
+                options={"PHIterLimit": iters})), timeout=1200)
+        entry["recovery_warm_ttfi_s"] = rec["ttfi_s"]
+        entry["recovery_certified"] = bool(rec["certified"])
+    except Exception as e:   # recovery SLOs are additive, never fatal
+        entry["recovery_error"] = repr(e)
     return entry
 
 

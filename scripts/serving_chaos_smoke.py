@@ -1,0 +1,372 @@
+#!/usr/bin/env python
+"""Serving chaos smoke: SIGKILL the solve server mid-slice, restart it,
+recover every journaled tenant warm.
+
+The nightly CI acceptance for DURABLE serving (doc/serving.md
+"Durability"), runnable locally::
+
+    JAX_PLATFORMS=cpu python scripts/serving_chaos_smoke.py
+
+Three legs, each a REAL OS process:
+
+1. **golden** — an uninterrupted server runs the 4 requests (two
+   isomorphic pairs across two model families: farmer + uc-lite) to
+   completion; the per-request certified gaps are the bar.
+2. **victim** — a TCP-served SolveServer over a fresh work dir receives
+   the same 4 requests (fixed request ids) from 4 client slots with a
+   ~1 s scheduling quantum, so the two family LEADERS time-slice
+   (park/resume) while the followers queue behind family affinity.  The
+   parent watches the request journal until one leader is PARKED (its
+   checkpoint banked) and the other is mid-slice RUNNING, then SIGKILLs
+   the server — no cleanup, no atexit.
+3. **recover** — ``SolveServer.recover_from`` on the SAME work dir (a
+   fresh TCP frontend, new port).  The parent reconnects with fresh
+   clients and asserts the durability contract:
+
+   - every journaled tenant recovered: all 4 finish ``done``;
+   - resumed tenants certify <= the golden's gap (+ dust) with
+     ``bounds_monotone`` vs the pre-kill snapshot;
+   - the leader that was PARKED at the kill resumed WARM from its park
+     checkpoint (``recovered == "warm"``);
+   - recovery is warm for previously-compiled families: the followers
+     (queued at the kill, running only after their family's leader
+     completed in the restarted lifetime) bind with ``aot_misses == 0``;
+   - queued tenants re-entered the queue in original submission order
+     (first ``running`` transitions after the recovery marker);
+   - reconnected clients get their ORIGINAL results by id
+     (``fetch``), and a duplicate submit of a journaled id resolves
+     idempotently to the original record.
+
+Exit 0 = pass.  A hard watchdog (``CHAOS_DEADLINE_SECS``, default 1500)
+``os._exit(2)``s a wedged run so CI never hangs.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEADLINE = float(os.environ.get("CHAOS_DEADLINE_SECS", "1500"))
+
+REQUESTS = {
+    # two isomorphic pairs; the *-1 member of each family is its
+    # compile leader (submitted first), *-2 the warm follower
+    "req-f1": {"model": "farmer", "num_scens": 4,
+               "creator_kwargs": {"seedoffset": 0},
+               "options": {"PHIterLimit": 150}},
+    "req-u1": {"model": "uc_lite", "num_scens": 3,
+               "creator_kwargs": {"num_gens": 2, "horizon": 4,
+                                  "relax_integers": True, "seedoffset": 0},
+               "options": {"PHIterLimit": 300, "rel_gap": 5e-3}},
+    "req-f2": {"model": "farmer", "num_scens": 4,
+               "creator_kwargs": {"seedoffset": 901},
+               "options": {"PHIterLimit": 150}},
+    "req-u2": {"model": "uc_lite", "num_scens": 3,
+               "creator_kwargs": {"num_gens": 2, "horizon": 4,
+                                  "relax_integers": True, "seedoffset": 44},
+               "options": {"PHIterLimit": 300, "rel_gap": 5e-3}},
+}
+ORDER = ["req-f1", "req-u1", "req-f2", "req-u2"]
+LEADERS = ("req-f1", "req-u1")
+FOLLOWERS = ("req-f2", "req-u2")
+GAP_TARGET = {"req-f1": 1e-3, "req-u1": 5e-3, "req-f2": 1e-3,
+              "req-u2": 5e-3}
+
+
+def log(msg):
+    print(f"serving-chaos: {msg}", file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# journal folding (parent-side, pure stdlib — no tpusppy imports needed
+# to WATCH the victim)
+# ---------------------------------------------------------------------------
+def fold_journal(path):
+    """{rid: status} + the raw event list (tolerates a torn tail)."""
+    status, events = {}, []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                events.append(ev)
+                if ev.get("ev") == "accepted":
+                    status[ev["rid"]] = "queued"
+                elif ev.get("ev") == "status" and ev.get("rid") in status:
+                    status[ev["rid"]] = ev["status"]
+    except OSError:
+        pass
+    return status, events
+
+
+def has_checkpoint(work, rid):
+    d = os.path.join(work, "tenants", rid)
+    try:
+        return any(nm.startswith("ckpt_") and nm.endswith(".npz")
+                   for nm in os.listdir(d))
+    except OSError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# server legs (child processes)
+# ---------------------------------------------------------------------------
+def serve():
+    sys.path.insert(0, REPO)
+    from tpusppy.service import SolveServer
+    from tpusppy.service.net import TcpServiceFrontend
+
+    mode = os.environ["SERVE_MODE"]        # golden | victim | recover
+    work = os.environ["SERVE_DIR"]
+
+    if mode == "golden":
+        from tpusppy.service import SolveRequest
+
+        with SolveServer(work_dir=work, quantum_secs=600.0,
+                         linger_secs=45.0) as srv:
+            rids = [srv.submit(SolveRequest(
+                request_id=f"golden-{rid}", **REQUESTS[rid]))
+                for rid in ORDER]
+            gaps = {r.split("golden-")[1]: srv.result(r, timeout=900)
+                    for r in rids}
+        bad = {k: v["status"] for k, v in gaps.items()
+               if v["status"] != "done" or not v["certified"]}
+        out = {rid: rec["rel_gap"] for rid, rec in gaps.items()}
+        with open(os.path.join(work, "golden.json"), "w") as f:
+            json.dump({"gaps": out, "bad": bad}, f)
+        print(json.dumps({"mode": "golden", "gaps": out}), flush=True)
+        return 0 if not bad else 1
+
+    recover = mode == "recover"
+    srv = (SolveServer.recover_from(work, quantum_secs=1.0,
+                                    linger_secs=45.0)
+           if recover else
+           SolveServer(work_dir=work, quantum_secs=1.0, linger_secs=45.0))
+    front = TcpServiceFrontend(srv, slots=4)
+    conn = {"port": front.port, "secret": front.secret}
+    # atomic conn-file publish (the parent polls for it)
+    tmp = os.path.join(work, f".conn_{mode}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(conn, f)
+    os.replace(tmp, os.path.join(work, f"conn_{mode}.json"))
+    log(f"{mode} serving on port {front.port} (pid {os.getpid()})")
+    # run until the parent is done with us (victim: SIGKILLed; recover:
+    # parent drops a PARENT_DONE marker after its assertions)
+    marker = os.path.join(work, "PARENT_DONE")
+    while not os.path.exists(marker):
+        time.sleep(0.2)
+    front.close()
+    srv.shutdown(drain=True, timeout=120)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# orchestration (parent)
+# ---------------------------------------------------------------------------
+def _arm_watchdog():
+    def _bomb():
+        time.sleep(DEADLINE)
+        print(json.dumps({"ok": False, "error": "deadline exceeded"}),
+              flush=True)
+        os._exit(2)
+
+    threading.Thread(target=_bomb, daemon=True).start()
+
+
+def _spawn(mode, work):
+    env = dict(os.environ, SERVE_MODE=mode, SERVE_DIR=work, PYTHONPATH=REPO)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen([sys.executable, os.path.abspath(__file__),
+                             "--serve"], env=env)
+
+
+def _wait_file(path, timeout, what):
+    t0 = time.time()
+    while not os.path.exists(path):
+        if time.time() - t0 > timeout:
+            raise SystemExit(f"timed out waiting for {what}")
+        time.sleep(0.2)
+    with open(path) as f:
+        return json.load(f)
+
+
+def main():
+    import tempfile
+
+    _arm_watchdog()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    base = tempfile.mkdtemp(prefix="serving_chaos_")
+    log(f"workdir {base}")
+
+    # ---- leg 1: golden --------------------------------------------------
+    golden_dir = os.path.join(base, "golden")
+    os.makedirs(golden_dir)
+    proc = _spawn("golden", golden_dir)
+    if proc.wait(timeout=900) != 0:
+        raise SystemExit("golden leg failed")
+    golden = json.load(open(os.path.join(golden_dir, "golden.json")))
+    assert not golden["bad"], f"golden leg uncertified: {golden['bad']}"
+    gaps = golden["gaps"]
+    log(f"golden gaps: { {k: round(v, 6) for k, v in gaps.items()} }")
+
+    # ---- leg 2: victim --------------------------------------------------
+    work = os.path.join(base, "work")
+    os.makedirs(work)
+    victim = _spawn("victim", work)
+    conn = _wait_file(os.path.join(work, "conn_victim.json"), 120,
+                      "victim conn file")
+    from tpusppy.service.net import SolveClient
+
+    clients = {rid: SolveClient("127.0.0.1", conn["port"], conn["secret"],
+                                slot=i + 1)
+               for i, rid in enumerate(ORDER)}
+    for rid in ORDER:                      # fixed ids => idempotent retries
+        clients[rid].submit(dict(REQUESTS[rid], request_id=rid))
+        time.sleep(0.3)                    # deterministic admission order
+
+    # kill window: one leader PARKED with its checkpoint banked, the
+    # other mid-slice RUNNING, both unfinished, followers still queued
+    jpath = os.path.join(work, "journal.jsonl")
+    parked_rid = None
+    t0 = time.time()
+    while time.time() - t0 < 600:
+        if victim.poll() is not None:
+            raise SystemExit("victim exited early — nothing to SIGKILL")
+        status, _ = fold_journal(jpath)
+        if len(status) == 4 and \
+                all(status[r] == "queued" for r in FOLLOWERS):
+            st = {r: status[r] for r in LEADERS}
+            parked = [r for r, s in st.items()
+                      if s == "parked" and has_checkpoint(work, r)]
+            running = [r for r, s in st.items() if s == "running"]
+            if parked and running:
+                parked_rid = parked[0]
+                break
+        time.sleep(0.1)
+    if parked_rid is None:
+        raise SystemExit("kill window never materialized (leaders "
+                         f"finished too fast? journal: {fold_journal(jpath)[0]})")
+    os.kill(victim.pid, signal.SIGKILL)    # the crash, for real
+    victim.wait(timeout=60)
+    status_at_kill, _ = fold_journal(jpath)
+    log(f"SIGKILLed victim with journal state {status_at_kill} "
+        f"(parked leader: {parked_rid})")
+    for cli in clients.values():
+        cli.close()
+
+    # ---- leg 3: recover -------------------------------------------------
+    recov = _spawn("recover", work)
+    conn2 = _wait_file(os.path.join(work, "conn_recover.json"), 180,
+                       "recover conn file")
+    # "reconnected clients": fresh client objects, same request ids
+    clients = {rid: SolveClient("127.0.0.1", conn2["port"], conn2["secret"],
+                                slot=i + 1)
+               for i, rid in enumerate(ORDER)}
+    failures = []
+
+    def check(cond, msg):
+        if not cond:
+            failures.append(msg)
+
+    recs = {}
+    for rid in ORDER:
+        rec = clients[rid].fetch(rid, timeout=900)
+        recs[rid] = rec
+        check(rec.get("request_id") == rid,
+              f"{rid}: fetched someone else's record: {rec}")
+        check(rec.get("status") == "done",
+              f"{rid}: {rec.get('status')} ({rec.get('error')})")
+        check(rec.get("certified"),
+              f"{rid}: uncertified (gap {rec.get('rel_gap')})")
+        check(rec.get("bounds_monotone"),
+              f"{rid}: bounds regressed across the recovery")
+        g = rec.get("rel_gap")
+        check(g is not None
+              and g <= max(gaps[rid], GAP_TARGET[rid]) + 1e-9,
+              f"{rid}: recovered gap {g} worse than golden {gaps[rid]}")
+    # the parked leader resumed WARM from its park checkpoint
+    check(recs[parked_rid].get("recovered") == "warm",
+          f"{parked_rid} was parked with a checkpoint but recovered "
+          f"{recs[parked_rid].get('recovered')!r}")
+    check(recs[parked_rid].get("slices", 0) >= 2,
+          f"{parked_rid} did not resume ({recs[parked_rid].get('slices')} "
+          "slices)")
+    # warm recovery for previously-compiled families: the followers ran
+    # only in the restarted lifetime, AFTER their family's leader
+    # completed there — zero recompiles (aot.misses delta 0)
+    for rid in FOLLOWERS:
+        check(recs[rid].get("warm_hit") is True,
+              f"{rid}: follower did not bind warm")
+        check(recs[rid].get("aot_misses") == 0,
+              f"{rid}: follower recompiled ({recs[rid].get('aot_misses')} "
+              "misses) — recovery was not warm")
+    # queued tenants re-entered in ORIGINAL order: among the followers,
+    # first `running` transitions after the recovery marker follow the
+    # journaled admission (seq) order
+    _, events = fold_journal(jpath)
+    seqs = {e["rid"]: e["seq"] for e in events
+            if e.get("ev") == "accepted" and e.get("rid") in FOLLOWERS}
+    expect_order = sorted(FOLLOWERS, key=lambda r: seqs.get(r, 1 << 30))
+    last_marker = max((i for i, e in enumerate(events)
+                       if e.get("ev") == "recovery"), default=-1)
+    check(last_marker >= 0, "no recovery marker journaled")
+    first_run = {}
+    for e in events[last_marker + 1:]:
+        if e.get("ev") == "status" and e.get("status") == "running":
+            first_run.setdefault(e["rid"], len(first_run))
+    f_order = [r for r in sorted(first_run, key=first_run.get)
+               if r in FOLLOWERS]
+    check(f_order == expect_order,
+          f"followers ran out of order after recovery: {f_order} "
+          f"(admitted {expect_order})")
+    # duplicate submit after reconnect resolves idempotently to the
+    # ORIGINAL record (same id, same result — not a second run)
+    dup = clients[ORDER[0]]
+    dup.submit(dict(REQUESTS["req-f1"], request_id="req-f1"))
+    rec = dup.wait(timeout=120)
+    check(rec.get("request_id") == "req-f1"
+          and rec.get("rel_gap") == recs["req-f1"]["rel_gap"],
+          f"duplicate submit did not resolve to the original: {rec}")
+
+    # let the recover leg drain + exit
+    with open(os.path.join(work, "PARENT_DONE"), "w") as f:
+        f.write("ok")
+    rc = recov.wait(timeout=240)
+    check(rc == 0, f"recover leg exited rc={rc}")
+    for cli in clients.values():
+        cli.close()
+
+    out = {
+        "ok": not failures, "failures": failures,
+        "parked_leader": parked_rid,
+        "status_at_kill": status_at_kill,
+        "recovered": {r: recs[r].get("recovered") for r in ORDER},
+        "gaps": {r: recs[r].get("rel_gap") for r in ORDER},
+        "golden_gaps": gaps,
+        "follower_misses": {r: recs[r].get("aot_misses")
+                            for r in FOLLOWERS},
+        "slices": {r: recs[r].get("slices") for r in ORDER},
+    }
+    print(json.dumps(out), flush=True)
+    if failures:
+        for f_ in failures:
+            log(f"FAIL: {f_}")
+        return 1
+    log("PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    if "--serve" in sys.argv[1:]:
+        sys.exit(serve())
+    sys.path.insert(0, REPO)
+    sys.exit(main())

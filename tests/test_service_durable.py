@@ -1,0 +1,528 @@
+"""Durable serving: the solve server survives crashes, restarts, and
+flaky clients (doc/serving.md "Durability").
+
+The contract under test:
+
+- WRITE-AHEAD journal: every accepted request is journaled before
+  ``submit`` returns; status transitions append record snapshots; a torn
+  tail (kill mid-append) never breaks replay; ``retire_finished``
+  compacts.
+- RESTART recovery: ``SolveServer.recover_from(work_dir)`` re-admits
+  every unfinished journaled tenant — parked tenants resume warm from
+  their banked checkpoints with ``PHIterLimit`` total-iteration
+  semantics and bounds monotone vs the park snapshot; queued tenants
+  re-enter in submission order; mid-slice tenants without a complete
+  checkpoint restart from scratch loudly (``service.recovered_cold``).
+- IDEMPOTENT clients: duplicate submit of a journaled id resolves to
+  the original record; a dead socket raises the typed ``ServerLost``
+  immediately instead of polling out the full timeout; undeliverable
+  responses are journaled for fetch-by-id.
+- ADMISSION + deadlines: a bounded queue fast-fails typed
+  (``service.rejected_overload``); ``deadline_secs`` parks-and-fails
+  UNCERTIFIED at the checkpoint seam.
+
+The full kill -9 end-to-end (SIGKILL mid-slice, restart, certify at the
+golden gap, warm recovery) lives in scripts/serving_chaos_smoke.py —
+the nightly ``serving-chaos`` job.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from tpusppy.obs import metrics
+from tpusppy.resilience import checkpoint as ck
+from tpusppy.resilience import faults
+from tpusppy.service import (RequestJournal, ServerOverloaded,
+                             SolveRequest, SolveServer)
+from tpusppy.service import journal as J
+
+
+def _req(rid, n=3, seed=0, iters=150, deadline=None, **opts):
+    return SolveRequest(model="farmer", num_scens=n, request_id=rid,
+                        creator_kwargs={"seedoffset": seed},
+                        deadline_secs=deadline,
+                        options=dict({"PHIterLimit": iters}, **opts))
+
+
+# ---------------------------------------------------------------------------
+# the journal itself (no wheels)
+# ---------------------------------------------------------------------------
+
+def test_journal_roundtrip_compaction_and_torn_tail(tmp_path):
+    p = str(tmp_path / "j.jsonl")
+    j = RequestJournal(p)
+    j.accepted(rid="r1", seq=0, request={"model": "farmer"}, family="f1",
+               checkpoint_dir="/x", deadline_at=123.0,
+               record={"status": "queued"})
+    j.transition("r1", "running", {"status": "running", "slices": 1})
+    j.accepted(rid="r2", seq=1, request={"model": "uc"}, family="f2",
+               checkpoint_dir="/y")
+    j.undelivered("r1", {"request_id": "r1", "status": "done"})
+    # a kill mid-append tears at most the final line
+    with open(p, "a") as f:
+        f.write('{"ev": "status", "rid": "r1", "sta')
+    recs = j.replay()
+    assert set(recs) == {"r1", "r2"}
+    assert recs["r1"].status == "running"
+    assert recs["r1"].record["slices"] == 1
+    assert recs["r1"].deadline_at == 123.0
+    assert recs["r1"].undelivered == {"request_id": "r1", "status": "done"}
+    assert recs["r2"].status == "queued" and recs["r2"].seq == 1
+    assert metrics.value("service.journal_torn") == 1
+    # compaction folds to a clean file with identical replay state
+    j.compact(recs.values())
+    recs2 = j.replay()
+    assert recs2["r1"].status == "running"
+    assert recs2["r1"].undelivered is not None
+    assert recs2["r2"].request == {"model": "uc"}
+    # dropped records vanish
+    j.compact([recs2["r2"]])
+    assert set(j.replay()) == {"r2"}
+    # missing file replays empty
+    assert J.replay(str(tmp_path / "nope.jsonl")) == {}
+
+
+def test_submit_write_ahead_idempotent_and_overload(tmp_path):
+    srv = SolveServer(work_dir=str(tmp_path), _start_executor=False,
+                      arm_caches=False, max_queue=2)
+    rid = srv.submit(_req("req-a"))
+    assert rid == "req-a"
+    # the WAL property: journaled before submit returned
+    jr = srv.journal.replay()["req-a"]
+    assert jr.status == "queued" and jr.recoverable
+    assert jr.request["creator_kwargs"]["seedoffset"] == 0
+    # duplicate id resolves idempotently (NOT a second run, NOT a raise)
+    assert srv.submit(_req("req-a", seed=999)) == "req-a"
+    assert metrics.value("service.duplicate_submits") == 1
+    assert len(srv._runq) == 1
+    # bounded queue: typed fast-fail past max_queue; the options
+    # spelling of deadline_secs is honored like rel_gap/linger_secs
+    srv.submit(_req("req-b", deadline_secs=60))
+    assert srv._tenants["req-b"].deadline_at is not None
+    assert srv.journal.replay()["req-b"].deadline_at is not None
+    with pytest.raises(ServerOverloaded):
+        srv.submit(_req("req-c"))
+    assert metrics.value("service.rejected_overload") == 1
+    assert "req-c" not in srv.journal.replay()   # rejected => no WAL entry
+    # custom creators journal as unrecoverable
+    srv.max_queue = None
+    def creator(name, **kw):                     # pragma: no cover - shape
+        raise NotImplementedError
+    req = SolveRequest(scenario_creator=creator, num_scens=2,
+                       request_id="req-custom")
+    try:
+        srv.submit(req)
+    except Exception:
+        pass                                     # ingest may reject it
+    else:
+        assert not srv.journal.replay()["req-custom"].recoverable
+
+
+def test_recovery_requeues_in_order_cold_and_unrecoverable(tmp_path):
+    work = str(tmp_path)
+    srv = SolveServer(work_dir=work, _start_executor=False,
+                      arm_caches=False)
+    for rid, seed in (("req-1", 0), ("req-2", 7), ("req-3", 0)):
+        srv.submit(_req(rid, seed=seed))
+    # simulate a crash mid-slice: req-1 journaled running, NO checkpoint
+    t1 = srv._tenants["req-1"]
+    srv.journal.transition("req-1", "running",
+                           dict(t1.record, status="running", slices=1,
+                                iters=9, ttfi_s=1.5))
+    # ... and an unrecoverable custom-creator obligation
+    srv.journal.accepted(rid="req-x", seq=99, request={},
+                         family="", checkpoint_dir="", recoverable=False)
+    del srv   # no shutdown — the crash
+
+    srv2 = SolveServer.recover_from(work, _start_executor=False,
+                                    arm_caches=False)
+    # queued tenants re-enter in ORIGINAL submission order
+    assert [t.id for t in srv2._runq] == ["req-1", "req-2", "req-3"]
+    assert metrics.value("service.recovered") == 3
+    # mid-slice without a checkpoint restarts from scratch, loudly
+    t1 = srv2._tenants["req-1"]
+    assert t1.record["recovered"] == "cold"
+    assert t1.slices == 0 and t1.record["iters"] == 0
+    assert t1.record["ttfi_s"] is None
+    assert metrics.value("service.recovered_cold") == 1
+    assert srv2._tenants["req-2"].record["recovered"] == "requeued"
+    # canonical models re-ingested (runnable), seq counter past max
+    assert all(t.canonical is not None for t in srv2._runq)
+    assert srv2._seq == 100
+    # the unrecoverable obligation failed loudly with waiters unblocked
+    tx = srv2._tenants["req-x"]
+    assert tx.status == "failed" and tx.done.is_set()
+    assert tx.record["error_code"] == "unrecoverable"
+    with pytest.raises(KeyError):
+        srv2.result("req-nope", timeout=0.1)
+
+
+def test_recovery_family_drift_is_cold_once_and_persisted(tmp_path):
+    """A journaled family digest that no longer matches the re-ingested
+    model (model code changed between lifetimes) forces a COLD restart
+    — the foreign checkpoint is never resumed — and the NEW digest is
+    re-journaled, so a SECOND restart does not re-detect drift and wipe
+    the tenant's legitimate new checkpoints again."""
+    import json
+
+    work = str(tmp_path)
+    srv = SolveServer(work_dir=work, _start_executor=False,
+                      arm_caches=False)
+    srv.submit(_req("req-d"))
+    true_family = srv._tenants["req-d"].family
+    del srv
+    # simulate drift: rewrite the journaled accepted family + mark the
+    # tenant as parked (it would warm-resume if the family matched)
+    lines = []
+    with open(f"{work}/journal.jsonl") as f:
+        for line in f:
+            ev = json.loads(line)
+            if ev.get("ev") == "accepted" and ev["rid"] == "req-d":
+                ev["family"] = "stale-digest"
+            lines.append(json.dumps(ev))
+    with open(f"{work}/journal.jsonl", "w") as f:
+        f.write("\n".join(lines) + "\n")
+    RequestJournal(f"{work}/journal.jsonl").transition(
+        "req-d", "parked", {"slices": 1, "status": "parked"})
+
+    srv2 = SolveServer.recover_from(work, _start_executor=False,
+                                    arm_caches=False)
+    t = srv2._tenants["req-d"]
+    assert t.record["recovered"] == "cold" and t.slices == 0
+    assert t.family == true_family
+    assert metrics.value("service.recovered_cold") == 1
+    # family bookkeeping counts the FINAL digest, not the stale one
+    assert "stale-digest" not in srv2._families
+    # the corrected family was persisted: a second recovery sees no
+    # drift (this lifetime never ran, so it recovers queued, not cold)
+    assert srv2.journal.replay()["req-d"].family == true_family
+    del srv2
+    srv3 = SolveServer.recover_from(work, _start_executor=False,
+                                    arm_caches=False)
+    assert srv3._tenants["req-d"].record["recovered"] == "requeued"
+
+
+def test_serving_fault_hooks():
+    """kill_server_after_slices / drop_client / stall_ingest are one
+    flag-check when disarmed and deterministic when armed."""
+    killed = []
+    orig = faults._SELF_KILL
+    faults._SELF_KILL = lambda: killed.append(True)
+    try:
+        # disarmed: all no-ops
+        faults.on_server_slice(5)
+        faults.on_client_op(1)
+        faults.on_ingest()
+        assert not killed
+        with faults.inject(faults.FaultPlan(kill_server_after_slices=2,
+                                            drop_client={1: 1},
+                                            stall_ingest=0.05)) as stats:
+            faults.on_server_slice(1)
+            assert not killed
+            faults.on_server_slice(2)
+            assert killed and stats["server_kills"] == 1
+            with pytest.raises(faults.InjectedFault):
+                faults.on_client_op(1)
+            faults.on_client_op(1)        # budget spent: clean
+            assert stats["client_drops"] == 1
+            t0 = time.monotonic()
+            faults.on_ingest()
+            assert time.monotonic() - t0 >= 0.05
+            assert stats["ingest_stalls"] == 1
+    finally:
+        faults._SELF_KILL = orig
+
+
+def test_answer_failure_journals_undelivered(tmp_path, monkeypatch):
+    """The TcpServiceFrontend satellite: a response the fabric cannot
+    deliver is journaled, so a reconnecting client still fetches the
+    result by request id."""
+    from tpusppy.service.net import TcpServiceFrontend
+
+    srv = SolveServer(work_dir=str(tmp_path), _start_executor=False,
+                      arm_caches=False)
+    srv.submit(_req("req-u"))
+    front = TcpServiceFrontend(srv, slots=1)
+    try:
+        def boom(values):
+            raise RuntimeError("TCP window service connection lost")
+        monkeypatch.setattr(front.fabric.to_spoke[1], "put", boom)
+        payload = {"request_id": "req-u", "status": "done", "rel_gap": 1e-4}
+        front._answer(1, payload)          # must not raise
+        assert metrics.value("service.undelivered_journaled") == 1
+        assert srv.journal.replay()["req-u"].undelivered == payload
+        # the banked payload IS fetchable: even with no terminal status
+        # transition journaled, the fetch-by-id path serves it
+        assert srv._journal_record("req-u") == payload
+    finally:
+        front.close()
+
+
+# ---------------------------------------------------------------------------
+# real wheels: crash-park-recover, deadlines, retire races, dead sockets
+# ---------------------------------------------------------------------------
+
+def test_shutdown_park_recover_complete_warm(tmp_path):
+    """The restart story end to end (in-process twin of the serving
+    chaos smoke): a running tenant is parked by shutdown(wait=False),
+    a RECOVERING server over the same work dir resumes it from the park
+    checkpoint — PHIterLimit keeps meaning TOTAL iterations, bounds are
+    monotone vs the parked snapshot, queue_wait is not double-counted —
+    and a follower of the (now completed) family binds warm with zero
+    recompiles."""
+    from tpusppy.solvers import aot
+
+    work = str(tmp_path)
+    limit = 1500
+    with SolveServer(work_dir=work, quantum_secs=600.0,
+                     linger_secs=10.0) as srv:
+        # an uncertifiable target: the wheel cannot finish before the
+        # shutdown lands (it would need all `limit` iterations)
+        rid = srv.submit(_req("req-r", iters=limit, rel_gap=1e-12))
+        t = srv._tenants[rid]
+        deadline = time.monotonic() + 240
+        while t.record["ttfi_s"] is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert t.record["ttfi_s"] is not None, "wheel never reached iter-1"
+        srv.shutdown(wait=False)
+        assert t.status == "parked", t.status
+        qw1 = t.record["queue_wait_s"]
+        park_iter = t.record["iters"]
+        park_outer, park_inner = t.record["outer"], t.record["inner"]
+    assert park_iter < limit
+    assert ck.latest_iteration(t.dir) is not None
+
+    srv2 = SolveServer.recover_from(work, quantum_secs=600.0,
+                                    linger_secs=10.0)
+    try:
+        rec = srv2.result("req-r", timeout=240)
+        assert rec["status"] == "done"
+        assert rec["recovered"] == "warm"
+        assert rec["slices"] >= 2 and rec["preemptions"] >= 1
+        # PHIterLimit is TOTAL across the restart, not per-lifetime
+        assert rec["iters"] == limit
+        assert not rec["certified"]        # 1e-12 is unreachable — the
+        # bounds monotone vs the parked snapshot
+        assert rec["bounds_monotone"]      # budget exhausts uncertified
+        assert rec["outer"] >= park_outer - 1e-9
+        assert rec["inner"] <= park_inner + 1e-9
+        # queue_wait is the FIRST lifetime's number, not re-accumulated
+        assert rec["queue_wait_s"] == pytest.approx(qw1, abs=1e-9)
+        s = srv2.slo_summary()
+        assert s["completed"] == 1 and s["p50_queue_wait_s"] is not None
+        # duplicate submit across the restart resolves to the original
+        assert srv2.submit(_req("req-r")) == "req-r"
+        assert srv2.result("req-r", timeout=5)["status"] == "done"
+        # a follower of the completed family binds WARM: zero recompiles
+        mark = aot.session_mark()
+        rec2 = srv2.result(srv2.submit(_req("req-w", seed=31)), timeout=240)
+        assert rec2["status"] == "done" and rec2["certified"]
+        assert rec2["warm_hit"] and rec2["aot_misses"] == 0
+        assert aot.session_keys_since(mark) == []
+    finally:
+        srv2.shutdown()
+
+
+def test_deadline_parks_and_fails_uncertified(tmp_path):
+    """deadline_secs: an impossible-gap request exits FAILED at the
+    checkpoint seam shortly after its deadline — typed, checkpoint
+    banked, bounds in the record — instead of burning quantum forever."""
+    with SolveServer(work_dir=str(tmp_path), quantum_secs=2.0,
+                     linger_secs=5.0) as srv:
+        rid = srv.submit(_req("req-dl", iters=100000, rel_gap=1e-12,
+                              deadline=4.0))
+        rec = srv.result(rid, timeout=240)
+        assert rec["status"] == "failed"
+        assert rec["error_code"] == "deadline"
+        assert not rec["certified"]
+        assert rec["iters"] > 0 and rec["outer"] is not None
+        assert metrics.value("service.deadline_failed") == 1
+        # the park state is banked — a recovering server COULD resume it
+        assert ck.latest_iteration(srv._tenants[rid].dir) is not None
+    # a NEW lifetime over the same work dir answers the journaled
+    # record by id even without full recovery (the fetch-by-id path)
+    srv2 = SolveServer(work_dir=str(tmp_path), _start_executor=False,
+                       arm_caches=False)
+    assert srv2.result(rid, timeout=1)["error_code"] == "deadline"
+
+
+def test_retire_finished_races_pending_result_waiter(tmp_path):
+    """retire_finished must not strand a result() waiter that grabbed
+    the tenant before the sweep: the waiter holds the tenant OBJECT, so
+    a sweep landing between the status flip and done.set() still
+    unblocks it with the full record.  A FULLY retired id (dropped from
+    memory AND compacted out of the journal) raises a clean KeyError."""
+    with SolveServer(work_dir=str(tmp_path), quantum_secs=600.0,
+                     linger_secs=10.0) as srv:
+        rid = srv.submit(_req("req-race", iters=150))
+        got, errs = [], []
+
+        def waiter():
+            try:
+                got.append(srv.result(rid, timeout=240))
+            except Exception as e:         # pragma: no cover - failure
+                errs.append(e)
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        # hammer the retire sweep while the request runs and completes
+        end = time.monotonic() + 240
+        while th.is_alive() and time.monotonic() < end:
+            srv.retire_finished(keep=0)
+            time.sleep(0.02)
+        th.join(timeout=10)
+        assert not errs and got, (errs, got)
+        assert got[0]["status"] == "done"
+        # fully retired: gone from memory AND compacted out of the
+        # journal — a late result() is a typed miss, never a hang
+        srv.retire_finished(keep=0)
+        assert rid not in srv._tenants
+        assert rid not in srv.journal.replay()
+        with pytest.raises(KeyError):
+            srv.result(rid, timeout=1)
+
+
+def test_client_dead_socket_raises_server_lost(tmp_path, monkeypatch):
+    """A crashed server costs a waiter bounded seconds, not the full
+    poll timeout: wait() detects the dead socket, reconnect-with-backoff
+    exhausts, and the typed ServerLost surfaces."""
+    from tpusppy.runtime import tcp_window_service as tws
+    from tpusppy.service.net import (ServerLost, SolveClient,
+                                     TcpServiceFrontend)
+
+    monkeypatch.setattr(tws, "_RETRIES", 1)       # shrink the inner
+    monkeypatch.setattr(tws, "_BACKOFF_BASE", 0.05)  # mailbox retry tier
+    srv = SolveServer(work_dir=str(tmp_path), _start_executor=False,
+                      arm_caches=False)
+    front = TcpServiceFrontend(srv, slots=1)
+    cli = SolveClient("127.0.0.1", front.port, front.secret, slot=1,
+                      reconnect_tries=1, reconnect_backoff=0.05,
+                      reconnect_dial_secs=0.5)
+    front.close()                                 # the server "crash"
+    t0 = time.monotonic()
+    with pytest.raises(ServerLost) as ei:
+        cli.wait(timeout=600.0)
+    assert time.monotonic() - t0 < 30.0
+    assert ei.value.code == "server_lost"
+    assert metrics.value("service.server_lost") == 1
+    cli.close()
+
+
+def test_tcp_fetch_by_id_and_structured_errors(tmp_path):
+    """The reconnect recipe: fetch-by-id answers finished records (from
+    the journal, across lifetimes), unknown ids answer a typed error
+    payload, and a malformed submit answers bad_request — never a
+    client poll-to-timeout."""
+    from tpusppy.service.net import SolveClient, TcpServiceFrontend
+
+    work = str(tmp_path)
+    srv = SolveServer(work_dir=work, _start_executor=False,
+                      arm_caches=False)
+    # a finished obligation from a "previous lifetime": journal only
+    srv.journal.accepted(rid="req-done", seq=0, request={}, family="f",
+                         checkpoint_dir="")
+    srv.journal.transition("req-done", "done",
+                           {"request_id": "req-done", "status": "done",
+                            "rel_gap": 2e-4, "certified": True})
+    front = TcpServiceFrontend(srv, slots=1)
+    try:
+        cli = SolveClient("127.0.0.1", front.port, front.secret, slot=1)
+        rec = cli.fetch("req-done", timeout=30)
+        assert rec["status"] == "done" and rec["rel_gap"] == 2e-4
+        rec = cli.fetch("req-unknown", timeout=30)
+        assert rec["status"] == "failed"
+        assert rec["error_code"] == "unknown_request"
+        cli.submit({"model": "no-such-model", "num_scens": 3})
+        rec = cli.wait(timeout=30)
+        assert rec["status"] == "failed"
+        assert rec["error_code"] == "bad_request"
+        cli.close()
+    finally:
+        front.close()
+
+
+def test_undelivered_without_accepted_replays_as_finished_stub(tmp_path):
+    """An undeliverable response for a request that was never ACCEPTED
+    (overload/shutdown/bad-request rejections carry no 'accepted' line)
+    still replays — as a finished, NON-recoverable stub that serves
+    fetch-by-id, in the same lifetime and after a recovery, and can
+    never be re-admitted as a runnable obligation."""
+    j = RequestJournal(str(tmp_path / "journal.jsonl"))
+    payload = {"request_id": "req-rej", "status": "rejected",
+               "error_code": "overload", "error": "queue full"}
+    j.undelivered("req-rej", payload)
+    j.undelivered("", {"status": "failed"})   # unattributable: dropped
+    fold = j.replay()
+    assert set(fold) == {"req-rej"}
+    assert fold["req-rej"].finished and not fold["req-rej"].recoverable
+    assert fold["req-rej"].undelivered == payload
+    srv = SolveServer(work_dir=str(tmp_path), recover=True,
+                      _start_executor=False, arm_caches=False)
+    try:
+        # the stub is a finished record, not a queued obligation, and
+        # the banked rejection is what result()/fetch answer by id
+        assert srv._journal_record("req-rej") == payload
+        t = srv.lookup("req-rej")
+        assert t is not None and t.done.is_set()
+        assert t.record["error_code"] == "overload"
+        assert "" not in srv._families      # stubs are not warm capital
+    finally:
+        srv.shutdown(wait=False)
+
+
+def test_client_idempotency_key_survives_explicit_none(tmp_path):
+    """submit() must assign a stable client-side id even when the caller
+    passes an explicit ``request_id: None`` (setdefault would keep the
+    None and a reconnect-retried put could start a second solve)."""
+    from tpusppy.service.net import SolveClient, TcpServiceFrontend
+
+    srv = SolveServer(work_dir=str(tmp_path), _start_executor=False,
+                      arm_caches=False)
+    front = TcpServiceFrontend(srv, slots=1)
+    try:
+        cli = SolveClient("127.0.0.1", front.port, front.secret, slot=1)
+        rid = cli.submit({"model": "no-such-model", "num_scens": 3,
+                          "request_id": None})
+        assert rid and rid.startswith("req-")
+        rec = cli.wait(timeout=30, request_id=rid)
+        assert rec["error_code"] == "bad_request"
+        cli.close()
+    finally:
+        front.close()
+
+
+def test_wait_discards_stale_duplicate_response(tmp_path):
+    """wait(request_id=...) must not hand a duplicated op's response to
+    the NEXT request on the slot: a reconnect retry can re-run a put the
+    server already ingested, producing a second (idempotent) response
+    for the OLD id ahead of the new request's answer."""
+    from tpusppy.service.net import SolveClient, TcpServiceFrontend
+
+    srv = SolveServer(work_dir=str(tmp_path), _start_executor=False,
+                      arm_caches=False)
+    # two finished previous-lifetime records answerable by fetch
+    for i, rid in enumerate(["req-old", "req-new"]):
+        srv.journal.accepted(rid=rid, seq=i, request={}, family="f",
+                             checkpoint_dir="")
+        srv.journal.transition(rid, "done",
+                               {"request_id": rid, "status": "done",
+                                "rel_gap": 1e-4 * (i + 1)})
+    front = TcpServiceFrontend(srv, slots=1)
+    try:
+        cli = SolveClient("127.0.0.1", front.port, front.secret, slot=1)
+        # the duplicated op: two puts for req-old, but only ONE wait —
+        # the second response is left stale in the slot's box
+        cli.submit({"op": "fetch", "request_id": "req-old"})
+        cli.submit({"op": "fetch", "request_id": "req-old"})
+        assert cli.wait(timeout=30,
+                        request_id="req-old")["request_id"] == "req-old"
+        # without the id filter this would return req-old's duplicate
+        rec = cli.fetch("req-new", timeout=30)
+        assert rec["request_id"] == "req-new"
+        assert rec["rel_gap"] == 2e-4
+        cli.close()
+    finally:
+        front.close()

@@ -326,6 +326,24 @@ def latest(directory: str, verify_integrity: bool = True) -> str | None:
     return None
 
 
+def latest_iteration(directory: str) -> int | None:
+    """Iteration number of the newest VALID checkpoint in ``directory``
+    (None when there is none) — WITHOUT decompressing any array block.
+    The solve server's restart-recovery triage runs this per journaled
+    tenant to decide warm-resume vs loud cold restart, so it must stay
+    cheap even when a work dir holds many parked tenants.  One
+    directory walk (``latest()``'s verify loop, keeping the iteration
+    instead of discarding it) — re-listing after ``latest()`` could
+    race a concurrent prune and miss the match."""
+    for it, p in reversed(list_checkpoints(directory)):
+        if verify(p):
+            return int(it)
+        _CTR_CORRUPT_SKIPPED.inc(1)
+        _log.warning("checkpoint %s failed integrity verification — "
+                     "falling back to the previous complete set", p)
+    return None
+
+
 def load_latest(path: str) -> WheelCheckpoint | None:
     """Load ``path`` directly (a file) or its newest VALID checkpoint (a
     directory — corrupt sets are skipped with a

@@ -92,6 +92,21 @@ class FaultPlan:
       collective (slow-fabric emulation; a delay under
       ``TPUSPPY_MESH_TIMEOUT`` must NOT trip the watchdog, over it
       must).
+    kill_server_after_slices: k — SIGKILL THIS process (for real) the
+      moment the solve server finishes its k-th scheduler slice, via the
+      ``on_server_slice`` hook in ``service/server.py``.  The kill lands
+      MID-TRANSITION: the slice's wheel has torn down (its terminal
+      checkpoint is banked) but the park/completion has NOT been
+      journaled — exactly the window the restart-recovery path must
+      handle (doc/serving.md "Durability").
+    drop_client: {slot (int) or "*": n} — the next ``n`` SolveClient ops
+      on that request slot raise a transient connection-lost error,
+      consumed by the client's bounded reconnect-with-backoff path
+      (:class:`tpusppy.service.net.SolveClient`); exhausting it raises
+      the typed ``ServerLost``.
+    stall_ingest: secs — sleep inside ``SolveServer.submit`` before
+      ingest (a slow/stuck canonicalization: admission control and the
+      shutdown-race path must stay correct while ingest crawls).
     """
 
     kill_spoke: dict = dataclasses.field(default_factory=dict)
@@ -101,6 +116,9 @@ class FaultPlan:
     kill_controller: dict = dataclasses.field(default_factory=dict)
     partition_tcp: dict = dataclasses.field(default_factory=dict)
     delay_collectives: float = 0.0
+    kill_server_after_slices: int = 0
+    drop_client: dict = dataclasses.field(default_factory=dict)
+    stall_ingest: float = 0.0
 
 
 _PLAN: FaultPlan | None = None
@@ -128,7 +146,8 @@ def arm(plan: FaultPlan):
     plan = dataclasses.replace(
         plan, stale_mailbox=dict(plan.stale_mailbox),
         drop_tcp=dict(plan.drop_tcp),
-        partition_tcp=dict(plan.partition_tcp))
+        partition_tcp=dict(plan.partition_tcp),
+        drop_client=dict(plan.drop_client))
     _PLAN = plan
     return plan
 
@@ -236,6 +255,47 @@ def on_controller_iter(process_index: int, iteration: int):
     if k is not None and iteration >= int(k):
         _record("controller_kills")
         _SELF_KILL()
+
+
+def on_server_slice(slices_done: int):
+    """Called by the solve server after each scheduler slice's wheel
+    tears down (checkpoint banked, status transition NOT yet journaled):
+    SIGKILLs this process when the plan schedules the server's death at
+    (or before) the ``slices_done``-th slice — the deterministic sibling
+    of the serving-chaos smoke's external SIGKILL."""
+    plan = _PLAN
+    if plan is None or not plan.kill_server_after_slices:
+        return
+    if int(slices_done) >= int(plan.kill_server_after_slices):
+        _record("server_kills")
+        _SELF_KILL()
+
+
+def on_client_op(slot):
+    """Called inside each SolveClient transport op: raises a budgeted
+    transient connection-lost error (consumed by the client's bounded
+    reconnect-with-backoff; exhaustion surfaces as the typed
+    ``ServerLost``)."""
+    plan = _PLAN
+    if plan is None or not plan.drop_client:
+        return
+    if _budget(plan.drop_client, int(slot) if str(slot).isdigit()
+               else slot):
+        _record("client_drops")
+        raise InjectedFault(
+            f"TCP window service connection lost (injected client drop, "
+            f"slot {slot})")
+
+
+def on_ingest():
+    """Called by ``SolveServer.submit`` before canonicalization: stalls
+    the (unlocked) ingest for the configured seconds so the admission /
+    shutdown races around a slow ingest are drivable on demand."""
+    plan = _PLAN
+    if plan is None or not plan.stall_ingest:
+        return
+    _record("ingest_stalls")
+    time.sleep(float(plan.stall_ingest))
 
 
 def on_collective(what: str = ""):

@@ -263,9 +263,14 @@ class TcpMailbox:
         self.length = self._io("length", lambda: ep.length(self.box))
 
     def _io(self, opname: str, fn):
-        """Run one window op under the transient-failure retry policy."""
+        """Run one window op under the transient-failure retry policy.
+        An endpoint may pin ``io_retries`` (e.g. 0) when a HIGHER layer
+        owns reconnection — :class:`~tpusppy.service.net.SolveClient`
+        does, so its dead-server detection isn't multiplied through two
+        nested retry stacks reading the same env knobs."""
         delay = _BACKOFF_BASE
-        for attempt in range(_RETRIES + 1):
+        retries = getattr(self.ep, "io_retries", _RETRIES)
+        for attempt in range(retries + 1):
             try:
                 if _faults.active():    # deterministic drop/delay injection
                     _faults.on_tcp_io(self.name)
@@ -281,7 +286,7 @@ class TcpMailbox:
                 # already billed where they surface (_check / reconnect)
                 transient = "connection lost" in str(e)
                 if (not transient or not self.ep.can_reconnect
-                        or attempt == _RETRIES):
+                        or attempt == retries):
                     raise
                 _CTR_RETRIES.inc(1)
                 time.sleep(delay)

@@ -4,13 +4,18 @@ ROADMAP item 2; doc/serving.md.  ``canonical`` splits model ingest from
 wheel execution and fingerprints shape families; ``server`` keeps
 compiled programs + tune verdicts + warm device state resident across
 requests and time-slices concurrent wheels with checkpoint-seam
-preemption; ``net`` serves requests over the TCP window runtime.
+preemption; ``journal`` is the write-ahead request log that makes the
+server crash-safe (restart recovery re-admits every journaled tenant);
+``net`` serves requests over the TCP window runtime with reconnecting,
+idempotent clients.
 """
 
 from .canonical import CanonicalModel, content_fingerprint, family_key, ingest
-from .server import SolveRequest, SolveServer
+from .journal import RequestJournal
+from .server import ServerOverloaded, SolveRequest, SolveServer
 
 __all__ = [
-    "CanonicalModel", "SolveRequest", "SolveServer",
+    "CanonicalModel", "RequestJournal", "ServerOverloaded",
+    "SolveRequest", "SolveServer",
     "content_fingerprint", "family_key", "ingest",
 ]

@@ -61,8 +61,18 @@ class CanonicalModel:
 
     @property
     def family_digest(self) -> str:
-        """Stable short id of the family key (log/SLO-record friendly)."""
-        return hashlib.sha1(repr(self.family).encode()).hexdigest()[:12]
+        """Stable short id of the family key (log/SLO-record friendly).
+        Equal keys <=> equal digests, and the digest survives the
+        request journal as a plain string — it is THE cross-lifetime
+        family identity the durable server keys its affinity/warm
+        bookkeeping on (doc/serving.md "Durability")."""
+        return family_digest_of(self.family)
+
+
+def family_digest_of(family) -> str:
+    """sha1-prefix digest of a family-key tuple (see
+    :attr:`CanonicalModel.family_digest`)."""
+    return hashlib.sha1(repr(family).encode()).hexdigest()[:12]
 
 
 def _batch_family_parts(batch, settings, ndev, axis) -> tuple:

@@ -12,24 +12,71 @@ JSON payloads travel as raw bytes memcpy'd into the box's float64 array:
 ``[byte_length, utf-8 bytes padded to 8-byte multiples]``.  A slot
 serves requests SEQUENTIALLY (one in flight per slot); concurrency comes
 from using several slots — see doc/serving.md for the client recipe.
+
+Failure semantics (doc/serving.md "Durability"):
+
+- Server-side failures answer STRUCTURED error payloads — ``status``
+  plus a typed ``error_code`` ("overload", "bad_request", "deadline",
+  "exception", ...) and message — so a failed request NEVER presents to
+  the client as a poll-to-timeout.
+- :class:`SolveClient` detects a dead socket, reconnects with bounded
+  exponential backoff (the ``TPUSPPY_TCP_RETRIES``/``_BACKOFF`` knobs),
+  and raises the typed :class:`ServerLost` when reconnection exhausts —
+  immediately, not after the full poll timeout.
+- Requests are IDEMPOTENT by ``request_id``: a re-submit after a
+  reconnect (or across a server restart on the same work dir) resolves
+  to the original journaled record, and ``{"op": "fetch"}`` retrieves a
+  finished result by id — even one whose original delivery failed (the
+  frontend journals undeliverable responses).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 
 import numpy as np
 
+import uuid
+
+from ..obs import metrics as _metrics
 from ..obs.log import get_logger
-from .server import SolveRequest
+from ..resilience import faults as _faults
+from .server import ServerClosed, ServerOverloaded, SolveRequest
 
 _log = get_logger("service")
+
+_CTR_UNDELIVERED = _metrics.counter("service.undelivered_journaled")
+_CTR_CLIENT_RECONNECTS = _metrics.counter("service.client_reconnects")
+_CTR_SERVER_LOST = _metrics.counter("service.server_lost")
 
 #: Mailbox sizes in float64 slots (first slot = byte length).
 REQ_SLOTS = 4096          # ~32 KB of JSON per request
 RESP_SLOTS = 4096
+
+
+class ServiceError(RuntimeError):
+    """A structured serving failure: typed ``code`` + human message."""
+
+    def __init__(self, message: str, code: str = "error"):
+        super().__init__(message)
+        self.code = str(code)
+
+    @classmethod
+    def from_record(cls, record: dict) -> "ServiceError":
+        return cls(str(record.get("error") or "request failed"),
+                   code=str(record.get("error_code") or "error"))
+
+
+class ServerLost(ServiceError):
+    """The server is unreachable and bounded reconnection exhausted.
+    Raised IMMEDIATELY by :meth:`SolveClient.wait` on a dead socket —
+    a crashed server must never cost a waiter the full poll timeout."""
+
+    def __init__(self, message: str):
+        super().__init__(message, code="server_lost")
 
 
 def encode_payload(obj, length: int) -> np.ndarray:
@@ -64,6 +111,11 @@ class TcpServiceFrontend:
     request finishes.  Requests on DIFFERENT slots run through the
     scheduler concurrently (time-sliced), exactly like in-process
     submits.
+
+    Besides plain request dicts, a slot accepts
+    ``{"op": "fetch", "request_id": ...}`` — answer a (possibly
+    already-finished, possibly previous-lifetime) request's record by
+    id.  Unknown ids answer a structured ``unknown_request`` error.
     """
 
     def __init__(self, server, slots: int = 4, port: int = 0,
@@ -86,6 +138,29 @@ class TcpServiceFrontend:
                                         name="service-tcp", daemon=True)
         self._thread.start()
 
+    def _handle_fetch(self, slot: int, rid: str):
+        """Answer a fetch-by-id: finished records answer immediately
+        (live tenants first, then the journal — which also covers
+        previous server lifetimes and previously-undeliverable
+        responses); an unfinished tenant registers the slot to be
+        answered at completion."""
+        t = self.server.lookup(rid)
+        if t is not None and not t.done.is_set():
+            with self._lock:
+                self._pending[slot] = t
+            return
+        if t is not None:
+            self._answer(slot, dict(t.record))
+            return
+        rec = self.server._journal_record(rid)
+        if rec is not None:
+            self._answer(slot, rec)
+        else:
+            self._answer(slot, {
+                "request_id": rid, "status": "failed",
+                "error_code": "unknown_request",
+                "error": f"unknown (or fully retired) request id {rid!r}"})
+
     def _submit_async(self, slot: int, data):
         """Decode + ingest + submit on a per-request thread: ingest is
         minutes of single-core numpy at reference scale, and running it
@@ -93,14 +168,44 @@ class TcpServiceFrontend:
         every other slot.  The pending entry holds the TENANT OBJECT
         (not its id), so a ``retire_finished()`` sweep between
         completion and the next poll cannot orphan the response."""
+        rid = ""
         try:
-            req = SolveRequest.from_dict(decode_payload(data))
+            payload = decode_payload(data)
+            if isinstance(payload, dict) and payload.get("op") == "fetch":
+                self._handle_fetch(slot, str(payload.get("request_id")))
+                return
+            req = SolveRequest.from_dict(payload)
+            rid = req.request_id
             rid = self.server.submit(req)
+            t = self.server.lookup(rid)
+            if t is None:
+                # idempotent re-submit of a finished-and-retired (or
+                # previous-lifetime) id: the journal has the record
+                self._answer(slot, self.server._journal_record(rid) or {
+                    "request_id": rid, "status": "failed",
+                    "error_code": "unknown_request",
+                    "error": f"request {rid!r} resolved to no record"})
+                return
             with self._lock:
-                self._pending[slot] = self.server._tenants[rid]
+                self._pending[slot] = t
+        except ServerOverloaded as e:      # typed fast-fail: back off
+            _log.warning("slot %d: overloaded: %s", slot, e)
+            self._answer(slot, {"request_id": rid, "status": "rejected",
+                                "error_code": ServerOverloaded.code,
+                                "error": str(e)})
+        except ServerClosed as e:
+            # shutting down is not the client's fault: "unavailable"
+            # says retry against the restarted server, where the same
+            # well-formed request would succeed — never "bad_request"
+            _log.warning("slot %d: closed: %s", slot, e)
+            self._answer(slot, {"request_id": rid, "status": "rejected",
+                                "error_code": ServerClosed.code,
+                                "error": str(e)})
         except Exception as e:             # malformed request: answer it
             _log.warning("slot %d: bad request: %r", slot, e)
-            self._answer(slot, {"status": "failed", "error": repr(e)})
+            self._answer(slot, {"request_id": rid, "status": "failed",
+                                "error_code": "bad_request",
+                                "error": repr(e)})
 
     def _loop(self):
         while not self._stop:
@@ -127,13 +232,23 @@ class TcpServiceFrontend:
     def _answer(self, slot: int, payload: dict):
         """Best-effort response put: a transient fabric error (client
         mid-reconnect, injected fault) must never kill the listener
-        thread — that would silently wedge EVERY slot forever."""
+        thread — that would silently wedge EVERY slot forever.  The
+        undeliverable response is JOURNALED (``service.undelivered_
+        journaled``) so a reconnecting client still fetches the result
+        by request id."""
         try:
             self.fabric.to_spoke[slot].put(
                 encode_payload(payload, RESP_SLOTS))
         except Exception as e:
-            _log.warning("slot %d: response put failed (dropped): %r",
-                         slot, e)
+            _log.warning("slot %d: response put failed (journaled for "
+                         "fetch-by-id): %r", slot, e)
+            _CTR_UNDELIVERED.inc(1)
+            try:
+                self.server.journal.undelivered(
+                    payload.get("request_id"), payload)
+            except Exception as je:
+                _log.warning("slot %d: undeliverable response could not "
+                             "be journaled either: %r", slot, je)
 
     def close(self):
         self._stop = True
@@ -142,38 +257,143 @@ class TcpServiceFrontend:
 
 
 class SolveClient:
-    """Remote client for one request slot of a TCP-served solve server."""
+    """Remote client for one request slot of a TCP-served solve server.
+
+    Reconnecting and idempotent: a transport failure triggers bounded
+    reconnect-with-backoff (``reconnect_tries`` total dials, backoff
+    from the ``TPUSPPY_TCP_BACKOFF`` knob); exhaustion raises the typed
+    :class:`ServerLost` IMMEDIATELY (a dead server never costs the full
+    poll timeout).  After a reconnect, re-:meth:`submit` with the same
+    ``request_id`` (idempotent server-side) or :meth:`fetch` the result
+    by id — including across a server restart on the same work dir.
+    """
 
     def __init__(self, host: str, port: int, secret: int, slot: int = 1,
-                 connect_timeout: float = 60.0):
+                 connect_timeout: float = 60.0,
+                 reconnect_tries: int | None = None,
+                 reconnect_backoff: float | None = None,
+                 reconnect_dial_secs: float = 1.0):
         from ..runtime.tcp_window_service import TcpWindowFabric
 
         self.fabric = TcpWindowFabric(connect=(host, port), secret=secret,
                                       connect_timeout=connect_timeout)
         self.slot = int(slot)
+        # RE-dials use a SHORT per-dial deadline: the C++ connect retries
+        # until its timeout (rendezvous semantics — right for the first
+        # connect, wrong mid-recovery), so redialing a dead server with
+        # the full connect_timeout would multiply into minutes across
+        # the retry stack before ServerLost could surface
+        self.fabric.ep._connect_spec = (
+            str(host), int(port), float(reconnect_dial_secs))
+        # the mailbox's own transparent per-op retry is driven by the
+        # SAME env knobs as _op — nested, a dead server would cost
+        # (retries+1)^2 dials before ServerLost could surface.  The
+        # client layer owns reconnection outright: inner ops fail fast,
+        # _op backs off and redials on the short per-dial spec above
+        self.fabric.ep.io_retries = 0
+        self.reconnect_tries = int(
+            reconnect_tries if reconnect_tries is not None
+            else os.environ.get("TPUSPPY_TCP_RETRIES", "4"))
+        self.reconnect_backoff = float(
+            reconnect_backoff if reconnect_backoff is not None
+            else os.environ.get("TPUSPPY_TCP_BACKOFF", "0.1"))
         self._last_resp = self.fabric.to_spoke[self.slot].write_id
 
-    def submit(self, request: dict):
-        """Send one request dict (model/num_scens/creator_kwargs/options)."""
-        self.fabric.to_hub[self.slot].put(
-            encode_payload(request, REQ_SLOTS))
+    def _op(self, fn):
+        """One transport op under the client-level reconnect policy (on
+        top of the mailbox's own per-op retry).  Raises
+        :class:`ServerLost` when every dial fails."""
+        delay = self.reconnect_backoff
+        for attempt in range(self.reconnect_tries + 1):
+            try:
+                if _faults.active():       # deterministic flaky-client
+                    _faults.on_client_op(self.slot)
+                return fn()
+            except (RuntimeError, OSError) as e:
+                if "connection lost" not in str(e):
+                    raise                  # not a transport death: loud
+                if attempt == self.reconnect_tries:
+                    _CTR_SERVER_LOST.inc(1)
+                    raise ServerLost(
+                        f"server unreachable on slot {self.slot} after "
+                        f"{attempt + 1} attempt(s): {e}") from e
+                time.sleep(delay)
+                delay = min(delay * 2.0, 5.0)
+                try:
+                    self.reconnect()
+                except (RuntimeError, OSError):
+                    continue               # keep backing off
 
-    def wait(self, timeout: float = 600.0, poll_secs: float = 0.1) -> dict:
-        """Block for this slot's next response; returns the SLO record."""
+    def reconnect(self):
+        """Re-dial the server (same host/port/secret).  A RESTARTED
+        server's mailboxes start at write-id 0 — the response cursor
+        rewinds so the restarted lifetime's responses aren't skipped
+        (responses are keyed by request id, never by cursor position)."""
+        self.fabric.ep.reconnect()
+        _CTR_CLIENT_RECONNECTS.inc(1)
+        wid = self.fabric.to_spoke[self.slot].write_id
+        self._last_resp = min(self._last_resp, wid)
+
+    def submit(self, request: dict) -> str:
+        """Send one request dict (model/num_scens/creator_kwargs/options/
+        request_id/deadline_secs); returns the request id.  A missing
+        ``request_id`` is assigned HERE, client-side, before the wire —
+        the reconnect path below may re-run the put (connection lost
+        mid-op with the first put already ingested), and only a stable
+        id makes that retry resolve idempotently server-side instead of
+        starting a second solve."""
+        request = dict(request)
+        if request.get("op") != "fetch" and not request.get("request_id"):
+            # not setdefault: an explicit ``request_id: None`` (natural
+            # when plumbing an optional parameter) must be replaced too,
+            # or the retried put starts a second solve after all
+            request["request_id"] = f"req-{uuid.uuid4().hex[:10]}"
+        self._op(lambda: self.fabric.to_hub[self.slot].put(
+            encode_payload(request, REQ_SLOTS)))
+        return str(request.get("request_id") or "")
+
+    def wait(self, timeout: float = 600.0, poll_secs: float = 0.1,
+             request_id: str | None = None) -> dict:
+        """Block for this slot's next response; returns the SLO record.
+        A dead socket raises :class:`ServerLost` as soon as bounded
+        reconnection exhausts — never after silently polling out the
+        full ``timeout``.
+
+        When ``request_id`` is given, a response carrying a DIFFERENT
+        (non-empty) id is consumed and discarded instead of returned:
+        the reconnect path can re-run a put the server already ingested,
+        and the duplicate's idempotent answer would otherwise be handed
+        to the NEXT request on the slot, shifting every later response
+        off by one.  Error answers the server could not attribute to an
+        id (``request_id`` "") still match — a malformed-request
+        rejection must not poll out the timeout."""
         t0 = time.time()
         mb = self.fabric.to_spoke[self.slot]
         while time.time() - t0 < timeout:
-            data, wid = mb.get()
+            data, wid = self._op(mb.get)
             if wid > self._last_resp:
                 self._last_resp = wid
-                return decode_payload(data)
+                payload = decode_payload(data)
+                rid = str((payload or {}).get("request_id") or "")
+                if (request_id is not None and rid
+                        and rid != str(request_id)):
+                    continue           # stale duplicate-op response
+                return payload
             time.sleep(poll_secs)
         raise TimeoutError(f"no response on slot {self.slot} "
                            f"after {timeout}s")
 
+    def fetch(self, request_id: str, timeout: float = 600.0) -> dict:
+        """Retrieve a request's record by id — finished requests (even
+        from a previous server lifetime, or whose original response
+        delivery failed) answer from the journal; unfinished ones answer
+        at completion."""
+        self.submit({"op": "fetch", "request_id": str(request_id)})
+        return self.wait(timeout=timeout, request_id=str(request_id))
+
     def solve(self, request: dict, timeout: float = 600.0) -> dict:
-        self.submit(request)
-        return self.wait(timeout=timeout)
+        rid = self.submit(request)
+        return self.wait(timeout=timeout, request_id=rid or None)
 
     def close(self):
         self.fabric.close()

@@ -47,7 +47,9 @@ import numpy as np
 
 from ..obs import metrics as _metrics
 from ..obs.log import get_logger
+from ..resilience import faults as _faults
 from . import canonical as _canonical
+from .journal import RequestJournal
 
 _log = get_logger("service")
 
@@ -57,9 +59,32 @@ _CTR_FAILED = _metrics.counter("service.failed")
 _CTR_WARM_HITS = _metrics.counter("service.warm_hits")
 _CTR_COLD_FAMILIES = _metrics.counter("service.cold_families")
 _CTR_SLICES = _metrics.counter("service.slices")
+_CTR_RECOVERED = _metrics.counter("service.recovered")
+_CTR_RECOVERED_COLD = _metrics.counter("service.recovered_cold")
+_CTR_REJECTED = _metrics.counter("service.rejected_overload")
+_CTR_DEADLINE = _metrics.counter("service.deadline_failed")
+_CTR_DUPLICATES = _metrics.counter("service.duplicate_submits")
 _HIST_QUEUE_WAIT = _metrics.histogram("service.queue_wait_s")
 _HIST_WALL = _metrics.histogram("service.wall_s")
 _HIST_TTFI = _metrics.histogram("service.ttfi_s")
+
+
+class ServerOverloaded(RuntimeError):
+    """Typed fast-fail admission rejection: the bounded queue is full.
+    Over the TCP transport this surfaces as a structured
+    ``{"status": "rejected", "error_code": "overload"}`` payload —
+    clients back off instead of timing out."""
+
+    code = "overload"
+
+
+class ServerClosed(RuntimeError):
+    """Submit refused because the server is shutting down.  Typed (and
+    surfaced over TCP as ``error_code="unavailable"``) so a client can
+    tell "retry against the restarted server" apart from "my request is
+    malformed"."""
+
+    code = "unavailable"
 
 
 def _model_registry():
@@ -92,12 +117,21 @@ class SolveRequest:
         ``kw_creator``).
       options: opt/hub option overrides (PHIterLimit, rel_gap,
         solver_options, ...).  ``rel_gap`` defaults to the server's.
-      request_id: optional stable id (generated when empty).
+      request_id: optional stable id (generated when empty).  A STABLE
+        id is the idempotency key: re-submitting a journaled id — a
+        client retry after a reconnect or a server restart — resolves to
+        the original record instead of starting a second run.
+      deadline_secs: optional wall-clock budget from ACCEPTANCE: a
+        request still unfinished past it parks at the next checkpoint
+        seam and completes ``failed`` (``error_code="deadline"``,
+        checkpoint banked) instead of burning scheduler quantum forever.
+        The deadline is absolute — it keeps ticking across server
+        restarts.
     """
 
     def __init__(self, model="farmer", num_scens=3, creator_kwargs=None,
                  options=None, request_id=None, scenario_creator=None,
-                 names=None):
+                 names=None, deadline_secs=None):
         self.model = str(model)
         self.num_scens = int(num_scens)
         self.creator_kwargs = dict(creator_kwargs or {})
@@ -105,6 +139,13 @@ class SolveRequest:
         self.request_id = request_id or f"req-{uuid.uuid4().hex[:10]}"
         self.scenario_creator = scenario_creator
         self.names = names
+        if deadline_secs is None:
+            # options spelling works too, like rel_gap/linger_secs (it
+            # is a hub-side knob — _resolve pops it from the canonical
+            # settings key either way)
+            deadline_secs = self.options.get("deadline_secs")
+        self.deadline_secs = (None if deadline_secs is None
+                              else float(deadline_secs))
 
     @classmethod
     def from_dict(cls, d: dict) -> "SolveRequest":
@@ -112,16 +153,53 @@ class SolveRequest:
                    num_scens=d.get("num_scens", 3),
                    creator_kwargs=d.get("creator_kwargs"),
                    options=d.get("options"),
-                   request_id=d.get("request_id"))
+                   request_id=d.get("request_id"),
+                   deadline_secs=d.get("deadline_secs"))
+
+    def to_dict(self) -> dict:
+        """The journal/wire form.  Custom in-process creators are NOT
+        representable (callables don't journal) — such requests are
+        accepted but flagged unrecoverable in the WAL."""
+        return {"model": self.model, "num_scens": self.num_scens,
+                "creator_kwargs": dict(self.creator_kwargs),
+                "options": dict(self.options),
+                "request_id": self.request_id,
+                "deadline_secs": self.deadline_secs}
+
+
+def _blank_record(rid, model, family, fingerprint) -> dict:
+    """THE SLO-record template — the single source of the field set
+    (both tenant constructors build from it; a recovered tenant's
+    journaled snapshot overlays it, so a field added here can never be
+    silently absent after a restart)."""
+    return {
+        "request_id": rid, "model": model,
+        "family": family, "fingerprint": fingerprint,
+        "status": "queued", "warm_hit": None,
+        "queue_wait_s": None, "exec_s": 0.0, "wall_s": None,
+        "ttfi_s": None, "compile_s": 0.0,
+        "aot_hits": 0.0, "aot_misses": 0.0,
+        "slices": 0, "preemptions": 0, "iters": 0,
+        "iters_per_sec": None, "rel_gap": None,
+        "inner": None, "outer": None, "certified": False,
+        "bounds_monotone": True, "error": None, "error_code": None,
+        "recovered": None,
+    }
 
 
 class _Tenant:
-    """Scheduler-side state of one request."""
+    """Scheduler-side state of one request.
+
+    ``family`` is the canonical model's FAMILY DIGEST (the stable short
+    hash of the family-key tuple) rather than the tuple itself: equal
+    tuples <=> equal digests, and a digest survives the journal, so
+    affinity/warm bookkeeping keys stay comparable across server
+    restarts."""
 
     def __init__(self, req, canon, opt_options, creator, names, workdir):
         self.req = req
         self.canonical = canon             # dropped on completion (the
-        self.family = canon.family         # batched arrays are the bulk
+        self.family = canon.family_digest  # batched arrays are the bulk
         self.opt_options = opt_options     # of a tenant's footprint)
         self.creator = creator
         self.names = names
@@ -131,23 +209,60 @@ class _Tenant:
         self.status = "queued"
         self.slices = 0
         self.submitted = time.monotonic()
+        self.deadline_at = (time.time() + req.deadline_secs
+                            if req.deadline_secs else None)
         self.first_exec = None
         self.done = threading.Event()
         self.last_outer = -inf
         self.last_inner = inf
-        self.record = {
-            "request_id": self.id, "model": req.model,
-            "family": canon.family_digest,
-            "fingerprint": canon.fingerprint[:12],
-            "status": "queued", "warm_hit": False,
-            "queue_wait_s": None, "exec_s": 0.0, "wall_s": None,
-            "ttfi_s": None, "compile_s": 0.0,
-            "aot_hits": 0.0, "aot_misses": 0.0,
-            "slices": 0, "preemptions": 0, "iters": 0,
-            "iters_per_sec": None, "rel_gap": None,
-            "inner": None, "outer": None, "certified": False,
-            "bounds_monotone": True, "error": None,
-        }
+        self.record = _blank_record(self.id, req.model,
+                                    canon.family_digest,
+                                    canon.fingerprint[:12])
+
+    def past_deadline(self) -> bool:
+        return self.deadline_at is not None and time.time() > self.deadline_at
+
+    @classmethod
+    def from_journal(cls, jr, workdir):
+        """Rebuild scheduler bookkeeping from a journal record — the
+        restart-recovery constructor.  The canonical model is NOT
+        rebuilt here (finished stubs never need it; unfinished tenants
+        re-ingest in ``SolveServer._recover``)."""
+        t = object.__new__(cls)
+        t.req = (SolveRequest.from_dict(jr.request) if jr.request
+                 else SolveRequest(request_id=jr.rid))
+        t.req.request_id = jr.rid
+        t.canonical = None
+        t.opt_options = None
+        t.creator = None
+        t.names = None
+        t.family = jr.family
+        t.id = jr.rid
+        t.dir = jr.checkpoint_dir or os.path.join(workdir, "tenants",
+                                                  jr.rid)
+        t.seq = int(jr.seq)
+        t.status = jr.status
+        t.slices = int(jr.record.get("slices") or 0)
+        t.submitted = time.monotonic()
+        t.deadline_at = jr.deadline_at
+        t.first_exec = None
+        t.done = threading.Event()
+        rec = dict(jr.record)
+        if not rec and jr.undelivered:
+            # no status snapshot ever landed (an undelivered-rejection
+            # stub, or a terminal transition whose append failed): the
+            # banked response payload is the best record we have
+            rec = dict(jr.undelivered)
+        ob, ib = rec.get("outer"), rec.get("inner")
+        t.last_outer = float(ob) if ob is not None and np.isfinite(ob) \
+            else -inf
+        t.last_inner = float(ib) if ib is not None and np.isfinite(ib) \
+            else inf
+        base = _blank_record(t.id, t.req.model, jr.family, "")
+        base.update(rec)
+        base["status"] = jr.status
+        t.record = base
+        return t
 
 
 class SolveServer:
@@ -164,19 +279,39 @@ class SolveServer:
       arm_caches: arm the AOT executable cache + persistent tune-verdict
         store under ``work_dir`` (kept as-is when the process already
         armed them).
+      max_queue: admission bound — a submit that would push the run
+        queue past this depth fast-fails with the typed
+        :class:`ServerOverloaded` (``service.rejected_overload``).
+        None (default) = unbounded.
+      checkpoint_every_secs: mid-slice checkpoint cadence for every
+        tenant wheel (on top of the terminal park capture) — bounds how
+        much work a server crash can cost a RUNNING tenant.
+      recover: replay the work dir's request journal on startup
+        (doc/serving.md "Durability"): parked tenants re-ingest and
+        resume from their banked checkpoints (warm — the AOT disk cache
+        under the same work dir re-arms first), queued-never-started
+        tenants re-enter the queue in submission order, mid-slice
+        tenants without a complete checkpoint restart from scratch
+        loudly (``service.recovered_cold``), and finished tenants'
+        records stay fetchable by id.  :meth:`recover_from` is the
+        explicit spelling.
     """
 
     def __init__(self, work_dir=None, quantum_secs=5.0, rel_gap=1e-3,
-                 linger_secs=30.0, arm_caches=True):
+                 linger_secs=30.0, arm_caches=True, max_queue=None,
+                 checkpoint_every_secs=20.0, recover=False,
+                 _start_executor=True):
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="tpusppy_srv_")
         os.makedirs(os.path.join(self.work_dir, "tenants"), exist_ok=True)
         self.quantum_secs = float(quantum_secs)
         self.rel_gap = float(rel_gap)
         self.linger_secs = float(linger_secs)
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.checkpoint_every_secs = float(checkpoint_every_secs)
         self._cv = threading.Condition()
         self._runq: collections.deque = collections.deque()
         self._tenants: dict = {}
-        self._families: dict = {}          # family key -> request count
+        self._families: dict = {}          # family digest -> request count
         self._families_done: set = set()   # families with a COMPLETED run
         self._family_open: dict = {}       # family -> set of UNFINISHED seqs
                                            # (affinity checks stay O(open),
@@ -185,11 +320,30 @@ class SolveServer:
         self._stop = False
         self._drain = True                 # shutdown(wait=True) semantics
         self._seq = 0
+        # the write-ahead request journal (service/journal.py): accepted
+        # requests + status transitions persist under the work dir, so a
+        # crashed server's obligations survive it
+        self.journal = RequestJournal(
+            os.path.join(self.work_dir, "journal.jsonl"))
         if arm_caches:
             self._arm_caches()
-        self._executor = threading.Thread(
-            target=self._executor_loop, name="solve-server", daemon=True)
-        self._executor.start()
+        if recover:
+            self._recover()
+        self._executor = None
+        if _start_executor:
+            self._executor = threading.Thread(
+                target=self._executor_loop, name="solve-server",
+                daemon=True)
+            self._executor.start()
+
+    @classmethod
+    def recover_from(cls, work_dir, **kwargs):
+        """A restarted server over an existing ``work_dir``: replay the
+        journal, re-admit every unfinished tenant, serve finished
+        records by id.  Equivalent to ``SolveServer(work_dir=...,
+        recover=True, ...)``."""
+        kwargs.setdefault("recover", True)
+        return cls(work_dir=work_dir, **kwargs)
 
     # ---- lifecycle ----------------------------------------------------------
     def _arm_caches(self):
@@ -212,6 +366,146 @@ class SolveServer:
         except Exception:      # tune persistence is an optimization only
             pass
 
+    # ---- restart recovery ---------------------------------------------------
+    def _recover(self):
+        """Replay the journal into live scheduler state.  Runs on the
+        constructing thread BEFORE the executor starts, so no locking is
+        needed against ourselves — and any prewarm the cache arm did has
+        already finished (the loader must never race a compile)."""
+        from ..resilience import checkpoint as _ckpt
+
+        replayed = self.journal.replay()
+        if not replayed:
+            return
+        # journal writes during recovery go through the degrade-not-die
+        # guard like everywhere else: an unwritable journal (disk full)
+        # must not abort the restart and strand every journaled
+        # obligation — it costs durability of the NEXT crash only
+        self._journal_append_safe(lambda: self.journal.recovery_marker(
+            {"pid": os.getpid(), "journaled": len(replayed)}))
+        self._seq = max(r.seq for r in replayed.values()) + 1
+        for jr in sorted(replayed.values(), key=lambda r: r.seq):
+            t = _Tenant.from_journal(jr, self.work_dir)
+            self._tenants[t.id] = t
+            if jr.finished:
+                # finished in a previous lifetime: the record stays
+                # fetchable by id (result()/the TCP fetch op), and a
+                # completed family is warm capital for followers
+                # (undelivered-rejection stubs carry no family)
+                if t.family:
+                    self._families[t.family] = \
+                        self._families.get(t.family, 0) + 1
+                    if jr.status == "done":
+                        self._families_done.add(t.family)
+                t.done.set()
+                continue
+            if not jr.recoverable:
+                # custom in-process creators don't journal (callables):
+                # fail the obligation loudly rather than strand waiters
+                t.status = "failed"
+                t.record.update(
+                    status="failed", error_code="unrecoverable",
+                    error="request used a custom scenario_creator — not "
+                          "recoverable across a server restart")
+                self._families[t.family] = \
+                    self._families.get(t.family, 0) + 1
+                self._journal_safe(t.id, "failed", t.record)
+                _CTR_FAILED.inc(1)
+                t.done.set()
+                continue
+            try:
+                creator, names, kwargs, opt_options = self._resolve(t.req)
+                canon = _canonical.ingest(names, creator, kwargs,
+                                          options=opt_options)
+                t.req.creator_kwargs = kwargs
+                t.canonical, t.opt_options = canon, opt_options
+                t.creator, t.names = creator, names
+                t.record["fingerprint"] = canon.fingerprint[:12]
+                drifted = bool(jr.family
+                               and canon.family_digest != jr.family)
+                if drifted:
+                    # the model code changed between lifetimes: the
+                    # banked checkpoint/executables belong to a
+                    # DIFFERENT program family — it must never be
+                    # resumed (shape/settings mismatch), so the warm
+                    # branch below is off the table and the stale
+                    # checkpoints are wiped by the cold slice's
+                    # fresh_start
+                    _log.warning(
+                        "request %s: family drifted across restart "
+                        "(%s -> %s) — cold restart", t.id, jr.family,
+                        canon.family_digest)
+                    t.family = canon.family_digest
+                    t.record["family"] = canon.family_digest
+                    t.slices = 0
+                    # PERSIST the new family: replay folds `family` from
+                    # the accepted event, so without re-journaling it a
+                    # SECOND restart would re-detect "drift" against the
+                    # stale digest and wipe the legitimately-banked
+                    # new-family checkpoints all over again
+                    self._journal_append_safe(
+                        lambda t=t, jr=jr, canon=canon:
+                        self.journal.accepted(
+                            rid=t.id, seq=t.seq,
+                            request=t.req.to_dict(),
+                            family=canon.family_digest,
+                            checkpoint_dir=t.dir,
+                            recoverable=jr.recoverable,
+                            deadline_at=t.deadline_at,
+                            record=t.record))
+            except Exception as e:
+                t.status = "failed"
+                t.record.update(status="failed", error_code="exception",
+                                error=repr(e))
+                self._families[t.family] = \
+                    self._families.get(t.family, 0) + 1
+                self._journal_safe(t.id, "failed", t.record)
+                _CTR_FAILED.inc(1)
+                t.done.set()
+                continue
+            banked = None if drifted else _ckpt.latest_iteration(t.dir)
+            started = jr.status in ("running", "parked") or t.slices > 0
+            if started and banked is not None:
+                # warm resume: the park (or mid-slice cadence) checkpoint
+                # carries W/xbars/rho + bounds; the next slice continues
+                # with PHIterLimit total-iteration semantics and bounds
+                # monotone vs the snapshot (seeded above from the
+                # journaled record)
+                t.slices = max(t.slices, 1)
+                t.record["recovered"] = "warm"
+                _log.info("request %s recovered PARKED at checkpoint "
+                          "iteration %d", t.id, banked)
+            elif started:
+                # mid-slice with no complete checkpoint: the slice's
+                # work is LOST — restart from scratch, loudly.  The
+                # record's execution state resets WITH the scheduler's
+                # (a journaled slices>0 would read as "started" at the
+                # next recovery and re-trigger the cold path forever)
+                _CTR_RECOVERED_COLD.inc(1)
+                t.slices = 0
+                t.record["recovered"] = "cold"
+                t.last_outer, t.last_inner = -inf, inf
+                t.record.update(slices=0, iters=0, ttfi_s=None,
+                                exec_s=0.0)
+                _log.warning(
+                    "request %s was mid-slice with no complete "
+                    "checkpoint — restarting from scratch", t.id)
+            else:
+                t.record["recovered"] = "requeued"
+            t.status = "queued"
+            t.record["status"] = "queued"
+            # family bookkeeping keyed on the FINAL digest (drift above
+            # may have rewritten t.family — counting earlier would bank
+            # the stale digest and double-count the family forever)
+            self._families[t.family] = self._families.get(t.family, 0) + 1
+            self._family_open.setdefault(t.family, set()).add(t.seq)
+            self._runq.append(t)           # seq-sorted iteration above
+            _CTR_RECOVERED.inc(1)          # => original admission order
+            self._journal_safe(t.id, "queued", t.record)
+        _log.info("recovery: %d journaled request(s) — %d re-admitted, "
+                  "%d already finished", len(replayed), len(self._runq),
+                  sum(1 for r in replayed.values() if r.finished))
+
     def __enter__(self):
         return self
 
@@ -219,12 +513,20 @@ class SolveServer:
         self.shutdown()
         return False
 
-    def shutdown(self, wait: bool = True, timeout: float = 600.0):
-        """Stop the server.  ``wait=True`` (default) drains the queue —
-        every submitted request finishes first; ``wait=False`` preempts
-        the running wheel at its next window boundary and leaves
-        unfinished tenants PARKED on disk (a later server over the same
-        work_dir could resume them)."""
+    def shutdown(self, wait: bool = True, timeout: float = 600.0,
+                 drain: bool | None = None, park_queued: bool = False):
+        """Stop the server.  ``wait=True`` / ``drain=True`` (default)
+        is the GRACEFUL DRAIN: admissions stop immediately (submit
+        raises), every already-admitted request finishes (or parks on
+        its deadline), and each final state is journaled by the normal
+        transition path.  ``wait=False`` preempts the running wheel at
+        its next window boundary and leaves unfinished tenants PARKED
+        on disk — ``SolveServer.recover_from(work_dir)`` resumes them;
+        ``park_queued=True`` additionally keeps queued-never-started
+        tenants journaled as queued (recoverable) instead of cancelling
+        them."""
+        if drain is not None:
+            wait = bool(drain)
         with self._cv:
             self._stop = True
             self._drain = bool(wait)
@@ -233,24 +535,30 @@ class SolveServer:
                                            if t.status == "running")
                 # queued-but-never-started tenants have no state to park:
                 # CANCEL them loudly so result() waiters unblock instead
-                # of timing out against a dead queue.  Tenants already
-                # PARKED in the queue DO have banked checkpoints — they
-                # stay parked (resumable), exactly like the running one
+                # of timing out against a dead queue (park_queued=True
+                # keeps them journaled-queued for a recovering
+                # successor).  Tenants already PARKED in the queue DO
+                # have banked checkpoints — they stay parked
+                # (resumable), exactly like the running one
                 for t in self._runq:
                     if t.slices > 0:
                         t.status = "parked"
                         t.record["status"] = "parked"
+                    elif park_queued:
+                        t.record["status"] = "queued"
                     else:
                         t.status = "cancelled"
                         t.record.update(
-                            status="cancelled",
+                            status="cancelled", error_code="cancelled",
                             error="server shut down before start")
                         t.canonical = None
+                    self._journal_safe(t.id, t.record["status"], t.record)
                     self._close_tenant_locked(t)
                     t.done.set()
                 self._runq.clear()
             self._cv.notify_all()
-        self._executor.join(timeout=timeout)
+        if self._executor is not None:
+            self._executor.join(timeout=timeout)
         # release shared device memory the serving process held (content-
         # keyed A caches): a clean shutdown parks no orphan device state
         from ..spopt import clear_device_caches
@@ -264,6 +572,22 @@ class SolveServer:
             open_.discard(t.seq)
             if not open_:
                 del self._family_open[t.family]
+
+    def _journal_append_safe(self, fn):
+        """Run one journal append; an IO failure (disk full, work dir
+        yanked) costs DURABILITY of that entry, never the serving path
+        itself — warned once per server."""
+        try:
+            fn()
+        except Exception as e:
+            if not getattr(self, "_journal_err_warned", False):
+                self._journal_err_warned = True
+                _log.warning("journal append failed (durability "
+                             "degraded): %r", e)
+
+    def _journal_safe(self, rid, status, record=None):
+        self._journal_append_safe(
+            lambda: self.journal.transition(rid, status, record))
 
     # ---- submission ---------------------------------------------------------
     def _resolve(self, req: SolveRequest):
@@ -293,19 +617,40 @@ class SolveServer:
         })
         opt_options.update(req.options)
         # hub-side knobs must not leak into the canonical settings key
-        for k in ("rel_gap", "abs_gap", "linger_secs"):
+        for k in ("rel_gap", "abs_gap", "linger_secs", "deadline_secs"):
             opt_options.pop(k, None)
         return creator, names, kwargs, opt_options
 
     def submit(self, req) -> str:
         """Ingest + canonicalize + enqueue; returns the request id.
         Ingestion runs on the CALLER's thread (pure numpy — it cannot
-        disturb the executor's device work)."""
+        disturb the executor's device work).
+
+        IDEMPOTENT on request id: re-submitting an already-journaled id
+        (a client retry after a reconnect, or after a server restart)
+        returns the existing request's id instead of starting a second
+        run — ``result(rid)`` then serves the original record.  The
+        bounded queue fast-fails with :class:`ServerOverloaded` before
+        paying for ingest."""
         if isinstance(req, dict):
             req = SolveRequest.from_dict(req)
+        req_payload = req.to_dict()        # journal the ORIGINAL request
         with self._cv:
             if self._stop:
-                raise RuntimeError("server is shut down")
+                raise ServerClosed("server is shut down")
+            if req.request_id in self._tenants:
+                _CTR_DUPLICATES.inc(1)
+                _log.info("request %s re-submitted — resolving to the "
+                          "existing record (idempotent)", req.request_id)
+                return req.request_id
+            if (self.max_queue is not None
+                    and len(self._runq) >= self.max_queue):
+                _CTR_REJECTED.inc(1)
+                raise ServerOverloaded(
+                    f"queue full ({len(self._runq)}/{self.max_queue}): "
+                    f"request {req.request_id!r} rejected")
+        if _faults.active():               # deterministic slow-ingest
+            _faults.on_ingest()            # injection (stall_ingest)
         creator, names, kwargs, opt_options = self._resolve(req)
         canon = _canonical.ingest(names, creator, kwargs,
                                   options=opt_options)
@@ -313,25 +658,67 @@ class SolveServer:
         t.req.creator_kwargs = kwargs
         with self._cv:
             if self._stop:
-                # re-check under the SAME lock hold as the enqueue: a
+                # re-check under a lock hold BEFORE any visible state: a
                 # shutdown racing the (slow, unlocked) ingest above must
                 # not slip a tenant into a queue nobody will ever drain
-                raise RuntimeError("server is shut down")
+                raise ServerClosed("server is shut down")
             if t.id in self._tenants:
-                # a duplicate id would silently shadow the first run's
-                # record and strand its result() waiters — reject loudly
-                # (retries should make a fresh SolveRequest)
-                raise ValueError(f"request id {t.id!r} already submitted")
-            self._families[canon.family] = \
-                self._families.get(canon.family, 0) + 1
+                # two concurrent submits of the same id raced the
+                # ingest: the loser resolves to the winner's record —
+                # same idempotency contract as the pre-ingest check
+                _CTR_DUPLICATES.inc(1)
+                return t.id
+            if (self.max_queue is not None
+                    and len(self._runq) >= self.max_queue):
+                # authoritative admission check at the enqueue (the
+                # pre-ingest one is the cheap fast path; concurrent
+                # ingests may both have passed it)
+                _CTR_REJECTED.inc(1)
+                raise ServerOverloaded(
+                    f"queue full ({len(self._runq)}/{self.max_queue}): "
+                    f"request {t.id!r} rejected")
+            self._families[t.family] = \
+                self._families.get(t.family, 0) + 1
             t.seq = self._seq
             self._seq += 1
-            self._family_open.setdefault(canon.family, set()).add(t.seq)
+            self._family_open.setdefault(t.family, set()).add(t.seq)
             self._tenants[t.id] = t
-            self._runq.append(t)
             # counted only once ACCEPTED (rejected duplicates/shutdown
             # races must not leave phantom requests on the dashboards)
             _CTR_REQUESTS.inc(1)
+        # WRITE-AHEAD: the acceptance is journaled BEFORE the tenant
+        # becomes runnable (enqueue + notify below) — otherwise a fast
+        # executor could journal this tenant's 'running' (even 'done')
+        # transition ahead of its 'accepted' line, and replay drops
+        # status events for unknown rids (the crash would then recover
+        # a mid-slice tenant as never-started).  The tenant is already
+        # in _tenants, so duplicate submits in this window resolve
+        # idempotently.
+        self._journal_append_safe(lambda: self.journal.accepted(
+            rid=t.id, seq=t.seq, request=req_payload,
+            family=canon.family_digest, checkpoint_dir=t.dir,
+            recoverable=req.scenario_creator is None,
+            deadline_at=t.deadline_at, record=t.record))
+        with self._cv:
+            if self._stop:
+                # a shutdown landed while we journaled: the executor may
+                # already have drained and exited, so enqueueing now
+                # would strand the waiters.  Un-admit loudly — and
+                # journal the cancellation so a recovering successor
+                # does not resurrect a request its submitter saw fail.
+                del self._tenants[t.id]
+                self._close_tenant_locked(t)
+                self._families[t.family] -= 1
+                t.status = "cancelled"
+                t.record.update(status="cancelled",
+                                error_code="cancelled",
+                                error="server shut down during submit")
+                self._journal_safe(t.id, "cancelled", t.record)
+                # a racing result() waiter that already grabbed the
+                # tenant object must unblock, not hang
+                t.done.set()
+                raise ServerClosed("server is shut down")
+            self._runq.append(t)
             self._cv.notify_all()
         # warm_hit is decided at FIRST EXECUTION, not here: only a family
         # whose compile leader actually COMPLETED has executables to bind
@@ -350,9 +737,14 @@ class SolveServer:
 
     # ---- results ------------------------------------------------------------
     def result(self, request_id: str, timeout: float | None = None) -> dict:
-        """Block until the request finishes; returns its SLO record."""
+        """Block until the request finishes; returns its SLO record.
+        A finished request that was retired from memory (or finished in
+        a PREVIOUS server lifetime) still answers from the journal."""
         t = self._tenants.get(request_id)
         if t is None:
+            rec = self._journal_record(request_id)
+            if rec is not None:
+                return rec
             raise KeyError(f"unknown (or retired) request id "
                            f"{request_id!r}")
         if not t.done.wait(timeout):
@@ -360,13 +752,42 @@ class SolveServer:
                                f"{t.status} after {timeout}s")
         return dict(t.record)
 
+    def _journal_record(self, request_id: str) -> dict | None:
+        """Finished record for ``request_id`` from the journal (None
+        when the journal never saw it, or it never finished).  Uses the
+        stat-memoized replay — a polling fetch-by-id client must not
+        re-parse the whole journal per call.  An UNDELIVERED banked
+        response serves as the fallback: if the terminal transition
+        append itself failed (durability degraded) but the frontend's
+        failed-put payload was journaled, that payload is still the
+        best record we have for the id."""
+        try:
+            jr = self.journal.replay_cached().get(request_id)
+        except Exception:
+            return None
+        if jr is None:
+            return None
+        if jr.finished and jr.record:
+            return dict(jr.record)
+        if jr.undelivered:
+            return dict(jr.undelivered)
+        return None
+
+    def lookup(self, request_id: str):
+        """The live tenant for ``request_id`` (None when unknown) — the
+        TCP frontend's non-blocking hook for fetch-by-id."""
+        return self._tenants.get(request_id)
+
     def retire_finished(self, keep: int = 0) -> int:
         """Drop finished tenants' bookkeeping (all but the newest
         ``keep``), returning how many were retired.  Completed tenants
         already released their batched arrays; this sheds the residual
         _Tenant + SLO-record dicts so a genuinely long-lived server's
         memory and ``slo_records`` cost stay bounded — call it (or wire
-        it on a cadence) after harvesting the records you need."""
+        it on a cadence) after harvesting the records you need.  The
+        journal COMPACTS in the same sweep: retired records leave the
+        file, retained ones fold to two lines each — so the journal's
+        replay cost tracks the retained window, not server lifetime."""
         with self._cv:
             finished = [t for t in self._tenants.values()
                         if t.status in ("done", "failed", "cancelled")]
@@ -374,6 +795,19 @@ class SolveServer:
             drop = finished[:max(0, len(finished) - int(keep))]
             for t in drop:
                 del self._tenants[t.id]
+            retained = set(self._tenants)
+        try:
+            # compact_keep folds + rewrites ATOMICALLY under the append
+            # lock — a submit/transition racing this sweep serializes
+            # against the rewrite instead of landing between read and
+            # os.replace and being erased.  UNFINISHED records always
+            # survive, retained or not: a submit journaled after the
+            # retained-set snapshot must not be un-written.
+            self.journal.compact_keep(
+                lambda r: r.rid in retained or not r.finished)
+        except Exception as e:
+            _log.warning("journal compaction failed (file keeps "
+                         "growing): %r", e)
         return len(drop)
 
     def slo_records(self) -> list:
@@ -461,13 +895,21 @@ class SolveServer:
                 _CTR_FAILED.inc(1)         # the server
                 _log.warning("request %s failed: %r", tenant.id, e)
                 tenant.status = "failed"
-                tenant.record.update(status="failed", error=repr(e))
+                tenant.record.update(status="failed",
+                                     error_code="exception",
+                                     error=repr(e))
                 tenant.canonical = None    # release the batched arrays
+                self._journal_safe(tenant.id, "failed", tenant.record)
                 with self._cv:
                     self._close_tenant_locked(tenant)
                 tenant.done.set()
 
     def _want_preempt(self, tenant, slice_start) -> bool:
+        # an expired deadline parks UNCONDITIONALLY — the checkpoint
+        # seam is where a doomed request exits cleanly (state banked,
+        # bounds harvested) instead of burning quantum forever
+        if tenant.past_deadline():
+            return True
         with self._cv:
             if tenant.id in self._force_preempt:
                 self._force_preempt.discard(tenant.id)
@@ -479,6 +921,31 @@ class SolveServer:
                        for o in self._runq):
                 return False
         return time.monotonic() - slice_start >= self.quantum_secs
+
+    def _finish_deadline(self, t: _Tenant):
+        """Fail a request whose ``deadline_secs`` expired: UNCERTIFIED
+        by construction, checkpoint (if any) left banked on disk, the
+        record says exactly what happened.  The park already harvested
+        bounds, so the record still carries the best-known gap."""
+        _CTR_DEADLINE.inc(1)
+        _CTR_FAILED.inc(1)
+        t.status = "failed"
+        t.record.update(
+            status="failed", error_code="deadline",
+            error=f"deadline_secs={t.req.deadline_secs} exceeded "
+                  f"(parked at iter {t.record['iters']})",
+            certified=False,
+            wall_s=time.monotonic() - t.submitted)
+        t.canonical = None
+        t.opt_options = None
+        t.creator = None
+        self._journal_safe(t.id, "failed", t.record)
+        with self._cv:
+            self._close_tenant_locked(t)
+        _log.warning("request %s failed its deadline (gap %s after %d "
+                     "iter(s), %d slice(s))", t.id, t.record["rel_gap"],
+                     t.record["iters"], t.slices)
+        t.done.set()
 
     def _build_wheel(self, t: _Tenant, preempt_check, on_iter0_done):
         """Hub/spoke dicts for one slice of one tenant — the standard
@@ -506,6 +973,10 @@ class SolveServer:
                                                    self.linger_secs)),
             "preempt_check": preempt_check,
             "checkpoint_dir": t.dir,
+            # mid-slice cadence on top of the terminal park capture: a
+            # server CRASH (not just a park) loses at most this much of
+            # a running tenant's work (doc/serving.md "Durability")
+            "checkpoint_every_secs": self.checkpoint_every_secs,
             "resume": t.dir if t.slices else None,
         }
         if "abs_gap" in t.req.options:
@@ -527,22 +998,34 @@ class SolveServer:
     def _run_slice(self, t: _Tenant):
         from ..spin_the_wheel import WheelSpinner
 
+        if t.past_deadline():
+            # expired while queued/parked: fail WITHOUT burning a slice
+            self._finish_deadline(t)
+            return
         t.status = "running"
         t.record["status"] = "running"
+        self._journal_safe(t.id, "running", t.record)
         if t.first_exec is None:
             t.first_exec = time.monotonic()
-            t.record["queue_wait_s"] = t.first_exec - t.submitted
-            _HIST_QUEUE_WAIT.add(t.record["queue_wait_s"])
+            if t.record["queue_wait_s"] is None:
+                # recovered tenants that already executed in a previous
+                # lifetime keep their journaled queue wait — the restart
+                # gap is recovery latency, not queueing, and summing the
+                # two would double-count the metric across a recovery
+                t.record["queue_wait_s"] = t.first_exec - t.submitted
+                _HIST_QUEUE_WAIT.add(t.record["queue_wait_s"])
             # warm verdict at first execution: true only when a member
             # of this family actually COMPLETED (its executables exist);
             # family affinity made any earlier leader finish (or fail)
-            # before this point
-            with self._cv:
-                warm = t.family in self._families_done
-            t.record["warm_hit"] = warm
-            (_CTR_WARM_HITS if warm else _CTR_COLD_FAMILIES).inc(1)
-            _log.info("request %s starts %s", t.id,
-                      "WARM" if warm else "cold")
+            # before this point.  None = never evaluated (a recovered
+            # tenant keeps its first lifetime's verdict)
+            if t.record["warm_hit"] is None:
+                with self._cv:
+                    warm = t.family in self._families_done
+                t.record["warm_hit"] = warm
+                (_CTR_WARM_HITS if warm else _CTR_COLD_FAMILIES).inc(1)
+                _log.info("request %s starts %s", t.id,
+                          "WARM" if warm else "cold")
         slice_start = time.monotonic()
 
         def on_iter0_done():
@@ -570,6 +1053,12 @@ class SolveServer:
         with _metrics.window() as w:
             ws = WheelSpinner(hub_dict, spokes).run()
         t.slices += 1
+        if _faults.active():
+            # deterministic serving chaos: the wheel tore down (terminal
+            # checkpoint banked) but the transition below has NOT been
+            # journaled — the kill lands in exactly the window restart
+            # recovery must close (kill_server_after_slices)
+            _faults.on_server_slice(t.slices)
         wall = time.monotonic() - slice_start
         hub = ws.spcomm
         rec = t.record
@@ -600,9 +1089,17 @@ class SolveServer:
 
         iter_limit = int(t.opt_options.get("PHIterLimit", 200))
         if getattr(hub, "preempted", False) and rec["iters"] < iter_limit:
+            if t.past_deadline():
+                # the park banked the checkpoint + harvested bounds;
+                # the request exits FAILED-UNCERTIFIED instead of
+                # re-queueing for quantum it can never certify within
+                rec["preemptions"] += 1
+                self._finish_deadline(t)
+                return
             t.status = "parked"
             rec["status"] = "parked"
             rec["preemptions"] += 1
+            self._journal_safe(t.id, "parked", rec)
             with self._cv:
                 if self._stop and not self._drain:
                     # shutdown(wait=False): the park WAS the drain — the
@@ -633,6 +1130,7 @@ class SolveServer:
             t.req.options.get("rel_gap", self.rel_gap)) + 1e-12)
         _HIST_WALL.add(rec["wall_s"])
         _CTR_COMPLETED.inc(1)
+        self._journal_safe(t.id, "done", rec)
         with self._cv:
             self._families_done.add(t.family)
             self._close_tenant_locked(t)
