@@ -73,7 +73,15 @@ every finished segment dumps ``BENCH_TRACE_DIR/bench_<tag>.perfetto.json``
 lines carry {path, report} per segment, and a small certified farmer
 WHEEL segment is added whose trace shows the hub/spoke/dispatch/host-sync
 tracks and whose report's gap-vs-wall array ends at the certified gap.
+The wheel segment also times a hub-only IN-WHEEL certification leg
+(``in_wheel_bounds``: the megastep's fused bound pass, zero spoke device
+programs) and banks its wall as ``certified_wall_s`` next to the
+3-cylinder golden's (doc/pipeline.md "In-wheel certification").
 See doc/observability.md.
+
+BENCH_TRACE_DIR defaults to ``bench_results/`` — every artifact this
+process writes (traces, reports, resume state) lands there, not at the
+repo root (root-level ``BENCH_*.json`` strays are gitignored).
 """
 
 import dataclasses
@@ -429,7 +437,7 @@ def trace_segment_dump(tag):
     if not trace.enabled():
         return None
     try:
-        out_dir = os.environ.get("BENCH_TRACE_DIR", ".")
+        out_dir = os.environ.get("BENCH_TRACE_DIR", "bench_results")
         os.makedirs(out_dir, exist_ok=True)
         path = os.path.join(out_dir, f"bench_{tag}.perfetto.json")
         evs = trace.events()
@@ -550,6 +558,47 @@ def traced_farmer_wheel():
         gvw = dump["report"]["gap_vs_wall"]
         assert gvw and abs(gvw[-1][1] - entry["rel_gap"]) < 1e-12, \
             "flight-recorder gap series must end at the reported gap"
+    # IN-WHEEL certification leg (doc/pipeline.md "In-wheel
+    # certification"): the same certified shape as a hub-ONLY wheel —
+    # the megastep's fused bound pass produces both bounds, zero spoke
+    # threads/device programs — timed to the certified gap.  Its wall is
+    # the headline `certified_wall_s`; the 3-cylinder golden's wall and
+    # gap ride next to it so the artifact carries the comparison whole.
+    if not os.environ.get("BENCH_SKIP_WHEEL_INWHEEL"):
+        try:
+            hub_iw, _ = wheel_dicts()
+            hub_iw = dict(hub_iw)
+            hub_iw["opt_kwargs"] = dict(hub_iw["opt_kwargs"])
+            iw_options = dict(hub_iw["opt_kwargs"]["options"],
+                              in_wheel_bounds=True)
+            hub_iw["opt_kwargs"]["options"] = iw_options
+            t_iw = time.time()
+            with obs_metrics.window() as iwin:
+                ws_iw = WheelSpinner(hub_iw, []).spin()
+            abs_iw, rel_iw = ws_iw.spcomm.compute_gaps()
+            entry["in_wheel"] = {
+                # wall to the certified gap, hub-only (the wall-clock
+                # flagship of the self-certifying megastep)
+                "certified_wall_s": round(time.time() - t_iw, 2),
+                "certified_wall_s_3cyl": entry["wall_secs"],
+                "abs_gap": float(abs_iw),
+                "rel_gap": float(rel_iw),
+                "inner": float(ws_iw.BestInnerBound),
+                "outer": float(ws_iw.BestOuterBound),
+                "host_sync_count": int(iwin.delta("host_sync.count")),
+                "host_sync_count_3cyl": entry["host_sync_count"],
+                "bound_passes": int(iwin.delta("megastep.bound_passes")),
+                "spoke_cylinders": 0,
+            }
+            # flagship field at the wheel-entry top level (the driver
+            # artifact's `certified_wall_s`)
+            entry["certified_wall_s"] = \
+                entry["in_wheel"]["certified_wall_s"]
+            trace_segment_dump(f"wheel_farmer{S}_inwheel")
+        except Exception as e:
+            log(f"in-wheel certification leg failed: {e!r}")
+            entry["in_wheel"] = {"error": repr(e)}
+            trace_segment_dump(f"wheel_farmer{S}_inwheel_failed")
     # legacy-dispatch comparison wheel (ADMMSettings.megastep = 1): the
     # same certified run, one dispatch + one fetch per hub iteration —
     # the host-sync drop factor is the megakernel's headline number
@@ -683,7 +732,7 @@ def ladder_workload():
     resuming = "--resume" in sys.argv[1:]
     state_dir = os.environ.get(
         "BENCH_RESUME_DIR",
-        os.path.join(os.environ.get("BENCH_TRACE_DIR", "."),
+        os.path.join(os.environ.get("BENCH_TRACE_DIR", "bench_results"),
                      "bench_resume"))
     os.makedirs(state_dir, exist_ok=True)
     state_path = os.path.join(state_dir, "ladder_state.json")

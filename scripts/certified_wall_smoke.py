@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Certified-wall smoke: in-wheel certification acceptance (doc/pipeline.md
+"In-wheel certification"), runnable locally::
+
+    JAX_PLATFORMS=cpu python scripts/certified_wall_smoke.py
+
+Two certified UC-lite wheels over the SAME family and solver settings:
+
+A. the **3-cylinder golden** — PH hub + Lagrangian outer spoke + XhatXbar
+   inner spoke, every cylinder its own batched device programs (the
+   pre-in-wheel certification topology);
+B. the **hub-only in-wheel wheel** — ``in_wheel_bounds``: the megastep's
+   fused bound pass produces both bounds, ZERO spoke cylinders.
+
+Asserts (the CPU-portable acceptance signals — wall clock is reported,
+not asserted, because in-process CPU fetches are nearly free and the
+contention the in-wheel pass removes only exists on a real device):
+
+1. **Certification** — both wheels terminate on the gap; the in-wheel
+   wheel's certified rel_gap is <= the golden's (plus float slack).
+2. **Strictly fewer host syncs** — the in-wheel leg's ``host_sync.count``
+   delta is strictly below the golden's (the spokes' own solve/bound
+   fetches are gone).
+3. **Zero spoke device programs** — the in-wheel leg spins no spoke
+   comms at all, at least one fused bound pass ran
+   (``megastep.bound_passes``), and both bounds are finite (with no
+   spokes, in-wheel evidence is the only possible source).
+
+The summary JSON line carries ``certified_wall_s`` for both legs — the
+field the bench wheel segment banks for the driver artifact.
+
+The whole script is bounded by a HARD watchdog
+(``CERTIFIED_WALL_DEADLINE_SECS``, default 1500 s): a hang past the
+deadline exits 2 via ``os._exit`` instead of pinning the CI job.  Env
+knobs: ``CWS_SCENS`` (default 4), ``CWS_ITERS`` (default 240),
+``CWS_REL_GAP`` (default 2e-2 — UC-lite's outer bound tightens slowly
+on CPU budgets; the acceptance signal is the RELATIVE one, in-wheel gap
+<= golden gap, not the absolute target).  Exit code 0 = pass.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+DEADLINE = float(os.environ.get("CERTIFIED_WALL_DEADLINE_SECS", "1500"))
+
+
+def log(msg):
+    print(f"certified-wall-smoke: {msg}", file=sys.stderr, flush=True)
+
+
+def _arm_hard_watchdog():
+    def killer():
+        time.sleep(DEADLINE)
+        log(f"HARD WATCHDOG: {DEADLINE}s deadline breached — exiting 2")
+        os._exit(2)
+
+    threading.Thread(target=killer, daemon=True).start()
+
+
+def main():
+    import numpy as np
+
+    import tpusppy
+    from tpusppy.cylinders import (LagrangianOuterBound, PHHub,
+                                   XhatXbarInnerBound)
+    from tpusppy.models import uc_lite
+    from tpusppy.obs import metrics
+    from tpusppy.opt.ph import PH
+    from tpusppy.phbase import PHBase
+    from tpusppy.spin_the_wheel import WheelSpinner
+    from tpusppy.xhat_eval import Xhat_Eval
+
+    tpusppy.disable_tictoc_output()
+    S = int(os.environ.get("CWS_SCENS", "4"))
+    iters = int(os.environ.get("CWS_ITERS", "240"))
+    rel_gap = float(os.environ.get("CWS_REL_GAP", "2e-2"))
+
+    def opt_kwargs(extra=None):
+        options = {"defaultPHrho": 500.0, "PHIterLimit": iters,
+                   "convthresh": -1.0}
+        options.update(extra or {})
+        return {
+            "options": options,
+            "all_scenario_names": uc_lite.scenario_names_creator(S),
+            "scenario_creator": uc_lite.scenario_creator,
+            "scenario_creator_kwargs": {"num_scens": S,
+                                        "relax_integers": True},
+        }
+
+    hub_kwargs = {"options": {"rel_gap": rel_gap, "abs_gap": 0.0,
+                              "linger_secs": 60.0}}
+
+    # ---- leg A: the 3-cylinder golden -----------------------------------
+    golden_hub = {"hub_class": PHHub, "hub_kwargs": hub_kwargs,
+                  "opt_class": PH, "opt_kwargs": opt_kwargs()}
+    golden_spokes = [
+        {"spoke_class": LagrangianOuterBound, "spoke_kwargs": {},
+         "opt_class": PHBase, "opt_kwargs": opt_kwargs()},
+        {"spoke_class": XhatXbarInnerBound, "spoke_kwargs": {},
+         "opt_class": Xhat_Eval, "opt_kwargs": opt_kwargs()},
+    ]
+    log(f"leg A (3-cylinder golden): S={S} rel_gap={rel_gap}")
+    t0 = time.time()
+    with metrics.window() as wa:
+        ws_a = WheelSpinner(golden_hub, golden_spokes).spin()
+    wall_a = time.time() - t0
+    _, gap_a = ws_a.spcomm.compute_gaps()
+    sync_a = int(wa.delta("host_sync.count"))
+    log(f"leg A: rel_gap={gap_a:.3e} host_syncs={sync_a} "
+        f"wall={wall_a:.1f}s")
+
+    # ---- leg B: hub-only, in-wheel certification ------------------------
+    inwheel_hub = {"hub_class": PHHub, "hub_kwargs": hub_kwargs,
+                   "opt_class": PH,
+                   "opt_kwargs": opt_kwargs({"in_wheel_bounds": True})}
+    log("leg B (hub-only, in-wheel bounds)")
+    t0 = time.time()
+    with metrics.window() as wb:
+        ws_b = WheelSpinner(inwheel_hub, []).spin()
+    wall_b = time.time() - t0
+    _, gap_b = ws_b.spcomm.compute_gaps()
+    sync_b = int(wb.delta("host_sync.count"))
+    passes = int(wb.delta("megastep.bound_passes"))
+    log(f"leg B: rel_gap={gap_b:.3e} host_syncs={sync_b} "
+        f"bound_passes={passes} wall={wall_b:.1f}s")
+
+    # 1. certification: the in-wheel wheel certifies the golden's gap
+    assert np.isfinite(gap_a) and gap_a <= rel_gap + 1e-12, \
+        f"golden leg failed to certify: rel_gap={gap_a}"
+    assert np.isfinite(gap_b) and gap_b <= max(rel_gap, gap_a) + 1e-9, \
+        f"in-wheel leg missed the golden's gap: {gap_b} vs {gap_a}"
+    # 2. strictly fewer host syncs
+    assert sync_b < sync_a, \
+        f"in-wheel host_syncs not strictly lower: {sync_b} vs {sync_a}"
+    # 3. zero spoke device programs
+    assert not ws_b.spoke_comms, "in-wheel leg spun spoke comms"
+    assert passes >= 1, "no fused bound pass executed"
+    assert np.isfinite(ws_b.BestOuterBound), "no in-wheel outer bound"
+    assert np.isfinite(ws_b.BestInnerBound), "no in-wheel inner bound"
+    # validity cross-check: legs agree the optimum sits in both sandwiches
+    assert ws_b.BestOuterBound <= ws_b.BestInnerBound + 1e-9
+
+    print(json.dumps({
+        "certified_wall_smoke": "ok",
+        "S": S,
+        "rel_gap_golden": float(gap_a),
+        "rel_gap_inwheel": float(gap_b),
+        "host_sync_count_golden": sync_a,
+        "host_sync_count_inwheel": sync_b,
+        "bound_passes": passes,
+        "spoke_cylinders_inwheel": 0,
+        "certified_wall_s": round(wall_b, 2),
+        "certified_wall_s_3cyl": round(wall_a, 2),
+    }), flush=True)
+    log("PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    _arm_hard_watchdog()
+    sys.exit(main())

@@ -16,7 +16,8 @@ case "$(basename "$1")" in
     echo solvers ;;
   test_ph.py|test_aph.py|test_fwph.py|test_wheel.py|test_tcp_wheel.py|\
   test_mp_wheel.py|test_distributed*.py|test_dist_aph.py|\
-  test_window_service.py|test_xhat.py|test_extensions.py|\
+  test_window_service.py|test_one_sided.py|test_xhat.py|\
+  test_extensions.py|test_inwheel_bounds.py|\
   test_cross_scen.py|test_mip_incumbents.py|test_lshaped.py|test_sc.py|\
   test_ef.py|test_obs.py|test_resilience.py|test_elastic.py|\
   test_service.py|test_service_durable.py)

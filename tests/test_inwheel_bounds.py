@@ -1,0 +1,514 @@
+"""In-wheel certification (doc/pipeline.md): the megastep's fused
+outer/inner bound pass.
+
+Golden parity pins the fused device scalars against the spoke-module
+delegations on IDENTICAL (W, xbar, warm) state — the outer bound against
+``lagrangian_bounder.in_wheel_outer_bound`` (the W-on/prox-off weak-duality
+assembly, ``admm.dual_objective_with_margin`` single-sourced) and the inner
+against ``xhatxbar_bounder.in_wheel_inner_bound`` (the xhat-at-xbar frozen
+evaluation) — at 1e-9, across the dense and shared-A engines.  The validity
+sandwich (outer <= EF optimum <= inner) is pinned on the analytic farmer,
+the lean-pack (device-resident state) and bucketed postures are covered,
+and an isomorphic warm repeat of the bound-pass megastep must hit the AOT
+executable cache with zero misses.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tpusppy.cylinders import PHHub
+from tpusppy.cylinders.lagrangian_bounder import in_wheel_outer_bound
+from tpusppy.cylinders.xhatxbar_bounder import in_wheel_inner_bound
+from tpusppy.ir import ScenarioBatch
+from tpusppy.models import farmer, uc_lite
+from tpusppy.obs import metrics as obs_metrics
+from tpusppy.opt.ph import PH
+from tpusppy.spin_the_wheel import WheelSpinner
+
+FARMER_EF = -108390.0
+
+
+def _farmer_ph(n=3, iters=40, **extra):
+    opts = {"defaultPHrho": 1.0, "PHIterLimit": iters, "convthresh": -1.0,
+            "in_wheel_bounds": True, **extra}
+    return PH(opts, farmer.scenario_names_creator(n),
+              farmer.scenario_creator,
+              scenario_creator_kwargs={"num_scens": n})
+
+
+def _uclite_ph(S=4, iters=40, **extra):
+    opts = {"defaultPHrho": 500.0, "PHIterLimit": iters, "convthresh": -1.0,
+            "in_wheel_bounds": True, **extra}
+    return PH(opts, uc_lite.scenario_names_creator(S),
+              uc_lite.scenario_creator,
+              scenario_creator_kwargs={"num_scens": S,
+                                       "relax_integers": True})
+
+
+def _warm_to_state(ph, iters=3):
+    """Iter0 + a few legacy iterations: frozen-ready (factors + warm),
+    host mirrors authoritative — the identical-state parity setup."""
+    ph.Iter0()
+    for k in range(1, iters + 1):
+        ph._iterk_one(k, -1.0)
+    assert ph._factors is not None and ph._warm is not None
+
+
+def _bound_scalars(ph, n_req=4):
+    """Dispatch ONE bound-pass megastep with ``n_live=0``: every scan
+    step takes the dead branch (state passes through untouched), so the
+    fused bound pass evaluates EXACTLY the current host-mirrored state —
+    the identical-state comparison point for the delegations."""
+    meas = ph._megastep_solve(n_req, 0, -1.0, ph.W, ph.xbars, ph.rho,
+                              bound_live=True)
+    assert meas["executed"] == 0
+    assert meas["bound_computed"]
+    return meas
+
+
+class TestGoldenParity:
+    def test_dense_outer_inner_match_delegations(self):
+        ph = _farmer_ph()
+        _warm_to_state(ph)
+        meas = _bound_scalars(ph)
+        ob_ref = in_wheel_outer_bound(ph)
+        scale = max(1.0, abs(ob_ref))
+        assert abs(meas["bound_outer"] - ob_ref) <= 1e-9 * scale
+        ib_ref, feas_ref = in_wheel_inner_bound(ph)
+        assert abs(meas["bound_inner_obj"] - ib_ref) <= 1e-9 * scale
+        assert meas["bound_inner_feas"] == pytest.approx(feas_ref,
+                                                         abs=1e-12)
+
+    def test_shared_engine_outer_inner_match_delegations(self):
+        ph = _uclite_ph()
+        assert ph.batch.A_shared is not None
+        _warm_to_state(ph)
+        meas = _bound_scalars(ph)
+        ob_ref = in_wheel_outer_bound(ph)
+        scale = max(1.0, abs(ob_ref))
+        assert abs(meas["bound_outer"] - ob_ref) <= 1e-9 * scale
+        ib_ref, feas_ref = in_wheel_inner_bound(ph)
+        assert abs(meas["bound_inner_obj"] - ib_ref) <= 1e-9 * scale
+        assert meas["bound_inner_feas"] == pytest.approx(feas_ref,
+                                                         abs=1e-12)
+
+    def test_outer_matches_spoke_edualbound_assembly(self):
+        """The delegation IS the spoke assembly: Edualbound on the
+        W-augmented (prox-off) objective with the warm duals — the exact
+        computation ``LagrangianOuterBound.lagrangian`` certifies with,
+        minus its fresh batched solve."""
+        ph = _farmer_ph()
+        _warm_to_state(ph)
+        b = ph.batch
+        q = np.array(b.c, copy=True)
+        q[:, ph.tree.nonant_indices] += ph.W
+        assert in_wheel_outer_bound(ph) == pytest.approx(
+            ph.Edualbound(q=q, q2=b.q2), abs=1e-9)
+
+
+class TestValiditySandwich:
+    def test_farmer_sandwich_and_certification(self):
+        """Hub-only wheel (ZERO spoke device programs): in-wheel bounds
+        must certify the analytic farmer with outer <= EF <= inner."""
+        opt_kwargs = {
+            "options": {"defaultPHrho": 1.0, "PHIterLimit": 120,
+                        "convthresh": -1.0, "in_wheel_bounds": True},
+            "all_scenario_names": farmer.scenario_names_creator(3),
+            "scenario_creator": farmer.scenario_creator,
+            "scenario_creator_kwargs": {"num_scens": 3},
+        }
+        hub_dict = {"hub_class": PHHub,
+                    "hub_kwargs": {"options": {"rel_gap": 1e-3,
+                                               "abs_gap": 5.0}},
+                    "opt_class": PH, "opt_kwargs": opt_kwargs}
+        with obs_metrics.window() as w:
+            ws = WheelSpinner(hub_dict, []).spin()
+        assert not ws.spoke_comms          # zero spokes, zero spoke programs
+        assert w.delta("megastep.bound_passes") >= 1
+        assert np.isfinite(ws.BestInnerBound)
+        assert ws.BestOuterBound <= FARMER_EF + 1e-6
+        assert ws.BestInnerBound >= FARMER_EF - 1e-6
+        gap = ws.BestInnerBound - ws.BestOuterBound
+        assert 0 <= gap <= max(5.0, 1e-3 * abs(ws.BestOuterBound))
+
+    def test_infeasible_eval_never_offers_inner(self):
+        """Early-wheel windows whose frozen evaluation misses the
+        feasibility gate must NOT install an inner bound (the Xhat_Eval
+        all-scenarios rule): consume a synthetic infeasible measurement
+        and check the typed update never fires."""
+        ph = _farmer_ph()
+        _warm_to_state(ph, iters=1)
+        offered = []
+
+        class _Hub:
+            def OuterBoundUpdate(self, b, idx=None, char='*'):
+                pass
+
+            def InnerBoundUpdate(self, b, idx=None, char='*'):
+                offered.append(b)
+
+        ph.spcomm = _Hub()
+        ph._consume_inwheel_bounds({
+            "bound_computed": True, "bound_outer": -1e6,
+            "bound_inner_obj": -1.0, "bound_inner_feas": 0.5,
+            "bound_sweeps": 1.0})
+        assert not offered
+        ph._consume_inwheel_bounds({
+            "bound_computed": True, "bound_outer": -1e6,
+            "bound_inner_obj": -1.0, "bound_inner_feas": 1.0,
+            "bound_sweeps": 1.0})
+        assert offered == [-1.0]
+
+
+class TestPostures:
+    def test_lean_pack_bounds_certify(self):
+        """Device-resident (O(1)-host) posture: the bound tail is scalars
+        only, so the lean pack carries it unchanged and a ph_device_state
+        wheel still certifies hub-only."""
+        opt_kwargs = {
+            "options": {"defaultPHrho": 1.0, "PHIterLimit": 120,
+                        "convthresh": -1.0, "in_wheel_bounds": True,
+                        "ph_device_state": True},
+            "all_scenario_names": farmer.scenario_names_creator(3),
+            "scenario_creator": farmer.scenario_creator,
+            "scenario_creator_kwargs": {"num_scens": 3},
+        }
+        hub_dict = {"hub_class": PHHub,
+                    "hub_kwargs": {"options": {"rel_gap": 1e-3,
+                                               "abs_gap": 5.0}},
+                    "opt_class": PH, "opt_kwargs": opt_kwargs}
+        ws = WheelSpinner(hub_dict, []).spin()
+        assert np.isfinite(ws.BestInnerBound)
+        gap = ws.BestInnerBound - ws.BestOuterBound
+        assert 0 <= gap <= max(5.0, 1e-3 * abs(ws.BestOuterBound))
+
+    def test_bucketed_bounds_sandwich(self):
+        """Bucketed (ragged farmer bundles) megastep with the bound pass:
+        per-bucket contributions compose into a valid global sandwich."""
+        opts = {"defaultPHrho": 1.0, "PHIterLimit": 2, "convthresh": -1.0,
+                "bundles_per_rank": 3, "shape_buckets": True,
+                "shape_bucket_quantum": 1, "solver_refresh_every": 6,
+                "in_wheel_bounds": True}
+        ph = PH(opts, farmer.scenario_names_creator(7),
+                farmer.scenario_creator,
+                scenario_creator_kwargs={"num_scens": 7})
+        ph.ph_main(finalize=False)
+        from tpusppy.ef import solve_ef
+        from tpusppy.ir import BucketedBatch
+
+        assert isinstance(ph.batch, BucketedBatch)
+        meas = ph._megastep_solve_bucketed(3, 3, -1.0, ph.W, ph.xbars,
+                                           ph.rho, bound_live=True)
+        assert meas["bound_computed"]
+        # bundling is exact, so the bundled-EF optimum equals the
+        # 7-scenario EF optimum: outer must sit below it
+        names = farmer.scenario_names_creator(7)
+        ef7, _ = solve_ef(ScenarioBatch.from_problems(
+            [farmer.scenario_creator(nm, num_scens=7) for nm in names]),
+            solver="highs")
+        assert meas["bound_outer"] <= ef7 + 1e-6
+        if meas["bound_inner_feas"] >= 1.0 - 1e-9:
+            assert meas["bound_inner_obj"] >= ef7 - 1e-6
+
+    def test_cadence_skips_windows(self):
+        """in_wheel_bound_every=k runs the pass every k-th window only
+        (the dead lax.cond branch otherwise — same compiled program)."""
+        ph = _farmer_ph(iters=60, in_wheel_bound_every=100)
+        with obs_metrics.window() as w:
+            ph.ph_main(finalize=False)
+        # window 0 computes (wc % 100 == 0), later windows skip
+        assert w.delta("megastep.bound_passes") == 1
+
+    def test_maximization_declines(self, monkeypatch):
+        ph = _farmer_ph(iters=2)
+        monkeypatch.setattr(type(ph), "is_minimizing",
+                            property(lambda self: False))
+        assert not ph._inwheel_on()
+
+    def test_cap_reservation_never_kills_megastep(self):
+        """A barely-fitting family (plain cap 2, reserved cap < 2) must
+        keep its megastep and decline in-wheel certification — not
+        silently lose both."""
+        ph = _farmer_ph(iters=2)
+        assert ph._inwheel_on()
+        assert ph._megastep_cap_with_bounds(
+            lambda bp: 1 if bp else 2) == 2
+        assert not ph._inwheel_on()      # declined for this family
+
+
+class TestCadenceTune:
+    def test_autotune_bound_cadence_picks_and_banks(self):
+        from tpusppy import tune
+
+        calls = []
+
+        def run_window(bound_live):
+            calls.append(bound_live)
+            return 4
+
+        res = tune.autotune_bound_cadence(
+            run_window, (3, 10, 8), settings=None, cache=False)
+        assert calls == [True, True, False]
+        assert res.every >= 1
+
+    def test_verdict_roundtrip(self, tmp_path):
+        from tpusppy import tune
+
+        tune.set_cache_path(str(tmp_path / "tc.json"))
+        # time.time() is read 4x: [t0_bound, t1_bound, t0_plain, t1_plain]
+        times = iter([0.0, 1.05, 0.0, 0.05])
+
+        def run_window(bound_live):
+            return 4
+
+        import time as _time
+
+        real = _time.time
+        try:
+            _time.time = lambda: next(times, real())
+            res = tune.autotune_bound_cadence(run_window, (3, 10, 8))
+        finally:
+            _time.time = real
+        # bound pass measured ~1.0s vs 0.05s window: cadence spreads it
+        assert res.every > 1
+        assert tune.bound_cadence_verdict((3, 10, 8)) == res.every
+        # disk roundtrip (fresh in-memory store)
+        tune._bound_cadence_cache.clear()
+        with tune._persist_lock:
+            tune._persist["bound_cadence"].clear()
+        tune._disk_loaded_from = None
+        assert tune.bound_cadence_verdict((3, 10, 8)) == res.every
+
+
+class TestAotWarmRepeat:
+    def test_bound_pass_megastep_warm_repeat_zero_misses(self, tmp_path):
+        """Isomorphic repeat of the bound-pass megastep family: the
+        second construction must serve from the AOT executable cache
+        (``aot.misses`` delta 0) — warm serving of a self-certifying
+        wheel stays zero-miss."""
+        from tpusppy.solvers import aot
+
+        aot.set_cache_path(str(tmp_path / "aot"))
+        try:
+            ph1 = _farmer_ph(iters=2)
+            _warm_to_state(ph1, iters=1)
+            _bound_scalars(ph1)          # compiles + serializes
+            with obs_metrics.window() as w:
+                ph2 = _farmer_ph(iters=2)
+                _warm_to_state(ph2, iters=1)
+                m2 = _bound_scalars(ph2)
+            assert m2["bound_computed"]
+            # the megastep program itself must not MISS again (hits may
+            # be zero when the in-process jit cache already serves it —
+            # the pin is on misses, the serving-path contract)
+            assert w.delta("aot.misses") == 0
+        finally:
+            aot.reset()
+
+
+class TestCandidateClip:
+    def test_xbar_candidate_clips_tolerance_noise(self):
+        """Consensus means carry ADMM tolerance noise (u = -4e-8): the
+        candidate rule must clip to the nonant box, or the clamped
+        evaluation reads a 1e-8 rounding artifact as infeasibility
+        (p <= pmax*u < 0 against p >= 0)."""
+        from tpusppy.cylinders.xhatxbar_bounder import xbar_candidate
+
+        ph = _farmer_ph(iters=2)
+        _warm_to_state(ph, iters=1)
+        nid = ph.tree.nonant_indices
+        lo = np.asarray(ph.batch.lb)[:, nid]
+        hi = np.asarray(ph.batch.ub)[:, nid]
+        noisy = np.array(ph.xbars, dtype=float)
+        noisy[:, 0] = lo[:, 0] - 4e-8       # eps below the box
+        cand = xbar_candidate(ph, noisy)
+        assert (cand >= lo).all() and (cand <= hi).all()
+
+    def test_device_pass_clips_like_host_twin(self):
+        """Device candidate and host twin must clip identically: poison
+        xbars eps outside the box and require 1e-9 parity to hold."""
+        ph = _farmer_ph()
+        _warm_to_state(ph)
+        nid = ph.tree.nonant_indices
+        ph.xbars = np.array(ph.xbars, dtype=float)
+        ph.xbars[:, 0] = np.asarray(ph.batch.lb)[:, nid][:, 0] - 4e-8
+        meas = _bound_scalars(ph)
+        ib_ref, feas_ref = in_wheel_inner_bound(ph)
+        scale = max(1.0, abs(ib_ref))
+        assert abs(meas["bound_inner_obj"] - ib_ref) <= 1e-9 * scale
+        assert meas["bound_inner_feas"] == pytest.approx(feas_ref,
+                                                         abs=1e-12)
+
+
+class TestHostRescue:
+    def test_uclite_gate_miss_rescues_exact(self):
+        """UC-lite's clamped evaluation stalls batched ADMM (pmin/ramp
+        coupling at fixed commitments), so the fused gate declines — the
+        host-exact rescue must certify the SAME candidate via per-
+        scenario LPs and install it through the typed 'M' update."""
+        ph = _uclite_ph(iters=30)
+        ph.Iter0()
+        for k in range(1, 31):
+            ph._iterk_one(k, -1.0)
+        offered = []
+
+        class _Hub:
+            def OuterBoundUpdate(self, b, idx=None, char='*'):
+                pass
+
+            def InnerBoundUpdate(self, b, idx=None, char='*'):
+                offered.append((b, char))
+
+        ph.spcomm = _Hub()
+        with obs_metrics.window() as w:
+            ph._consume_inwheel_bounds({
+                "bound_computed": True, "bound_outer": -np.inf,
+                "bound_inner_obj": 0.0, "bound_inner_feas": 0.0,
+                "bound_sweeps": 1.0})
+        assert w.delta("megastep.bound_pass_infeasible") == 1
+        assert w.delta("megastep.bound_rescues") == 1
+        assert len(offered) == 1 and offered[0][1] == 'M'
+        ib = offered[0][0]
+        assert np.isfinite(ib)
+        # the rescue is EXACT: it must match per-scenario host LPs on
+        # the clamped batch directly
+        import dataclasses
+
+        from tpusppy.solvers import scipy_backend
+
+        nid = ph.tree.nonant_indices
+        b = ph.batch
+        cand = np.clip(np.array(ph.xbars, dtype=float),
+                       np.asarray(b.lb)[:, nid], np.asarray(b.ub)[:, nid])
+        lb = np.array(b.lb, copy=True)
+        ub = np.array(b.ub, copy=True)
+        lb[:, nid] = cand
+        ub[:, nid] = cand
+        res = scipy_backend.solve_batch(
+            dataclasses.replace(b, lb=lb, ub=ub), mip=False)
+        ref = float(np.asarray(ph.probs, float)
+                    @ np.array([r.obj for r in res]))
+        assert ib == pytest.approx(ref, rel=1e-9)
+
+    def test_rescue_cadence_and_disable(self):
+        ph = _farmer_ph(iters=6, in_wheel_rescue_every=3)
+        _warm_to_state(ph, iters=5)      # feasible regime: rescues certify
+        infeas = {"bound_computed": True, "bound_outer": -np.inf,
+                  "bound_inner_obj": 0.0, "bound_inner_feas": 0.0,
+                  "bound_sweeps": 1.0}
+        with obs_metrics.window() as w:
+            for _ in range(6):
+                ph._consume_inwheel_bounds(dict(infeas))
+        # misses 0 and 3 fire; 1, 2, 4, 5 wait out the cadence
+        assert w.delta("megastep.bound_rescues") == 2
+        assert np.isfinite(getattr(ph, "inwheel_inner_bound", np.inf))
+        ph2 = _farmer_ph(iters=2, in_wheel_host_rescue=False)
+        _warm_to_state(ph2, iters=1)
+        with obs_metrics.window() as w:
+            ph2._consume_inwheel_bounds(dict(infeas))
+        assert w.delta("megastep.bound_rescues") == 0
+
+    def test_declined_rescue_backs_off_then_retries(self, monkeypatch):
+        """An early DECLINE (genuinely infeasible candidate) must retry
+        with a short backoff, not burn a full cadence slot: a feasible
+        later window would otherwise wait `every` windows for its first
+        certified incumbent."""
+        from tpusppy.phbase import PHBase
+
+        ph = _farmer_ph(iters=2, in_wheel_rescue_every=3)
+        _warm_to_state(ph, iters=1)
+        calls = []
+        monkeypatch.setattr(
+            type(ph), "_inwheel_host_rescue",
+            lambda self: calls.append(len(calls)) or None)
+        infeas = {"bound_computed": True, "bound_outer": -np.inf,
+                  "bound_inner_obj": 0.0, "bound_inner_feas": 0.0,
+                  "bound_sweeps": 1.0}
+        for _ in range(6):
+            ph._consume_inwheel_bounds(dict(infeas))
+        # declines at misses 0, 1, 3 (backoff 1, 2, then the cadence cap)
+        assert len(calls) == 3
+
+
+class TestServiceInWheel:
+    def test_self_certifying_tenant_runs_zero_spokes(self, tmp_path):
+        """Serving path: a tenant on an in-wheel server certifies with
+        ZERO spoke threads/device programs per slice — the per-request
+        device footprint shrinks to one cylinder's programs."""
+        import threading
+
+        from tpusppy.service import SolveRequest, SolveServer
+
+        before = {t.name for t in threading.enumerate()}
+        with SolveServer(work_dir=str(tmp_path), quantum_secs=60.0,
+                         linger_secs=30.0, in_wheel_bounds=True) as srv:
+            with obs_metrics.window() as w:
+                rid = srv.submit(SolveRequest(
+                    model="farmer", num_scens=3,
+                    options={"PHIterLimit": 150}))
+                rec = srv.result(rid, timeout=300)
+            during = {t.name for t in threading.enumerate()}
+        assert rec["status"] == "done" and rec["certified"], rec
+        assert rec["outer"] <= rec["inner"] + 1e-6
+        assert w.delta("megastep.bound_passes") >= 1
+        # no spoke cylinder threads were ever spawned for the slice
+        # (spin_the_wheel names them after the spoke class)
+        spoke_threads = {"LagrangianOuterBound", "XhatShuffleInnerBound",
+                         "XhatXbarInnerBound"}
+        assert not (during - before) & spoke_threads, during - before
+
+    def test_nonviable_family_keeps_spokes(self, tmp_path):
+        """A family whose slices cannot megastep (refresh window too
+        small -> no fused bound pass, ever) must FALL BACK to the spoke
+        topology instead of shipping a spoke-less slice that can never
+        certify."""
+        import threading
+
+        from tpusppy.service import SolveRequest, SolveServer
+
+        with SolveServer(work_dir=str(tmp_path), quantum_secs=60.0,
+                         linger_secs=30.0, in_wheel_bounds=True) as srv:
+            rid = srv.submit(SolveRequest(
+                model="farmer", num_scens=3,
+                options={"PHIterLimit": 150,
+                         "solver_refresh_every": 2}))
+            # sample live threads while the slice runs in the executor
+            seen, box = set(), {}
+
+            def waiter():
+                box["rec"] = srv.result(rid, timeout=300)
+
+            th = threading.Thread(target=waiter)
+            th.start()
+            import time as _t
+            while th.is_alive():
+                seen |= {t.name for t in threading.enumerate()}
+                _t.sleep(0.02)
+            th.join()
+        rec = box["rec"]
+        assert rec["status"] == "done" and rec["certified"], rec
+        assert "LagrangianOuterBound" in seen     # spokes really ran
+
+
+class TestSkipSolveDecline:
+    def test_skip_without_donors_declines_loudly(self):
+        """lagrangian_skip_solve WITHOUT lagrangian_dual_donors must run
+        the full solve (no silent skip) and record the decline."""
+        from tpusppy.cylinders.lagrangian_bounder import LagrangianOuterBound
+        from tpusppy.phbase import PHBase
+
+        ph = PH({"defaultPHrho": 1.0, "PHIterLimit": 2,
+                 "convthresh": -1.0, "lagrangian_skip_solve": True},
+                farmer.scenario_names_creator(3), farmer.scenario_creator,
+                scenario_creator_kwargs={"num_scens": 3})
+        spoke = LagrangianOuterBound.__new__(LagrangianOuterBound)
+        spoke.opt = ph
+        ph.W_on, ph.prox_on = True, False
+        ph.W = np.zeros((3, ph.nonant_length))
+        with obs_metrics.window() as w:
+            bound = spoke.lagrangian()
+        assert np.isfinite(bound)
+        assert w.delta("lagrangian.skip_declined") == 1
+        assert ph._warm is not None      # the solve actually ran

@@ -7,6 +7,14 @@ bounds with write-id freshness checks (hub.py:174-200,396-436), tracks the
 best inner/outer bounds, and terminates the wheel on ``rel_gap`` / ``abs_gap``
 / ``max_stalled_iters`` (hub.py:77-161) by broadcasting the kill sentinel
 (hub.py:438-450).
+
+Bound source chars: spokes report through their class chars (L/X/I/O/...),
+``'T'`` is the trivial bound, ``'R'`` a checkpoint re-seed, ``'B'`` the
+Benders root, and ``'M'`` an IN-WHEEL bound — the megastep's fused bound
+pass (doc/pipeline.md "In-wheel certification") landing through the same
+typed ``OuterBoundUpdate``/``InnerBoundUpdate`` path, so gap termination
+and the gap-vs-wall trace treat in-wheel and spoke bounds identically; a
+single-cylinder wheel certifies with zero spoke device programs.
 """
 
 from __future__ import annotations

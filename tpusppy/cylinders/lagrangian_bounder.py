@@ -11,7 +11,33 @@ from __future__ import annotations
 
 import numpy as np
 
+from .. import global_toc
+from ..obs import metrics as _metrics
 from .spoke import OuterBoundWSpoke
+
+
+def in_wheel_outer_bound(opt) -> float:
+    """The Lagrangian outer bound computed from ``opt``'s CURRENT state —
+    no fresh batched solve: the W-augmented (W on, prox off) objective
+    evaluated through the weak-duality assembly with the warm state's row
+    duals.  This is EXACTLY what the in-wheel bound pass fuses into the
+    megastep window (``parallel.sharded._bound_pass_terms``), exposed
+    host-side so parity tests and spoke-less callers share one
+    definition.  Any duals certify (weak duality); the carried duals of a
+    near-converged wheel are tight, which is why a self-certifying wheel
+    needs no spoke device program (doc/pipeline.md "In-wheel
+    certification").
+
+    The device-resident posture syncs the host mirrors first (one billed
+    boundary fetch); requires a prior solve (warm duals must exist).
+    """
+    if getattr(opt, "_host_state_stale", False):
+        opt._sync_host_state()
+    b = opt.batch
+    idx = opt.tree.nonant_indices
+    q = np.array(b.c, copy=True)
+    q[:, idx] += np.asarray(opt.W, dtype=float)
+    return opt.Edualbound(q=q, q2=b.q2)
 
 
 class LagrangianOuterBound(OuterBoundWSpoke):
@@ -54,6 +80,20 @@ class LagrangianOuterBound(OuterBoundWSpoke):
         # bound from k host-exact donor duals alone.
         skip_solve = bool(opt.options.get("lagrangian_skip_solve")
                           and donor_cfg)
+        if opt.options.get("lagrangian_skip_solve") and not donor_cfg:
+            # the knob reads as armed but is NOT: skipping the solve is
+            # only sound when donor duals supply the bound, so without
+            # ``lagrangian_dual_donors`` this silently downgraded to the
+            # full batched solve the caller believed they had skipped —
+            # say so loudly once, and record the decline
+            _metrics.inc("lagrangian.skip_declined")
+            if not getattr(self, "_skip_declined_warned", False):
+                self._skip_declined_warned = True
+                global_toc(
+                    "WARNING: lagrangian_skip_solve is set but "
+                    "lagrangian_dual_donors is not — the skip is "
+                    "DECLINED (full batched solve runs; configure "
+                    "donors, or drop the knob)", True)
         if not skip_solve:
             opt.solve_loop(q=q, q2=q2)
         # CERTIFIED bound: dual objective of the W-augmented subproblems

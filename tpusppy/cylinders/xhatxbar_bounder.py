@@ -13,6 +13,43 @@ import numpy as np
 from .spoke import InnerBoundNonantSpoke
 
 
+def candidate_rule(batch, nid, cand: np.ndarray,
+                   threshold: float = 0.5) -> np.ndarray:
+    """THE host-side xhat candidate rule, single-sourced for every
+    consumer (:func:`xbar_candidate`, :func:`in_wheel_inner_bound`,
+    ``PHBase._inwheel_host_rescue``; ``parallel.sharded.
+    _bound_pass_terms`` is its traced jnp twin, pinned by 1e-9 parity
+    tests): round integer nonant slots at ``threshold``, then CLIP to
+    the nonant box.
+
+    The clip is load-bearing: the mean of eps-accurate ADMM solutions
+    carries tolerance noise (``u = 1 + 4e-6``, ``u = -4e-8``), and a
+    clamped column eps OUTSIDE its box poisons every row coupling to it
+    (``p <= pmax*u`` with ``u < 0`` forces ``p < 0`` against ``p >= 0``)
+    — the whole evaluation would read infeasible over a 1e-8 rounding
+    artifact.  Touches only (S, K) column slices — no full-bound
+    copies, so the spoke's per-pass call stays allocation-light."""
+    ints = np.asarray(batch.is_int, bool)[nid]
+    if ints.any():
+        cand = np.where(ints[None, :],
+                        np.floor(cand + (1.0 - threshold)), cand)
+    return np.clip(cand, np.asarray(batch.lb)[:, nid],
+                   np.asarray(batch.ub)[:, nid])
+
+
+def clamp_candidate(batch, nid, cand: np.ndarray, threshold: float = 0.5):
+    """:func:`candidate_rule` plus the clamp: returns ``(cand, lb, ub)``
+    with FRESH full bound copies whose nonant columns are fixed at the
+    candidate — the form the clamped-evaluation consumers (the in-wheel
+    host twin and the host-exact rescue) feed a solver."""
+    cand = candidate_rule(batch, nid, cand, threshold)
+    lb = np.array(batch.lb, copy=True)
+    ub = np.array(batch.ub, copy=True)
+    lb[:, nid] = cand
+    ub[:, nid] = cand
+    return cand, lb, ub
+
+
 def xbar_candidate(opt, xk: np.ndarray, threshold: float = 0.5) -> np.ndarray:
     """(S, K) per-node weighted mean of xk, integer slots rounded
     (xhatxbar_bounder.py:31-80 semantics on the batched layout).
@@ -29,11 +66,67 @@ def xbar_candidate(opt, xk: np.ndarray, threshold: float = 0.5) -> np.ndarray:
     xbar_nk = num / np.maximum(den, 1e-300)
     kidx = np.arange(xk.shape[1])[None, :]
     cand = xbar_nk[opt.nid_sk, kidx]
-    ints = opt.batch.is_int[opt.tree.nonant_indices]
-    if ints.any():
-        cand = np.where(ints[None, :],
-                        np.floor(cand + (1.0 - threshold)), cand)
-    return cand
+    return candidate_rule(opt.batch, opt.tree.nonant_indices, cand,
+                          threshold)
+
+
+def in_wheel_inner_bound(opt, threshold: float = 0.5, feas_tol=None):
+    """The xhat-at-xbar inner bound computed from ``opt``'s CURRENT state
+    — the host-side twin of the megastep's fused bound pass
+    (``parallel.sharded._bound_pass_terms``), single-sourcing the
+    candidate rule with :func:`xbar_candidate` semantics: the candidate
+    is ``opt.xbars`` (already the per-node weighted mean, gathered per
+    scenario) with integer nonant slots rounded at ``threshold``, clamped
+    onto the nonant columns and evaluated by ONE frozen solve on the
+    window's cached factors.  The clamped problem is solved under the
+    PH-augmented (q, q2) — identical minimizer (the augmentation is
+    constant on the clamped coordinates) and exactly-matching factors —
+    and the PLAIN expected objective is reported.
+
+    Returns ``(inner, feas_mass)``: the expected objective at the
+    evaluated point and the feasible probability mass under the
+    ``Xhat_Eval`` residual gate (``inner`` is only a certified-to-
+    tolerance incumbent when ``feas_mass >= 1 - 1e-9``, the all-scenarios
+    rule).  Requires frozen-ready state (factors + warm); parity tests
+    pin this against the in-megastep scalars at 1e-9.
+    """
+    import jax.numpy as jnp
+
+    from ..solvers import admm, hostsync, shared_admm
+
+    if getattr(opt, "_host_state_stale", False):
+        opt._sync_host_state()
+    if opt._factors is None or opt._warm is None:
+        raise RuntimeError("in_wheel_inner_bound requires frozen-ready "
+                           "state (a prior refresh solve)")
+    b = opt.batch
+    nid = np.asarray(opt.tree.nonant_indices)
+    cand, lb, ub = clamp_candidate(
+        b, nid, np.array(opt.xbars, dtype=float), threshold)
+    q, q2 = opt._augmented_q()
+    st = opt.admm_settings
+    dt = st.jdtype()
+    A_d, cl_d, cu_d = opt._device_consts(dt)
+    x, z, y, yx = opt._warm
+    x0 = jnp.asarray(x, dt).at[:, nid].set(jnp.asarray(cand, dt))
+    warm = (x0, jnp.asarray(z, dt), jnp.asarray(y, dt),
+            jnp.asarray(yx, dt))
+    args = (jnp.asarray(q, dt), jnp.asarray(q2, dt), A_d, cl_d, cu_d,
+            jnp.asarray(lb, dt), jnp.asarray(ub, dt))
+    if getattr(b, "A_shared", None) is not None:
+        sol = shared_admm.solve_shared_frozen(
+            *args, factors=opt._factors, settings=st, warm=warm)
+    else:
+        sol = admm.solve_batch_frozen(
+            *args, factors=opt._factors, settings=st, warm=warm)
+    xs, pri = (np.asarray(a) for a in hostsync.fetch((sol.x, sol.pri_res)))
+    obj = (np.einsum("sn,sn->s", np.asarray(b.c), xs)
+           + 0.5 * np.einsum("sn,sn->s", np.asarray(b.q2), xs * xs)
+           + np.broadcast_to(np.asarray(b.const), (b.num_scenarios,)))
+    if feas_tol is None:
+        feas_tol = opt._inwheel_feas_tol()
+    probs = np.asarray(opt.probs, dtype=float)
+    return float(probs @ obj), float(probs @ (pri < feas_tol))
 
 
 class XhatXbarInnerBound(InnerBoundNonantSpoke):

@@ -688,8 +688,15 @@ def make_ph_fused_step(nonant_idx: np.ndarray, settings: ADMMSettings,
                    aot_cache.array_digest(nonant_idx)))
 
 
+# scalars the in-wheel bound pass appends to the packed measurement
+# (lean-pack compatible by construction): [computed flag, Lagrangian outer
+# bound, xhat-at-xbar expected objective, feasible probability mass of the
+# frozen evaluation, its sweep count (billing)]
+BOUND_PACK_LEN = 5
+
+
 def megastep_measure_len(n_iters: int, S: int, n: int, K: int,
-                         pack: str = "full") -> int:
+                         pack: str = "full", bounds: bool = False) -> int:
     """Length of the packed megastep measurement vector.
 
     ``pack="lean"`` is the O(1)-host-traffic wheel posture (ROADMAP item
@@ -697,15 +704,37 @@ def megastep_measure_len(n_iters: int, S: int, n: int, K: int,
     residual/done diagnostics ONLY — the (S, n) iterate and the (S, K)
     W/xbars stay device-resident in the returned :class:`PHState`, to be
     fetched explicitly (and billed) at checkpoint/termination boundaries
-    instead of every window."""
+    instead of every window.
+
+    ``bounds=True`` (in-wheel certification, doc/pipeline.md) appends
+    :data:`BOUND_PACK_LEN` scalars — outer/inner bound evidence computed
+    on the window's final device state — compatible with BOTH packs (the
+    bound pass emits scalars only)."""
     base = 6 * n_iters + 2 + 3 * S
-    if pack == "lean":
-        return base
-    return base + S * n + 2 * S * K
+    if pack != "lean":
+        base += S * n + 2 * S * K
+    if bounds:
+        base += BOUND_PACK_LEN
+    return base
+
+
+def unpack_bound_tail(out: dict, vec) -> dict:
+    """Install the in-wheel bound scalars (the trailing
+    :data:`BOUND_PACK_LEN` entries of a ``bounds=True`` measurement) into
+    an unpacked measurement dict.  ``bound_computed`` False means the
+    window's traced ``bound_live`` flag was off (cadence skip) — the
+    other entries are inert zeros then."""
+    tail = np.asarray(vec)[-BOUND_PACK_LEN:]
+    out["bound_computed"] = bool(tail[0])
+    out["bound_outer"] = float(tail[1])
+    out["bound_inner_obj"] = float(tail[2])
+    out["bound_inner_feas"] = float(tail[3])
+    out["bound_sweeps"] = float(tail[4])
+    return out
 
 
 def megastep_unpack(vec, n_iters: int, S: int, n: int, K: int,
-                    pack: str = "full") -> dict:
+                    pack: str = "full", bounds: bool = False) -> dict:
     """Split a fetched :func:`make_wheel_megastep` measurement.
 
     Returns per-iteration arrays (length ``n_iters``; entries past
@@ -721,7 +750,8 @@ def megastep_unpack(vec, n_iters: int, S: int, n: int, K: int,
     everything the host wheel reads between termination checks, from ONE
     fetch.  With ``pack="lean"`` the x/W/xbars blocks are absent (device-
     resident state; see :func:`megastep_measure_len`) and those keys are
-    not in the dict."""
+    not in the dict.  ``bounds=True`` additionally parses the in-wheel
+    bound tail (:func:`unpack_bound_tail`)."""
     vec = np.asarray(vec)
     N = n_iters
     per = vec[:6 * N].reshape(6, N)
@@ -737,6 +767,8 @@ def megastep_unpack(vec, n_iters: int, S: int, n: int, K: int,
         "done": vec[off + 2 * S:off + 3 * S] != 0.0,
     }
     off += 3 * S
+    if bounds:
+        out = unpack_bound_tail(out, vec)
     if pack == "lean":
         return out
     out["x"] = vec[off:off + S * n].reshape(S, n)
@@ -747,10 +779,77 @@ def megastep_unpack(vec, n_iters: int, S: int, n: int, K: int,
     return out
 
 
+def _bound_pass_terms(arr, st, idx, settings, frozen_fn, factors,
+                      feas_tol, int_mask, xhat_threshold):
+    """One engine leg of the IN-WHEEL bound pass (doc/pipeline.md
+    "In-wheel certification"): probability-weighted partial sums of the
+    two certification bounds, computed as fused device contractions on the
+    window's final device-resident :class:`PHState` — so a megastep window
+    can certify without any spoke device program.
+
+    * OUTER — the Lagrangian dual bound (W on, prox off): the subproblem
+      objective ``c + W`` on the nonant columns, evaluated through the
+      single-sourced :func:`~tpusppy.solvers.admm.
+      dual_objective_with_margin_traced` weak-duality assembly with the
+      state's row duals ``y`` (ANY y certifies; the carried duals of a
+      near-converged wheel are tight) — the
+      ``cylinders.lagrangian_bounder`` semantics without the spoke's own
+      batched solve.
+    * INNER — xhat-at-xbar: the candidate is the window's consensus
+      ``xbars`` (integer nonant slots rounded at ``xhat_threshold``, the
+      ``cylinders.xhatxbar_bounder.xbar_candidate`` rule), clamped onto
+      the nonant columns and evaluated by ONE batched frozen solve.  The
+      clamped problem is solved under the PH-AUGMENTED (q, q2) — on the
+      clamped box the augmentation differs from the plain objective only
+      on fixed coordinates (a constant), so the minimizer is identical
+      AND the window's cached factors match exactly; the reported
+      objective is the PLAIN one.  Feasibility is the ``Xhat_Eval`` gate:
+      the per-scenario primal residual against ``feas_tol``, emitted as a
+      probability mass so the host applies the all-scenarios rule.
+
+    Returns ``(outer, inner_obj, feas_mass, sweeps)`` scalars; the
+    bucketed kernel sums the per-bucket contributions (probs are
+    global-tree slices there, so the sums compose exactly)."""
+    dt = settings.jdtype()
+    W = st.W.astype(dt)
+    qL = arr.c.astype(dt).at[:, idx].add(W)
+    packed = admm.dual_objective_with_margin_traced(
+        qL, arr.q2, arr.A, arr.cl, arr.cu, arr.lb, arr.ub,
+        st.y.astype(dt), st.x.astype(dt))
+    outer = arr.probs @ (packed[0].astype(dt) - packed[1].astype(dt)
+                         + arr.const)
+    cand = st.xbars.astype(dt)
+    if int_mask is not None and int_mask.any():
+        cand = jnp.where(jnp.asarray(int_mask)[None, :],
+                         jnp.floor(cand + (1.0 - xhat_threshold)), cand)
+    # the `xbar_candidate` bounds clip: consensus means carry ADMM
+    # tolerance noise (u = -4e-8), and a clamped column eps outside its
+    # box poisons every coupled row (p <= pmax*u < 0 vs p >= 0) — the
+    # frozen evaluation would read a 1e-8 rounding artifact as batchwide
+    # infeasibility
+    cand = jnp.clip(cand, arr.lb.astype(dt)[:, idx],
+                    arr.ub.astype(dt)[:, idx])
+    lb2 = arr.lb.at[:, idx].set(cand)
+    ub2 = arr.ub.at[:, idx].set(cand)
+    q, q2, _, _ = _ph_objective(arr, st, 1.0, idx, settings)
+    x0 = st.x.astype(dt).at[:, idx].set(cand)
+    sol = frozen_fn(q, q2, arr.A, arr.cl, arr.cu, lb2, ub2,
+                    x0, st.z, st.y, st.yx, factors)
+    lin = jnp.einsum("sn,sn->s", arr.c.astype(dt), sol.x)
+    quad = 0.5 * jnp.einsum("sn,sn->s", arr.q2.astype(dt),
+                            sol.x * sol.x)
+    inner_obj = arr.probs @ (lin + quad + arr.const)
+    feas = arr.probs @ (sol.pri_res < jnp.asarray(feas_tol, dt)).astype(dt)
+    return (outer.astype(dt), inner_obj.astype(dt), feas.astype(dt),
+            jnp.max(sol.iters).astype(dt))
+
+
 def make_wheel_megastep(nonant_idx: np.ndarray, settings: ADMMSettings,
                         mesh: Mesh | None = None, axis: str = "scen",
                         n_iters: int = 8, donate: bool = True,
-                        pack: str = "full"):
+                        pack: str = "full", bounds: bool = False,
+                        int_nonants: np.ndarray | None = None,
+                        xhat_threshold: float = 0.5):
     """ONE jitted program running up to ``n_iters`` FROZEN wheel iterations
     — the device-resident wheel megakernel (ROADMAP item 4).
 
@@ -806,19 +905,34 @@ def make_wheel_megastep(nonant_idx: np.ndarray, settings: ADMMSettings,
     state only at checkpoint/termination boundaries
     (:meth:`tpusppy.phbase.PHBase._sync_host_state`).
 
+    ``bounds=True`` makes the megastep SELF-CERTIFYING (in-wheel
+    certification, doc/pipeline.md): after the scan, an optional bound
+    pass (:func:`_bound_pass_terms` — the Lagrangian outer bound and the
+    xhat-at-xbar inner bound as fused contractions on the final device
+    state) appends :data:`BOUND_PACK_LEN` scalars to the packed
+    measurement (lean-pack compatible).  The pass is gated by the TRACED
+    ``bound_live`` flag — a cadence skip takes a dead ``lax.cond`` branch
+    at zero cost inside the SAME compiled program, so the bound cadence
+    never multiplies compiles or AOT cache entries.  ``int_nonants`` is
+    the (K,) integer mask of nonant slots (candidate rounding at
+    ``xhat_threshold``); both are baked constants and ride the AOT key.
+
     Returns ``mega(state, arr, prox_on, factors, convthresh, n_live,
-    accept_tol) -> (state, packed)``.
+    accept_tol) -> (state, packed)`` — with ``bounds=True`` the signature
+    gains trailing ``(bound_live, feas_tol)`` arguments.
     """
     if n_iters < 1:
         raise ValueError(f"n_iters ({n_iters}) must be >= 1")
     if pack not in ("full", "lean"):
         raise ValueError(f"pack must be 'full' or 'lean': {pack!r}")
     idx = jnp.asarray(nonant_idx)
+    int_mask = (None if int_nonants is None
+                else np.asarray(int_nonants, dtype=bool))
     _, shared_frozen, _, frozen_solve = _solver_fns_for(settings, mesh, axis)
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def mega(state: PHState, arr: PHArrays, prox_on, factors, convthresh,
-             n_live, accept_tol):
+             n_live, accept_tol, bound_live=False, feas_tol=1e-3):
         dt = settings.jdtype()
         S = arr.c.shape[0]
         n_live_t = jnp.asarray(n_live, jnp.int32)
@@ -888,34 +1002,62 @@ def make_wheel_megastep(nonant_idx: np.ndarray, settings: ADMMSettings,
             parts += [st.x.astype(dt).reshape(-1),
                       st.W.astype(dt).reshape(-1),
                       st.xbars.astype(dt).reshape(-1)]
+        if bounds:
+            fsolve = shared_frozen if arr.A.ndim == 2 else frozen_solve
+
+            def bounds_on(stf):
+                outer, inner, feas, sweeps = _bound_pass_terms(
+                    arr, stf, idx, settings, fsolve, factors, feas_tol,
+                    int_mask, xhat_threshold)
+                return jnp.stack(
+                    [jnp.ones((), dt), outer, inner, feas, sweeps])
+
+            parts.append(jax.lax.cond(
+                jnp.asarray(bound_live, bool),
+                bounds_on, lambda _: jnp.zeros((BOUND_PACK_LEN,), dt), st))
         return st, jnp.concatenate(parts)
 
     # AOT executable cache: one megakernel compile per width N — resumed
     # and repeated wheels load the serialized executable instead
-    # (tpusppy/solvers/aot.py; passthrough when disarmed)
+    # (tpusppy/solvers/aot.py; passthrough when disarmed).  The bound-pass
+    # variant (and its baked rounding constants) rides the key so warm
+    # serving of a self-certifying wheel stays zero-miss.
     return aot_cache.cached_program(
         mega, "wheel_megastep",
         key_extra=(settings, n_iters, bool(donate), axis, pack,
+                   # the rounding constants exist only in the bounds=True
+                   # program — keying them while bounds are off would
+                   # recompile a byte-identical megastep over an inert
+                   # knob (a warm-serving aot.misses hit)
+                   (float(xhat_threshold),
+                    None if int_mask is None
+                    else aot_cache.array_digest(int_mask))
+                   if bounds else None,
                    aot_cache.mesh_fingerprint(mesh),
                    aot_cache.array_digest(nonant_idx)))
 
 
-def bucketed_megastep_measure_len(n_iters: int, shapes, K: int) -> int:
+def bucketed_megastep_measure_len(n_iters: int, shapes, K: int,
+                                  bounds: bool = False) -> int:
     """Length of the bucketed packed measurement (``shapes`` =
-    ``[(S_b, n_b), ...]`` per bucket, concatenated in bucket order)."""
+    ``[(S_b, n_b), ...]`` per bucket, concatenated in bucket order).
+    ``bounds`` appends the :data:`BOUND_PACK_LEN` in-wheel bound tail."""
     S = sum(s for s, _ in shapes)
     return (6 * n_iters + 2 + 3 * S
-            + sum(s * n for s, n in shapes) + 2 * S * K)
+            + sum(s * n for s, n in shapes) + 2 * S * K
+            + (BOUND_PACK_LEN if bounds else 0))
 
 
-def bucketed_megastep_unpack(vec, n_iters: int, shapes, K: int) -> dict:
+def bucketed_megastep_unpack(vec, n_iters: int, shapes, K: int,
+                             bounds: bool = False) -> dict:
     """Split a fetched :func:`make_bucketed_wheel_megastep` measurement.
 
     Global per-iteration stats exactly as :func:`megastep_unpack`; the
     per-scenario blocks come back PER BUCKET (``shapes`` order): ``pri``/
     ``dua``/``done`` are lists of (S_b,) arrays, ``x`` a list of
     (S_b, n_b), ``W``/``xbars`` lists of (S_b, K) — the host scatters
-    them through each bucket's scenario-index array."""
+    them through each bucket's scenario-index array.  ``bounds`` parses
+    the trailing in-wheel bound tail (:func:`unpack_bound_tail`)."""
     vec = np.asarray(vec)
     N = n_iters
     per = vec[:6 * N].reshape(6, N)
@@ -926,6 +1068,8 @@ def bucketed_megastep_unpack(vec, n_iters: int, shapes, K: int) -> dict:
         "executed": int(vec[off]), "refresh_hit": bool(vec[off + 1]),
     }
     off += 2
+    if bounds:
+        out = unpack_bound_tail(out, vec)
     pri, dua, done = [], [], []
     for S_b, _ in shapes:
         pri.append(vec[off:off + S_b])
@@ -992,7 +1136,9 @@ def _bucketed_finish(arrs, states, sols, Ws, rhos, idx, dt):
 def make_bucketed_wheel_megastep(nonant_idx: np.ndarray,
                                  settings: ADMMSettings,
                                  n_iters: int = 8, donate: bool = True,
-                                 axis: str = "scen"):
+                                 axis: str = "scen", bounds: bool = False,
+                                 int_nonants=None,
+                                 xhat_threshold: float = 0.5):
     """ONE jitted program running up to ``n_iters`` frozen wheel
     iterations over a BUCKETED (ragged) family — the shape-bucketed twin
     of :func:`make_wheel_megastep`.
@@ -1015,20 +1161,32 @@ def make_bucketed_wheel_megastep(nonant_idx: np.ndarray,
     (one scan step is the SUM of all buckets' sweeps against the worker
     watchdog).
 
+    ``bounds=True`` appends the in-wheel bound tail exactly like the
+    homogeneous kernel: each bucket contributes its probability-weighted
+    partial sums (:func:`_bound_pass_terms` — probs/onehot are
+    GLOBAL-tree slices, so cross-bucket accumulation is exact), and the
+    feasibility mass is global like the acceptance mask.
+    ``int_nonants`` is per-bucket (a tuple of (K,) masks — bucketing can
+    key on the integer pattern, so slots may differ across buckets).
+
     Returns ``mega(states, arrs, prox_on, factors, convthresh, n_live,
     accept_tol) -> (states, packed)`` over tuples of per-bucket
-    :class:`PHState` / :class:`PHArrays` / factors.
+    :class:`PHState` / :class:`PHArrays` / factors — with ``bounds=True``
+    the signature gains trailing ``(bound_live, feas_tol)``.
     """
     if n_iters < 1:
         raise ValueError(f"n_iters ({n_iters}) must be >= 1")
     idx = jnp.asarray(nonant_idx)
+    int_masks = (None if int_nonants is None else
+                 tuple(None if m is None else np.asarray(m, dtype=bool)
+                       for m in int_nonants))
     shared_refresh, shared_frozen, _, frozen_solve = _solver_fns_for(
         settings, None, axis)
     del shared_refresh
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def mega(states, arrs, prox_on, factors, convthresh, n_live,
-             accept_tol):
+             accept_tol, bound_live=False, feas_tol=1e-3):
         dt = settings.jdtype()
         n_live_t = jnp.asarray(n_live, jnp.int32)
         thresh = jnp.asarray(convthresh, dt)
@@ -1108,14 +1266,45 @@ def make_bucketed_wheel_megastep(nonant_idx: np.ndarray,
         parts += [st.x.astype(dt).reshape(-1) for st in sts]
         parts += [st.W.astype(dt).reshape(-1) for st in sts]
         parts += [st.xbars.astype(dt).reshape(-1) for st in sts]
+        if bounds:
+            def bounds_on(stsf):
+                outer = inner = feas = jnp.zeros((), dt)
+                sweeps = jnp.zeros((), dt)
+                for bi, (arr, stf) in enumerate(zip(arrs, stsf)):
+                    fsolve = (shared_frozen if arr.A.ndim == 2
+                              else frozen_solve)
+                    ob, ib, fm, sw = _bound_pass_terms(
+                        arr, stf, idx, settings, fsolve, factors[bi],
+                        feas_tol,
+                        None if int_masks is None else int_masks[bi],
+                        xhat_threshold)
+                    outer = outer + ob
+                    inner = inner + ib
+                    feas = feas + fm
+                    sweeps = jnp.maximum(sweeps, sw)
+                return jnp.stack(
+                    [jnp.ones((), dt), outer, inner, feas, sweeps])
+
+            parts.append(jax.lax.cond(
+                jnp.asarray(bound_live, bool),
+                bounds_on, lambda _: jnp.zeros((BOUND_PACK_LEN,), dt),
+                sts))
         return sts, jnp.concatenate(parts)
 
     # AOT executable cache: keyed on the bucket count via the call
     # signature (per-bucket shapes ride the avals); cadence and constants
-    # ride key_extra like the homogeneous megakernel
+    # — including the bound-pass variant — ride key_extra like the
+    # homogeneous megakernel
     return aot_cache.cached_program(
         mega, "bucketed_megastep",
         key_extra=(settings, n_iters, bool(donate), axis,
+                   # bounds-only constants keyed only when the bound-pass
+                   # variant is compiled (see the homogeneous kernel)
+                   (float(xhat_threshold),
+                    None if int_masks is None else tuple(
+                        None if m is None else aot_cache.array_digest(m)
+                        for m in int_masks))
+                   if bounds else None,
                    aot_cache.array_digest(nonant_idx)))
 
 

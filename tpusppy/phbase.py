@@ -21,6 +21,7 @@ from __future__ import annotations
 import numpy as np
 
 from . import global_toc
+from .obs import metrics as _metrics
 from .obs import trace as _trace
 from .spopt import SPOpt
 from .extensions.extension import Extension
@@ -312,7 +313,9 @@ class PHBase(SPOpt):
                 if seg_f < st.max_iter:
                     return 0
                 shapes.append((idx.size, sub.num_vars, sub.num_rows, fb))
-            cap = segmented.megastep_cap_multi(shapes, st)
+            cap = self._megastep_cap_with_bounds(
+                lambda bp: segmented.megastep_cap_multi(
+                    shapes, st, bound_pass=bp))
             if req > 1:
                 n_sel = req
             else:
@@ -332,8 +335,10 @@ class PHBase(SPOpt):
                                                sparse_factor=sf)
         if seg_f < st.max_iter:
             return 0          # segmentation regime: the step pair owns it
-        cap = segmented.megastep_cap(S, n, m, st, factor_batch=fb,
-                                     sparse_factor=sf)
+        cap = self._megastep_cap_with_bounds(
+            lambda bp: segmented.megastep_cap(S, n, m, st, factor_batch=fb,
+                                              sparse_factor=sf,
+                                              bound_pass=bp))
         if req > 1:
             n_sel = req
         else:
@@ -388,15 +393,241 @@ class PHBase(SPOpt):
         return self._solve_sig(self._augmented_q2(), b.lb, b.ub) \
             == self._factors_sig
 
-    def _megastep_dispatch(self, n_req, n_live, convthresh):
-        """Route one window to the homogeneous or bucketed megakernel."""
+    def _megastep_dispatch(self, n_req, n_live, convthresh,
+                           bound_live=None):
+        """Route one window to the homogeneous or bucketed megakernel.
+        ``bound_live``: the in-wheel certification flag for THIS window
+        (None = bound-pass variant not armed — the legacy program)."""
         from .ir import BucketedBatch
 
         if isinstance(self.batch, BucketedBatch):
             return self._megastep_solve_bucketed(
-                n_req, n_live, convthresh, self.W, self.xbars, self.rho)
+                n_req, n_live, convthresh, self.W, self.xbars, self.rho,
+                bound_live=bound_live)
         return self._megastep_solve(n_req, n_live, convthresh,
-                                    self.W, self.xbars, self.rho)
+                                    self.W, self.xbars, self.rho,
+                                    bound_live=bound_live)
+
+    # ---- in-wheel certification (doc/pipeline.md) ---------------------------
+    def _megastep_cap_with_bounds(self, cap_fn):
+        """Watchdog cap with the in-wheel bound-pass reservation — and
+        the reservation must never KILL the megastep: a family that
+        barely fits (plain cap 2, reserved cap < 2) would otherwise
+        silently lose both the megastep AND the bounds.  There, in-wheel
+        certification is disabled for this family loudly (the bound
+        spokes remain the certification path) and the plain cap is
+        kept."""
+        if not self._inwheel_on():
+            return cap_fn(False)
+        cap = cap_fn(True)
+        if cap >= 2:
+            return cap
+        cap_plain = cap_fn(False)
+        if cap_plain >= 2 and not getattr(self, "_inwheel_cap_declined",
+                                          False):
+            self._inwheel_cap_declined = True
+            global_toc(
+                "in_wheel_bounds: the bound-pass watchdog reservation "
+                "would disable the megastep for this shape — in-wheel "
+                "certification disabled (bound spokes remain the "
+                "certification path)", True)
+        return cap_plain
+
+    def _inwheel_on(self) -> bool:
+        """Whether megastep windows run the fused bound pass — the
+        ``in_wheel_bounds`` option, gated to minimization (the
+        weak-duality outer assembly and the xhat feasibility gate are
+        minimization-convention, like the bound spokes they replace)."""
+        if not self.options.get("in_wheel_bounds"):
+            return False
+        if getattr(self, "_inwheel_cap_declined", False):
+            return False    # the bound-pass reservation would kill the
+            # megastep for this shape (_megastep_cap_with_bounds)
+        if not self.is_minimizing:
+            if not getattr(self, "_inwheel_min_warned", False):
+                self._inwheel_min_warned = True
+                global_toc(
+                    "in_wheel_bounds: maximization families are not "
+                    "supported (bound spokes remain the certification "
+                    "path) — disabled", True)
+            return False
+        return True
+
+    def _inwheel_inner_ok(self) -> bool:
+        """Whether the in-wheel INNER bound may be consumed: every
+        integer column must be a nonant slot (the device candidate
+        rounds those integral; leftover second-stage integers need the
+        Xhat_Eval dive/MILP machinery, which cannot run in-scan — the
+        xhat spokes keep that posture)."""
+        ok = getattr(self, "_inwheel_inner_ok_cache", None)
+        if ok is None:
+            from .ir import BucketedBatch
+
+            b = self.batch
+            subs = ([sub for _, sub in b.buckets]
+                    if isinstance(b, BucketedBatch) else [b])
+            ok = True
+            for sub in subs:
+                free = np.ones(sub.num_vars, dtype=bool)
+                free[sub.tree.nonant_indices] = False
+                if np.asarray(sub.is_int, bool)[free].any():
+                    ok = False
+                    break
+            self._inwheel_inner_ok_cache = ok
+            if not ok:
+                global_toc(
+                    "in_wheel_bounds: second-stage integer columns — the "
+                    "in-wheel INNER bound is not certified (outer-only "
+                    "mode; run xhat spokes to evaluate incumbents, or "
+                    "the wheel cannot close the gap)", True)
+        return ok
+
+    def _inwheel_every(self) -> int:
+        """Bound-pass cadence in WINDOWS: ``in_wheel_bound_every`` when
+        set, else the autotuner's banked verdict (the ``bound_cadence``
+        persist kind), else every window."""
+        every = self.options.get("in_wheel_bound_every")
+        if every:
+            return max(1, int(every))
+        from . import tune
+
+        v = tune.bound_cadence_verdict(self._mega_shape_key(),
+                                       settings=self.admm_settings)
+        return max(1, int(v)) if v else 1
+
+    def _consume_inwheel_bounds(self, meas):
+        """Install one window's fused bound evidence through the typed
+        hub updates (``OuterBoundUpdate``/``InnerBoundUpdate``, source
+        char ``'M'`` — megastep) so ``compute_gaps`` termination and the
+        gap-vs-wall trace see in-wheel bounds exactly like spoke bounds;
+        tracked on the opt too for hub-less runs.  The inner bound is
+        offered only when the frozen evaluation was feasible on the
+        whole batch (the ``Xhat_Eval`` all-scenarios gate)."""
+        if not meas.get("bound_computed"):
+            return
+        c = self.spcomm
+        ob = float(meas["bound_outer"])
+        if np.isfinite(ob):
+            if ob > getattr(self, "inwheel_outer_bound", -np.inf):
+                self.inwheel_outer_bound = ob
+            if ob > self.best_bound:
+                self.best_bound = ob
+            if c is not None and hasattr(c, "OuterBoundUpdate"):
+                c.OuterBoundUpdate(ob, char='M')
+        # the all-scenarios rule with a DTYPE-AWARE slack: the device
+        # computes the mass as probs @ mask in the settings dtype, and
+        # an all-feasible f32 sum over S non-representable probabilities
+        # (0.1) lands ~S*eps below 1.0 — a bare 1e-9 gate would reject
+        # every feasible window on the float32 TPU posture
+        slack = max(1e-9, 4.0 * self.batch.num_scenarios
+                    * float(np.finfo(self.admm_settings.jdtype()).eps))
+        feasible = meas["bound_inner_feas"] >= 1.0 - slack
+        if feasible and self._inwheel_inner_ok():
+            self._offer_inwheel_inner(float(meas["bound_inner_obj"]))
+        elif not feasible:
+            _metrics.inc("megastep.bound_pass_infeasible")
+            self._maybe_inwheel_rescue()
+
+    def _offer_inwheel_inner(self, ib: float):
+        """Track + typed-install one certified in-wheel incumbent value
+        (source char ``'M'``)."""
+        if not np.isfinite(ib):
+            return
+        if ib < getattr(self, "inwheel_inner_bound", np.inf):
+            self.inwheel_inner_bound = ib
+        c = self.spcomm
+        if c is not None and hasattr(c, "InnerBoundUpdate"):
+            c.InnerBoundUpdate(ib, char='M')
+
+    def _maybe_inwheel_rescue(self):
+        """Cadence gate in front of :meth:`_inwheel_host_rescue`: fire on
+        the first feasibility-gate miss, then every
+        ``in_wheel_rescue_every``-th miss (default 4 — a rescue is S host
+        LPs, so it must not run every window on big-S wheels).  A rescue
+        that DECLINES (the candidate is genuinely infeasible — the
+        iter-1 consensus usually is) retries with a growing backoff
+        (next miss, then +2, ... capped at the cadence) instead of
+        spending a full cadence slot: the earliest windows fail
+        together, and one early decline must not starve the wheel of
+        its first certified incumbent for ``every`` more windows.
+        ``in_wheel_host_rescue=False`` disables."""
+        if not self.options.get("in_wheel_host_rescue", True):
+            return
+        if not self._inwheel_inner_ok():
+            return
+        every = max(1, int(self.options.get("in_wheel_rescue_every", 4)))
+        miss = getattr(self, "_inwheel_gate_misses", 0)
+        self._inwheel_gate_misses = miss + 1
+        if miss < getattr(self, "_inwheel_next_rescue", 0):
+            return
+        ib = self._inwheel_host_rescue()
+        if ib is None:
+            declines = getattr(self, "_inwheel_rescue_declines", 0) + 1
+            self._inwheel_rescue_declines = declines
+            self._inwheel_next_rescue = miss + min(declines, every)
+        else:
+            self._inwheel_next_rescue = miss + every
+            self._offer_inwheel_inner(ib)
+
+    def _inwheel_host_rescue(self):
+        """Host-EXACT inner-bound rescue — the straggler-rescue
+        philosophy applied to the certification path.  Stiff families
+        (UC's pmin/ramp coupling at fixed commitments) stall batched
+        ADMM on the clamped evaluation even at refresh grade, so the
+        fused pass's ``Xhat_Eval`` gate keeps declining; here the SAME
+        candidate (the single-sourced ``xbar_candidate`` rule: rounded
+        at the in-wheel threshold, clipped to the nonant box) is
+        evaluated by per-scenario host solves — an LP, or the exact host
+        QP when the scenario carries a quadratic objective (the
+        straggler rescue's own split; the LP-only HiGHS wrapper raises
+        on q2) — so the expected objective is a certified incumbent.
+        Integer nonants are FIXED at their rounded values and
+        :meth:`_inwheel_inner_ok` guarantees no other integer columns,
+        so the value is the true candidate value, not a relaxation.
+        Zero spoke threads, zero device programs.  Returns the bound, or
+        None when any scenario is genuinely infeasible at the candidate
+        — or when the host solver errors: a rescue failure must decline,
+        never kill the wheel."""
+        from .cylinders.xhatxbar_bounder import clamp_candidate
+        from .ir import BucketedBatch
+        from .solvers import scipy_backend
+
+        if getattr(self, "_host_state_stale", False):
+            self._sync_host_state()
+        _metrics.inc("megastep.bound_rescues")
+        thr = self._inwheel_threshold()
+        b = self.batch
+        total = 0.0
+        parts = (b.buckets if isinstance(b, BucketedBatch)
+                 else [(np.arange(b.num_scenarios), b)])
+        probs = np.asarray(self.probs, dtype=float)
+        xbars = np.asarray(self.xbars, dtype=float)
+        try:
+            for idx, sub in parts:
+                _, lb, ub = clamp_candidate(
+                    sub, sub.tree.nonant_indices, xbars[np.asarray(idx)],
+                    thr)
+                objs = []
+                for s in range(sub.num_scenarios):
+                    q2s = np.asarray(sub.q2[s])
+                    if q2s.any():
+                        r = scipy_backend.solve_qp_with_duals(
+                            sub.c[s], q2s, sub.A[s], sub.cl[s],
+                            sub.cu[s], lb[s], ub[s], const=sub.const[s])
+                    else:
+                        r = scipy_backend.solve_lp(
+                            sub.c[s], sub.A[s], sub.cl[s], sub.cu[s],
+                            lb[s], ub[s], const=sub.const[s])
+                    objs.append(r.obj)
+                objs = np.asarray(objs, dtype=float)
+                if not np.isfinite(objs).all():
+                    return None
+                total += float(probs[np.asarray(idx)] @ objs)
+        except Exception as e:     # a failed rescue declines, loudly
+            global_toc(f"in-wheel host rescue failed ({e!r}) — declined",
+                       True)
+            return None
+        return total
 
     def _mega_shape_key(self):
         """The autotuner shape key: (S, n, m), or the tuple of per-bucket
@@ -478,7 +709,59 @@ class PHBase(SPOpt):
                     run_window, self._mega_shape_key(), n_cap=n_req,
                     settings=self.admm_settings)
                 return prog["executed"], bool(self.conv < convthresh)
-        meas = self._megastep_dispatch(n_req, n_live, convthresh)
+        bound_live = None
+        if self._inwheel_on():
+            wc = getattr(self, "_mega_window_count", 0)
+            self._mega_window_count = wc + 1
+            # opt-in measured cadence (the tune.py bound-cadence stage):
+            # two real probe windows — one with the fused bound pass, one
+            # without — measure its marginal cost, and the banked verdict
+            # (persistent via TPUSPPY_TUNE_CACHE) serves this and later
+            # runs of the shape; probes are real iterations, applied
+            # normally, so warmup work is never wasted
+            if (self.options.get("in_wheel_bound_autotune")
+                    and not self.options.get("in_wheel_bound_every")
+                    and not getattr(self, "_bound_tuned", False)):
+                self._bound_tuned = True
+                from . import tune
+
+                if tune.bound_cadence_verdict(
+                        self._mega_shape_key(),
+                        settings=self.admm_settings) is None:
+                    prog = {"k": k, "executed": 0}
+
+                    def run_bwin(bl):
+                        if self.conv is not None and self.conv < convthresh:
+                            return 0
+                        nl = min(n_req, refresh_every - self._mega_age(),
+                                 max_iters - prog["k"] + 1)
+                        if nl < 1:
+                            return 0
+                        m = self._megastep_dispatch(n_req, nl, convthresh,
+                                                    bound_live=bl)
+                        # same contract as the main path: an executed==0
+                        # (first-iterate-rejected) window's bound
+                        # evidence still certifies the INCOMING state
+                        self._consume_inwheel_bounds(m)
+                        ex = m["executed"]
+                        if ex:
+                            self._apply_megastep_meas(prog["k"], m)
+                            prog["k"] += ex
+                            prog["executed"] += ex
+                        return ex
+
+                    tune.autotune_bound_cadence(
+                        run_bwin, self._mega_shape_key(),
+                        settings=self.admm_settings)
+                    return prog["executed"], bool(self.conv < convthresh)
+            bound_live = (wc % self._inwheel_every() == 0)
+        meas = self._megastep_dispatch(n_req, n_live, convthresh,
+                                       bound_live=bound_live)
+        if bound_live is not None:
+            # bound evidence is valid on whatever state the window ended
+            # with — including an executed == 0 (first-iterate-rejected)
+            # window, whose bounds certify the INCOMING state
+            self._consume_inwheel_bounds(meas)
         executed = meas["executed"]
         if executed == 0:
             # the window's FIRST iterate failed the in-scan acceptance

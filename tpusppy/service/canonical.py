@@ -108,7 +108,18 @@ def _program_options_parts(options) -> tuple:
             ("refresh_every",
              int(options.get("solver_refresh_every", 16) or 0)),
             ("sparse_device_A",
-             str(options.get("sparse_device_A", "auto"))))
+             str(options.get("sparse_device_A", "auto"))),
+            # the self-certifying megastep is a DIFFERENT program (the
+            # fused bound pass + bound tail); its cadence is a traced
+            # flag inside that one program, so only the bool shapes.
+            # The rounding threshold is a baked constant of the
+            # bounds=True program ONLY — keying it while bounds are off
+            # would recompile a byte-identical megastep (an aot.misses
+            # hit on the warm-serving path) over a knob with no effect
+            ("in_wheel_bounds", bool(options.get("in_wheel_bounds"))),
+            ("xhat_threshold",
+             float(options.get("in_wheel_xhat_threshold", 0.5))
+             if options.get("in_wheel_bounds") else None))
 
 
 def family_key(batch, settings=None, ndev: int = 1,

@@ -300,12 +300,19 @@ class SolveServer:
     def __init__(self, work_dir=None, quantum_secs=5.0, rel_gap=1e-3,
                  linger_secs=30.0, arm_caches=True, max_queue=None,
                  checkpoint_every_secs=20.0, recover=False,
-                 _start_executor=True):
+                 in_wheel_bounds=False, _start_executor=True):
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="tpusppy_srv_")
         os.makedirs(os.path.join(self.work_dir, "tenants"), exist_ok=True)
         self.quantum_secs = float(quantum_secs)
         self.rel_gap = float(rel_gap)
         self.linger_secs = float(linger_secs)
+        # self-certifying tenant wheels (doc/pipeline.md "In-wheel
+        # certification"): the megastep's fused bound pass certifies the
+        # gap, so a slice runs ZERO spoke threads/device programs —
+        # shrinking each request's device footprint to one cylinder.
+        # Server default; a request option "in_wheel_bounds" overrides
+        # per tenant.
+        self.in_wheel_bounds = bool(in_wheel_bounds)
         self.max_queue = None if max_queue is None else int(max_queue)
         self.checkpoint_every_secs = float(checkpoint_every_secs)
         self._cv = threading.Condition()
@@ -619,6 +626,12 @@ class SolveServer:
         # hub-side knobs must not leak into the canonical settings key
         for k in ("rel_gap", "abs_gap", "linger_secs", "deadline_secs"):
             opt_options.pop(k, None)
+        # the server-level self-certifying default resolves HERE so the
+        # family key sees the effective value (a request that rode a
+        # different server default must never warm-bind the other
+        # variant's programs)
+        if opt_options.get("in_wheel_bounds") is None:
+            opt_options["in_wheel_bounds"] = self.in_wheel_bounds
         return creator, names, kwargs, opt_options
 
     def submit(self, req) -> str:
@@ -947,15 +960,94 @@ class SolveServer:
                      t.record["iters"], t.slices)
         t.done.set()
 
+    def _tenant_in_wheel(self, t: _Tenant) -> bool:
+        """Whether this tenant's slices run the SELF-CERTIFYING wheel —
+        resolved into ``opt_options`` at ingest (request option wins over
+        the server default) so the family key keyed the same value."""
+        return bool((t.opt_options or {}).get("in_wheel_bounds"))
+
+    def _in_wheel_viable(self, t: _Tenant) -> bool:
+        """Whether a spoke-LESS slice can actually certify: the fused
+        bound pass exists only on the MEGASTEP path, so a family in the
+        segmentation regime (the shape can't fit one frozen dispatch
+        under the worker watchdog) or with too small a refresh window
+        must keep its bound spokes — dropping them would leave the hub
+        with zero bound sources and the slice would burn its whole
+        budget uncertified.  Mirrors the ``PHBase`` megastep gate on the
+        ingest-time canonical model; sparse shapes are modeled at dense
+        sweep cost here, which errs toward KEEPING spokes, never toward
+        an uncertifiable spoke-less slice."""
+        from ..ir import BucketedBatch
+        from ..solvers import segmented
+        from ..spbase import make_admm_settings
+        from ..spopt import bucket_shared
+
+        if int(t.opt_options.get("solver_refresh_every", 16) or 0) <= 2:
+            return False
+        b = t.canonical.batch
+        # mirror PHBase._inwheel_inner_ok: second-stage integer columns
+        # make the in-scan frozen evaluation an uncertified relaxation
+        # AND gate off the host rescue — a spoke-less slice would have
+        # zero inner-bound sources
+        subs = ([sub for _, sub in b.buckets]
+                if hasattr(b, "buckets") else [b])
+        for sub in subs:
+            free = np.ones(sub.num_vars, dtype=bool)
+            free[sub.tree.nonant_indices] = False
+            if np.asarray(sub.is_int, bool)[free].any():
+                return False
+        st = make_admm_settings(dict(t.opt_options), t.canonical.bundling)
+
+        def fits(S, n, m, fb):
+            _, seg_f = segmented.dispatch_segments(S, n, m, st,
+                                                   factor_batch=fb)
+            return seg_f >= st.max_iter
+
+        if isinstance(b, BucketedBatch):
+            shapes = []
+            for idx, sub in b.buckets:
+                fb = 1 if bucket_shared(sub) else idx.size
+                if not fits(idx.size, sub.num_vars, sub.num_rows, fb):
+                    return False
+                shapes.append((idx.size, sub.num_vars, sub.num_rows, fb))
+            # the bound-pass reservation must leave the megastep alive:
+            # a barely-fitting family (reserved cap < 2) never runs the
+            # fused pass (PHBase._megastep_cap_with_bounds declines it)
+            return segmented.megastep_cap_multi(
+                shapes, st, bound_pass=True) >= 2
+        S, n, m = b.num_scenarios, b.num_vars, b.num_rows
+        fb = 1 if getattr(b, "A_shared", None) is not None else S
+        return (fits(S, n, m, fb)
+                and segmented.megastep_cap(S, n, m, st, factor_batch=fb,
+                                           bound_pass=True) >= 2)
+
     def _build_wheel(self, t: _Tenant, preempt_check, on_iter0_done):
         """Hub/spoke dicts for one slice of one tenant — the standard
         certified-wheel topology (PH hub + Lagrangian outer + XhatShuffle
-        inner), every cylinder binding the SAME canonical model."""
+        inner), every cylinder binding the SAME canonical model.
+
+        In-wheel mode (:meth:`_tenant_in_wheel`): the hub's megastep
+        windows certify via the fused bound pass and the slice spawns NO
+        spoke threads — per-request device footprint shrinks to one
+        cylinder's programs (doc/pipeline.md "In-wheel certification").
+        """
         from ..cylinders import (LagrangianOuterBound, PHHub,
                                  XhatShuffleInnerBound)
         from ..opt.ph import PH
         from ..phbase import PHBase
         from ..xhat_eval import Xhat_Eval
+
+        in_wheel = self._tenant_in_wheel(t)
+        if in_wheel and not self._in_wheel_viable(t):
+            # keep the bound spokes: a spoke-less slice of this family
+            # could never certify (no megastep -> no fused bound pass)
+            if not getattr(t, "_in_wheel_declined", False):
+                t._in_wheel_declined = True
+                _log.warning(
+                    "request %s: in_wheel_bounds requested but the "
+                    "family cannot megastep (segmentation regime / "
+                    "refresh window) — keeping bound spokes", t.id)
+            in_wheel = False
 
         def opt_kwargs(extra=None):
             options = dict(t.opt_options, canonical_model=t.canonical)
@@ -987,6 +1079,8 @@ class SolveServer:
             "opt_class": PH,
             "opt_kwargs": opt_kwargs({"on_iter0_done": on_iter0_done}),
         }
+        if in_wheel:
+            return hub_dict, []
         spokes = [
             {"spoke_class": LagrangianOuterBound, "spoke_kwargs": {},
              "opt_class": PHBase, "opt_kwargs": opt_kwargs()},

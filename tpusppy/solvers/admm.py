@@ -1331,6 +1331,21 @@ def _dual_objective_with_margin_jit(c, q2, A, cl, cu, lb, ub, y, x_hint,
 # still traces under the pinned full-precision matmul context
 dual_objective_with_margin = _highest_precision(_aot.cached_program(
     _dual_objective_with_margin_jit, "admm.dual_objective_with_margin"))
+
+
+def dual_objective_with_margin_traced(c, q2, A, cl, cu, lb, ub, y, x_hint,
+                                      margin_scale=100.0):
+    """TRACEABLE twin of :func:`dual_objective_with_margin` for callers
+    fusing the certified-bound assembly into a larger device program (the
+    in-wheel bound pass of ``parallel.sharded.make_wheel_megastep``).
+    Same (2, S) stack of [dual_objective, margin], traced under the SAME
+    ``_highest_precision`` matmul pin as the spoke-path wrapper — the
+    bound's validity is numerical, so the fused assembly must not
+    inherit a caller's lowered (bf16) matmul precision.  The
+    tolerance-absorbing margin stays single-sourced here."""
+    with jax.default_matmul_precision("highest"):
+        return _dual_objective_with_margin_jit(c, q2, A, cl, cu, lb, ub, y,
+                                               x_hint, margin_scale)
 dual_objective_with_margin.__doc__ = \
     """(2, S): :func:`dual_objective` stacked with
     :func:`dual_objective_margin` in ONE device program.

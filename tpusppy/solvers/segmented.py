@@ -187,7 +187,7 @@ def fused_iteration_budget(S, n, m, st, refresh_every, factor_batch=1,
 
 
 def megastep_cap(S, n, m, st, eff_flops=None, target_secs=None,
-                 factor_batch=1, sparse_factor=1.0):
+                 factor_batch=1, sparse_factor=1.0, bound_pass=False):
     """Max wheel iterations ONE megastep dispatch may carry for these
     shapes under the worker watchdog (0 or 1 = don't megastep: the shape
     is in the segmentation regime, or barely fits one iteration).
@@ -200,19 +200,31 @@ def megastep_cap(S, n, m, st, eff_flops=None, target_secs=None,
     against the same ``target_secs`` watchdog budget.  The in-scan
     early-exit mask never shrinks the worst case (a masked iteration does
     no sweeps, but the cap must hold when nothing converges).
+
+    ``bound_pass=True`` (in-wheel certification, doc/pipeline.md): the
+    dispatch may end with the fused bound pass — worst-cased at one extra
+    frozen iteration (the xhat frozen evaluation's full sweep budget; the
+    dual-objective contraction is a rounding error next to it) — so one
+    frozen-iteration budget is reserved out of the watchdog window.
     """
     eff = _dense_clamped_eff(eff_flops, factor_batch)
     target = _DISPATCH_TARGET_SECS if target_secs is None else target_secs
     t_sweep = flops_model.sweep_flops(S, n, m, sparse_factor) / eff
-    return int(target / max(_frozen_iter_secs(st, t_sweep), 1e-12))
+    t_iter = _frozen_iter_secs(st, t_sweep)
+    if bound_pass:
+        target = max(target - t_iter, 0.0)
+    return int(target / max(t_iter, 1e-12))
 
 
-def megastep_cap_multi(shapes, st, eff_flops=None, target_secs=None):
+def megastep_cap_multi(shapes, st, eff_flops=None, target_secs=None,
+                       bound_pass=False):
     """Watchdog cap for a BUCKETED megastep: one scan step runs EVERY
     bucket's frozen sweep back to back inside the same program, so the
     per-iteration worst case is the SUM over buckets of the homogeneous
     :func:`megastep_cap` accounting.  ``shapes`` is
-    ``[(S_b, n_b, m_b[, factor_batch_b[, sparse_factor_b]]), ...]``."""
+    ``[(S_b, n_b, m_b[, factor_batch_b[, sparse_factor_b]]), ...]``.
+    ``bound_pass`` reserves one cross-bucket frozen-iteration budget for
+    the fused bound pass (see :func:`megastep_cap`)."""
     target = _DISPATCH_TARGET_SECS if target_secs is None else target_secs
     total = 0.0
     for shp in shapes:
@@ -222,6 +234,8 @@ def megastep_cap_multi(shapes, st, eff_flops=None, target_secs=None):
         eff = _dense_clamped_eff(eff_flops, fb)
         t_sweep = flops_model.sweep_flops(S, n, m, sf) / eff
         total += _frozen_iter_secs(st, t_sweep)
+    if bound_pass:
+        target = max(target - total, 0.0)
     return int(target / max(total, 1e-12))
 
 
@@ -258,6 +272,26 @@ def bill_megastep(S, n, m, n_iters, sweeps, sparse_factor=1.0,
     if _trace.enabled():
         _trace.instant("dispatch", "megastep", S=S, n=n, m=m,
                        iters=int(n_iters), sweeps=float(sweeps))
+    return fl
+
+
+def bill_bound_pass(S, n, m, sweeps, sparse_factor=1.0,
+                    count_pass=True):
+    """Bill one EXECUTED in-wheel bound pass (doc/pipeline.md "In-wheel
+    certification"): the xhat-at-xbar frozen evaluation's measured
+    ``sweeps`` plus the Lagrangian dual-objective contraction, at this
+    shape, into ``dispatch.flops`` — dispatched work inside the megastep
+    window that is certification, not PH iterations, so it never inflates
+    ``dispatch.mega_iterations``.  ``count_pass=False``: FLOPS only (the
+    bucketed kernel bills per bucket but the window ran ONE pass)."""
+    if count_pass:
+        _metrics.inc("megastep.bound_passes")
+    fl = flops_model.bound_pass_flops(S, n, m, sweeps, sparse_factor)
+    if fl:
+        _metrics.inc("dispatch.flops", fl)
+    if _trace.enabled():
+        _trace.instant("dispatch", "bound_pass", S=S, n=n, m=m,
+                       sweeps=float(sweeps))
     return fl
 
 

@@ -757,25 +757,52 @@ class SPOpt(SPBase):
             v = os.environ.get("TPUSPPY_DEVICE_STATE", "0") != "0"
         return bool(v)
 
-    def _megastep_fn(self, n_req: int, pack: str = "full"):
+    def _inwheel_int_mask(self, batch=None):
+        """(K,) integer mask of nonant slots for the in-wheel xhat
+        candidate rounding (None when the family has no integer
+        nonants)."""
+        b = self.batch if batch is None else batch
+        mask = np.asarray(b.is_int, bool)[self.tree.nonant_indices]
+        return mask if mask.any() else None
+
+    def _inwheel_feas_tol(self) -> float:
+        """THE feasibility-gate tolerance — single-sourced for
+        :meth:`feas_prob`, the ``Xhat_Eval`` integer gate, and the fused
+        in-wheel evaluation (their claimed parity depends on one
+        definition): option ``feas_tol`` floored at 10x the solver's own
+        eps (a loose solve cannot certify tighter than itself)."""
+        return max(float(self.options.get("feas_tol", 1e-3)),
+                   10.0 * self.admm_settings.eps_rel)
+
+    def _inwheel_threshold(self) -> float:
+        """Integer rounding threshold of the in-wheel xhat candidate (the
+        ``xbar_candidate`` rule; ``in_wheel_xhat_threshold`` option)."""
+        return float(self.options.get("in_wheel_xhat_threshold", 0.5))
+
+    def _megastep_fn(self, n_req: int, pack: str = "full",
+                     bounds: bool = False):
         """The jitted megakernel for this instance at width ``n_req``
-        (one compile per distinct (N, pack); the traced ``n_live`` budget
-        serves every executed count below it)."""
+        (one compile per distinct (N, pack, bounds); the traced
+        ``n_live`` budget serves every executed count below it, and the
+        traced ``bound_live`` flag serves every bound cadence)."""
         cache = getattr(self, "_mega_fn_cache", None)
         if cache is None:
             cache = self._mega_fn_cache = {}
-        fn = cache.get((n_req, pack))
+        fn = cache.get((n_req, pack, bounds))
         if fn is None:
             from .parallel import sharded
 
             fn = sharded.make_wheel_megastep(
                 self.tree.nonant_indices, self.admm_settings, None,
-                n_iters=n_req, donate=True, pack=pack)
-            cache[(n_req, pack)] = fn
+                n_iters=n_req, donate=True, pack=pack, bounds=bounds,
+                int_nonants=self._inwheel_int_mask() if bounds else None,
+                xhat_threshold=(self._inwheel_threshold() if bounds
+                                else 0.5))
+            cache[(n_req, pack, bounds)] = fn
         return fn
 
     def _megastep_solve(self, n_req: int, n_live: int, convthresh: float,
-                        W, xbars, rho):
+                        W, xbars, rho, bound_live=None):
         """Dispatch ONE wheel megastep window and fetch its packed
         measurement — the megakernel twin of ``n_live`` frozen
         ``_solve_amortized`` iterations, sharing the same amortization
@@ -789,6 +816,11 @@ class SPOpt(SPBase):
         final iterate forces the NEXT solve onto the legacy refresh path
         (``_factors_age`` maxed) — the serial acceptance test at window
         granularity.
+
+        ``bound_live`` (None = the bound-pass program variant is not even
+        compiled): in-wheel certification — True runs the fused
+        outer/inner bound pass on the window's final device state, False
+        rides the same compiled program through the dead cadence branch.
         """
         import jax.numpy as jnp
 
@@ -814,10 +846,17 @@ class SPOpt(SPBase):
         # in-scan acceptance at the serial ladder: the megastep solves
         # the PH prox objective, so every scenario is QP
         _, tol_qp = self._straggler_tols()
+        bounds = bound_live is not None
         with _trace.span(None, "solve.megastep") as _sp:
-            state, packed = self._megastep_fn(n_req, pack)(
-                state, arr, 1.0, self._factors, convthresh, n_live,
-                tol_qp)
+            fn = self._megastep_fn(n_req, pack, bounds=bounds)
+            if bounds:
+                state, packed = fn(
+                    state, arr, 1.0, self._factors, convthresh, n_live,
+                    tol_qp, bool(bound_live), self._inwheel_feas_tol())
+            else:
+                state, packed = fn(
+                    state, arr, 1.0, self._factors, convthresh, n_live,
+                    tol_qp)
             # rebind the warm slot BEFORE the blocking fetch: the old
             # buffers were donated into the dispatch, so a fetch failure
             # (remote-tunnel error, fault injection) must not leave
@@ -828,10 +867,12 @@ class SPOpt(SPBase):
             # go stale until a boundary sync fetches them explicitly
             self._dev_state = state if pack == "lean" else None
             meas = sharded.megastep_unpack(
-                hostsync.fetch(packed), n_req, S, n, K, pack=pack)
+                hostsync.fetch(packed), n_req, S, n, K, pack=pack,
+                bounds=bounds)
             if _trace.enabled():
                 _sp.add(n_live=n_live, executed=meas["executed"],
-                        refresh_hit=meas["refresh_hit"])
+                        refresh_hit=meas["refresh_hit"],
+                        bound_pass=bool(meas.get("bound_computed")))
         executed = meas["executed"]
         self._factors_age += executed
         sf = (segmented.SPARSE_DISPATCH_FACTOR
@@ -843,6 +884,9 @@ class SPOpt(SPBase):
                if meas["refresh_hit"] and executed < n_req else None)
         segmented.bill_megastep(S, n, m, executed, sweeps, sparse_factor=sf,
                                 rejected_sweeps=rej)
+        if meas.get("bound_computed"):
+            segmented.bill_bound_pass(S, n, m, meas["bound_sweeps"],
+                                      sparse_factor=sf)
 
         refresh_every = self._refresh_every()
         guard = False
@@ -909,23 +953,34 @@ class SPOpt(SPBase):
         self._mega_arr_bucket_cache = (key, arrs)
         return arrs
 
-    def _bucketed_megastep_fn(self, n_req: int):
+    def _bucketed_megastep_fn(self, n_req: int, bounds: bool = False):
         cache = getattr(self, "_mega_fn_cache", None)
         if cache is None:
             cache = self._mega_fn_cache = {}
-        keyb = ("bucketed", n_req)
+        keyb = ("bucketed", n_req, bounds)
         fn = cache.get(keyb)
         if fn is None:
             from .parallel import sharded
 
+            int_masks = None
+            if bounds:
+                # per-bucket integer masks: bucketing may key on the
+                # integer pattern, so nonant integrality can differ
+                int_masks = tuple(
+                    self._inwheel_int_mask(batch=sub)
+                    for _, sub in self.batch.buckets)
             fn = sharded.make_bucketed_wheel_megastep(
                 self.tree.nonant_indices, self.admm_settings,
-                n_iters=n_req, donate=True)
+                n_iters=n_req, donate=True, bounds=bounds,
+                int_nonants=int_masks,
+                xhat_threshold=(self._inwheel_threshold() if bounds
+                                else 0.5))
             cache[keyb] = fn
         return fn
 
     def _megastep_solve_bucketed(self, n_req: int, n_live: int,
-                                 convthresh: float, W, xbars, rho):
+                                 convthresh: float, W, xbars, rho,
+                                 bound_live=None):
         """Bucketed twin of :meth:`_megastep_solve`: ONE device dispatch
         runs ``n_live`` wheel iterations over every bucket's compact
         shapes, the packed per-bucket blocks scatter back through each
@@ -970,17 +1025,25 @@ class SPOpt(SPBase):
         factors = tuple(slot["factors"] for slot in slots)
         _, tol_qp = self._straggler_tols()
         shapes = [(idx.size, sub.num_vars) for idx, sub in b.buckets]
+        bounds = bound_live is not None
         with _trace.span(None, "solve.megastep") as _sp:
-            states, packed = self._bucketed_megastep_fn(n_req)(
-                tuple(states), arrs, 1.0, factors, convthresh, n_live,
-                tol_qp)
+            fnb = self._bucketed_megastep_fn(n_req, bounds=bounds)
+            if bounds:
+                states, packed = fnb(
+                    tuple(states), arrs, 1.0, factors, convthresh,
+                    n_live, tol_qp, bool(bound_live),
+                    self._inwheel_feas_tol())
+            else:
+                states, packed = fnb(
+                    tuple(states), arrs, 1.0, factors, convthresh,
+                    n_live, tol_qp)
             # rebind every bucket's warm slot BEFORE the blocking fetch
             # (the donated buffers are gone — same contract as the
             # homogeneous path)
             for slot, stb in zip(slots, states):
                 slot["warm"] = (stb.x, stb.z, stb.y, stb.yx)
             bmeas = sharded.bucketed_megastep_unpack(
-                hostsync.fetch(packed), n_req, shapes, K)
+                hostsync.fetch(packed), n_req, shapes, K, bounds=bounds)
             if _trace.enabled():
                 _sp.add(n_live=n_live, executed=bmeas["executed"],
                         refresh_hit=bmeas["refresh_hit"], buckets=len(arrs))
@@ -991,6 +1054,10 @@ class SPOpt(SPBase):
         meas = {k: bmeas[k] for k in (
             "conv", "eobj", "pri_max", "dua_max", "iters", "all_done",
             "executed", "refresh_hit")}
+        if bounds:
+            meas.update({k: bmeas[k] for k in (
+                "bound_computed", "bound_outer", "bound_inner_obj",
+                "bound_inner_feas", "bound_sweeps")})
         pri = np.zeros(S)
         dua = np.zeros(S)
         done = np.zeros(S, dtype=bool)
@@ -1032,6 +1099,10 @@ class SPOpt(SPBase):
             segmented.bill_megastep(idx.size, sub.num_vars, sub.num_rows,
                                     executed, sweeps, rejected_sweeps=rej,
                                     count_dispatch=bi == 0)
+            if meas.get("bound_computed"):
+                segmented.bill_bound_pass(
+                    idx.size, sub.num_vars, sub.num_rows,
+                    meas["bound_sweeps"], count_pass=bi == 0)
             slot["age"] = slot.get("age", 0) + executed
             if meas["refresh_hit"] or guard:
                 slot["age"] = max(slot["age"], refresh_every)
@@ -1284,8 +1355,7 @@ class SPOpt(SPBase):
         eps (e.g. via the Gapper schedule) cannot certify feasibility tighter
         than its own tolerance, so the floor scales with eps_rel."""
         if tol is None:
-            tol = max(self.options.get("feas_tol", 1e-3),
-                      10.0 * self.admm_settings.eps_rel)
+            tol = self._inwheel_feas_tol()   # the ONE gate tolerance
         if self.pri_res is None:
             return 1.0
         return float(self.probs @ (self.pri_res < tol))

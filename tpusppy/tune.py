@@ -111,7 +111,11 @@ _PERSIST_VERSION = 2
 # thread before iter0 (tpusppy/solvers/aot.py).  Absent in older v2
 # files, tolerated (just no prewarm) — no schema bump needed: fused/
 # pipeline/megastep keys are unchanged.
-_PERSIST_KINDS = ("fused", "pipeline", "megastep", "aot")
+# "bound_cadence" (the in-wheel certification PR): per-shape verdict for
+# how often a self-certifying megastep window runs its fused bound pass
+# (doc/pipeline.md "In-wheel certification").  Absent in older v2 files,
+# tolerated — existing kinds' keys are unchanged, no schema bump.
+_PERSIST_KINDS = ("fused", "pipeline", "megastep", "aot", "bound_cadence")
 _persist: dict = {k: {} for k in _PERSIST_KINDS}
 _persist_lock = threading.Lock()
 _disk_loaded_from: str | None = None
@@ -238,6 +242,7 @@ def reset_persist():
         for kind in _PERSIST_KINDS:
             _persist[kind].clear()
     _mega_cache.clear()
+    _bound_cadence_cache.clear()
     _disk_loaded_from = None
     _cache_path_override = None
 
@@ -831,4 +836,114 @@ def autotune_megastep(run_window, shape, n_cap, target_pct: float = 1.0,
             "n": int(n_pick), "per_iter_secs": float(per_iter),
             "overhead_secs": float(overhead),
             "overhead_pct_at_n": float(pct)})
+    return res
+
+
+# ---------------------------------------------------------------------------
+# Bound-cadence stage (in-wheel certification, doc/pipeline.md): pick how
+# often a self-certifying megastep window runs its fused bound pass from
+# the MEASURED marginal bound-pass cost vs the window wall.  Fresh bounds
+# every window close the certified gap soonest; when the pass costs a
+# meaningful fraction of the window (the xhat frozen evaluation is about
+# one extra frozen iteration), spacing it every k windows trades bound
+# staleness (at most k-1 windows of gap-closing lag) for wheel
+# throughput.  Verdicts persist under the "bound_cadence" kind on the
+# same shape+settings key family as the megastep stage.
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class BoundCadenceTune:
+    every: int                # bound pass every k-th megastep window
+    bound_secs: float         # marginal cost of one fused bound pass
+    window_secs: float        # wall of one bound-less megastep window
+    overhead_pct_at_pick: float
+
+
+_bound_cadence_cache: dict = {}
+
+
+def _bound_cadence_disk_lookup(key):
+    dk = _persist_get("bound_cadence", repr(key))
+    if dk is None:
+        return None
+    _metrics.inc("tune.disk_hits")
+    res = BoundCadenceTune(
+        every=int(dk["every"]), bound_secs=float(dk["bound_secs"]),
+        window_secs=float(dk["window_secs"]),
+        overhead_pct_at_pick=float(dk["overhead_pct_at_pick"]))
+    _bound_cadence_cache[key] = res
+    return res
+
+
+def bound_cadence_verdict(shape, settings=None) -> int | None:
+    """Banked bound-pass cadence for a shape (None = no verdict — the
+    hub then runs the pass every window).  ``shape`` is one (S, n, m)
+    triple or the bucketed tuple-of-triples, like
+    :func:`megastep_verdict`."""
+    key = _mega_key(shape, settings)
+    hit = _bound_cadence_cache.get(key) or _bound_cadence_disk_lookup(key)
+    return hit.every if hit is not None else None
+
+
+def autotune_bound_cadence(run_window, shape, settings=None,
+                           target_pct: float = 10.0, every_cap: int = 8,
+                           cache: bool = True):
+    """Measure the marginal cost of the in-wheel bound pass and pick the
+    smallest cadence k keeping it under ``target_pct`` percent of the
+    wheel wall (bound_secs / (k*window_secs + bound_secs) <= f).
+
+    ``run_window(bound_live)`` executes ONE real megastep window end to
+    end (dispatch + packed fetch, measurement applied normally — warmup
+    work is never wasted, the autotune_megastep posture) and returns the
+    executed iteration count.  Three windows run: a compile-absorbing
+    bound-pass warmup, a timed bound-pass window, a timed plain window.
+    k=1 (every window) wins whenever the pass is cheap — the common case,
+    since the frozen evaluation re-enters the window's still-hot factors.
+    Degenerate probes (a converged or rejected window) return the
+    conservative every-window answer WITHOUT banking.
+    """
+    key = _mega_key(shape, settings)
+    if cache:
+        hit = (_bound_cadence_cache.get(key)
+               or _bound_cadence_disk_lookup(key))
+        if hit is not None:
+            return hit
+    run_window(True)                    # compile-absorbing warmup
+    t0 = time.time()
+    ex_b = int(run_window(True))
+    t_bound = time.time() - t0
+    t0 = time.time()
+    ex_p = int(run_window(False))
+    t_plain = time.time() - t0
+    if ex_b < 1 or ex_p < 1:
+        _probe_event("bound_cadence", {"shape": repr(shape),
+                                       "skipped": "degenerate probe",
+                                       "executed": (ex_b, ex_p)})
+        return BoundCadenceTune(every=1, bound_secs=max(t_bound, 0.0),
+                                window_secs=max(t_plain, 1e-9),
+                                overhead_pct_at_pick=100.0)
+    # normalize to per-iteration so unequal executed counts don't skew
+    # the marginal-cost estimate: t_bound = ex_b*c + B with c =
+    # t_plain/ex_p, so B = ex_b * (t_bound/ex_b - t_plain/ex_p) — the
+    # multiplier is the BOUND window's executed count (the pass ran once
+    # in THAT window), not the plain window's
+    bound_secs = max(t_bound / ex_b - t_plain / ex_p, 0.0) * ex_b
+    window_secs = max(t_plain, 1e-9)
+    f = max(target_pct, 1e-3) / 100.0
+    k = int(np.ceil(bound_secs * (1.0 - f) / (f * window_secs))) \
+        if bound_secs > 0 else 1
+    k = max(1, min(k, max(1, int(every_cap))))
+    pct = 100.0 * bound_secs / (bound_secs + k * window_secs)
+    res = BoundCadenceTune(every=k, bound_secs=bound_secs,
+                           window_secs=window_secs,
+                           overhead_pct_at_pick=pct)
+    _probe_event("bound_cadence", {"shape": repr(shape), "pick": k,
+                                   "bound_secs": bound_secs,
+                                   "window_secs": window_secs,
+                                   "overhead_pct_at_pick": pct})
+    if cache:
+        _bound_cadence_cache[key] = res
+        _persist_put("bound_cadence", repr(key), {
+            "every": int(k), "bound_secs": float(bound_secs),
+            "window_secs": float(window_secs),
+            "overhead_pct_at_pick": float(pct)})
     return res
