@@ -617,6 +617,96 @@ def traced_farmer_wheel():
     return entry
 
 
+def integer_segment():
+    """Batched integer wheel (doc/integer.md): hub-only in-wheel wheels
+    on the two INTEGER families (netdes + sizes, ``relax_integers=
+    False``) — certified gap, wall, host escalation seconds, and the
+    ``integer.*`` counter deltas per family.  The per-family LP-only
+    floor (the EF integrality gap) rides next to the certified gap so
+    the artifact shows the wheel certifying PAST what LP-only bounds
+    can ever reach; ``all_host_lift_secs`` is the measured wall of one
+    full UNRANKED gap-closed MILP lift over every scenario (the
+    pure-host posture's unit of work) for the escalation-fraction
+    comparison.
+    """
+    from tpusppy.cylinders import PHHub
+    from tpusppy.models import netdes as netdes_model
+    from tpusppy.models import sizes as sizes_model
+    from tpusppy.obs import metrics as obs_metrics
+    from tpusppy.opt.ph import PH
+    from tpusppy.solvers import integer as integer_solvers
+    from tpusppy.spin_the_wheel import WheelSpinner
+
+    S = int(os.environ.get("BENCH_INT_SCENS", "3"))
+    fams = {
+        "netdes": dict(
+            module=netdes_model, rho=1.0, iters=60, rel_gap=0.04,
+            budget_s=20.0,
+            kw={"num_scens": S, "relax_integers": False}),
+        # sizes: the MIP-rescue leg alone prices ~10s/scenario before
+        # the lift runs — the budget must cover both tiers
+        "sizes": dict(
+            module=sizes_model, rho=0.01, iters=80, rel_gap=0.02,
+            budget_s=60.0,
+            kw={"scenario_count": S, "relax_integers": False}),
+    }
+    out = {"S": S}
+    for name, f in fams.items():
+        mod = f["module"]
+        opt_kwargs = {
+            "options": {"defaultPHrho": f["rho"],
+                        "PHIterLimit": f["iters"], "convthresh": -1.0,
+                        "in_wheel_bounds": True,
+                        "integer_escalation_budget_s": f["budget_s"]},
+            "all_scenario_names": mod.scenario_names_creator(S),
+            "scenario_creator": mod.scenario_creator,
+            "scenario_creator_kwargs": f["kw"],
+        }
+        hub_dict = {"hub_class": PHHub,
+                    "hub_kwargs": {"options": {"rel_gap": f["rel_gap"]}},
+                    "opt_class": PH, "opt_kwargs": opt_kwargs}
+        t0 = time.time()
+        with obs_metrics.window() as w:
+            ws = WheelSpinner(hub_dict, []).spin()
+        wall = time.time() - t0
+        abs_gap, rel_gap = ws.spcomm.compute_gaps()
+        entry = {
+            "wall_secs": round(wall, 2),
+            "rel_gap": float(rel_gap),
+            "inner": float(ws.BestInnerBound),
+            "outer": float(ws.BestOuterBound),
+            "escalation_secs": round(
+                w.delta("integer.escalation_secs"), 3),
+            "candidates": int(w.delta("integer.candidates")),
+            "feasible_hits": int(w.delta("integer.feasible_hits")),
+            "rcfix_slots": int(w.delta("integer.rcfix_slots")),
+            "escalations": int(w.delta("integer.escalations")),
+            "bound_passes": int(w.delta("megastep.bound_passes")),
+        }
+        # the pure-host comparison: ONE full unranked gap-closed MILP
+        # lift over every scenario from the final W is what a MIP-backed
+        # bound spoke pays PER ITERATION — the baseline wall is the
+        # measured unit times the iterations this wheel ran
+        try:
+            from tpusppy.solvers.milp_bound import milp_lift
+
+            qL = integer_solvers._waug_q(ws.opt)
+            base = ws.opt.Edualbound_perscen(q=qL, q2=ws.opt.batch.q2)
+            t0 = time.time()
+            milp_lift(ws.opt.batch, qL, base, budget_s=120.0,
+                      mip_rel_gap=1e-4)
+            unit = time.time() - t0
+            iters_run = max(1, int(getattr(ws.opt, "_iter", 1)))
+            entry["lift_unit_secs"] = round(unit, 3)
+            entry["all_host_lift_secs"] = round(unit * iters_run, 3)
+        except Exception as e:
+            entry["all_host_lift_secs"] = None
+            log(f"integer all-host baseline failed ({name}): {e!r}")
+        out[name] = entry
+        trace_segment_dump(f"integer_{name}")
+    return out
+
+
 def serving_segment():
     """Serving SLOs through the wheel-as-a-service path (tpusppy.service,
     doc/serving.md): one in-process SolveServer receives
@@ -1248,6 +1338,14 @@ def workload():
             line["serving"] = {"error": repr(e)}
             trace_segment_dump("serving_failed")   # bank + reset
         emit_partial(line)   # serving segment banked
+    if not os.environ.get("BENCH_SKIP_INTEGER"):
+        try:   # integer-wheel numbers are additive too
+            line["integer"] = integer_segment()
+        except Exception as e:
+            log(f"integer segment failed: {e!r}")
+            line["integer"] = {"error": repr(e)}
+            trace_segment_dump("integer_failed")   # bank + reset
+        emit_partial(line)   # integer segment banked
     print(json.dumps(line))
     sys.stdout.flush()
     sys.stderr.flush()

@@ -30,6 +30,12 @@ def _parse_args():
     cfg.slammax_args()
     cfg.cross_scenario_cuts_args()
     netdes.inparser_adder(cfg)
+    # the batched integer wheel (doc/integer.md): true-integer arcs +
+    # hub-side in-wheel certification with the rounding sweep and the
+    # gap-ranked host escalation tier — spokes become optional
+    cfg.add_to_config("integer", "solve the TRUE integer instance "
+                      "(relax_integers=False) with in-wheel integer "
+                      "bounds", bool, False)
     cfg.parse_command_line("netdes_cylinders")
     return cfg
 
@@ -40,6 +46,8 @@ def main():
         raise RuntimeError("specify --default-rho")
     all_scenario_names = netdes.scenario_names_creator(cfg.num_scens)
     kw = netdes.kw_creator(cfg)
+    if cfg.integer:
+        kw["relax_integers"] = False
     beans = dict(
         cfg=cfg, scenario_creator=netdes.scenario_creator,
         scenario_denouement=netdes.scenario_denouement,
@@ -47,6 +55,9 @@ def main():
         scenario_creator_kwargs=kw,
     )
     hub_dict = vanilla.ph_hub(**beans)
+    if cfg.integer:
+        hub_dict["opt_kwargs"]["options"].update(
+            in_wheel_bounds=True, integer_escalation_budget_s=20.0)
     if cfg.cross_scenario_cuts:
         vanilla.add_cross_scenario_cuts(hub_dict, cfg)
 

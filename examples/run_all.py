@@ -66,6 +66,14 @@ RUNS = [
      ["--num-scens", "3", "--max-iterations", "12", "--default-rho", "1.0",
       "--rel-gap", "0.05", "--cross-scenario-cuts", "--xhatshuffle"],
      {"obj": 376.3056, "rel": 2e-2}),
+    # the batched integer wheel (doc/integer.md): the TRUE integer
+    # instance, hub-only — in-wheel bounds + rounding sweep + gap-ranked
+    # MILP escalation must certify strictly inside the family's ~5.5%
+    # EF integrality gap (golden MIP objective 398.333; no spokes)
+    ("netdes/netdes_cylinders.py",
+     ["--num-scens", "3", "--max-iterations", "60", "--default-rho", "1.0",
+      "--rel-gap", "0.04", "--integer"],
+     {"obj": 398.3333, "rel": 2e-2, "gap": 0.04}),
     ("hydro/hydro_pysp.py", [], None),
     ("hydro/hydro_cylinders.py",
      ["--branching-factors", "3 3", "--max-iterations", "20",

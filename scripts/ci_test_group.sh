@@ -12,7 +12,7 @@ case "$(basename "$1")" in
   test_segmented.py|test_pipeline.py|test_megastep.py|\
   test_pallas.py|test_sparse_structured.py|test_fused_step.py|\
   test_tune.py|test_precision*.py|test_milp_bound.py|test_bench_smoke.py|\
-  test_aot.py|test_scale_out.py)
+  test_aot.py|test_scale_out.py|test_integer.py)
     echo solvers ;;
   test_ph.py|test_aph.py|test_fwph.py|test_wheel.py|test_tcp_wheel.py|\
   test_mp_wheel.py|test_distributed*.py|test_dist_aph.py|\

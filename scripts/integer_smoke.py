@@ -1,0 +1,150 @@
+"""Nightly integer-wheel smoke (doc/integer.md): integer families certify
+on the fast path.
+
+Two hub-only in-wheel wheels on the true-integer (``relax_integers=
+False``) posture:
+
+* **netdes** (S=3): must certify ``rel_gap <= NETDES_GAP`` — strictly
+  inside the family's ~5.5% EF integrality gap, which floors ANY LP-only
+  bound pair at ~5.85% (outer <= LP EF 376.306, inner >= MIP 398.333) —
+  with the device rounding sweep supplying incumbents
+  (``integer.feasible_hits > 0``) and the certified outer bound strictly
+  ABOVE the LP EF optimum (only the MILP escalation tier can get there).
+* **sizes** (S=3): must certify ``rel_gap <= SIZES_GAP`` (the golden
+  host-lift gap; the family's EF integrality gap is ~2.07%, flooring
+  LP-only pairs at ~2.11%).
+
+Host-tail discipline: each wheel's ``integer.escalation_secs`` must stay
+within its configured budget (+ scheduling slack), and strictly below
+the ALL-HOST baseline — the wall of one full unranked gap-closed MILP
+lift over every scenario times the number of bound events the wheel ran
+(what a MIP-backed bound spoke pays per fresh W, the reference posture).
+
+A hard watchdog (INTEGER_SMOKE_DEADLINE_SECS, default 1200) ``os._exit``s
+so a wedged wheel can never pin the nightly job for the workflow
+timeout.  Exit 0 = pass.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+NETDES_GAP = 0.04      # < the ~5.85% LP-only floor
+NETDES_LP_EF = 376.306
+SIZES_GAP = 0.02       # golden host-lift gap; < the ~2.11% LP-only floor
+
+DEADLINE = float(os.environ.get("INTEGER_SMOKE_DEADLINE_SECS", "1200"))
+
+
+def _watchdog():
+    time.sleep(DEADLINE)
+    print(f"INTEGER SMOKE WATCHDOG: {DEADLINE}s deadline passed — "
+          "killing", flush=True)
+    os._exit(2)
+
+
+def run_family(name, module, kw, rho, iters, rel_gap, budget_s):
+    import numpy as np
+
+    from tpusppy.cylinders import PHHub
+    from tpusppy.obs import metrics as obs_metrics
+    from tpusppy.opt.ph import PH
+    from tpusppy.solvers import integer as integer_solvers
+    from tpusppy.solvers.milp_bound import milp_lift
+    from tpusppy.spin_the_wheel import WheelSpinner
+
+    opt_kwargs = {
+        "options": {"defaultPHrho": rho, "PHIterLimit": iters,
+                    "convthresh": -1.0, "in_wheel_bounds": True,
+                    "integer_escalation_budget_s": budget_s},
+        "all_scenario_names": module.scenario_names_creator(3),
+        "scenario_creator": module.scenario_creator,
+        "scenario_creator_kwargs": kw,
+    }
+    hub_dict = {"hub_class": PHHub,
+                "hub_kwargs": {"options": {"rel_gap": rel_gap}},
+                "opt_class": PH, "opt_kwargs": opt_kwargs}
+    t0 = time.time()
+    with obs_metrics.window() as w:
+        ws = WheelSpinner(hub_dict, []).spin()
+    wall = time.time() - t0
+    _, gap = ws.spcomm.compute_gaps()
+    # all-host baseline unit: ONE full unranked gap-closed lift from the
+    # final W.  The pure-host posture (the reference's MIP-backed
+    # Lagrangian spoke / the old ``lagrangian_milp_lift every=1`` knob)
+    # pays this for EVERY fresh W — once per hub iteration — so the
+    # baseline wall is the unit times the iterations this wheel ran.
+    qL = integer_solvers._waug_q(ws.opt)
+    base = ws.opt.Edualbound_perscen(q=qL, q2=ws.opt.batch.q2)
+    t0 = time.time()
+    milp_lift(ws.opt.batch, qL, base, budget_s=180.0, mip_rel_gap=1e-4)
+    lift_unit_secs = time.time() - t0
+    events = max(1, int(getattr(ws.opt, "_iter", 1)))
+    res = {
+        "family": name,
+        "wall_secs": round(wall, 2),
+        "rel_gap": float(gap),
+        "inner": float(ws.BestInnerBound),
+        "outer": float(ws.BestOuterBound),
+        "feasible_hits": int(w.delta("integer.feasible_hits")),
+        "rcfix_slots": int(w.delta("integer.rcfix_slots")),
+        "escalations": int(w.delta("integer.escalations")),
+        "escalation_secs": round(w.delta("integer.escalation_secs"), 3),
+        "bound_passes": events,
+        "all_host_lift_secs": round(lift_unit_secs * events, 3),
+    }
+    print(json.dumps(res), flush=True)
+    bad = []
+    if not (np.isfinite(gap) and gap <= rel_gap):
+        bad.append(f"rel_gap {gap} > target {rel_gap}")
+    if res["feasible_hits"] < 1:
+        bad.append("integer.feasible_hits == 0 (no sweep incumbents)")
+    if res["escalation_secs"] > budget_s + 30.0:
+        bad.append(f"escalation secs {res['escalation_secs']} blew the "
+                   f"{budget_s}s budget")
+    if not (res["escalation_secs"] < res["all_host_lift_secs"]):
+        bad.append(
+            f"escalation secs {res['escalation_secs']} not below the "
+            f"all-host baseline {res['all_host_lift_secs']}")
+    return res, bad
+
+
+def main():
+    threading.Thread(target=_watchdog, daemon=True).start()
+    from tpusppy.models import netdes, sizes
+
+    badguys = []
+    res_n, bad = run_family(
+        "netdes", netdes, {"num_scens": 3, "relax_integers": False},
+        rho=1.0, iters=60, rel_gap=NETDES_GAP, budget_s=20.0)
+    badguys += [f"netdes: {b}" for b in bad]
+    # netdes-only check: the certified outer bound must sit ABOVE the LP
+    # EF optimum — only the MILP tier can certify there
+    if not (res_n["outer"] > NETDES_LP_EF + 1e-6):
+        badguys.append(
+            f"netdes: outer {res_n['outer']} not past the LP EF "
+            f"{NETDES_LP_EF} — the lift did not engage")
+    if not os.environ.get("INTEGER_SMOKE_SKIP_SIZES"):
+        _, bad = run_family(
+            "sizes", sizes,
+            {"scenario_count": 3, "relax_integers": False},
+            rho=0.01, iters=80, rel_gap=SIZES_GAP, budget_s=45.0)
+        badguys += [f"sizes: {b}" for b in bad]
+    if badguys:
+        print("INTEGER SMOKE FAILED:", flush=True)
+        for b in badguys:
+            print("  ", b, flush=True)
+        sys.exit(1)
+    print("INTEGER SMOKE PASSED", flush=True)
+    # daemon threads + device caches: exit hard like bench.py so a
+    # lingering teardown can never turn a pass into rc!=0
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,691 @@
+"""Batched integer wheel (doc/integer.md): device rounding sweep,
+reduced-cost fixing, and the gap-ranked host escalation tier.
+
+Pins the PR's contracts: the vmapped rounding sweep equals per-candidate
+single dispatches at 1e-9 (and the host candidate ladder is its exact
+twin); reduced-cost fixing is CERTIFICATE-SAFE (a property test checks
+every per-scenario tightened bound against the scenario's true integer
+minimum via HiGHS MIP — the validity argument mirrored from
+milp_bound.py); bounds=True without integer slots stays byte-identical
+whatever the integer knobs say (warm serving zero-miss); the escalation
+budget controller is deterministic under a fake clock (gap-ranked
+ordering, partial-budget elasticity, exhausted-budget leaves LP
+certificates); and the end-to-end netdes wheel certifies a gap target
+UNREACHABLE by LP-only bounds (the 5.5% integrality gap) with the sweep
+supplying incumbents.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from tpusppy.models import netdes, sizes
+from tpusppy.obs import metrics as obs_metrics
+from tpusppy.opt.ph import PH
+from tpusppy.solvers import integer as I
+from tpusppy.solvers import scipy_backend
+
+N = 3
+NETDES_KW = {"num_scens": N, "relax_integers": False}
+
+
+def _netdes_ph(iters=40, **extra):
+    opts = {"defaultPHrho": 1.0, "PHIterLimit": iters, "convthresh": -1.0,
+            "in_wheel_bounds": True, "integer_escalation": False, **extra}
+    return PH(opts, netdes.scenario_names_creator(N),
+              netdes.scenario_creator, scenario_creator_kwargs=NETDES_KW)
+
+
+def _warm(ph, iters=3):
+    ph.Iter0()
+    for k in range(1, iters + 1):
+        ph._iterk_one(k, -1.0)
+    assert ph._factors is not None and ph._warm is not None
+
+
+def _device_inputs(ph):
+    """(arr, state, idx, q_aug, q2_aug, fsolve, dt) — the megastep bound
+    pass's exact inputs rebuilt from the warm host state."""
+    import jax.numpy as jnp
+
+    from tpusppy.parallel import sharded
+    from tpusppy.parallel.sharded import _ph_objective, _solver_fns_for
+
+    st = ph.admm_settings
+    dt = st.jdtype()
+    arr = ph._mega_arrays(dt)
+    warm = ph._warm
+    state = sharded.PHState(
+        W=jnp.asarray(ph.W, dt), xbars=jnp.asarray(ph.xbars, dt),
+        rho=jnp.asarray(ph.rho, dt),
+        x=jnp.asarray(warm[0], dt), z=jnp.asarray(warm[1], dt),
+        y=jnp.asarray(warm[2], dt), yx=jnp.asarray(warm[3], dt))
+    idx = jnp.asarray(ph.tree.nonant_indices)
+    _, shared_frozen, _, frozen_solve = _solver_fns_for(st, None, "scen")
+    fsolve = shared_frozen if arr.A.ndim == 2 else frozen_solve
+    q, q2, _, _ = _ph_objective(arr, state, 1.0, idx, st)
+    return arr, state, idx, q, q2, fsolve, dt
+
+
+class TestCandidateLadder:
+    def test_device_ladder_matches_host_twin(self):
+        """candidate_ladder (traced) == host_candidates at 1e-9 on the
+        identical state — one rule, two execution paths."""
+        import jax
+        import jax.numpy as jnp
+
+        ph = _netdes_ph()
+        _warm(ph)
+        th = ph._inwheel_int_thresholds()
+        host = I.host_candidates(ph, th)
+        arr, state, idx, _, _, _, dt = _device_inputs(ph)
+        mask = jnp.asarray(ph._inwheel_int_mask())
+        dev = jax.jit(lambda s: I.candidate_ladder(
+            s.xbars.astype(dt), s.x.astype(dt)[:, idx], mask, th,
+            arr.onehot, arr.nid_sk, arr.lb.astype(dt)[:, idx],
+            arr.ub.astype(dt)[:, idx]))(state)
+        np.testing.assert_allclose(np.asarray(dev), host, atol=1e-9)
+
+    def test_candidates_integral_and_boxed(self):
+        ph = _netdes_ph()
+        _warm(ph)
+        cands = I.host_candidates(ph)
+        nid = ph.tree.nonant_indices
+        ints = np.asarray(ph.batch.is_int, bool)[nid]
+        lo = np.asarray(ph.batch.lb)[:, nid]
+        hi = np.asarray(ph.batch.ub)[:, nid]
+        assert cands.shape[0] == I.n_candidates(I.DEFAULT_THRESHOLDS)
+        for cand in cands:
+            iv = cand[:, ints]
+            np.testing.assert_allclose(iv, np.round(iv), atol=1e-12)
+            assert (cand >= lo - 1e-12).all()
+            assert (cand <= hi + 1e-12).all()
+
+
+class TestSweepParity:
+    def test_vmapped_sweep_equals_single_dispatches(self):
+        """The vmapped rounding sweep == evaluating each candidate by
+        its own (non-vmapped) frozen dispatch, at 1e-9 — the device
+        argmin sees exactly what C serial dispatches would have."""
+        import jax
+        import jax.numpy as jnp
+
+        ph = _netdes_ph()
+        _warm(ph)
+        th = ph._inwheel_int_thresholds()
+        arr, state, idx, q, q2, fsolve, dt = _device_inputs(ph)
+        mask = jnp.asarray(ph._inwheel_int_mask())
+        feas_tol = ph._inwheel_feas_tol()
+
+        inner_c, feas_c, sweeps_c, u_cs, fm_cs = jax.jit(
+            lambda s: I.sweep_partials(arr, s, idx, q, q2, fsolve,
+                                       ph._factors, feas_tol, dt, mask,
+                                       th))(state)
+        cands = I.host_candidates(ph, th)
+        W = np.asarray(ph.W, dtype=float)
+        probs = np.asarray(ph.probs, dtype=float)
+        b = ph.batch
+        nid = np.asarray(ph.tree.nonant_indices)
+        for ci in range(cands.shape[0]):
+            cand = jnp.asarray(cands[ci], dt)
+            lb2 = arr.lb.at[:, idx].set(cand)
+            ub2 = arr.ub.at[:, idx].set(cand)
+            x0 = state.x.astype(dt).at[:, idx].set(cand)
+            sol = fsolve(q, q2, arr.A, arr.cl, arr.cu, lb2, ub2, x0,
+                         state.z, state.y, state.yx, ph._factors)
+            xs = np.asarray(sol.x)
+            per = (np.einsum("sn,sn->s", np.asarray(b.c), xs)
+                   + 0.5 * np.einsum("sn,sn->s", np.asarray(b.q2),
+                                     xs * xs)
+                   + np.broadcast_to(np.asarray(b.const), (N,)))
+            pri = np.asarray(sol.pri_res)
+            scale = max(1.0, abs(float(probs @ per)))
+            assert abs(float(inner_c[ci]) - probs @ per) <= 1e-9 * scale
+            assert abs(float(feas_c[ci])
+                       - probs @ (pri < feas_tol)) <= 1e-12
+            u_ref = (np.einsum("sn,sn->s", np.asarray(b.c), xs)
+                     + 0.5 * np.einsum("sn,sn->s", np.asarray(b.q2),
+                                       xs * xs)
+                     + np.einsum("sk,sk->s", W, xs[:, nid]))
+            np.testing.assert_allclose(np.asarray(u_cs[ci]), u_ref,
+                                       atol=1e-9 * scale)
+
+
+class TestCertificateSafety:
+    def test_rc_fixed_bounds_lower_bound_integer_minima(self):
+        """THE property test (validity argument mirrored from
+        milp_bound.py's docstring contract): every per-scenario
+        reduced-cost-tightened bound must lower-bound that scenario's
+        TRUE integer minimum of the W-augmented objective (HiGHS MIP
+        ground truth) — fixing never cuts off an integer minimizer."""
+        import jax
+        import jax.numpy as jnp
+
+        ph = _netdes_ph()
+        _warm(ph, iters=8)
+        th = ph._inwheel_int_thresholds()
+        arr, state, idx, q, q2, fsolve, dt = _device_inputs(ph)
+        mask = jnp.asarray(ph._inwheel_int_mask())
+        feas_tol = ph._inwheel_feas_tol()
+        int_cols = jnp.asarray(np.asarray(ph.batch.is_int, bool))
+
+        @jax.jit
+        def run(s):
+            inner_c, feas_c, _, u_cs, fm_cs = I.sweep_partials(
+                arr, s, idx, q, q2, fsolve, ph._factors, feas_tol, dt,
+                mask, th)
+            slack = jnp.asarray(I.feas_slack(N, dt), dt)
+            ok_c = feas_c >= 1.0 - slack
+            best = jnp.argmin(jnp.where(ok_c, inner_c,
+                                        jnp.asarray(np.inf, dt)))
+            return I.rc_outer_partials(
+                arr, s, idx, q, q2, fsolve, ph._factors, dt, int_cols,
+                u_cs[best], fm_cs[best], want_perscen=True)
+
+        final_s, d_cmp, n_fixed, _ = run(state)
+        final_s = np.asarray(final_s, dtype=float)
+        d_cmp = np.asarray(d_cmp, dtype=float)
+        # tightening is monotone per scenario
+        assert (final_s >= d_cmp - 1e-9).all()
+        # ground truth: per-scenario integer minimum of the W-augmented
+        # objective (const-free, matching the device convention)
+        b = ph.batch
+        qL = np.array(b.c, copy=True)
+        qL[:, ph.tree.nonant_indices] += np.asarray(ph.W, dtype=float)
+        for s in range(N):
+            r = scipy_backend.solve_lp(
+                qL[s], b.A[s], b.cl[s], b.cu[s], b.lb[s], b.ub[s],
+                is_int=np.asarray(b.is_int, bool), mip_rel_gap=1e-9)
+            assert r.feasible
+            true_min = float(qL[s] @ r.x)
+            scale = max(1.0, abs(true_min))
+            assert final_s[s] <= true_min + 1e-6 * scale, \
+                (s, final_s[s], true_min)
+
+    def test_bound_pass_outer_never_below_lp_base(self):
+        ph = _netdes_ph()
+        _warm(ph)
+        meas = ph._megastep_solve(4, 0, -1.0, ph.W, ph.xbars, ph.rho,
+                                  bound_live=True)
+        assert meas["bound_computed"]
+        assert "int_feas_cands" in meas
+        assert meas["bound_outer"] >= meas["bound_outer_base"] - 1e-9
+
+    def test_second_stage_integers_compile_out_rc_fixing(self):
+        """sizes carries second-stage integer columns: the candidate
+        evaluation RELAXES them, so its value is not a valid
+        integer-minimum upper bound and the fixing must be compiled out
+        — the pass emits the plain weak-duality outer twice and zero
+        fixed slots (an invalid tightened bound here could falsely
+        certify the wheel)."""
+        opts = {"defaultPHrho": 0.01, "PHIterLimit": 6,
+                "convthresh": -1.0, "in_wheel_bounds": True,
+                "integer_escalation": False,
+                "in_wheel_host_rescue": False}
+        ph = PH(opts, sizes.scenario_names_creator(N),
+                sizes.scenario_creator,
+                scenario_creator_kwargs={"scenario_count": N,
+                                         "relax_integers": False})
+        _warm(ph, iters=3)
+        assert not ph._inwheel_inner_ok()
+        meas = ph._megastep_solve(4, 0, -1.0, ph.W, ph.xbars, ph.rho,
+                                  bound_live=True)
+        assert meas["bound_computed"]
+        assert meas["int_rcfix_slots"] == 0
+        assert meas["bound_outer"] == pytest.approx(
+            meas["bound_outer_base"], rel=1e-12)
+
+    def test_bucketed_ladder_drops_slams(self):
+        """Per-bucket SLAM extremes are NOT nonanticipative across
+        buckets (a node spanning buckets would get different first-stage
+        values per bucket): the bucketed sweep must evaluate the
+        ladder-only candidate set."""
+        import jax.numpy as jnp
+
+        ph = _netdes_ph()
+        _warm(ph, iters=1)
+        th = ph._inwheel_int_thresholds()
+        arr, state, idx, _, _, _, dt = _device_inputs(ph)
+        mask = jnp.asarray(ph._inwheel_int_mask())
+        cands = I.candidate_ladder(
+            state.xbars.astype(dt), state.x.astype(dt)[:, idx], mask,
+            th, arr.onehot, arr.nid_sk, arr.lb.astype(dt)[:, idx],
+            arr.ub.astype(dt)[:, idx], include_slams=False)
+        assert cands.shape[0] == len(th)
+
+
+class TestAotZeroMissContract:
+    def test_no_integer_slots_ignores_integer_knobs(self, tmp_path):
+        """bounds=True WITHOUT integer slots: the integer knobs are
+        inert — a warm repeat under DIFFERENT ladder options must serve
+        from the AOT executable cache with zero misses (byte-identical
+        program, the warm-serving contract)."""
+        from tpusppy.models import farmer
+        from tpusppy.solvers import aot
+
+        def _farmer_ph(**extra):
+            opts = {"defaultPHrho": 1.0, "PHIterLimit": 2,
+                    "convthresh": -1.0, "in_wheel_bounds": True, **extra}
+            return PH(opts, farmer.scenario_names_creator(3),
+                      farmer.scenario_creator,
+                      scenario_creator_kwargs={"num_scens": 3})
+
+        aot.set_cache_path(str(tmp_path / "aot"))
+        try:
+            ph1 = _farmer_ph()
+            _warm(ph1, iters=1)
+            m1 = ph1._megastep_solve(4, 0, -1.0, ph1.W, ph1.xbars,
+                                     ph1.rho, bound_live=True)
+            assert m1["bound_computed"]
+            assert "int_feas_cands" not in m1     # legacy tail
+            with obs_metrics.window() as w:
+                ph2 = _farmer_ph(
+                    in_wheel_int_thresholds=(0.5, 0.25, 0.75),
+                    in_wheel_int_sweep=True)
+                _warm(ph2, iters=1)
+                m2 = ph2._megastep_solve(4, 0, -1.0, ph2.W, ph2.xbars,
+                                         ph2.rho, bound_live=True)
+            assert m2["bound_computed"]
+            assert w.delta("aot.misses") == 0
+        finally:
+            aot.reset()
+
+
+class TestEscalationBudget:
+    def _clock(self, times):
+        it = iter(times)
+        last = [0.0]
+
+        def clock():
+            v = next(it, None)
+            if v is None:
+                return last[0]
+            last[0] = v
+            return v
+
+        return clock
+
+    def test_take_and_timed_elasticity(self):
+        b = I.EscalationBudget(10.0, clock=self._clock([0.0, 3.0, 3.0,
+                                                        10.0]))
+        assert b.take(4.0) == 4.0
+        with b.timed():
+            pass                      # clock advances 0 -> 3
+        assert b.spent_s == pytest.approx(3.0)
+        assert b.take(None) == pytest.approx(7.0)   # elastic remainder
+        with b.timed():
+            pass                      # 3 -> 10
+        assert b.remaining == 0.0
+        assert b.take(5.0) == 0.0     # exhausted: grants nothing
+
+    def test_gap_ranked_order(self):
+        probs = np.array([0.2, 0.5, 0.3])
+        lp = np.array([10.0, 10.0, 10.0])
+        up = np.array([12.0, 11.0, np.inf])     # gaps: .4, .5, non-finite
+        order = I.gap_ranked_order(probs, lp, up)
+        assert list(order[:2]) == [1, 0]
+        assert order[2] == 2                    # non-finite sorts last
+
+    def test_escalate_outer_gap_ranked_and_budgeted(self, monkeypatch):
+        """escalate_outer hands milp_lift the gap-ranked order and the
+        granted budget; an exhausted budget never calls it (every
+        untouched scenario keeps its LP certificate)."""
+        from tpusppy.solvers import milp_bound
+
+        ph = _netdes_ph(iters=4)
+        _warm(ph, iters=2)
+        calls = {}
+
+        def fake_lift(batch, q, base, budget_s=None, order=None,
+                      time_limit=None, mip_rel_gap=None, want_x=False):
+            calls["order"] = None if order is None else list(order)
+            calls["budget_s"] = budget_s
+            out = (np.asarray(base, float), 0)
+            return out + (None,) if want_x else out
+
+        monkeypatch.setattr(milp_bound, "milp_lift", fake_lift)
+        upper = np.array([100.0, 50.0, 400.0])
+        base = np.asarray(ph.Edualbound_perscen(
+            q=I._waug_q(ph), q2=ph.batch.q2), dtype=float)
+        budget = I.EscalationBudget(5.0)
+        ob = I.escalate_outer(ph, budget, upper_perscen=upper)
+        assert ob is not None
+        assert calls["budget_s"] == pytest.approx(5.0, abs=0.2)
+        assert calls["order"] == list(I.gap_ranked_order(
+            ph.probs, base, upper))
+        # exhausted budget: milp_lift never called, LP certificates stay
+        calls.clear()
+        empty = I.EscalationBudget(0.0)
+        assert I.escalate_outer(ph, empty, upper_perscen=upper) is None
+        assert not calls
+
+    def test_partial_budget_second_round_elastic(self, monkeypatch):
+        """Two escalation rounds share ONE pool: the second grant is
+        exactly the un-spent remainder (fake clock pins the spend)."""
+        from tpusppy.solvers import milp_bound
+
+        ph = _netdes_ph(iters=4)
+        _warm(ph, iters=2)
+        grants = []
+
+        def fake_lift(batch, q, base, budget_s=None, order=None,
+                      time_limit=None, mip_rel_gap=None, want_x=False):
+            grants.append(budget_s)
+            out = (np.asarray(base, float), 1)
+            return out + (None,) if want_x else out
+
+        monkeypatch.setattr(milp_bound, "milp_lift", fake_lift)
+        # timed() reads the clock twice per round: spend 2s then 1s
+        budget = I.EscalationBudget(
+            10.0, clock=self._clock([0.0, 2.0, 2.0, 3.0]))
+        I.escalate_outer(ph, budget)
+        I.escalate_outer(ph, budget)
+        assert grants[0] == pytest.approx(10.0)
+        assert grants[1] == pytest.approx(8.0)    # 10 - 2 spent
+        assert budget.spent_s == pytest.approx(3.0)
+
+    def test_escalate_outer_real_lift_is_valid(self):
+        """Unmocked: the lifted bound sits between the LP certificate
+        and the true integer Lagrangian value (weak duality on MIP
+        minima)."""
+        ph = _netdes_ph(iters=8)
+        _warm(ph, iters=6)
+        b = ph.batch
+        qL = I._waug_q(ph)
+        base = float(np.asarray(ph.probs)
+                     @ ph.Edualbound_perscen(q=qL, q2=b.q2))
+        budget = I.EscalationBudget(60.0)
+        ob = I.escalate_outer(ph, budget)
+        assert ob is not None and np.isfinite(ob)
+        assert ob >= base - 1e-9
+        # valid: every scenario term is a bound on the scenario integer
+        # minimum, so the expectation bounds the EF MIP optimum
+        from tpusppy.ef import solve_ef
+        ef_mip, _ = solve_ef(b, solver="highs", mip=True,
+                             time_limit=60.0)
+        assert ob <= ef_mip + 1e-6 * abs(ef_mip)
+        assert budget.spent_s > 0.0
+
+
+class TestHostRescueLadder:
+    def test_rescue_sweeps_ladder_and_counts_hit(self):
+        """Device gate misses (stalled clamped eval) but a ladder
+        candidate IS feasible: the host rescue must certify it exactly
+        and count the sweep-supplied incumbent."""
+        ph = _netdes_ph(iters=24)
+        _warm(ph, iters=20)
+        with obs_metrics.window() as w:
+            ib = ph._inwheel_host_rescue()
+        assert ib is not None and np.isfinite(ib)
+        assert w.delta("integer.feasible_hits") == 1
+        # exact: matches the host evaluation of SOME ladder candidate
+        cands = I.host_candidates(ph)
+        vals = [ph._inwheel_eval_candidate_host(c) for c in cands]
+        feas = [v for v in vals if v is not None]
+        assert feas and any(abs(ib - v) <= 1e-9 * max(1, abs(v))
+                            for v in feas)
+
+
+class TestLiftIncumbents:
+    def test_restricted_ef_incumbent_is_valid(self):
+        """The restricted-EF dive returns an EF-feasible objective —
+        an upper bound on the EF MIP optimum."""
+        ph = _netdes_ph(iters=8)
+        _warm(ph, iters=6)
+        b = ph.batch
+        qL = I._waug_q(ph)
+        base = np.asarray(ph.Edualbound_perscen(q=qL, q2=b.q2), float)
+        budget = I.EscalationBudget(120.0)
+        _, X = I.escalate_outer(ph, budget, want_x=True)
+        assert X is not None and not np.isnan(X[:, 0]).any()
+        ib = I.restricted_ef_incumbent(ph, X, budget)
+        assert ib is not None
+        from tpusppy.ef import solve_ef
+        ef_mip, _ = solve_ef(b, solver="highs", mip=True,
+                             time_limit=60.0)
+        assert ib >= ef_mip - 1e-6 * abs(ef_mip)
+
+
+class TestWheelCertifies:
+    def test_netdes_certifies_past_lp_only_floor(self):
+        """ACCEPTANCE: the hub-only netdes integer wheel certifies a
+        rel_gap the LP-only posture can NEVER reach (the ~5.5%
+        integrality gap floors any LP outer bound at ~5.85% against the
+        MIP incumbent), with the sweep supplying incumbents and bounded
+        host escalation seconds."""
+        import time
+
+        from tpusppy.cylinders import PHHub
+        from tpusppy.spin_the_wheel import WheelSpinner
+
+        opt_kwargs = {
+            "options": {"defaultPHrho": 1.0, "PHIterLimit": 60,
+                        "convthresh": -1.0, "in_wheel_bounds": True,
+                        "integer_escalation_budget_s": 30.0},
+            "all_scenario_names": netdes.scenario_names_creator(N),
+            "scenario_creator": netdes.scenario_creator,
+            "scenario_creator_kwargs": NETDES_KW,
+        }
+        hub_dict = {"hub_class": PHHub,
+                    "hub_kwargs": {"options": {"rel_gap": 0.04}},
+                    "opt_class": PH, "opt_kwargs": opt_kwargs}
+        t0 = time.time()
+        with obs_metrics.window() as w:
+            ws = WheelSpinner(hub_dict, []).spin()
+        gap = (ws.BestInnerBound - ws.BestOuterBound) / abs(
+            ws.BestOuterBound)
+        # LP-only floor: outer <= LP EF (376.306), inner >= MIP (398.333)
+        assert gap <= 0.04, (ws.BestInnerBound, ws.BestOuterBound)
+        assert ws.BestOuterBound > 376.306 + 1e-6     # past the LP bound
+        assert w.delta("integer.feasible_hits") > 0
+        assert w.delta("integer.escalations") >= 1
+        # the host tail is a fraction of the wheel wall, not a serial
+        # host MILP sweep
+        assert w.delta("integer.escalation_secs") < time.time() - t0
+
+
+class TestTuneIntegerStage:
+    def test_autotune_integer_picks_and_banks(self, tmp_path):
+        from tpusppy import tune
+
+        tune.set_cache_path(str(tmp_path / "tc.json"))
+        calls = []
+
+        def run_window(int_live):
+            calls.append(bool(int_live))
+            return 4
+
+        # fake clock: integer window 1.2s, plain window 0.05s
+        times = iter([0.0, 1.2, 1.2, 1.25])
+        import time as _time
+
+        real = _time.time
+        try:
+            _time.time = lambda: next(times, real())
+            res = tune.autotune_integer(run_window, (3, 10, 8))
+        finally:
+            _time.time = real
+        assert calls == [True, True, False]
+        # the expensive sweep must shrink K and/or stretch the cadence
+        assert res.k == 1 and res.every > 1
+        v = tune.integer_verdict((3, 10, 8))
+        assert v is not None and (v.k, v.every) == (res.k, res.every)
+        # disk roundtrip (fresh in-memory store)
+        tune._integer_cache.clear()
+        with tune._persist_lock:
+            tune._persist["integer"].clear()
+        tune._disk_loaded_from = None
+        v2 = tune.integer_verdict((3, 10, 8))
+        assert v2 is not None and (v2.k, v2.every) == (res.k, res.every)
+
+    def test_verdict_truncates_hub_ladder(self):
+        from tpusppy import tune
+
+        ph = _netdes_ph()
+        key = ph._mega_shape_key()
+        tune._integer_cache[tune._mega_key(
+            key, ph.admm_settings)] = tune.IntegerTune(
+            k=1, every=3, sweep_secs=1.0, window_secs=1.0)
+        try:
+            th = ph._inwheel_int_thresholds()
+            assert len(th) == 1          # truncated to the verdict's K
+            assert ph._inwheel_every() == 3
+        finally:
+            tune._integer_cache.clear()
+
+    def test_degenerate_probe_not_banked(self):
+        from tpusppy import tune
+
+        res = tune.autotune_integer(lambda live: 0, (5, 6, 7),
+                                    cache=True)
+        assert res.every == 1
+        assert tune.integer_verdict((5, 6, 7)) is None
+
+
+class TestSecondStageIntegers:
+    @pytest.mark.slow    # ~34s of host MIPs; the nightly integer-smoke
+    # certifies the sizes family end-to-end every night regardless
+    def test_sizes_inner_mip_escalation_certifies(self):
+        """sizes carries SECOND-STAGE integers: the device eval is a
+        relaxation (``_inwheel_inner_ok`` False), so the candidate is
+        certified by per-scenario host MIPs (escalate_inner) — the
+        value must be a true EF incumbent."""
+        opts = {"defaultPHrho": 0.01, "PHIterLimit": 12,
+                "convthresh": -1.0, "in_wheel_bounds": True}
+        ph = PH(opts, sizes.scenario_names_creator(N),
+                sizes.scenario_creator,
+                scenario_creator_kwargs={"scenario_count": N,
+                                         "relax_integers": False})
+        _warm(ph, iters=8)
+        assert not ph._inwheel_inner_ok()
+        cands = I.host_candidates(ph)
+        budget = I.EscalationBudget(120.0)
+        vals = [I.escalate_inner(ph, budget, c) for c in cands]
+        feas = [v for v in vals if v is not None]
+        assert feas, "no candidate certified"
+        # every certified value upper-bounds the EF MIP optimum
+        # (~224481 for SIZES3) and is integer-consistent — above the LP
+        # wait-and-see floor
+        assert min(feas) >= 219842.0
+
+
+class TestMeasurePack:
+    def test_int_tail_lengths_and_unpack(self):
+        from tpusppy.parallel import sharded
+
+        base = sharded.megastep_measure_len(4, N, 10, 5, bounds=True)
+        intl = sharded.megastep_measure_len(4, N, 10, 5, bounds=True,
+                                            int_sweep=True)
+        assert intl - base == I.INT_BOUND_EXTRA
+        vec = np.zeros(intl)
+        vec[-9:] = [1.0, 2.0, 3.0, 0.5, 7.0, 2.0, 1.0, 4.0, 1.5]
+        out = sharded.megastep_unpack(vec, 4, N, 10, 5, bounds=True,
+                                      int_sweep=True)
+        assert out["bound_computed"] and out["bound_outer"] == 2.0
+        assert out["int_feas_cands"] == 2
+        assert out["int_best_idx"] == 1
+        assert out["int_rcfix_slots"] == 4
+        assert out["bound_outer_base"] == 1.5
+
+
+class TestServiceRegistry:
+    def test_integer_families_resolve_and_ingest(self, tmp_path):
+        """sizes and netdes are one-line servable requests: the registry
+        resolves them, the kw plumbing honors num_scens +
+        relax_integers, and ingest produces an integer-patterned family
+        key with the in-wheel integer knobs on it."""
+        from tpusppy.service import SolveRequest, SolveServer, canonical
+
+        with SolveServer(work_dir=str(tmp_path)) as srv:
+            for model in ("sizes", "netdes"):
+                req = SolveRequest(
+                    model=model, num_scens=3,
+                    creator_kwargs={"relax_integers": False},
+                    options={"in_wheel_bounds": True})
+                creator, names, kwargs, opts = srv._resolve(req)
+                assert len(names) == 3
+                canon = canonical.ingest(names, creator, kwargs,
+                                         options=opts)
+                assert np.asarray(canon.batch.is_int).any()
+                flat = repr(canon.family)
+                assert "('int_sweep', (True" in flat
+                # the knobs are program identity ONLY when the sweep is
+                # compiled in: a continuous family keys identically
+                # whatever they say
+                cont = canonical._program_options_parts(
+                    {"in_wheel_bounds": True,
+                     "in_wheel_int_thresholds": (0.9,)},
+                    int_nonants=False)
+                cont2 = canonical._program_options_parts(
+                    {"in_wheel_bounds": True, "in_wheel_int_sweep":
+                     False}, int_nonants=False)
+                assert cont == cont2
+
+
+class TestBucketedIntegerSweep:
+    @pytest.mark.slow    # bundled-integer wheel + a 7-scenario EF MIP
+    def test_bucketed_pass_emits_int_tail_and_valid_outer(self):
+        """Ragged (bundled) integer netdes: the bucketed megakernel's
+        integer sweep composes per-bucket partial sums into one global
+        best-of-C selection, and the tightened outer still lower-bounds
+        the EF MIP optimum."""
+        from tpusppy.ef import solve_ef
+        from tpusppy.ir import BucketedBatch, ScenarioBatch
+
+        opts = {"defaultPHrho": 1.0, "PHIterLimit": 2, "convthresh": -1.0,
+                "bundles_per_rank": 3, "shape_buckets": True,
+                "shape_bucket_quantum": 1, "solver_refresh_every": 6,
+                "in_wheel_bounds": True, "integer_escalation": False}
+        ph = PH(opts, netdes.scenario_names_creator(7),
+                netdes.scenario_creator,
+                scenario_creator_kwargs={"num_scens": 7,
+                                         "relax_integers": False})
+        ph.ph_main(finalize=False)
+        assert isinstance(ph.batch, BucketedBatch)
+        assert ph._inwheel_int_sweep_on()
+        meas = ph._megastep_solve_bucketed(3, 0, -1.0, ph.W, ph.xbars,
+                                           ph.rho, bound_live=True)
+        assert meas["bound_computed"]
+        assert "int_feas_cands" in meas
+        assert meas["bound_outer"] >= meas["bound_outer_base"] - 1e-9
+        # bundling is exact: the bundled-EF optimum equals the
+        # 7-scenario EF MIP optimum, and the outer must sit below it
+        names = netdes.scenario_names_creator(7)
+        ef7, _ = solve_ef(ScenarioBatch.from_problems(
+            [netdes.scenario_creator(nm, num_scens=7,
+                                     relax_integers=False)
+             for nm in names]), solver="highs", mip=True,
+            time_limit=60.0)
+        assert meas["bound_outer"] <= ef7 + 1e-6 * abs(ef7)
+
+
+class TestMilpLiftContract:
+    def test_worsening_best_bound_never_installed(self, monkeypatch):
+        """Regression (the result-plumbing contract): a time-limited
+        HiGHS best-bound BELOW a scenario's existing LP certificate must
+        never replace it — milp_lift takes the per-scenario max."""
+        from tpusppy.ir import ScenarioBatch
+        from tpusppy.solvers import milp_bound
+
+        names = netdes.scenario_names_creator(N)
+        batch = ScenarioBatch.from_problems(
+            [netdes.scenario_creator(nm, **NETDES_KW) for nm in names])
+        base = np.array([50.0, 60.0, 70.0])
+
+        def fake_solve(c, A, cl, cu, lb, ub, is_int=None, q2=None,
+                       const=0.0, mip_rel_gap=None, time_limit=None):
+            # a "time-limited" result whose best bound is WORSE than
+            # every LP certificate
+            return scipy_backend.SolveResult(
+                x=np.zeros(c.shape[0]), obj=1e9, duals=None,
+                status="1", feasible=True, dual_bound=-1e6)
+
+        monkeypatch.setattr(milp_bound.scipy_backend, "solve_lp",
+                            fake_solve)
+        lifted, n, X = milp_bound.milp_lift(
+            batch, np.asarray(batch.c), base, budget_s=5.0,
+            time_limit=0.01, want_x=True)
+        np.testing.assert_array_equal(lifted, base)
+        assert n == N                      # solves completed...
+        assert np.isnan(X).all()           # ...but no minimizer claimed

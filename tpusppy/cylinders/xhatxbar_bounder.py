@@ -151,8 +151,33 @@ class XhatXbarInnerBound(InnerBoundNonantSpoke):
                 return
 
     def main(self):
-        self._thresholds = list(self.opt.options.get(
-            "xhat_xbar_options", {}).get("thresholds", [0.5]))
+        th = self.opt.options.get(
+            "xhat_xbar_options", {}).get("thresholds")
+        if th is None:
+            # integer families default to the SAME rounding ladder the
+            # in-wheel batched integer sweep evaluates on device
+            # (solvers.integer.DEFAULT_THRESHOLDS — one candidate rule,
+            # two execution paths); continuous families keep the single
+            # pass-through candidate.  Bucketed batches carry is_int
+            # per bucket (no shared global pattern — reading batch.is_int
+            # raises), so the check walks the buckets.
+            from ..ir import BucketedBatch
+
+            b = self.opt.batch
+            if isinstance(b, BucketedBatch):
+                ints_any = any(
+                    np.asarray(sub.is_int,
+                               bool)[sub.tree.nonant_indices].any()
+                    for _, sub in b.buckets)
+            else:
+                ints_any = bool(np.asarray(
+                    b.is_int, bool)[self.opt.tree.nonant_indices].any())
+            if ints_any:
+                from ..solvers.integer import DEFAULT_THRESHOLDS
+                th = list(DEFAULT_THRESHOLDS)
+            else:
+                th = [0.5]
+        self._thresholds = list(th)
         self._seen = False
         while not self.got_kill_signal():
             if self.new_nonants:

@@ -45,8 +45,13 @@ def scenario_names_creator(num_scens, start=0):
 def kw_creator(cfg=None, **kwargs):
     cfg = cfg or {}
     get = cfg.get if hasattr(cfg, "get") else lambda k, d=None: getattr(cfg, k, d)
-    return {"scenario_count": kwargs.get("scenario_count",
-                                         get("num_scens", 3))}
+    # num_scens may arrive as a plain kwarg too (the service registry's
+    # calling convention) — it must not be shadowed by the cfg default
+    out = {"scenario_count": kwargs.get(
+        "scenario_count", kwargs.get("num_scens", get("num_scens", 3)))}
+    if "relax_integers" in kwargs:
+        out["relax_integers"] = bool(kwargs["relax_integers"])
+    return out
 
 
 def inparser_adder(cfg):

@@ -695,8 +695,24 @@ def make_ph_fused_step(nonant_idx: np.ndarray, settings: ADMMSettings,
 BOUND_PACK_LEN = 5
 
 
+def bound_pack_len(bounds: bool = False, int_sweep: bool = False) -> int:
+    """Length of the in-wheel bound tail: :data:`BOUND_PACK_LEN` scalars,
+    plus the :data:`~tpusppy.solvers.integer.INT_BOUND_EXTRA` integer
+    extras (feasible-candidate count, best candidate index, reduced-cost
+    fixed slots, untightened outer) when the batched integer sweep is
+    armed (doc/integer.md)."""
+    if not bounds:
+        return 0
+    if int_sweep:
+        from ..solvers import integer as integer_solvers
+
+        return BOUND_PACK_LEN + integer_solvers.INT_BOUND_EXTRA
+    return BOUND_PACK_LEN
+
+
 def megastep_measure_len(n_iters: int, S: int, n: int, K: int,
-                         pack: str = "full", bounds: bool = False) -> int:
+                         pack: str = "full", bounds: bool = False,
+                         int_sweep: bool = False) -> int:
     """Length of the packed megastep measurement vector.
 
     ``pack="lean"`` is the O(1)-host-traffic wheel posture (ROADMAP item
@@ -707,34 +723,42 @@ def megastep_measure_len(n_iters: int, S: int, n: int, K: int,
     instead of every window.
 
     ``bounds=True`` (in-wheel certification, doc/pipeline.md) appends
-    :data:`BOUND_PACK_LEN` scalars — outer/inner bound evidence computed
+    :func:`bound_pack_len` scalars — outer/inner bound evidence computed
     on the window's final device state — compatible with BOTH packs (the
-    bound pass emits scalars only)."""
+    bound pass emits scalars only); ``int_sweep=True`` is the batched
+    integer variant (doc/integer.md) with its longer tail."""
     base = 6 * n_iters + 2 + 3 * S
     if pack != "lean":
         base += S * n + 2 * S * K
-    if bounds:
-        base += BOUND_PACK_LEN
-    return base
+    return base + bound_pack_len(bounds, int_sweep)
 
 
-def unpack_bound_tail(out: dict, vec) -> dict:
+def unpack_bound_tail(out: dict, vec, int_sweep: bool = False) -> dict:
     """Install the in-wheel bound scalars (the trailing
-    :data:`BOUND_PACK_LEN` entries of a ``bounds=True`` measurement) into
+    :func:`bound_pack_len` entries of a ``bounds=True`` measurement) into
     an unpacked measurement dict.  ``bound_computed`` False means the
     window's traced ``bound_live`` flag was off (cadence skip) — the
-    other entries are inert zeros then."""
-    tail = np.asarray(vec)[-BOUND_PACK_LEN:]
+    other entries are inert zeros then.  ``int_sweep`` additionally
+    parses the integer extras (``int_feas_cands``/``int_best_idx``/
+    ``int_rcfix_slots``/``bound_outer_base``)."""
+    tail_len = bound_pack_len(True, int_sweep)
+    tail = np.asarray(vec)[-tail_len:]
     out["bound_computed"] = bool(tail[0])
     out["bound_outer"] = float(tail[1])
     out["bound_inner_obj"] = float(tail[2])
     out["bound_inner_feas"] = float(tail[3])
     out["bound_sweeps"] = float(tail[4])
+    if int_sweep:
+        out["int_feas_cands"] = int(tail[5])
+        out["int_best_idx"] = int(tail[6])
+        out["int_rcfix_slots"] = int(tail[7])
+        out["bound_outer_base"] = float(tail[8])
     return out
 
 
 def megastep_unpack(vec, n_iters: int, S: int, n: int, K: int,
-                    pack: str = "full", bounds: bool = False) -> dict:
+                    pack: str = "full", bounds: bool = False,
+                    int_sweep: bool = False) -> dict:
     """Split a fetched :func:`make_wheel_megastep` measurement.
 
     Returns per-iteration arrays (length ``n_iters``; entries past
@@ -768,7 +792,7 @@ def megastep_unpack(vec, n_iters: int, S: int, n: int, K: int,
     }
     off += 3 * S
     if bounds:
-        out = unpack_bound_tail(out, vec)
+        out = unpack_bound_tail(out, vec, int_sweep=int_sweep)
     if pack == "lean":
         return out
     out["x"] = vec[off:off + S * n].reshape(S, n)
@@ -849,7 +873,11 @@ def make_wheel_megastep(nonant_idx: np.ndarray, settings: ADMMSettings,
                         n_iters: int = 8, donate: bool = True,
                         pack: str = "full", bounds: bool = False,
                         int_nonants: np.ndarray | None = None,
-                        xhat_threshold: float = 0.5):
+                        xhat_threshold: float = 0.5,
+                        int_rounding: tuple | None = None,
+                        int_cols: np.ndarray | None = None,
+                        rcfix_slack: float = 1e-5,
+                        int_rcfix: bool = True):
     """ONE jitted program running up to ``n_iters`` FROZEN wheel iterations
     — the device-resident wheel megakernel (ROADMAP item 4).
 
@@ -917,6 +945,25 @@ def make_wheel_megastep(nonant_idx: np.ndarray, settings: ADMMSettings,
     the (K,) integer mask of nonant slots (candidate rounding at
     ``xhat_threshold``); both are baked constants and ride the AOT key.
 
+    ``int_rounding`` (a tuple of rounding thresholds) arms the BATCHED
+    INTEGER sweep (doc/integer.md) for ``bounds=True`` families with
+    integer nonants: the bound pass becomes the vmapped best-of-C
+    rounding ladder + SLAM slams with device argmin over feasible
+    candidates, plus reduced-cost fixing from the frozen duals and a
+    tightened Lagrangian outer bound
+    (:func:`tpusppy.solvers.integer.integer_bound_pass`); the bound tail
+    grows by :data:`~tpusppy.solvers.integer.INT_BOUND_EXTRA` scalars.
+    ``int_cols`` is the (n,) mask of ALL integer columns (the
+    reduced-cost fixing scope; defaults to integer nonant slots only).
+    ``int_rcfix=False`` disables the reduced-cost fixing +
+    re-certification (MANDATORY for families with second-stage integer
+    columns: the candidate evaluation relaxes them, so its value is not
+    a valid integer-minimum upper bound for the fixing argument — see
+    :func:`tpusppy.solvers.integer.integer_bound_pass`).  Families
+    WITHOUT integer nonants ignore all the integer knobs and compile
+    the byte-identical legacy bound pass (the warm-serving zero-miss
+    contract — pinned by test).
+
     Returns ``mega(state, arr, prox_on, factors, convthresh, n_live,
     accept_tol) -> (state, packed)`` — with ``bounds=True`` the signature
     gains trailing ``(bound_live, feas_tol)`` arguments.
@@ -928,6 +975,20 @@ def make_wheel_megastep(nonant_idx: np.ndarray, settings: ADMMSettings,
     idx = jnp.asarray(nonant_idx)
     int_mask = (None if int_nonants is None
                 else np.asarray(int_nonants, dtype=bool))
+    # the integer sweep exists in the program ONLY when the family has
+    # integer nonants AND a rounding ladder was requested — a bounds=True
+    # megastep without integer slots stays byte-identical to the legacy
+    # program whatever the integer knobs say (warm serving stays
+    # zero-miss; pinned by test)
+    int_sweep = bool(bounds and int_mask is not None and int_mask.any()
+                     and int_rounding)
+    int_thresholds = tuple(float(t) for t in (int_rounding or ()))
+    from ..solvers import integer as integer_solvers
+    tail_len = bound_pack_len(True, int_sweep)
+    if int_sweep:
+        int_cols_mask = (np.asarray(int_cols, dtype=bool)
+                         if int_cols is not None else None)
+        int_mask_arr = jnp.asarray(int_mask)
     _, shared_frozen, _, frozen_solve = _solver_fns_for(settings, mesh, axis)
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
@@ -1005,16 +1066,35 @@ def make_wheel_megastep(nonant_idx: np.ndarray, settings: ADMMSettings,
         if bounds:
             fsolve = shared_frozen if arr.A.ndim == 2 else frozen_solve
 
-            def bounds_on(stf):
-                outer, inner, feas, sweeps = _bound_pass_terms(
-                    arr, stf, idx, settings, fsolve, factors, feas_tol,
-                    int_mask, xhat_threshold)
-                return jnp.stack(
-                    [jnp.ones((), dt), outer, inner, feas, sweeps])
+            if int_sweep:
+                # fixing scope: all integer columns when the caller
+                # supplied them, else the integer nonant slots only
+                if int_cols_mask is not None:
+                    cols = jnp.asarray(int_cols_mask)
+                else:
+                    cols = jnp.zeros(arr.c.shape[1], bool).at[idx].set(
+                        int_mask_arr)
+
+                def bounds_on(stf):
+                    # PH-augmented objective, prox ON — the factors match
+                    # exactly (the _bound_pass_terms argument)
+                    q, q2, _, _ = _ph_objective(arr, stf, 1.0, idx,
+                                                settings)
+                    return integer_solvers.integer_bound_pass(
+                        arr, stf, idx, q, q2, fsolve, factors, feas_tol,
+                        dt, int_mask_arr, int_thresholds, cols,
+                        rcfix_slack, rcfix_enabled=bool(int_rcfix))
+            else:
+                def bounds_on(stf):
+                    outer, inner, feas, sweeps = _bound_pass_terms(
+                        arr, stf, idx, settings, fsolve, factors,
+                        feas_tol, int_mask, xhat_threshold)
+                    return jnp.stack(
+                        [jnp.ones((), dt), outer, inner, feas, sweeps])
 
             parts.append(jax.lax.cond(
                 jnp.asarray(bound_live, bool),
-                bounds_on, lambda _: jnp.zeros((BOUND_PACK_LEN,), dt), st))
+                bounds_on, lambda _: jnp.zeros((tail_len,), dt), st))
         return st, jnp.concatenate(parts)
 
     # AOT executable cache: one megakernel compile per width N — resumed
@@ -1028,28 +1108,41 @@ def make_wheel_megastep(nonant_idx: np.ndarray, settings: ADMMSettings,
                    # the rounding constants exist only in the bounds=True
                    # program — keying them while bounds are off would
                    # recompile a byte-identical megastep over an inert
-                   # knob (a warm-serving aot.misses hit)
+                   # knob (a warm-serving aot.misses hit).  The integer-
+                   # sweep constants (ladder + fixing scope) likewise
+                   # ride the key ONLY when the sweep is compiled in: a
+                   # no-integer-slots family keys identically whatever
+                   # the integer knobs say.
                    (float(xhat_threshold),
                     None if int_mask is None
-                    else aot_cache.array_digest(int_mask))
+                    else aot_cache.array_digest(int_mask),
+                    (int_thresholds, float(rcfix_slack),
+                     bool(int_rcfix),
+                     None if int_cols is None
+                     else aot_cache.array_digest(
+                         np.asarray(int_cols, dtype=bool)))
+                    if int_sweep else None)
                    if bounds else None,
                    aot_cache.mesh_fingerprint(mesh),
                    aot_cache.array_digest(nonant_idx)))
 
 
 def bucketed_megastep_measure_len(n_iters: int, shapes, K: int,
-                                  bounds: bool = False) -> int:
+                                  bounds: bool = False,
+                                  int_sweep: bool = False) -> int:
     """Length of the bucketed packed measurement (``shapes`` =
     ``[(S_b, n_b), ...]`` per bucket, concatenated in bucket order).
-    ``bounds`` appends the :data:`BOUND_PACK_LEN` in-wheel bound tail."""
+    ``bounds`` appends the :func:`bound_pack_len` in-wheel bound tail
+    (``int_sweep`` = the longer batched-integer variant)."""
     S = sum(s for s, _ in shapes)
     return (6 * n_iters + 2 + 3 * S
             + sum(s * n for s, n in shapes) + 2 * S * K
-            + (BOUND_PACK_LEN if bounds else 0))
+            + bound_pack_len(bounds, int_sweep))
 
 
 def bucketed_megastep_unpack(vec, n_iters: int, shapes, K: int,
-                             bounds: bool = False) -> dict:
+                             bounds: bool = False,
+                             int_sweep: bool = False) -> dict:
     """Split a fetched :func:`make_bucketed_wheel_megastep` measurement.
 
     Global per-iteration stats exactly as :func:`megastep_unpack`; the
@@ -1069,7 +1162,7 @@ def bucketed_megastep_unpack(vec, n_iters: int, shapes, K: int,
     }
     off += 2
     if bounds:
-        out = unpack_bound_tail(out, vec)
+        out = unpack_bound_tail(out, vec, int_sweep=int_sweep)
     pri, dua, done = [], [], []
     for S_b, _ in shapes:
         pri.append(vec[off:off + S_b])
@@ -1138,7 +1231,11 @@ def make_bucketed_wheel_megastep(nonant_idx: np.ndarray,
                                  n_iters: int = 8, donate: bool = True,
                                  axis: str = "scen", bounds: bool = False,
                                  int_nonants=None,
-                                 xhat_threshold: float = 0.5):
+                                 xhat_threshold: float = 0.5,
+                                 int_rounding: tuple | None = None,
+                                 int_cols=None,
+                                 rcfix_slack: float = 1e-5,
+                                 int_rcfix: bool = True):
     """ONE jitted program running up to ``n_iters`` frozen wheel
     iterations over a BUCKETED (ragged) family — the shape-bucketed twin
     of :func:`make_wheel_megastep`.
@@ -1180,6 +1277,19 @@ def make_bucketed_wheel_megastep(nonant_idx: np.ndarray,
     int_masks = (None if int_nonants is None else
                  tuple(None if m is None else np.asarray(m, dtype=bool)
                        for m in int_nonants))
+    # the batched integer sweep arms when ANY bucket has integer nonants
+    # and a ladder was requested; candidates are evaluated per bucket and
+    # the best-of-C selection is GLOBAL (summed partial objectives) —
+    # no-integer families compile the byte-identical legacy pass
+    int_sweep = bool(
+        bounds and int_rounding and int_masks is not None
+        and any(m is not None and m.any() for m in int_masks))
+    int_thresholds = tuple(float(t) for t in (int_rounding or ()))
+    int_cols_masks = (None if int_cols is None else
+                      tuple(None if m is None else np.asarray(m, bool)
+                            for m in int_cols))
+    from ..solvers import integer as integer_solvers
+    tail_len = bound_pack_len(True, int_sweep)
     shared_refresh, shared_frozen, _, frozen_solve = _solver_fns_for(
         settings, None, axis)
     del shared_refresh
@@ -1267,27 +1377,106 @@ def make_bucketed_wheel_megastep(nonant_idx: np.ndarray,
         parts += [st.W.astype(dt).reshape(-1) for st in sts]
         parts += [st.xbars.astype(dt).reshape(-1) for st in sts]
         if bounds:
-            def bounds_on(stsf):
-                outer = inner = feas = jnp.zeros((), dt)
-                sweeps = jnp.zeros((), dt)
-                for bi, (arr, stf) in enumerate(zip(arrs, stsf)):
-                    fsolve = (shared_frozen if arr.A.ndim == 2
-                              else frozen_solve)
-                    ob, ib, fm, sw = _bound_pass_terms(
-                        arr, stf, idx, settings, fsolve, factors[bi],
-                        feas_tol,
-                        None if int_masks is None else int_masks[bi],
-                        xhat_threshold)
-                    outer = outer + ob
-                    inner = inner + ib
-                    feas = feas + fm
-                    sweeps = jnp.maximum(sweeps, sw)
-                return jnp.stack(
-                    [jnp.ones((), dt), outer, inner, feas, sweeps])
+            if int_sweep:
+                def bounds_on(stsf):
+                    # per-bucket partial sums of the candidate sweep —
+                    # probs/onehot are GLOBAL-tree slices, so summing
+                    # composes exactly and the argmin is global.  SLAM
+                    # candidates are DROPPED on the bucketed posture: a
+                    # per-bucket slam extreme is not nonanticipative
+                    # across buckets (candidate_ladder docstring); the
+                    # ladder candidates derive from the GLOBAL xbars and
+                    # are identical across buckets for shared nodes.
+                    S_tot = sum(arr.c.shape[0] for arr in arrs)
+                    per = []
+                    for bi, (arr, stf) in enumerate(zip(arrs, stsf)):
+                        fsolve = (shared_frozen if arr.A.ndim == 2
+                                  else frozen_solve)
+                        q, q2, _, _ = _ph_objective(arr, stf, 1.0, idx,
+                                                    settings)
+                        mb = (int_masks[bi] if int_masks is not None and
+                              int_masks[bi] is not None
+                              else np.zeros(arr.nid_sk.shape[1], bool))
+                        per.append((integer_solvers.sweep_partials(
+                            arr, stf, idx, q, q2, fsolve, factors[bi],
+                            feas_tol, dt, jnp.asarray(mb),
+                            int_thresholds, include_slams=False),
+                            q, q2, fsolve, mb))
+                    inner_c = sum(p[0][0] for p in per)
+                    feas_c = sum(p[0][1] for p in per)
+                    sweeps_c = functools.reduce(
+                        jnp.maximum, (p[0][2] for p in per))
+                    slack = jnp.asarray(
+                        integer_solvers.feas_slack(S_tot, dt), dt)
+                    ok_c = feas_c >= 1.0 - slack
+                    best = jnp.argmin(jnp.where(
+                        ok_c, inner_c, jnp.asarray(np.inf, dt)))
+                    n_feas = jnp.sum(ok_c.astype(dt))
+                    outer = base = nfix = jnp.zeros((), dt)
+                    sweeps = jnp.max(sweeps_c)
+                    for bi, (arr, stf) in enumerate(zip(arrs, stsf)):
+                        (res, q, q2, fsolve, mb) = per[bi]
+                        _, _, _, u_cs, fm_cs = res
+                        if int_rcfix:
+                            if int_cols_masks is not None and \
+                                    int_cols_masks[bi] is not None:
+                                cols = jnp.asarray(int_cols_masks[bi])
+                            else:
+                                cols = jnp.zeros(
+                                    arr.c.shape[1], bool).at[idx].set(
+                                    jnp.asarray(mb))
+                            ob, obb, nf, swF = \
+                                integer_solvers.rc_outer_partials(
+                                    arr, stf, idx, q, q2, fsolve,
+                                    factors[bi], dt, cols, u_cs[best],
+                                    fm_cs[best], rcfix_slack)
+                            sweeps = jnp.maximum(sweeps, swF)
+                        else:
+                            # second-stage integers somewhere in the
+                            # family: plain weak duality only (the
+                            # fixing argument has no valid u_s)
+                            W = stf.W.astype(dt)
+                            qL = arr.c.astype(dt).at[:, idx].add(W)
+                            packed = \
+                                admm.dual_objective_with_margin_traced(
+                                    qL, arr.q2.astype(dt), arr.A,
+                                    arr.cl, arr.cu, arr.lb.astype(dt),
+                                    arr.ub.astype(dt),
+                                    stf.y.astype(dt), stf.x.astype(dt))
+                            ob = obb = (arr.probs @ (
+                                packed[0].astype(dt)
+                                - packed[1].astype(dt)
+                                + arr.const)).astype(dt)
+                            nf = jnp.zeros((), dt)
+                        outer = outer + ob
+                        base = base + obb
+                        nfix = nfix + nf
+                    return jnp.stack([
+                        jnp.ones((), dt), outer, inner_c[best],
+                        feas_c[best], sweeps, n_feas, best.astype(dt),
+                        nfix, base])
+            else:
+                def bounds_on(stsf):
+                    outer = inner = feas = jnp.zeros((), dt)
+                    sweeps = jnp.zeros((), dt)
+                    for bi, (arr, stf) in enumerate(zip(arrs, stsf)):
+                        fsolve = (shared_frozen if arr.A.ndim == 2
+                                  else frozen_solve)
+                        ob, ib, fm, sw = _bound_pass_terms(
+                            arr, stf, idx, settings, fsolve, factors[bi],
+                            feas_tol,
+                            None if int_masks is None else int_masks[bi],
+                            xhat_threshold)
+                        outer = outer + ob
+                        inner = inner + ib
+                        feas = feas + fm
+                        sweeps = jnp.maximum(sweeps, sw)
+                    return jnp.stack(
+                        [jnp.ones((), dt), outer, inner, feas, sweeps])
 
             parts.append(jax.lax.cond(
                 jnp.asarray(bound_live, bool),
-                bounds_on, lambda _: jnp.zeros((BOUND_PACK_LEN,), dt),
+                bounds_on, lambda _: jnp.zeros((tail_len,), dt),
                 sts))
         return sts, jnp.concatenate(parts)
 
@@ -1299,11 +1488,19 @@ def make_bucketed_wheel_megastep(nonant_idx: np.ndarray,
         mega, "bucketed_megastep",
         key_extra=(settings, n_iters, bool(donate), axis,
                    # bounds-only constants keyed only when the bound-pass
-                   # variant is compiled (see the homogeneous kernel)
+                   # variant is compiled (see the homogeneous kernel);
+                   # the integer-sweep ladder/scope likewise only when
+                   # the sweep is compiled in
                    (float(xhat_threshold),
                     None if int_masks is None else tuple(
                         None if m is None else aot_cache.array_digest(m)
-                        for m in int_masks))
+                        for m in int_masks),
+                    (int_thresholds, float(rcfix_slack),
+                     bool(int_rcfix),
+                     None if int_cols_masks is None else tuple(
+                         None if m is None else aot_cache.array_digest(m)
+                         for m in int_cols_masks))
+                    if int_sweep else None)
                    if bounds else None,
                    aot_cache.array_digest(nonant_idx)))
 

@@ -419,7 +419,9 @@ class PHBase(SPOpt):
         kept."""
         if not self._inwheel_on():
             return cap_fn(False)
-        cap = cap_fn(True)
+        # the reservation scales with the pass's evaluation count — the
+        # batched integer sweep reserves C candidates + 1 re-solve
+        cap = cap_fn(self._inwheel_pass_evals())
         if cap >= 2:
             return cap
         cap_plain = cap_fn(False)
@@ -484,13 +486,19 @@ class PHBase(SPOpt):
 
     def _inwheel_every(self) -> int:
         """Bound-pass cadence in WINDOWS: ``in_wheel_bound_every`` when
-        set, else the autotuner's banked verdict (the ``bound_cadence``
-        persist kind), else every window."""
+        set, else the autotuner's banked verdict (the ``integer`` kind's
+        cadence for integer-sweep families, else the ``bound_cadence``
+        kind), else every window."""
         every = self.options.get("in_wheel_bound_every")
         if every:
             return max(1, int(every))
         from . import tune
 
+        if self._inwheel_int_sweep_on():
+            vi = tune.integer_verdict(self._mega_shape_key(),
+                                      settings=self.admm_settings)
+            if vi is not None:
+                return max(1, int(vi.every))
         v = tune.bound_cadence_verdict(self._mega_shape_key(),
                                        settings=self.admm_settings)
         return max(1, int(v)) if v else 1
@@ -514,30 +522,67 @@ class PHBase(SPOpt):
                 self.best_bound = ob
             if c is not None and hasattr(c, "OuterBoundUpdate"):
                 c.OuterBoundUpdate(ob, char='M')
-        # the all-scenarios rule with a DTYPE-AWARE slack: the device
-        # computes the mass as probs @ mask in the settings dtype, and
-        # an all-feasible f32 sum over S non-representable probabilities
-        # (0.1) lands ~S*eps below 1.0 — a bare 1e-9 gate would reject
-        # every feasible window on the float32 TPU posture
-        slack = max(1e-9, 4.0 * self.batch.num_scenarios
-                    * float(np.finfo(self.admm_settings.jdtype()).eps))
+        # integer-sweep evidence (doc/integer.md): candidate/fixing
+        # counters feed the flight recorder and the bench's integer
+        # segment — feasible_hits > 0 is the "device sweep supplies
+        # incumbents" acceptance signal
+        if "int_feas_cands" in meas:
+            from .ir import BucketedBatch
+            from .solvers import integer as integer_solvers
+
+            th = self._inwheel_int_thresholds() or ()
+            # the bucketed kernel evaluates the ladder WITHOUT the slams
+            # (nonanticipativity — doc/integer.md); count what actually
+            # ran, matching _inwheel_pass_evals' billing arithmetic
+            n_cand = len(th) + (
+                0 if isinstance(self.batch, BucketedBatch)
+                else integer_solvers.N_SLAM)
+            _metrics.inc("integer.candidates", n_cand)
+            _metrics.inc("integer.feasible_hits",
+                         int(meas["int_feas_cands"]))
+            _metrics.inc("integer.rcfix_slots",
+                         int(meas["int_rcfix_slots"]))
+            self._int_best_idx = int(meas["int_best_idx"])
+        # the all-scenarios rule with a DTYPE-AWARE slack (single-sourced
+        # in solvers.integer.feas_slack with the device argmin's gate):
+        # the device computes the mass as probs @ mask in the settings
+        # dtype, and an all-feasible f32 sum over S non-representable
+        # probabilities (0.1) lands ~S*eps below 1.0 — a bare 1e-9 gate
+        # would reject every feasible window on the float32 TPU posture
+        from .solvers.integer import feas_slack as _feas_slack
+
+        slack = _feas_slack(self.batch.num_scenarios,
+                            self.admm_settings.jdtype())
         feasible = meas["bound_inner_feas"] >= 1.0 - slack
         if feasible and self._inwheel_inner_ok():
             self._offer_inwheel_inner(float(meas["bound_inner_obj"]))
+        elif feasible and "int_best_idx" in meas:
+            # second-stage-integer families (sizes): the device eval is a
+            # RELAXATION of the true second-stage cost — certify the
+            # sweep's best candidate by per-scenario host MIPs instead
+            self._maybe_integer_inner_mip(int(meas["int_best_idx"]))
         elif not feasible:
             _metrics.inc("megastep.bound_pass_infeasible")
-            self._maybe_inwheel_rescue()
+            if "int_best_idx" in meas and not self._inwheel_inner_ok():
+                # gate miss on a second-stage-integer family: the LP
+                # rescue cannot certify (relaxed second stage) — the MIP
+                # escalation leg is the rescue
+                self._maybe_integer_inner_mip(int(meas["int_best_idx"]))
+            else:
+                self._maybe_inwheel_rescue()
+        self._maybe_integer_escalation()
 
-    def _offer_inwheel_inner(self, ib: float):
+    def _offer_inwheel_inner(self, ib: float, char: str = 'M'):
         """Track + typed-install one certified in-wheel incumbent value
-        (source char ``'M'``)."""
+        (source char ``'M'`` — megastep; ``'I'`` — integer host
+        escalation)."""
         if not np.isfinite(ib):
             return
         if ib < getattr(self, "inwheel_inner_bound", np.inf):
             self.inwheel_inner_bound = ib
         c = self.spcomm
         if c is not None and hasattr(c, "InnerBoundUpdate"):
-            c.InnerBoundUpdate(ib, char='M')
+            c.InnerBoundUpdate(ib, char=char)
 
     def _maybe_inwheel_rescue(self):
         """Cadence gate in front of :meth:`_inwheel_host_rescue`: fire on
@@ -597,37 +642,307 @@ class PHBase(SPOpt):
         _metrics.inc("megastep.bound_rescues")
         thr = self._inwheel_threshold()
         b = self.batch
-        total = 0.0
-        parts = (b.buckets if isinstance(b, BucketedBatch)
-                 else [(np.arange(b.num_scenarios), b)])
-        probs = np.asarray(self.probs, dtype=float)
         xbars = np.asarray(self.xbars, dtype=float)
+        eval_clamped = self._inwheel_eval_candidate_host
         try:
+            if self._inwheel_int_sweep_on():
+                # the batched integer posture: sweep the SAME rounding
+                # ladder the device evaluates, device-preferred order
+                # (its best index first, then the SLAM-up slam — the
+                # most conservative commit, usually the first feasible
+                # on under-converged consensus), first feasible wins —
+                # the host leg of the best-of-C recovery
+                from .solvers import integer as integer_solvers
+
+                th = self._inwheel_int_thresholds() or ()
+                cands = integer_solvers.host_candidates(self, th)
+                order = list(range(len(cands)))
+                slam_up = len(th)      # first slam after the ladder
+                pref = [min(getattr(self, "_int_best_idx", 0),
+                            len(cands) - 1), slam_up]
+                order = list(dict.fromkeys(pref + order))
+                for ci in order:
+                    total = eval_clamped(np.asarray(cands[ci], float))
+                    if total is not None:
+                        # a host-CERTIFIED sweep candidate: the device
+                        # ladder supplied the incumbent, host LPs
+                        # certified it (doc/integer.md counter contract)
+                        _metrics.inc("integer.feasible_hits")
+                        return total
+                return None
+            # legacy single-candidate path: the candidate rule applied
+            # per part (bucketed batches carry is_int per bucket)
+            cand = np.array(xbars, copy=True)
+            parts = (b.buckets if isinstance(b, BucketedBatch)
+                     else [(np.arange(b.num_scenarios), b)])
             for idx, sub in parts:
-                _, lb, ub = clamp_candidate(
-                    sub, sub.tree.nonant_indices, xbars[np.asarray(idx)],
-                    thr)
-                objs = []
-                for s in range(sub.num_scenarios):
-                    q2s = np.asarray(sub.q2[s])
-                    if q2s.any():
-                        r = scipy_backend.solve_qp_with_duals(
-                            sub.c[s], q2s, sub.A[s], sub.cl[s],
-                            sub.cu[s], lb[s], ub[s], const=sub.const[s])
-                    else:
-                        r = scipy_backend.solve_lp(
-                            sub.c[s], sub.A[s], sub.cl[s], sub.cu[s],
-                            lb[s], ub[s], const=sub.const[s])
-                    objs.append(r.obj)
-                objs = np.asarray(objs, dtype=float)
-                if not np.isfinite(objs).all():
-                    return None
-                total += float(probs[np.asarray(idx)] @ objs)
+                rows = np.asarray(idx)
+                cand[rows], _, _ = clamp_candidate(
+                    sub, sub.tree.nonant_indices, xbars[rows], thr)
+            return eval_clamped(cand)
         except Exception as e:     # a failed rescue declines, loudly
             global_toc(f"in-wheel host rescue failed ({e!r}) — declined",
                        True)
             return None
+
+    def _inwheel_eval_candidate_host(self, cand_sk):
+        """Expected objective of ONE fixed candidate via per-scenario
+        host solves — the host-EXACT certification leg shared by the
+        rescue and the escalation heuristics (None = any scenario
+        infeasible).  LP scenarios through HiGHS, quadratic ones through
+        the exact host QP (the straggler rescue's split)."""
+        from .ir import BucketedBatch
+        from .solvers import scipy_backend
+
+        b = self.batch
+        probs = np.asarray(self.probs, dtype=float)
+        cand_sk = np.asarray(cand_sk, dtype=float)
+        total = 0.0
+        parts = (b.buckets if isinstance(b, BucketedBatch)
+                 else [(np.arange(b.num_scenarios), b)])
+        for idx, sub in parts:
+            rows = np.asarray(idx)
+            lb = np.array(sub.lb, copy=True)
+            ub = np.array(sub.ub, copy=True)
+            nid = sub.tree.nonant_indices
+            lb[:, nid] = cand_sk[rows]
+            ub[:, nid] = cand_sk[rows]
+            objs = []
+            for s in range(sub.num_scenarios):
+                q2s = np.asarray(sub.q2[s])
+                if q2s.any():
+                    r = scipy_backend.solve_qp_with_duals(
+                        sub.c[s], q2s, sub.A[s], sub.cl[s],
+                        sub.cu[s], lb[s], ub[s], const=sub.const[s])
+                else:
+                    r = scipy_backend.solve_lp(
+                        sub.c[s], sub.A[s], sub.cl[s], sub.cu[s],
+                        lb[s], ub[s], const=sub.const[s])
+                objs.append(r.obj)
+            objs = np.asarray(objs, dtype=float)
+            if not np.isfinite(objs).all():
+                return None
+            total += float(probs[rows] @ objs)
         return total
+
+    # ---- integer host escalation tier (doc/integer.md) ----------------------
+    def _integer_budget(self):
+        """The wheel's shared :class:`~tpusppy.solvers.integer.
+        EscalationBudget` (lazily built; ``integer_escalation_budget_s``
+        option, default 30 host-seconds): every host escalation — the
+        gap-ranked MILP lift AND the candidate MIP certification — draws
+        from this one pool, so the host tail is bounded per wheel."""
+        b = getattr(self, "_int_budget", None)
+        if b is None:
+            from .solvers.integer import EscalationBudget
+
+            b = self._int_budget = EscalationBudget(
+                float(self.options.get("integer_escalation_budget_s",
+                                       30.0)))
+        return b
+
+    def _integer_escalation_on(self) -> bool:
+        """Whether the gap-ranked host escalation tier is armed: the
+        ``integer_escalation`` option (default on), in-wheel
+        certification running, an integer homogeneous family (the MILP
+        lift iterates ``batch.A[s]`` — bucketed batches have no global
+        A tensor)."""
+        if not self.options.get("integer_escalation", True):
+            return False
+        if not self._inwheel_on():
+            return False
+        from .ir import BucketedBatch
+
+        b = self.batch
+        if isinstance(b, BucketedBatch):
+            return False
+        return bool(np.asarray(b.is_int).any())
+
+    def _integer_gap_target(self):
+        """(rel_gap, abs_gap) certification targets the escalation tier
+        aims for — the hub's when attached, else the opt options'."""
+        opts = getattr(self.spcomm, "options", None) or {}
+        return (opts.get("rel_gap", self.options.get("rel_gap")),
+                opts.get("abs_gap", self.options.get("abs_gap")))
+
+    def _integer_bounds_now(self):
+        """(inner, outer) best-known bounds across the in-wheel tracking
+        and the hub (when attached)."""
+        ib = getattr(self, "inwheel_inner_bound", np.inf)
+        ob = getattr(self, "inwheel_outer_bound", -np.inf)
+        c = self.spcomm
+        if c is not None:
+            ib = min(ib, getattr(c, "BestInnerBound", np.inf))
+            ob = max(ob, getattr(c, "BestOuterBound", -np.inf))
+        return ib, ob
+
+    def _maybe_integer_inner_mip(self, best_idx: int):
+        """Certify the device sweep's best candidate by per-scenario
+        host MIPs — the inner-bound escalation leg for families with
+        SECOND-STAGE integers (the device evaluation relaxes those
+        columns, so ``_inwheel_inner_ok`` rightly refuses it; fixing the
+        nonants at the candidate and solving each scenario MIP exactly
+        IS an incumbent).  Cadence-gated like the host rescue (S host
+        MIPs must not run every window), budgeted from the shared
+        escalation pool, installed under source char ``'I'``."""
+        if not self.options.get("in_wheel_host_rescue", True):
+            return
+        if not self._integer_escalation_on():
+            return
+        every = max(1, int(self.options.get("in_wheel_rescue_every", 4)))
+        cnt = getattr(self, "_int_mip_calls", 0)
+        self._int_mip_calls = cnt + 1
+        if cnt % every:
+            return
+        from .solvers import integer as integer_solvers
+
+        budget = self._integer_budget()
+        if budget.remaining <= 0.05:
+            return
+        try:
+            th = self._inwheel_int_thresholds() or ()
+            cands = integer_solvers.host_candidates(self, th)
+            # device-preferred order, then the SLAM-up slam, then the
+            # rest — one infeasible best-index candidate must not end
+            # the round (the LP rescue's ladder-sweep discipline)
+            bi = min(max(int(best_idx), 0), len(cands) - 1)
+            order = list(dict.fromkeys(
+                [bi, len(th)] + list(range(len(cands)))))
+            ib = None
+            for ci in order:
+                if budget.remaining <= 0.05:
+                    break
+                ib = integer_solvers.escalate_inner(self, budget,
+                                                    cands[ci])
+                if ib is not None:
+                    break
+        except Exception as e:   # a failed escalation declines, loudly
+            global_toc(f"integer inner escalation failed ({e!r}) — "
+                       "declined", True)
+            return
+        if ib is not None:
+            # a MIP-certified sweep candidate is a sweep-supplied
+            # incumbent (the doc/integer.md counter contract)
+            _metrics.inc("integer.feasible_hits")
+            self._offer_inwheel_inner(ib, char='I')
+
+    def _maybe_integer_escalation(self):
+        """ONE gap-gated round of the gap-ranked host MILP escalation
+        (doc/integer.md tier 3): when the wheel's certified gap still
+        misses its target and integrality gap remains, spend a slice of
+        the shared HiGHS budget lifting the per-scenario LP certificates
+        with the LARGEST estimated remaining gap first, and install the
+        lifted outer bound under source char ``'I'``.  Fires on the
+        ``integer_escalation_every`` window cadence (default 4) once an
+        incumbent exists; an exhausted budget leaves every untouched
+        scenario on its LP certificate (budget-elastic by
+        construction)."""
+        if not self._integer_escalation_on():
+            return
+        budget = self._integer_budget()
+        if budget.remaining <= 0.05:
+            return
+        ib, ob = self._integer_bounds_now()
+        if not np.isfinite(ib):
+            return          # no incumbent yet: nothing to close against
+        rel, abs_ = self._integer_gap_target()
+        gap = ib - ob
+        relgap = (gap / (abs(ob) or 1.0)) if np.isfinite(ob) else np.inf
+        hit = ((rel is not None and relgap <= float(rel))
+               or (abs_ is not None and gap <= float(abs_)))
+        if hit or (rel is None and abs_ is None):
+            return          # already certified (or no target to chase)
+        every = max(1, int(self.options.get("integer_escalation_every",
+                                            4)))
+        cnt = getattr(self, "_int_esc_calls", 0)
+        self._int_esc_calls = cnt + 1
+        if cnt % every:
+            return
+        from .solvers import integer as integer_solvers
+
+        upper = None
+        try:
+            th = self._inwheel_int_thresholds()
+            if th is not None:
+                cands = integer_solvers.host_candidates(self, th)
+                bi = min(getattr(self, "_int_best_idx", 0),
+                         len(cands) - 1)
+                u, ok = integer_solvers.candidate_upper_perscen(
+                    self, cands[bi])
+                upper = np.where(ok, u, np.inf)
+        except Exception:
+            upper = None    # ranking falls back to probability order
+        try:
+            ob2, X = integer_solvers.escalate_outer(
+                self, budget,
+                want_s=self.options.get("integer_escalation_slice_s"),
+                upper_perscen=upper, want_x=True)
+        except Exception as e:
+            global_toc(f"integer outer escalation failed ({e!r}) — "
+                       "declined", True)
+            return
+        if ob2 is None or not np.isfinite(ob2):
+            return
+        if ob2 > getattr(self, "inwheel_outer_bound", -np.inf):
+            self.inwheel_outer_bound = ob2
+        if ob2 > self.best_bound:
+            self.best_bound = ob2
+        c = self.spcomm
+        if c is not None and hasattr(c, "OuterBoundUpdate"):
+            c.OuterBoundUpdate(ob2, char='I')
+        self._integer_lift_incumbents(X, budget)
+
+    def _integer_lift_incumbents(self, X, budget):
+        """Lagrangian-heuristic incumbent recovery from the MILP lift's
+        per-scenario minimizers: when every scenario was lifted
+        gap-closed, the rows' per-node consensus (rounded) and SLAM-up
+        slam are natural integer candidates — the subproblem minima
+        under a near-converged W nearly agree, so their consensus is
+        usually feasible and far tighter than a relaxation-consensus
+        rounding.  Certified host-exact (LPs, or per-scenario MIPs for
+        second-stage-integer families), installed under ``'I'``."""
+        if X is None or np.isnan(np.asarray(X)[:, 0]).any():
+            return
+        from .cylinders.xhatxbar_bounder import xbar_candidate
+        from .extensions.xhatbase import slam_cache
+        from .solvers import integer as integer_solvers
+
+        try:
+            nid = self.tree.nonant_indices
+            xk = np.asarray(X, dtype=float)[:, nid]
+            ints = integer_solvers.int_mask_rows(self)
+            lo = np.asarray(self.batch.lb)[:, nid]
+            hi = np.asarray(self.batch.ub)[:, nid]
+            cands = [xbar_candidate(self, xk, threshold=0.5)]
+            up = slam_cache(self, xk, how="max")
+            cands.append(np.clip(
+                np.where(ints, np.ceil(up - 1e-9), up), lo, hi))
+            inner_ok = self._inwheel_inner_ok()
+            best = None
+            for cand in cands:
+                if inner_ok:
+                    if budget.remaining <= 0.05:
+                        break
+                    with budget.timed():
+                        ib = self._inwheel_eval_candidate_host(cand)
+                else:
+                    ib = integer_solvers.escalate_inner(self, budget,
+                                                        cand)
+                if ib is not None and (best is None or ib < best):
+                    best = ib
+            # strongest host heuristic last: the restricted-EF dive on
+            # the minimizers' agreement pattern (certified by
+            # construction — any feasible restricted-EF solution is an
+            # EF incumbent)
+            ib = integer_solvers.restricted_ef_incumbent(self, X, budget)
+            if ib is not None and (best is None or ib < best):
+                best = ib
+            if best is not None:
+                _metrics.inc("integer.feasible_hits")
+                self._offer_inwheel_inner(best, char='I')
+        except Exception as e:
+            global_toc(f"integer lift-incumbent recovery failed ({e!r}) "
+                       "— declined", True)
 
     def _mega_shape_key(self):
         """The autotuner shape key: (S, n, m), or the tuple of per-bucket
@@ -713,6 +1028,54 @@ class PHBase(SPOpt):
         if self._inwheel_on():
             wc = getattr(self, "_mega_window_count", 0)
             self._mega_window_count = wc + 1
+            # opt-in measured integer stage (tune.py "integer" kind):
+            # two real probe windows — one with the batched integer
+            # sweep, one plain — measure the sweep's marginal cost, and
+            # the banked (K, cadence) verdict serves this and later runs
+            # of the shape.  A verdict can TRUNCATE the ladder, which is
+            # a DIFFERENT compiled program: the megastep fn cache is
+            # dropped so the next window rebuilds at the picked K.
+            if (self._inwheel_int_sweep_on()
+                    and self.options.get("in_wheel_int_autotune")
+                    and not self.options.get("in_wheel_int_thresholds")
+                    and not getattr(self, "_int_tuned", False)):
+                self._int_tuned = True
+                from . import tune
+
+                if tune.integer_verdict(
+                        self._mega_shape_key(),
+                        settings=self.admm_settings) is None:
+                    prog = {"k": k, "executed": 0}
+
+                    def run_iwin(int_live):
+                        if self.conv is not None \
+                                and self.conv < convthresh:
+                            return 0
+                        nl = min(n_req,
+                                 refresh_every - self._mega_age(),
+                                 max_iters - prog["k"] + 1)
+                        if nl < 1:
+                            return 0
+                        m = self._megastep_dispatch(
+                            n_req, nl, convthresh,
+                            bound_live=bool(int_live))
+                        self._consume_inwheel_bounds(m)
+                        ex = m["executed"]
+                        if ex:
+                            self._apply_megastep_meas(prog["k"], m)
+                            prog["k"] += ex
+                            prog["executed"] += ex
+                        return ex
+
+                    from .solvers.integer import DEFAULT_THRESHOLDS
+
+                    tune.autotune_integer(
+                        run_iwin, self._mega_shape_key(),
+                        settings=self.admm_settings,
+                        k_full=len(self._inwheel_int_thresholds()
+                                   or DEFAULT_THRESHOLDS))
+                    self._mega_fn_cache = {}
+                    return prog["executed"], bool(self.conv < convthresh)
             # opt-in measured cadence (the tune.py bound-cadence stage):
             # two real probe windows — one with the fused bound pass, one
             # without — measure its marginal cost, and the banked verdict

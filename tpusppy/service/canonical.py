@@ -91,7 +91,21 @@ def _batch_family_parts(batch, settings, ndev, axis) -> tuple:
     )
 
 
-def _program_options_parts(options) -> tuple:
+def _has_int_nonants(batch) -> bool:
+    """Whether ANY nonant slot is integer — the condition under which
+    the bounds=True megastep compiles the batched integer sweep
+    (bucketed batches carry is_int per bucket)."""
+    from ..ir import BucketedBatch
+
+    if isinstance(batch, BucketedBatch):
+        return any(
+            np.asarray(sub.is_int, bool)[sub.tree.nonant_indices].any()
+            for _, sub in batch.buckets)
+    return bool(np.asarray(batch.is_int,
+                           bool)[batch.tree.nonant_indices].any())
+
+
+def _program_options_parts(options, int_nonants: bool = False) -> tuple:
     """Options-level knobs that are PROGRAM identity without being
     ADMMSettings fields: anything here changes which programs a wheel
     compiles (a lean-pack megastep vs full, a different megastep width,
@@ -119,7 +133,21 @@ def _program_options_parts(options) -> tuple:
             ("in_wheel_bounds", bool(options.get("in_wheel_bounds"))),
             ("xhat_threshold",
              float(options.get("in_wheel_xhat_threshold", 0.5))
-             if options.get("in_wheel_bounds") else None))
+             if options.get("in_wheel_bounds") else None),
+            # batched integer sweep knobs (doc/integer.md): program
+            # identity ONLY when the sweep is actually compiled in —
+            # in_wheel_bounds AND integer nonant slots (mirroring the
+            # AOT-key rule in make_wheel_megastep): a continuous family
+            # keys identically whatever these knobs say.  An explicit
+            # ladder equal to the resolved default still keys as its
+            # tuple (a conservative cold family, never a wrong warm
+            # bind).
+            ("int_sweep",
+             (bool(options.get("in_wheel_int_sweep", True)),
+              tuple(float(t) for t in
+                    options.get("in_wheel_int_thresholds") or ()) or None)
+             if (options.get("in_wheel_bounds") and int_nonants)
+             else None))
 
 
 def family_key(batch, settings=None, ndev: int = 1,
@@ -130,7 +158,7 @@ def family_key(batch, settings=None, ndev: int = 1,
     options, same mesh width).  Coefficient values never enter."""
     from ..ir import BucketedBatch
 
-    opts = _program_options_parts(options)
+    opts = _program_options_parts(options, _has_int_nonants(batch))
     if isinstance(batch, BucketedBatch):
         return ("bucketed", opts) + tuple(
             _batch_family_parts(sub, settings, ndev, axis)

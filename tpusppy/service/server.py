@@ -90,7 +90,7 @@ class ServerClosed(RuntimeError):
 def _model_registry():
     """Name -> (module, default opt options).  Lazily imported so the
     server module stays importable without touching every model."""
-    from ..models import farmer, sslp, uc_lite
+    from ..models import farmer, netdes, sizes, sslp, uc_lite
 
     return {
         "farmer": (farmer, {"defaultPHrho": 1.0,
@@ -101,6 +101,14 @@ def _model_registry():
                               "xhat_looper_options": {"scen_limit": 3}}),
         "sslp": (sslp, {"defaultPHrho": 5.0,
                         "xhat_looper_options": {"scen_limit": 3}}),
+        # integer families (doc/integer.md): one-line requests for the
+        # batched integer wheel — rho from the example drivers; requests
+        # add {"relax_integers": False} in creator_kwargs for the true
+        # integer posture (the sweep arms itself from the int pattern)
+        "sizes": (sizes, {"defaultPHrho": 0.01,
+                          "xhat_looper_options": {"scen_limit": 3}}),
+        "netdes": (netdes, {"defaultPHrho": 1.0,
+                            "xhat_looper_options": {"scen_limit": 3}}),
     }
 
 
@@ -985,16 +993,31 @@ class SolveServer:
         if int(t.opt_options.get("solver_refresh_every", 16) or 0) <= 2:
             return False
         b = t.canonical.batch
-        # mirror PHBase._inwheel_inner_ok: second-stage integer columns
-        # make the in-scan frozen evaluation an uncertified relaxation
-        # AND gate off the host rescue — a spoke-less slice would have
-        # zero inner-bound sources
+        # second-stage integer columns make the in-scan frozen
+        # evaluation an uncertified relaxation (PHBase._inwheel_inner_ok
+        # refuses it) — but since the batched-integer-wheel PR
+        # (doc/integer.md) such a family STILL certifies spoke-less:
+        # the escalation tier's MIP leg (_maybe_integer_inner_mip /
+        # escalate_inner) supplies the inner bound, provided the
+        # escalation + rescue knobs are armed and the batch is
+        # homogeneous (the MILP tier iterates batch.A[s]).  Only when
+        # that inner source is UNAVAILABLE must the bound spokes stay.
         subs = ([sub for _, sub in b.buckets]
                 if hasattr(b, "buckets") else [b])
+        second_stage_int = False
         for sub in subs:
             free = np.ones(sub.num_vars, dtype=bool)
             free[sub.tree.nonant_indices] = False
             if np.asarray(sub.is_int, bool)[free].any():
+                second_stage_int = True
+                break
+        if second_stage_int:
+            mip_leg_ok = (
+                not hasattr(b, "buckets")
+                and t.opt_options.get("integer_escalation", True)
+                and t.opt_options.get("in_wheel_host_rescue", True)
+                and t.opt_options.get("in_wheel_int_sweep", True))
+            if not mip_leg_ok:
                 return False
         st = make_admm_settings(dict(t.opt_options), t.canonical.bundling)
 

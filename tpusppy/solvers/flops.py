@@ -115,14 +115,16 @@ def megastep_flops(S, n, m, n_iters, sweeps, sparse_factor=1.0):
         * max(float(sweeps), 1.0)
 
 
-def bound_pass_flops(S, n, m, sweeps, sparse_factor=1.0):
+def bound_pass_flops(S, n, m, sweeps, sparse_factor=1.0, n_evals=1):
     """Model flops of ONE in-wheel bound pass (doc/pipeline.md "In-wheel
-    certification"): the xhat-at-xbar frozen evaluation at its measured
-    ``sweeps`` plus one sweep-equivalent for the Lagrangian
+    certification"): ``n_evals`` frozen evaluations at the measured
+    ``sweeps`` (1 for the legacy xhat-at-xbar pass; the batched integer
+    sweep runs its C rounding candidates + 1 reduced-cost re-solve,
+    doc/integer.md) plus one sweep-equivalent for the Lagrangian
     dual-objective assembly (an A'y matvec pair and per-coordinate
     closed-form minima — the same matvec volume as a single sweep)."""
     return sweep_flops(S, n, m, sparse_factor) \
-        * (max(float(sweeps), 1.0) + 1.0)
+        * (max(1, int(n_evals)) * max(float(sweeps), 1.0) + 1.0)
 
 
 def ph_iteration_flops(S, n, m, sweeps, refresh_every=16, restarts=1,

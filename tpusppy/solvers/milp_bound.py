@@ -101,10 +101,20 @@ def milp_lift(batch, q, base_perscen, *, budget_s=30.0, mip_rel_gap=1e-4,
                 s, res = fut.result()
                 db = None if res is None else res.dual_bound
                 if db is not None and np.isfinite(db):
+                    # RESULT-PLUMBING CONTRACT (regression-tested): a
+                    # time-limited best-bound that is LOOSER than the
+                    # scenario's existing LP certificate is never
+                    # installed — both certify the same integer minimum,
+                    # so the per-scenario max is the certificate
                     cand = db + float(const[s])
                     if cand > lifted[s]:
                         lifted[s] = cand
-                    if X is not None and res.feasible:
+                    if X is not None and res.feasible \
+                            and res.status == "0":
+                        # only gap-closed solves install X: the rows are
+                        # documented as MILP MINIMIZERS (milp_dual_ascent
+                        # consumes them as subgradients), and a
+                        # time-limited incumbent is merely feasible
                         X[s] = res.x
                     n_lifted += 1
     return (lifted, n_lifted, X) if want_x else (lifted, n_lifted)

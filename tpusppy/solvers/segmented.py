@@ -201,18 +201,22 @@ def megastep_cap(S, n, m, st, eff_flops=None, target_secs=None,
     early-exit mask never shrinks the worst case (a masked iteration does
     no sweeps, but the cap must hold when nothing converges).
 
-    ``bound_pass=True`` (in-wheel certification, doc/pipeline.md): the
+    ``bound_pass`` (in-wheel certification, doc/pipeline.md): the
     dispatch may end with the fused bound pass — worst-cased at one extra
-    frozen iteration (the xhat frozen evaluation's full sweep budget; the
-    dual-objective contraction is a rounding error next to it) — so one
-    frozen-iteration budget is reserved out of the watchdog window.
+    frozen iteration PER EVALUATION (the xhat frozen evaluation's full
+    sweep budget; the dual-objective contraction is a rounding error next
+    to it) — so that many frozen-iteration budgets are reserved out of
+    the watchdog window.  ``True`` reserves 1 (the legacy single-
+    candidate pass); an int reserves that many (the batched integer
+    sweep reserves its C candidate evaluations + 1 reduced-cost
+    re-solve, doc/integer.md).
     """
     eff = _dense_clamped_eff(eff_flops, factor_batch)
     target = _DISPATCH_TARGET_SECS if target_secs is None else target_secs
     t_sweep = flops_model.sweep_flops(S, n, m, sparse_factor) / eff
     t_iter = _frozen_iter_secs(st, t_sweep)
     if bound_pass:
-        target = max(target - t_iter, 0.0)
+        target = max(target - int(bound_pass) * t_iter, 0.0)
     return int(target / max(t_iter, 1e-12))
 
 
@@ -223,8 +227,9 @@ def megastep_cap_multi(shapes, st, eff_flops=None, target_secs=None,
     per-iteration worst case is the SUM over buckets of the homogeneous
     :func:`megastep_cap` accounting.  ``shapes`` is
     ``[(S_b, n_b, m_b[, factor_batch_b[, sparse_factor_b]]), ...]``.
-    ``bound_pass`` reserves one cross-bucket frozen-iteration budget for
-    the fused bound pass (see :func:`megastep_cap`)."""
+    ``bound_pass`` reserves cross-bucket frozen-iteration budgets for
+    the fused bound pass — ``True`` = 1, an int = that many evaluations
+    (the batched integer sweep; see :func:`megastep_cap`)."""
     target = _DISPATCH_TARGET_SECS if target_secs is None else target_secs
     total = 0.0
     for shp in shapes:
@@ -235,7 +240,7 @@ def megastep_cap_multi(shapes, st, eff_flops=None, target_secs=None,
         t_sweep = flops_model.sweep_flops(S, n, m, sf) / eff
         total += _frozen_iter_secs(st, t_sweep)
     if bound_pass:
-        target = max(target - total, 0.0)
+        target = max(target - int(bound_pass) * total, 0.0)
     return int(target / max(total, 1e-12))
 
 
@@ -276,17 +281,20 @@ def bill_megastep(S, n, m, n_iters, sweeps, sparse_factor=1.0,
 
 
 def bill_bound_pass(S, n, m, sweeps, sparse_factor=1.0,
-                    count_pass=True):
+                    count_pass=True, n_evals=1):
     """Bill one EXECUTED in-wheel bound pass (doc/pipeline.md "In-wheel
     certification"): the xhat-at-xbar frozen evaluation's measured
     ``sweeps`` plus the Lagrangian dual-objective contraction, at this
     shape, into ``dispatch.flops`` — dispatched work inside the megastep
     window that is certification, not PH iterations, so it never inflates
     ``dispatch.mega_iterations``.  ``count_pass=False``: FLOPS only (the
-    bucketed kernel bills per bucket but the window ran ONE pass)."""
+    bucketed kernel bills per bucket but the window ran ONE pass).
+    ``n_evals``: frozen evaluations in the pass (the batched integer
+    sweep runs C candidates + 1 reduced-cost re-solve, doc/integer.md)."""
     if count_pass:
         _metrics.inc("megastep.bound_passes")
-    fl = flops_model.bound_pass_flops(S, n, m, sweeps, sparse_factor)
+    fl = flops_model.bound_pass_flops(S, n, m, sweeps, sparse_factor,
+                                      n_evals=n_evals)
     if fl:
         _metrics.inc("dispatch.flops", fl)
     if _trace.enabled():

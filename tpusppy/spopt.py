@@ -779,6 +779,65 @@ class SPOpt(SPBase):
         ``xbar_candidate`` rule; ``in_wheel_xhat_threshold`` option)."""
         return float(self.options.get("in_wheel_xhat_threshold", 0.5))
 
+    def _inwheel_int_thresholds(self):
+        """The batched integer sweep's rounding ladder (doc/integer.md),
+        or None when the sweep is off: no integer nonants, or the
+        ``in_wheel_int_sweep`` option disables it.  Resolution order:
+        the ``in_wheel_int_thresholds`` option, then the autotuner's
+        banked "integer" verdict (which truncates the default ladder to
+        its measured K), then :data:`~tpusppy.solvers.integer.
+        DEFAULT_THRESHOLDS`."""
+        from .ir import BucketedBatch
+
+        b = self.batch
+        if isinstance(b, BucketedBatch):
+            if all(self._inwheel_int_mask(batch=sub) is None
+                   for _, sub in b.buckets):
+                return None
+        elif self._inwheel_int_mask() is None:
+            return None
+        if not self.options.get("in_wheel_int_sweep", True):
+            return None
+        th = self.options.get("in_wheel_int_thresholds")
+        if th:
+            return tuple(float(t) for t in th)
+        from .solvers import integer as integer_solvers
+
+        ladder = integer_solvers.DEFAULT_THRESHOLDS
+        try:
+            from . import tune
+
+            v = tune.integer_verdict(self._mega_shape_key(),
+                                     settings=self.admm_settings)
+        except AttributeError:      # non-PH opt: no shape key — default
+            v = None
+        if v is not None and v.k:
+            ladder = ladder[:max(1, int(v.k))]
+        return tuple(float(t) for t in ladder)
+
+    def _inwheel_int_sweep_on(self) -> bool:
+        """Whether the bounds=True megastep for this instance compiles
+        the batched integer sweep (and its longer packed tail)."""
+        return self._inwheel_int_thresholds() is not None
+
+    def _inwheel_pass_evals(self) -> int:
+        """Frozen-evaluation count of ONE in-wheel bound pass — the
+        watchdog-reservation and FLOP-billing unit: 1 for the legacy
+        single-candidate pass; for the batched integer sweep, the
+        ladder evaluations (+ the SLAM slams on the homogeneous kernel
+        only — the bucketed posture drops them) + 1 reduced-cost
+        re-solve when the fixing is certificate-safe for the family."""
+        th = self._inwheel_int_thresholds()
+        if th is None:
+            return 1
+        from .ir import BucketedBatch
+        from .solvers import integer as integer_solvers
+
+        c = len(th)
+        if not isinstance(self.batch, BucketedBatch):
+            c += integer_solvers.N_SLAM
+        return c + (1 if self._inwheel_inner_ok() else 0)
+
     def _megastep_fn(self, n_req: int, pack: str = "full",
                      bounds: bool = False):
         """The jitted megakernel for this instance at width ``n_req``
@@ -792,12 +851,22 @@ class SPOpt(SPBase):
         if fn is None:
             from .parallel import sharded
 
+            int_rounding = (self._inwheel_int_thresholds() if bounds
+                            else None)
             fn = sharded.make_wheel_megastep(
                 self.tree.nonant_indices, self.admm_settings, None,
                 n_iters=n_req, donate=True, pack=pack, bounds=bounds,
                 int_nonants=self._inwheel_int_mask() if bounds else None,
                 xhat_threshold=(self._inwheel_threshold() if bounds
-                                else 0.5))
+                                else 0.5),
+                int_rounding=int_rounding,
+                int_cols=(np.asarray(self.batch.is_int, bool)
+                          if bounds and int_rounding else None),
+                # reduced-cost fixing is only certificate-safe when the
+                # candidate evaluation is at a true integer-feasible
+                # point — every integer column a nonant slot
+                int_rcfix=(self._inwheel_inner_ok()
+                           if bounds and int_rounding else True))
             cache[(n_req, pack, bounds)] = fn
         return fn
 
@@ -868,7 +937,8 @@ class SPOpt(SPBase):
             self._dev_state = state if pack == "lean" else None
             meas = sharded.megastep_unpack(
                 hostsync.fetch(packed), n_req, S, n, K, pack=pack,
-                bounds=bounds)
+                bounds=bounds,
+                int_sweep=bounds and self._inwheel_int_sweep_on())
             if _trace.enabled():
                 _sp.add(n_live=n_live, executed=meas["executed"],
                         refresh_hit=meas["refresh_hit"],
@@ -886,7 +956,8 @@ class SPOpt(SPBase):
                                 rejected_sweeps=rej)
         if meas.get("bound_computed"):
             segmented.bill_bound_pass(S, n, m, meas["bound_sweeps"],
-                                      sparse_factor=sf)
+                                      sparse_factor=sf,
+                                      n_evals=self._inwheel_pass_evals())
 
         refresh_every = self._refresh_every()
         guard = False
@@ -963,18 +1034,28 @@ class SPOpt(SPBase):
             from .parallel import sharded
 
             int_masks = None
+            int_rounding = None
+            int_cols = None
             if bounds:
                 # per-bucket integer masks: bucketing may key on the
                 # integer pattern, so nonant integrality can differ
                 int_masks = tuple(
                     self._inwheel_int_mask(batch=sub)
                     for _, sub in self.batch.buckets)
+                int_rounding = self._inwheel_int_thresholds()
+                if int_rounding:
+                    int_cols = tuple(
+                        np.asarray(sub.is_int, bool)
+                        for _, sub in self.batch.buckets)
             fn = sharded.make_bucketed_wheel_megastep(
                 self.tree.nonant_indices, self.admm_settings,
                 n_iters=n_req, donate=True, bounds=bounds,
                 int_nonants=int_masks,
                 xhat_threshold=(self._inwheel_threshold() if bounds
-                                else 0.5))
+                                else 0.5),
+                int_rounding=int_rounding, int_cols=int_cols,
+                int_rcfix=(self._inwheel_inner_ok()
+                           if bounds and int_rounding else True))
             cache[keyb] = fn
         return fn
 
@@ -1043,7 +1124,8 @@ class SPOpt(SPBase):
             for slot, stb in zip(slots, states):
                 slot["warm"] = (stb.x, stb.z, stb.y, stb.yx)
             bmeas = sharded.bucketed_megastep_unpack(
-                hostsync.fetch(packed), n_req, shapes, K, bounds=bounds)
+                hostsync.fetch(packed), n_req, shapes, K, bounds=bounds,
+                int_sweep=bounds and self._inwheel_int_sweep_on())
             if _trace.enabled():
                 _sp.add(n_live=n_live, executed=bmeas["executed"],
                         refresh_hit=bmeas["refresh_hit"], buckets=len(arrs))
@@ -1058,6 +1140,10 @@ class SPOpt(SPBase):
             meas.update({k: bmeas[k] for k in (
                 "bound_computed", "bound_outer", "bound_inner_obj",
                 "bound_inner_feas", "bound_sweeps")})
+            for k in ("int_feas_cands", "int_best_idx",
+                      "int_rcfix_slots", "bound_outer_base"):
+                if k in bmeas:
+                    meas[k] = bmeas[k]
         pri = np.zeros(S)
         dua = np.zeros(S)
         done = np.zeros(S, dtype=bool)
@@ -1091,6 +1177,10 @@ class SPOpt(SPBase):
             else 0.0
         rej = (float(meas["iters"][executed])
                if meas["refresh_hit"] and executed < n_req else None)
+        # loop-invariant: the threshold-ladder resolution behind this is
+        # a per-bucket scan + verdict lookup, not per-bucket billing work
+        pass_evals = (self._inwheel_pass_evals()
+                      if meas.get("bound_computed") else 1)
         for bi, (slot, (idx, sub)) in enumerate(zip(slots, b.buckets)):
             # per-bucket FLOP billing on each bucket's own shapes (the
             # packed sweep counter is the cross-bucket max —
@@ -1102,7 +1192,8 @@ class SPOpt(SPBase):
             if meas.get("bound_computed"):
                 segmented.bill_bound_pass(
                     idx.size, sub.num_vars, sub.num_rows,
-                    meas["bound_sweeps"], count_pass=bi == 0)
+                    meas["bound_sweeps"], count_pass=bi == 0,
+                    n_evals=pass_evals)
             slot["age"] = slot.get("age", 0) + executed
             if meas["refresh_hit"] or guard:
                 slot["age"] = max(slot["age"], refresh_every)

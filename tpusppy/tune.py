@@ -115,7 +115,12 @@ _PERSIST_VERSION = 2
 # how often a self-certifying megastep window runs its fused bound pass
 # (doc/pipeline.md "In-wheel certification").  Absent in older v2 files,
 # tolerated — existing kinds' keys are unchanged, no schema bump.
-_PERSIST_KINDS = ("fused", "pipeline", "megastep", "aot", "bound_cadence")
+# "integer" (the batched integer wheel PR, doc/integer.md): per-shape
+# verdict for the rounding-sweep width K (how many ladder thresholds the
+# integer bound pass evaluates) and its window cadence, picked from the
+# measured marginal pass cost.  Absent in older files, tolerated.
+_PERSIST_KINDS = ("fused", "pipeline", "megastep", "aot", "bound_cadence",
+                  "integer")
 _persist: dict = {k: {} for k in _PERSIST_KINDS}
 _persist_lock = threading.Lock()
 _disk_loaded_from: str | None = None
@@ -243,6 +248,7 @@ def reset_persist():
             _persist[kind].clear()
     _mega_cache.clear()
     _bound_cadence_cache.clear()
+    _integer_cache.clear()
     _disk_loaded_from = None
     _cache_path_override = None
 
@@ -946,4 +952,116 @@ def autotune_bound_cadence(run_window, shape, settings=None,
             "every": int(k), "bound_secs": float(bound_secs),
             "window_secs": float(window_secs),
             "overhead_pct_at_pick": float(pct)})
+    return res
+
+
+# ---------------------------------------------------------------------------
+# Integer stage (batched integer wheel, doc/integer.md): pick the rounding
+# sweep width K (how many ladder thresholds the integer bound pass
+# evaluates on device — the SLAM slams always ride) and the pass cadence
+# from the MEASURED marginal sweep cost vs the plain window wall.  A wide
+# ladder finds integer-feasible incumbents sooner (best-of-C); each extra
+# candidate costs one more vmapped frozen evaluation per pass.  Verdicts
+# persist under the "integer" kind on the same shape+settings key family
+# as the megastep/bound-cadence stages.
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class IntegerTune:
+    k: int                    # picked ladder width (thresholds evaluated)
+    every: int                # integer pass every k-th megastep window
+    sweep_secs: float         # marginal cost of one full integer pass
+    window_secs: float        # wall of one bound-less megastep window
+
+
+_integer_cache: dict = {}
+
+
+def _integer_disk_lookup(key):
+    dk = _persist_get("integer", repr(key))
+    if dk is None:
+        return None
+    _metrics.inc("tune.disk_hits")
+    res = IntegerTune(
+        k=int(dk["k"]), every=int(dk["every"]),
+        sweep_secs=float(dk["sweep_secs"]),
+        window_secs=float(dk["window_secs"]))
+    _integer_cache[key] = res
+    return res
+
+
+def integer_verdict(shape, settings=None) -> IntegerTune | None:
+    """Banked integer-sweep verdict for a shape (None = no verdict — the
+    hub then runs the default ladder every bound window).  ``shape`` is
+    one (S, n, m) triple or the bucketed tuple-of-triples, like
+    :func:`megastep_verdict`."""
+    key = _mega_key(shape, settings)
+    return _integer_cache.get(key) or _integer_disk_lookup(key)
+
+
+def autotune_integer(run_window, shape, settings=None, k_full: int = 3,
+                     target_pct: float = 15.0, every_cap: int = 8,
+                     cache: bool = True):
+    """Measure the marginal cost of the batched integer bound pass and
+    pick (K, cadence) keeping it under ``target_pct`` percent of the
+    wheel wall.
+
+    ``run_window(int_live)`` executes ONE real megastep window end to end
+    (dispatch + packed fetch, measurement applied normally — warmup work
+    is never wasted, the autotune_megastep posture) with the integer
+    bound pass on (True) or off (False), returning the executed
+    iteration count.  Three windows run: a compile-absorbing integer
+    warmup, a timed integer window, a timed plain window.  The sweep
+    cost scales ~linearly in the candidate count (C = K + 2 slams), so
+    K shrinks first (never below 1 — the nearest-rounding candidate
+    always rides) and the cadence stretches only when K=1 still misses
+    the target.  Degenerate probes (a converged or rejected window)
+    return the conservative full-ladder answer WITHOUT banking.
+    """
+    key = _mega_key(shape, settings)
+    if cache:
+        hit = _integer_cache.get(key) or _integer_disk_lookup(key)
+        if hit is not None:
+            return hit
+    k_full = max(1, int(k_full))
+    run_window(True)                    # compile-absorbing warmup
+    t0 = time.time()
+    ex_i = int(run_window(True))
+    t_int = time.time() - t0
+    t0 = time.time()
+    ex_p = int(run_window(False))
+    t_plain = time.time() - t0
+    if ex_i < 1 or ex_p < 1:
+        _probe_event("integer", {"shape": repr(shape),
+                                 "skipped": "degenerate probe",
+                                 "executed": (ex_i, ex_p)})
+        return IntegerTune(k=k_full, every=1,
+                           sweep_secs=max(t_int, 0.0),
+                           window_secs=max(t_plain, 1e-9))
+    # per-iteration normalization (the bound_cadence estimator): the
+    # pass ran once in the integer window
+    sweep_secs = max(t_int / ex_i - t_plain / ex_p, 0.0) * ex_i
+    window_secs = max(t_plain, 1e-9)
+    f = max(target_pct, 1e-3) / 100.0
+    # cost model: sweep_secs covers C_full = k_full + 2 evaluations + the
+    # reduced-cost re-solve; per-evaluation cost is ~sweep/(C_full + 1)
+    per_eval = sweep_secs / max(k_full + 3, 1)
+    k = k_full
+    every = 1
+    while k > 1 and (k + 3) * per_eval > f * window_secs:
+        k -= 1
+    if (k + 3) * per_eval > f * window_secs:
+        cost = (k + 3) * per_eval
+        every = int(np.ceil(cost * (1.0 - f) / (f * window_secs)))
+        every = max(1, min(every, max(1, int(every_cap))))
+    res = IntegerTune(k=k, every=every, sweep_secs=sweep_secs,
+                      window_secs=window_secs)
+    _probe_event("integer", {"shape": repr(shape), "k": k, "every": every,
+                             "sweep_secs": sweep_secs,
+                             "window_secs": window_secs})
+    if cache:
+        _integer_cache[key] = res
+        _persist_put("integer", repr(key), {
+            "k": int(k), "every": int(every),
+            "sweep_secs": float(sweep_secs),
+            "window_secs": float(window_secs)})
     return res
