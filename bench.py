@@ -782,6 +782,70 @@ def serving_segment():
         entry["recovery_certified"] = bool(rec["certified"])
     except Exception as e:   # recovery SLOs are additive, never fatal
         entry["recovery_error"] = repr(e)
+    # continuous batching vs forced time-slicing (doc/serving.md
+    # "Continuous batching"): the same isomorphic burst through a
+    # batch_slots=K server and through a FORCED time-sliced baseline —
+    # batch_slots=None plus a churn driver that preempt()s the running
+    # tenant every quantum, because family affinity would otherwise run
+    # the burst serially FCFS, which is not time-slicing.  Banks the
+    # aggregate requests/s pair, the speedup, and the batched p50 queue
+    # wait (the >=3x bar asserted nightly by scripts/batching_smoke.py).
+    try:
+        n_b = int(os.environ.get("BENCH_BATCH_REQUESTS", "6"))
+        slots = int(os.environ.get("BENCH_BATCH_SLOTS", "3"))
+        S_b = int(os.environ.get("BENCH_BATCH_SCENS", "3"))
+        quantum = float(os.environ.get("BENCH_BATCH_QUANTUM", "0.2"))
+        reps = int(os.environ.get("BENCH_BATCH_REPS", "2"))
+
+        def _breq(rid, i):
+            return SolveRequest(
+                model="farmer", num_scens=S_b, request_id=rid,
+                creator_kwargs={"seedoffset": 31 * i},
+                options={"PHIterLimit": 400})
+
+        def _burst(batch_slots, tag):
+            wd = tempfile.mkdtemp(prefix=f"bench_srv_batch_{tag}_")
+            with SolveServer(work_dir=wd, batch_slots=batch_slots,
+                             in_wheel_bounds=True, quantum_secs=300.0,
+                             linger_secs=0.0) as s2:
+                s2.result(s2.submit(_breq(f"warm-{tag}", 99)),
+                          timeout=1200)
+                stop = threading.Event()
+                if batch_slots is None:
+                    def _churn():
+                        while not stop.is_set():
+                            time.sleep(quantum)
+                            for t in list(s2._tenants.values()):
+                                if (t.status == "running"
+                                        and t.id != f"warm-{tag}"):
+                                    s2.preempt(t.id)
+                                    break
+                    threading.Thread(target=_churn, daemon=True).start()
+                # min-of-reps: a steady-state rate, not a one-shot
+                # sample (same protocol as scripts/batching_smoke.py)
+                walls = []
+                for rep in range(reps):
+                    t0 = time.time()
+                    rb = [s2.submit(_breq(f"{tag}{rep}_{i}", i))
+                          for i in range(n_b)]
+                    recs_b = [s2.result(r, timeout=1200) for r in rb]
+                    walls.append(time.time() - t0)
+                stop.set()
+                qsum = s2.slo_summary()
+            return min(walls), recs_b, qsum
+
+        wall_k, recs_k, sum_k = _burst(slots, "bk")
+        wall_1, recs_1, _ = _burst(None, "bt")
+        entry["batched_requests_per_s"] = round(n_b / wall_k, 3)
+        entry["timesliced_requests_per_s"] = round(n_b / wall_1, 3)
+        entry["batched_speedup"] = round(wall_1 / max(wall_k, 1e-9), 2)
+        entry["p50_queue_wait"] = sum_k["p50_queue_wait_s"]
+        entry["batched_certified"] = all(
+            r["certified"] and r["batched"] for r in recs_k)
+        entry["timesliced_certified"] = all(
+            r["certified"] for r in recs_1)
+    except Exception as e:   # batching SLOs are additive, never fatal
+        entry["batching_error"] = repr(e)
     return entry
 
 

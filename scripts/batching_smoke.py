@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Continuous-batching smoke: K-slot fused serving vs forced time-slicing.
+
+Nightly CI acceptance for doc/serving.md "Continuous batching", runnable
+locally::
+
+    JAX_PLATFORMS=cpu python scripts/batching_smoke.py
+
+Two phases against a warm same-family farmer workload:
+
+1. SEMANTICS (untimed, ``SolveServer(batch_slots=3)``): six staggered
+   requests — the back half submitted only after the front half's batch
+   has executed windows, so they must JOIN mid-run — plus one forced
+   preemption of a running member, which must EVICT (bank through the
+   checkpoint seam), free the slot for a queued backfill, and later
+   rejoin and complete certified.  Joiners bind the batch's programs
+   WARM: zero ``aot.misses`` on every post-leader request.
+
+2. THROUGHPUT (timed, min-of-``SMOKE_BATCH_REPS`` bursts): six requests
+   submitted at once through the batched server, then through a FORCED
+   time-sliced baseline — ``batch_slots=None`` plus a churn driver that
+   ``preempt()``s the running tenant every ``SMOKE_BATCH_QUANTUM``
+   seconds.  The forcing matters: without it the server's family
+   affinity serializes same-family requests FCFS (head-of-line
+   blocking, no concurrent progress), which is not time-slicing at all.
+   The churned baseline grants every tenant a quantum — the same
+   fairness the batch gives all K slots each window — and pays the
+   park/bank/resume/Iter0 cycle per quantum that continuous batching
+   deletes.  Asserts the batched burst sustains at least
+   ``SMOKE_BATCH_SPEEDUP``x (default 3) the baseline's aggregate
+   requests/s, with every request in BOTH modes certified at the same
+   gap target (certification is unchanged; the per-request gap values
+   legitimately differ because certification is checked at window
+   boundaries and the two modes traverse different window grids).
+
+Prints one JSON line with the measured figures.  Exit 0 = pass.  A hard
+watchdog (``SMOKE_BATCH_DEADLINE_SECS``, default 900) ``os._exit(2)``s a
+wedged run so CI never hangs.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SPEEDUP = float(os.environ.get("SMOKE_BATCH_SPEEDUP", "3.0"))
+DEADLINE = float(os.environ.get("SMOKE_BATCH_DEADLINE_SECS", "900"))
+QUANTUM = float(os.environ.get("SMOKE_BATCH_QUANTUM", "0.2"))
+REPS = int(os.environ.get("SMOKE_BATCH_REPS", "2"))
+N_REQ = 6
+K = 3
+S = int(os.environ.get("SMOKE_BATCH_SCENS", "3"))
+ITERS = 400
+
+
+def _arm_watchdog():
+    def _bomb():
+        time.sleep(DEADLINE)
+        print(json.dumps({"ok": False, "error": "deadline exceeded"}),
+              flush=True)
+        os._exit(2)
+
+    threading.Thread(target=_bomb, daemon=True).start()
+
+
+def _req(SolveRequest, rid, i):
+    return SolveRequest(model="farmer", num_scens=S, request_id=rid,
+                        creator_kwargs={"seedoffset": 31 * i},
+                        options={"PHIterLimit": ITERS})
+
+
+def main():
+    _arm_watchdog()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import tempfile
+
+    from tpusppy.obs import metrics
+    from tpusppy.service import SolveRequest, SolveServer
+
+    # ---- phase 1: boundary semantics on the batched server ----------------
+    work_b = tempfile.mkdtemp(prefix="batching_smoke_b_")
+    with SolveServer(work_dir=work_b, batch_slots=K,
+                     in_wheel_bounds=True, quantum_secs=300.0,
+                     linger_secs=0.0) as srv:
+        # warm the family: the one-time program build must not pollute
+        # either the semantics run or the throughput comparison
+        srv.result(srv.submit(_req(SolveRequest, "warm-b", 99)),
+                   timeout=600)
+        joins0 = metrics.value("batching.joins")
+        evict0 = metrics.value("batching.evictions")
+        rids = [srv.submit(_req(SolveRequest, f"b{i}", i))
+                for i in range(K)]
+        # stagger the back half: they must JOIN mid-run.  Wait until the
+        # front half's batch has executed windows AND is still live.
+        w0 = metrics.value("batching.windows")
+        deadline = time.monotonic() + 300
+        while (metrics.value("batching.windows") <= w0
+               and time.monotonic() < deadline):
+            time.sleep(0.002)
+        mid_run = any(srv._tenants[r].status == "running" for r in rids)
+        rids += [srv.submit(_req(SolveRequest, f"b{i}", i))
+                 for i in range(K, N_REQ)]
+        # force ONE eviction-with-backfill: preempt a running member —
+        # its slot banks + frees at the next boundary, a queued request
+        # backfills it, and the preempted tenant rejoins later
+        evicted = None
+        for _ in range(5000):
+            running = [r for r in rids
+                       if srv._tenants[r].status == "running"
+                       and srv._tenants[r].record["iters"] > 0]
+            if running:
+                evicted = running[0]
+                srv.preempt(evicted)
+                break
+            if all(srv._tenants[r].status in ("done", "failed")
+                   for r in rids):
+                break
+            time.sleep(0.002)
+        recs_sem = {r: srv.result(r, timeout=600) for r in rids}
+        joins = metrics.value("batching.joins") - joins0
+        evictions = metrics.value("batching.evictions") - evict0
+        warm_misses = sum(recs_sem[f"b{i}"]["aot_misses"]
+                          for i in range(N_REQ))
+
+        # ---- phase 2a: timed batched bursts (clean, all-at-once) ----------
+        walls_b, gaps_b = [], []
+        for rep in range(REPS):
+            t0 = time.monotonic()
+            burst = [srv.submit(_req(SolveRequest, f"tb{rep}_{i}", i))
+                     for i in range(N_REQ)]
+            recs = [srv.result(r, timeout=600) for r in burst]
+            walls_b.append(time.monotonic() - t0)
+            gaps_b = [r["rel_gap"] for r in recs]
+            cert_b = all(r["certified"] and r["batched"] for r in recs)
+        summary_b = srv.slo_summary()
+    wall_b = min(walls_b)
+
+    # ---- phase 2b: forced time-sliced baseline ----------------------------
+    # batch_slots=None alone is NOT time-slicing — family affinity runs
+    # same-family requests serially FCFS.  The churn driver imposes the
+    # fairness quantum a real time-sliced scheduler grants each tenant.
+    work_t = tempfile.mkdtemp(prefix="batching_smoke_t_")
+    with SolveServer(work_dir=work_t, batch_slots=None,
+                     in_wheel_bounds=True, quantum_secs=QUANTUM,
+                     linger_secs=0.0) as srv:
+        srv.result(srv.submit(_req(SolveRequest, "warm-t", 99)),
+                   timeout=600)
+        stop = threading.Event()
+        active = set()
+
+        def _churn():
+            while not stop.is_set():
+                time.sleep(QUANTUM)
+                for t in list(srv._tenants.values()):
+                    if t.status == "running" and t.id in active:
+                        srv.preempt(t.id)
+                        break
+
+        threading.Thread(target=_churn, daemon=True).start()
+        walls_t, gaps_t, slices_t = [], [], []
+        for rep in range(REPS):
+            t0 = time.monotonic()
+            burst = [srv.submit(_req(SolveRequest, f"tt{rep}_{i}", i))
+                     for i in range(N_REQ)]
+            active.update(burst)
+            recs = [srv.result(r, timeout=600) for r in burst]
+            walls_t.append(time.monotonic() - t0)
+            active.clear()
+            gaps_t = [r["rel_gap"] for r in recs]
+            slices_t = [r["slices"] for r in recs]
+            cert_t = all(r["certified"] for r in recs)
+        stop.set()
+        summary_t = srv.slo_summary()
+    wall_t = min(walls_t)
+
+    batched_rps = N_REQ / wall_b
+    timesliced_rps = N_REQ / wall_t
+    gap_drift = max(abs(a - b) / max(abs(b), 1e-12)
+                    for a, b in zip(gaps_b, gaps_t))
+
+    checks = {
+        "semantics_all_certified": all(r["certified"] and r["batched"]
+                                       for r in recs_sem.values()),
+        "mid_run_join": bool(mid_run),
+        "eviction_with_backfill": (evicted is not None
+                                   and evictions >= 1
+                                   and joins >= N_REQ + 1
+                                   and recs_sem[evicted]["certified"]
+                                   and recs_sem[evicted]["slices"] >= 2),
+        "joiners_warm_zero_misses": warm_misses == 0,
+        "all_batched_certified": bool(cert_b),
+        "all_timesliced_certified": bool(cert_t),
+        "baseline_actually_timesliced": min(slices_t) >= 2,
+        "speedup_ok": batched_rps >= SPEEDUP * timesliced_rps,
+    }
+    line = {
+        "ok": all(checks.values()),
+        "checks": checks,
+        "requests": N_REQ, "batch_slots": K, "S": S,
+        "batched_walls_s": [round(w, 3) for w in walls_b],
+        "timesliced_walls_s": [round(w, 3) for w in walls_t],
+        "batched_requests_per_s": round(batched_rps, 3),
+        "timesliced_requests_per_s": round(timesliced_rps, 3),
+        "speedup": round(batched_rps / max(timesliced_rps, 1e-9), 2),
+        "speedup_bar": SPEEDUP,
+        "quantum_s": QUANTUM,
+        "baseline_slices": slices_t,
+        "gap_drift": gap_drift,
+        "joins": joins, "evictions": evictions,
+        "evicted_rejoined": evicted,
+        "p50_queue_wait_batched_s": summary_b["p50_queue_wait_s"],
+        "p50_queue_wait_timesliced_s": summary_t["p50_queue_wait_s"],
+        "gaps_batched": [round(g, 8) for g in gaps_b],
+        "gaps_timesliced": [round(g, 8) for g in gaps_t],
+    }
+    print(json.dumps(line), flush=True)
+    return 0 if line["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,7 +20,7 @@ case "$(basename "$1")" in
   test_extensions.py|test_inwheel_bounds.py|\
   test_cross_scen.py|test_mip_incumbents.py|test_lshaped.py|test_sc.py|\
   test_ef.py|test_obs.py|test_resilience.py|test_elastic.py|\
-  test_service.py|test_service_durable.py)
+  test_service.py|test_service_durable.py|test_batching.py)
     echo cylinders-wheel ;;
   *)
     echo confint-utils ;;

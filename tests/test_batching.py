@@ -1,0 +1,418 @@
+"""Continuous batching: K isomorphic tenants in ONE fused megastep
+(doc/serving.md "Continuous batching").
+
+The contract under test, bottom-up:
+
+- KERNEL (``sharded.make_tenant_megastep``): a tenant's trajectory
+  inside a K-batch is the EXACT solo-megastep computation on its own
+  state — batched-vs-solo parity at 1e-9 for a MIXED tenant population
+  (same family, different coefficients), a ghost slot rides fully inert
+  (state passthrough, zero stats), one tenant stopping early (or being
+  divergence-frozen) never perturbs a sibling's masks, and the
+  tenant-batched partition rules keep the tenant axis unsharded
+  (scenario-within-tenant).
+- RUNNER (``service.batching.BatchedFamilyRunner``): per-tenant
+  certification via the bound packs under source char 'B', joins and
+  evictions ONLY at window boundaries (evict = bank through the normal
+  checkpoint seam; re-admit resumes the SAME trajectory), shared-
+  dispatch SLO attribution by live-row fraction.
+- SERVER (``SolveServer(batch_slots=K)``): batched requests complete
+  CERTIFIED at the same target as time-slicing; a joiner binds the
+  batch's already-built programs (``warm_hit`` with ZERO aot misses);
+  duplicate submits stay idempotent; a ``deadline_secs`` crossing
+  evicts ONLY the expiring tenant's slot — never the batch; a killed
+  batched server recovers each slot from its own banked slice.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpusppy.ir import ScenarioBatch
+from tpusppy.models import farmer
+from tpusppy.obs import metrics
+from tpusppy.parallel import sharded
+from tpusppy.resilience import checkpoint as ck
+from tpusppy.service import SolveRequest, SolveServer
+from tpusppy.service import canonical as canonical_mod
+from tpusppy.service.batching import (BatchedFamilyRunner, BoundTracker,
+                                      qos_rank)
+from tpusppy.solvers.admm import ADMMSettings
+
+pytestmark = pytest.mark.filterwarnings("ignore::UserWarning")
+
+
+def make_batch(n, **kw):
+    names = farmer.scenario_names_creator(n)
+    return ScenarioBatch.from_problems(
+        [farmer.scenario_creator(nm, num_scens=n, **kw) for nm in names])
+
+
+def _prep(arr, idx, settings, mesh):
+    """Iter0 + one prox-on refresh: frozen-ready (state, factors)."""
+    refresh, _ = sharded.make_ph_step_pair(idx, settings, mesh)
+    state = sharded.init_state(arr, 1.0, settings)
+    state, _, _ = refresh(state, arr, 0.0)
+    state, _, factors = refresh(state, arr, 1.0)
+    return state, factors
+
+
+def _solo_run(idx, settings, mesh, state, arr, factors, n,
+              convthresh=-1.0, tol=np.inf):
+    solo = sharded.make_wheel_megastep(idx, settings, mesh, n_iters=n,
+                                       donate=False)
+    s, packed = solo(state, arr, 1.0, factors, convthresh, n, tol)
+    S, nv = arr.c.shape
+    return s, sharded.megastep_unpack(np.asarray(packed), n, S, nv,
+                                      arr.nid_sk.shape[1])
+
+
+class TestTenantKernel:
+    """make_tenant_megastep == K independent solo megasteps."""
+
+    settings = ADMMSettings(max_iter=120, restarts=2, check_every=4)
+
+    def _tenants(self, k=3):
+        """K same-family tenants with DIFFERENT numbers: scaled costs /
+        shifted bounds so each slot converges on its own trajectory."""
+        mesh = sharded.make_mesh(1)
+        batch = make_batch(3)
+        idx = batch.tree.nonant_indices
+        arr0 = sharded.shard_batch(batch, mesh)
+        arrs = [arr0, arr0._replace(c=arr0.c * 1.07),
+                arr0._replace(c=arr0.c * 0.93)][:k]
+        prepped = [_prep(a, idx, self.settings, mesh) for a in arrs]
+        return mesh, idx, arrs, prepped
+
+    def test_batched_vs_solo_parity_k3(self):
+        mesh, idx, arrs, prepped = self._tenants(3)
+        N = 5
+        S = arrs[0].c.shape[0]
+        refs = [_solo_run(idx, self.settings, mesh, st, a, f, N)
+                for a, (st, f) in zip(arrs, prepped)]
+        tm = sharded.make_tenant_megastep(idx, self.settings, n_iters=N,
+                                          donate=False)
+        sts, packed = tm(tuple(st for st, _ in prepped), tuple(arrs),
+                         1.0, tuple(f for _, f in prepped),
+                         np.full(3, -1.0), np.full(3, N), np.inf,
+                         np.ones(3, bool))
+        assert len(np.asarray(packed)) == \
+            sharded.tenant_megastep_measure_len(N, S, 3)
+        m = sharded.tenant_megastep_unpack(np.asarray(packed), N, S, 3)
+        for t, (s_ref, m_ref) in enumerate(refs):
+            assert m["executed"][t] == m_ref["executed"]
+            assert float(jnp.max(jnp.abs(sts[t].W - s_ref.W))) <= 1e-9
+            assert float(jnp.max(jnp.abs(sts[t].xbars
+                                         - s_ref.xbars))) <= 1e-9
+            np.testing.assert_allclose(m["conv"][t], m_ref["conv"],
+                                       atol=1e-9)
+            np.testing.assert_allclose(m["pri"][t], m_ref["pri"],
+                                       atol=1e-9)
+        # the mixed population really is mixed: trajectories differ
+        assert float(jnp.max(jnp.abs(sts[0].xbars - sts[1].xbars))) > 1e-6
+
+    def test_ghost_slot_inert(self):
+        mesh, idx, arrs, prepped = self._tenants(2)
+        N = 4
+        S = arrs[0].c.shape[0]
+        tm = sharded.make_tenant_megastep(idx, self.settings, n_iters=N,
+                                          donate=False)
+        sts, packed = tm(tuple(st for st, _ in prepped), tuple(arrs),
+                         1.0, tuple(f for _, f in prepped),
+                         np.full(2, -1.0), np.full(2, N), np.inf,
+                         np.array([True, False]))
+        m = sharded.tenant_megastep_unpack(np.asarray(packed), N, S, 2)
+        st1 = prepped[1][0]
+        assert m["executed"][1] == 0
+        assert not np.any(m["conv"][1])
+        # BITWISE passthrough: the dead branch never touches the slot
+        for name in ("W", "xbars", "x", "z", "y"):
+            a, b = getattr(sts[1], name), getattr(st1, name)
+            assert float(jnp.max(jnp.abs(a - b))) == 0.0, name
+        # the live sibling is unperturbed by the ghost: exact solo
+        s_ref, m_ref = _solo_run(idx, self.settings, mesh, prepped[0][0],
+                                 arrs[0], prepped[0][1], N)
+        assert m["executed"][0] == m_ref["executed"]
+        assert float(jnp.max(jnp.abs(sts[0].W - s_ref.W))) <= 1e-9
+
+    def test_early_stop_isolation(self):
+        """Per-tenant convergence masks: slot 1 stops after iteration 1
+        (huge convthresh) while slot 0 runs the full window — slot 0's
+        trajectory must equal its solo run exactly."""
+        mesh, idx, arrs, prepped = self._tenants(2)
+        N = 5
+        S = arrs[0].c.shape[0]
+        tm = sharded.make_tenant_megastep(idx, self.settings, n_iters=N,
+                                          donate=False)
+        sts, packed = tm(tuple(st for st, _ in prepped), tuple(arrs),
+                         1.0, tuple(f for _, f in prepped),
+                         np.array([-1.0, 1e30]), np.full(2, N), np.inf,
+                         np.ones(2, bool))
+        m = sharded.tenant_megastep_unpack(np.asarray(packed), N, S, 2)
+        assert m["executed"][1] == 1          # stopped by its own mask
+        assert m["executed"][0] == N          # sibling ran the window
+        s_ref, _ = _solo_run(idx, self.settings, mesh, prepped[0][0],
+                             arrs[0], prepped[0][1], N)
+        assert float(jnp.max(jnp.abs(sts[0].W - s_ref.W))) <= 1e-9
+        s1_ref, _ = _solo_run(idx, self.settings, mesh, prepped[1][0],
+                              arrs[1], prepped[1][1], N,
+                              convthresh=1e30)
+        assert float(jnp.max(jnp.abs(sts[1].W - s1_ref.W))) <= 1e-9
+
+    def test_divergence_freeze_parity(self):
+        """An impossible acceptance tol rejects the frozen iterate: the
+        batched kernel must discard it exactly as the solo kernel does
+        (refresh_hit, state parity) for every slot independently."""
+        mesh, idx, arrs, prepped = self._tenants(2)
+        N = 3
+        S = arrs[0].c.shape[0]
+        tm = sharded.make_tenant_megastep(idx, self.settings, n_iters=N,
+                                          donate=False)
+        sts, packed = tm(tuple(st for st, _ in prepped), tuple(arrs),
+                         1.0, tuple(f for _, f in prepped),
+                         np.full(2, -1.0), np.full(2, N), 1e-300,
+                         np.ones(2, bool))
+        m = sharded.tenant_megastep_unpack(np.asarray(packed), N, S, 2)
+        for t in range(2):
+            s_ref, m_ref = _solo_run(idx, self.settings, mesh,
+                                     prepped[t][0], arrs[t],
+                                     prepped[t][1], N, tol=1e-300)
+            assert bool(m["refresh_hit"][t]) == bool(m_ref["refresh_hit"])
+            assert m["executed"][t] == m_ref["executed"]
+            assert float(jnp.max(jnp.abs(sts[t].W - s_ref.W))) <= 1e-9
+            assert float(jnp.max(jnp.abs(sts[t].xbars
+                                         - s_ref.xbars))) <= 1e-9
+
+    def test_bound_packs_per_tenant(self):
+        """bounds=True returns ONE bound pack per tenant, each gated by
+        its own bound_live flag."""
+        mesh, idx, arrs, prepped = self._tenants(2)
+        N = 4
+        S = arrs[0].c.shape[0]
+        tm = sharded.make_tenant_megastep(idx, self.settings, n_iters=N,
+                                          donate=False, bounds=True)
+        _, packed = tm(tuple(st for st, _ in prepped), tuple(arrs),
+                       1.0, tuple(f for _, f in prepped),
+                       np.full(2, -1.0), np.full(2, N), np.inf,
+                       np.ones(2, bool), np.array([True, False]), 1e-3)
+        assert len(np.asarray(packed)) == \
+            sharded.tenant_megastep_measure_len(N, S, 2, bounds=True)
+        m = sharded.tenant_megastep_unpack(np.asarray(packed), N, S, 2,
+                                           bounds=True)
+        assert m["bound_computed"][0] and not m["bound_computed"][1]
+        assert np.isfinite(m["bound_outer"][0])
+        # tenants 0/1 differ in costs, so their outers must differ from
+        # a same-flags re-run on the swapped population — cheap check:
+        # the computed outer is the slot's own, not a shared reduction
+        assert m["bound_outer"][1] == 0.0     # gated-off slot: inert
+
+    def test_partition_rules_tenant_posture(self):
+        """Scenario-within-tenant: every tenant-posture spec leads with
+        an UNSHARDED tenant dim."""
+        from jax.sharding import PartitionSpec as P
+
+        for shared in (False, True):
+            solo = sharded.ph_partition_rules(shared=shared)
+            ten = sharded.ph_partition_rules(shared=shared, tenant=True)
+            assert len(solo) == len(ten)
+            for (rs, ss), (rt, st) in zip(solo, ten):
+                assert rs == rt
+                assert st == P(None, *ss)
+
+
+def _ingest(opt, n=3):
+    names = farmer.scenario_names_creator(n)
+    kw = farmer.kw_creator(num_scens=n)
+    return canonical_mod.ingest(names, farmer.scenario_creator, kw,
+                                options=opt)
+
+
+class TestRunner:
+    """BatchedFamilyRunner: certification, boundaries, attribution."""
+
+    OPT = {"defaultPHrho": 1.0, "PHIterLimit": 60, "convthresh": -1.0,
+           "in_wheel_bounds": True,
+           "xhat_looper_options": {"scen_limit": 3}}
+
+    def test_certifies_attributes_and_counters(self, tmp_path):
+        canon = _ingest(self.OPT)
+        runner = BatchedFamilyRunner(canon, self.OPT, k_slots=3)
+        j0 = metrics.value("batching.joins")
+        w0 = metrics.value("batching.windows")
+        g0 = metrics.value("batching.ghost_rows")
+        runner.admit("a", canon, str(tmp_path / "a"), 60, resume=False)
+        runner.admit("b", canon, str(tmp_path / "b"), 60, resume=False)
+        assert runner.free_slots() == 1
+        gaps = {}
+        for _ in range(20):
+            reps = runner.window()
+            for rid, rep in reps.items():
+                # attribution: equal live populations split the shared
+                # dispatch evenly; flops come from the tenant's model
+                assert rep["wall_s"] >= 0.0 and rep["flops"] > 0.0
+                if rep["rel_gap"] <= 1e-3:
+                    gaps[rid] = rep["rel_gap"]
+                    runner.complete(rid)
+            if not runner.live_rids():
+                break
+        assert set(gaps) == {"a", "b"}
+        assert all(np.isfinite(g) and g <= 1e-3 for g in gaps.values())
+        assert metrics.value("batching.joins") == j0 + 2
+        assert metrics.value("batching.windows") > w0
+        # the K=3 runner ran 2 live tenants: the third slot rode ghost
+        assert metrics.value("batching.ghost_rows") > g0
+
+    def test_evict_bank_readmit_resumes(self, tmp_path):
+        canon = _ingest(self.OPT)
+        runner = BatchedFamilyRunner(canon, self.OPT, k_slots=2)
+        d = str(tmp_path / "t")
+        runner.admit("t", canon, d, 60, resume=False)
+        for _ in range(2):
+            reps = runner.window()
+        pre = reps["t"]
+        e0 = metrics.value("batching.evictions")
+        banked_iter = runner.evict("t", bank=True)
+        assert metrics.value("batching.evictions") == e0 + 1
+        assert banked_iter == pre["iters"]
+        assert ck.latest_iteration(d) == banked_iter
+        assert not runner.has("t") and runner.free_slots() == 2
+        # boundary semantics: re-admit RESUMES the banked trajectory
+        info = runner.admit("t", canon, d, 60, resume=True)
+        assert info["resumed"] and info["iteration"] == banked_iter
+        tr = runner.tracker("t")
+        assert tr.best_outer >= pre["outer"] - 1e-9
+        for _ in range(20):
+            reps = runner.window()
+            if reps["t"]["rel_gap"] <= 1e-3:
+                break
+        assert reps["t"]["rel_gap"] <= 1e-3
+        assert reps["t"]["iters"] > banked_iter
+
+    def test_bound_tracker_hub_semantics(self):
+        tr = BoundTracker()
+        assert tr.gaps() == (float("inf"), float("inf"))
+        tr.outer_update(-110.0)
+        tr.outer_update(-120.0)           # worse outer: ignored (max)
+        tr.inner_update(-100.0)
+        tr.inner_update(-90.0)            # worse inner: ignored (min)
+        tr.outer_update(float("nan"))     # non-finite: ignored
+        abs_gap, rel_gap = tr.gaps()
+        assert abs_gap == pytest.approx(10.0)
+        assert rel_gap == pytest.approx(10.0 / 110.0)
+
+    def test_qos_ranks(self):
+        assert qos_rank("interactive") < qos_rank("standard")
+        assert qos_rank("standard") < qos_rank("batch")
+        assert qos_rank(None) == qos_rank("standard")
+        assert qos_rank("nonsense") == qos_rank("standard")
+
+
+def _req(rid, n=3, iters=60, deadline=None, **opts):
+    return SolveRequest(model="farmer", num_scens=n, request_id=rid,
+                        deadline_secs=deadline,
+                        options=dict({"PHIterLimit": iters}, **opts))
+
+
+class TestServerBatched:
+    """SolveServer(batch_slots=K): the scheduler half end to end."""
+
+    def test_end_to_end_join_warm_idempotent(self, tmp_path):
+        with SolveServer(work_dir=str(tmp_path), batch_slots=3,
+                         in_wheel_bounds=True, quantum_secs=300.0,
+                         linger_secs=0.0) as srv:
+            j0 = metrics.value("batching.joins")
+            rids = [srv.submit(_req(f"r{i}")) for i in range(3)]
+            # a STAGGERED same-family request must join the live batch
+            # (or a fresh one) rather than wait for a full drain
+            time.sleep(0.5)
+            rids.append(srv.submit(_req("r3")))
+            recs = [srv.result(r, timeout=300) for r in rids]
+            for rec in recs:
+                assert rec["status"] == "done"
+                assert rec["batched"] is True
+                assert rec["certified"], rec
+                assert rec["rel_gap"] <= 1e-3 + 1e-12
+                assert rec["attributed_flops"] > 0.0
+            # every member after the leader binds the batch's programs:
+            # warm with ZERO aot misses (the satellite-1 contract)
+            assert not recs[0]["warm_hit"]
+            for rec in recs[1:]:
+                assert rec["warm_hit"] and rec["aot_misses"] == 0
+            assert metrics.value("batching.joins") >= j0 + 4
+            # per-request certified gaps match the family golden: all
+            # tenants solved the same numbers, so equal gaps
+            assert recs[1]["rel_gap"] == pytest.approx(
+                recs[0]["rel_gap"], rel=1e-9)
+            # duplicate submit stays idempotent
+            assert srv.submit(_req("r0")) == "r0"
+            assert srv.result("r0", timeout=5)["status"] == "done"
+
+    def test_deadline_evicts_slot_not_batch(self, tmp_path):
+        """A deadline crossing evicts ONLY the expiring tenant's slot —
+        its state banked, error_code='deadline' — while the sibling
+        keeps running in the batch and completes certified."""
+        with SolveServer(work_dir=str(tmp_path), batch_slots=2,
+                         in_wheel_bounds=True, quantum_secs=300.0,
+                         linger_secs=0.0) as srv:
+            # warm the family first so the deadline races WINDOWS, not
+            # the one-time program build
+            srv.result(srv.submit(_req("warmup")), timeout=300)
+            doomed = srv.submit(_req("doomed", iters=100000,
+                                     rel_gap=1e-12, deadline=4.0))
+            ok = srv.submit(_req("ok"))
+            rec_ok = srv.result(ok, timeout=300)
+            rec_dl = srv.result(doomed, timeout=300)
+            assert rec_ok["status"] == "done" and rec_ok["certified"]
+            assert rec_ok["batched"] is True
+            assert rec_dl["status"] == "failed"
+            assert rec_dl["error_code"] == "deadline"
+            assert not rec_dl["certified"]
+            assert rec_dl["batched"] is True
+            assert rec_dl["iters"] > 0
+            # the evicted slot banked through the checkpoint seam
+            d = srv._tenants[doomed].dir
+            assert ck.latest_iteration(d) is not None
+
+    def test_killed_batched_server_recovers_each_slot(self, tmp_path):
+        """PR-13 composition: shutdown(wait=False) mid-batch parks every
+        member from its own banked slice; a recovering server resumes
+        each (batched again), bounds monotone, PHIterLimit total."""
+        work = str(tmp_path)
+        limit = 1200
+        kw = dict(batch_slots=2, in_wheel_bounds=True,
+                  quantum_secs=600.0, linger_secs=0.0)
+        with SolveServer(work_dir=work, **kw) as srv:
+            r1 = srv.submit(_req("k1", iters=limit, rel_gap=1e-12))
+            r2 = srv.submit(_req("k2", iters=limit, rel_gap=1e-12))
+            t1, t2 = srv._tenants[r1], srv._tenants[r2]
+            deadline = time.monotonic() + 240
+            while ((t1.record["iters"] == 0 or t2.record["iters"] == 0)
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert t1.record["iters"] > 0 and t2.record["iters"] > 0
+            srv.shutdown(wait=False)
+            assert t1.status == "parked" and t2.status == "parked"
+            park = {r1: t1.record["iters"], r2: t2.record["iters"]}
+            outer = {r1: t1.record["outer"], r2: t2.record["outer"]}
+        # each slot banked its OWN slice
+        for rid, t in ((r1, t1), (r2, t2)):
+            assert ck.latest_iteration(t.dir) == park[rid]
+
+        srv2 = SolveServer.recover_from(work, **kw)
+        try:
+            for rid in (r1, r2):
+                rec = srv2.result(rid, timeout=300)
+                assert rec["status"] == "done"
+                assert rec["recovered"] == "warm"
+                assert rec["batched"] is True
+                assert rec["slices"] >= 2
+                # PHIterLimit is TOTAL across the restart
+                assert rec["iters"] == limit
+                assert not rec["certified"]    # 1e-12 is unreachable
+                assert rec["bounds_monotone"]
+                assert rec["outer"] >= outer[rid] - 1e-9
+        finally:
+            srv2.shutdown()

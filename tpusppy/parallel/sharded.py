@@ -138,7 +138,7 @@ class PHStepOut(NamedTuple):
 # per-scenario leaf is the difference between O(S/ndev) and O(S) HBM.
 # ---------------------------------------------------------------------------
 def ph_partition_rules(axis: str = "scen", row_axis: str | None = None,
-                       shared: bool = False) -> list:
+                       shared: bool = False, tenant: bool = False) -> list:
     """[(leaf-path regex, PartitionSpec)] for one mesh posture.
 
     ``shared``: the batch carries one (m, n) ``A_shared`` — A is replicated
@@ -146,6 +146,16 @@ def ph_partition_rules(axis: str = "scen", row_axis: str | None = None,
     row-state leaves sharded on both axes); dense per-scenario batches
     shard A's leading scenario axis like every other leaf.  First match
     wins, so the specific rows precede the catch-all scenario rule.
+
+    ``tenant``: the TENANT-BATCHED posture (continuous batching,
+    doc/serving.md): leaves carry a leading tenant axis — (T, S, ...)
+    instead of (S, ...) — and sharding is SCENARIO-WITHIN-TENANT: the
+    tenant axis is never partitioned (each slot's scenario rows must stay
+    whole so per-tenant masked reductions never cross a device boundary
+    mid-slot), the scenario axis shards exactly as in the solo posture.
+    Every scenario-leading spec gains a leading ``None``; engine-shaped
+    leaves (a replicated shared A) are tenant-stacked but otherwise
+    unchanged.
     """
     scen = P(axis)
     if shared:
@@ -153,7 +163,7 @@ def ph_partition_rules(axis: str = "scen", row_axis: str | None = None,
         row = P(axis, row_axis) if row_axis else scen
     else:
         A_spec, row = scen, scen
-    return [
+    rules = [
         # constraint matrix: the one leaf whose layout depends on the
         # engine (dense stack / replicated shared / SparseA sub-leaves)
         (r"(^|/)A(/|$)", A_spec),
@@ -163,6 +173,13 @@ def ph_partition_rules(axis: str = "scen", row_axis: str | None = None,
         (r"(^|/)(c|q2|lb|ub|const|probs|onehot|nid_sk)$", scen),
         (r"(^|/)(W|xbars|rho|x|yx)$", scen),
     ]
+    if tenant:
+        # scenario-within-tenant: prepend an UNSHARDED tenant dim to every
+        # spec that leads with the scenario axis (the engine-dependent A
+        # spec keeps its own layout — a tenant-stacked replicated A simply
+        # gains an unsharded leading dim through the same transform)
+        rules = [(r, P(None, *s)) for r, s in rules]
+    return rules
 
 
 def _leaf_path(path) -> str:
@@ -197,14 +214,16 @@ def match_partition_rules(rules, tree):
 
 
 def ph_shardings(mesh: Mesh, tree, axis: str = "scen",
-                 row_axis: str | None = None, shared: bool = False):
+                 row_axis: str | None = None, shared: bool = False,
+                 tenant: bool = False):
     """Pytree of :class:`NamedSharding` for ``tree`` (a PHArrays, a
     PHState, or any sub-pytree of their leaves) under the placement
     table.  THE single source of wheel-state placement: shard_batch,
     init_state and the shard-read checkpoint restore all derive their
-    shardings here, so they cannot drift."""
+    shardings here, so they cannot drift.  ``tenant`` selects the
+    scenario-within-tenant posture for (T, S, ...)-stacked trees."""
     specs = match_partition_rules(
-        ph_partition_rules(axis, row_axis, shared), tree)
+        ph_partition_rules(axis, row_axis, shared, tenant), tree)
     return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                         is_leaf=lambda x: isinstance(x, P))
 
@@ -1501,6 +1520,249 @@ def make_bucketed_wheel_megastep(nonant_idx: np.ndarray,
                          None if m is None else aot_cache.array_digest(m)
                          for m in int_cols_masks))
                     if int_sweep else None)
+                   if bounds else None,
+                   aot_cache.array_digest(nonant_idx)))
+
+
+def tenant_megastep_measure_len(n_iters: int, S: int, n_tenants: int,
+                                bounds: bool = False) -> int:
+    """Length of the packed TENANT-BATCHED measurement
+    (:func:`make_tenant_megastep`): per-tenant per-iteration stat blocks
+    (``6 * n_iters`` each, tenant-major), per-tenant ``executed``/
+    ``refresh`` scalars, the per-tenant final-iterate ``pri``/``dua``/
+    ``done`` diagnostics, and — with ``bounds=True`` — ONE
+    :data:`BOUND_PACK_LEN` bound pack PER TENANT (per-tenant masked
+    certification; the tenant kernel never compiles the integer sweep —
+    integer-sweep families are gated to solo time-slicing).
+
+    The pack is LEAN by construction (the big-S wheel posture): x/W/xbars
+    stay in the returned per-slot device states, fetched explicitly at
+    join/evict/termination boundaries."""
+    return n_tenants * (6 * n_iters + 2 + 3 * S) \
+        + (n_tenants * BOUND_PACK_LEN if bounds else 0)
+
+
+def tenant_megastep_unpack(vec, n_iters: int, S: int, n_tenants: int,
+                           bounds: bool = False) -> dict:
+    """Split a fetched :func:`make_tenant_megastep` measurement into
+    PER-TENANT lists (index = slot): ``conv``/``eobj``/``pri_max``/
+    ``dua_max``/``iters``/``all_done`` are lists of length-``n_iters``
+    arrays, ``executed``/``refresh_hit`` lists of scalars, ``pri``/
+    ``dua``/``done`` lists of (S,) arrays; ``bounds=True`` adds
+    ``bound_computed``/``bound_outer``/``bound_inner_obj``/
+    ``bound_inner_feas``/``bound_sweeps`` lists (each tenant's own
+    in-wheel bound pack).  Ghost/dead slots come back as inert zeros
+    (``executed == 0``)."""
+    vec = np.asarray(vec)
+    N, T = n_iters, n_tenants
+    out = {k: [] for k in ("conv", "eobj", "pri_max", "dua_max", "iters",
+                           "all_done", "executed", "refresh_hit",
+                           "pri", "dua", "done")}
+    off = 0
+    for _t in range(T):
+        per = vec[off:off + 6 * N].reshape(6, N)
+        off += 6 * N
+        out["conv"].append(per[0])
+        out["eobj"].append(per[1])
+        out["pri_max"].append(per[2])
+        out["dua_max"].append(per[3])
+        out["iters"].append(per[4])
+        out["all_done"].append(per[5] != 0.0)
+        out["executed"].append(int(vec[off]))
+        out["refresh_hit"].append(bool(vec[off + 1]))
+        off += 2
+        out["pri"].append(vec[off:off + S])
+        out["dua"].append(vec[off + S:off + 2 * S])
+        out["done"].append(vec[off + 2 * S:off + 3 * S] != 0.0)
+        off += 3 * S
+    if bounds:
+        for k in ("bound_computed", "bound_outer", "bound_inner_obj",
+                  "bound_inner_feas", "bound_sweeps"):
+            out[k] = []
+        for _t in range(T):
+            tail = vec[off:off + BOUND_PACK_LEN]
+            off += BOUND_PACK_LEN
+            out["bound_computed"].append(bool(tail[0]))
+            out["bound_outer"].append(float(tail[1]))
+            out["bound_inner_obj"].append(float(tail[2]))
+            out["bound_inner_feas"].append(float(tail[3]))
+            out["bound_sweeps"].append(float(tail[4]))
+    return out
+
+
+def make_tenant_megastep(nonant_idx: np.ndarray, settings: ADMMSettings,
+                         n_iters: int = 8, donate: bool = True,
+                         axis: str = "scen", bounds: bool = False,
+                         int_nonants: np.ndarray | None = None,
+                         xhat_threshold: float = 0.5):
+    """ONE jitted program running up to ``n_iters`` frozen wheel
+    iterations for K ISOMORPHIC TENANTS AT ONCE — the continuous-batching
+    megakernel (ROADMAP item 2, doc/serving.md "Continuous batching").
+
+    Where the bucketed kernel (:func:`make_bucketed_wheel_megastep`)
+    couples its slots through a shared scenario tree, the tenant kernel
+    keeps every slot a FULLY INDEPENDENT wheel: per-slot
+    :class:`PHState`/:class:`PHArrays`/factors tuples (all the same
+    shape family, so ONE compile serves any tenant mix of that family —
+    the AOT key is effectively (family, K) via the tuple avals), and
+    every reduction — xbar/W onehot contractions, the early-exit/
+    acceptance masks, the in-wheel bound pack — is PER-TENANT masked:
+    slot ``t``'s block solve, ``_ph_finish`` outer update, acceptance
+    test ``ok_t``, convergence stop and bound pass read ONLY slot ``t``'s
+    arrays.  A tenant's trajectory inside a K-batch is therefore the
+    EXACT solo-megastep computation on its own state (the 1e-9 batched-
+    vs-solo parity contract, pinned by tests/test_batching.py); the
+    throughput win is K wheels sharing one dispatch + one host fetch per
+    window instead of K park/resume/sync cycles.
+
+    Per-slot liveness: ``live_mask[t]`` False is a GHOST SLOT — the
+    slot's rows ride the program inert (dead ``lax.cond`` branch, zero
+    stats, state passthrough), exactly like ghost scenarios pad an
+    uneven mesh.  A finished/evicted tenant's slot goes ghost until the
+    scheduler backfills it at a window boundary (join = write fresh
+    state/arrays into the slot; evict = bank the slot's W/xbars/rho
+    through the checkpoint seam).  ``convthresh``/``n_live``/
+    ``bound_live`` are (K,) per-tenant — one tenant stopping (or
+    skipping its bound cadence) never perturbs a sibling's masks.
+
+    ``bounds=True`` appends ONE :data:`BOUND_PACK_LEN` pack PER TENANT
+    (each slot's own :func:`_bound_pass_terms` under its own traced
+    ``bound_live[t]`` flag) — per-tenant in-wheel certification under
+    the batched source char ('B', service/batching.py).  The tenant
+    kernel does NOT compile the batched integer sweep: integer-sweep
+    families are gated to solo time-slicing by the scheduler (the
+    sweep's global argmin semantics have no per-tenant masked form).
+
+    Returns ``mega(states, arrs, prox_on, factors, convthresh, n_live,
+    accept_tol, live_mask) -> (states, packed)`` over K-tuples of
+    per-slot :class:`PHState` / :class:`PHArrays` / factors, with
+    (K,)-shaped ``convthresh``/``n_live``/``live_mask``; ``bounds=True``
+    adds trailing ``(bound_live, feas_tol)`` with (K,) ``bound_live``.
+    Unpack with :func:`tenant_megastep_unpack`.
+    """
+    if n_iters < 1:
+        raise ValueError(f"n_iters ({n_iters}) must be >= 1")
+    idx = jnp.asarray(nonant_idx)
+    int_mask = (None if int_nonants is None
+                else np.asarray(int_nonants, dtype=bool))
+    _, shared_frozen, _, frozen_solve = _solver_fns_for(
+        settings, None, axis)
+
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def mega(states, arrs, prox_on, factors, convthresh, n_live,
+             accept_tol, live_mask, bound_live=None, feas_tol=1e-3):
+        dt = settings.jdtype()
+        T = len(states)
+        n_live_t = jnp.asarray(n_live, jnp.int32)
+        thresh = jnp.asarray(convthresh, dt)
+        tol = jnp.asarray(accept_tol, dt)
+        live_m = jnp.asarray(live_mask, bool)
+
+        def body(carry, k):
+            sts, pris, duas, dones, exs, stps, rfs = carry
+            new_sts, new_pris, new_duas, new_dones = [], [], [], []
+            new_exs, new_stps, new_rfs, stats_rows = [], [], [], []
+            for t in range(T):
+                arr = arrs[t]
+                fsolve = (shared_frozen if arr.A.ndim == 2
+                          else frozen_solve)
+
+                # the solo megastep's live_fn, verbatim, on slot t only —
+                # per-tenant masked isolation is BY CONSTRUCTION: no
+                # cross-slot array ever enters this closure
+                def live_fn(op, arr=arr, fsolve=fsolve, t=t,
+                            fac=factors[t]):
+                    st, pri, dua, done_s, ex, stp, rf = op
+                    q, q2, W, rho = _ph_objective(arr, st, prox_on, idx,
+                                                  settings)
+                    sol = fsolve(q, q2, arr.A, arr.cl, arr.cu, arr.lb,
+                                 arr.ub, st.x, st.z, st.y, st.yx, fac)
+                    ok = jnp.all(sol.done) | jnp.all(
+                        (sol.pri_res <= tol) & (sol.dua_res <= tol))
+                    new_st, out = _ph_finish(arr, st, sol, W, rho, idx)
+                    stats = jnp.stack([
+                        out.conv.astype(dt), out.eobj.astype(dt),
+                        jnp.max(sol.pri_res).astype(dt),
+                        jnp.max(sol.dua_res).astype(dt),
+                        jnp.max(sol.iters).astype(dt),
+                        jnp.all(sol.done).astype(dt)])
+                    sel = lambda a, b: jnp.where(ok, a, b)
+                    new_st = jax.tree.map(sel, new_st, st)
+                    return ((new_st, sel(sol.pri_res, pri),
+                             sel(sol.dua_res, dua), sel(sol.done, done_s),
+                             ex + ok.astype(jnp.int32),
+                             stp | (ok & (out.conv < thresh[t])) | ~ok,
+                             rf | ~ok),
+                            stats)
+
+                def dead_fn(op):
+                    return op, jnp.zeros((6,), dt)
+
+                live_t = live_m[t] & (~stps[t]) & (k < n_live_t[t])
+                (st2, pri2, dua2, done2, ex2, stp2, rf2), stats_t = \
+                    jax.lax.cond(
+                        live_t, live_fn, dead_fn,
+                        (sts[t], pris[t], duas[t], dones[t], exs[t],
+                         stps[t], rfs[t]))
+                new_sts.append(st2)
+                new_pris.append(pri2)
+                new_duas.append(dua2)
+                new_dones.append(done2)
+                new_exs.append(ex2)
+                new_stps.append(stp2)
+                new_rfs.append(rf2)
+                stats_rows.append(stats_t)
+            return ((tuple(new_sts), tuple(new_pris), tuple(new_duas),
+                     tuple(new_dones), tuple(new_exs), tuple(new_stps),
+                     tuple(new_rfs)), jnp.stack(stats_rows))
+
+        infs = tuple(jnp.full((arr.c.shape[0],), jnp.inf, dt)
+                     for arr in arrs)
+        falses = tuple(jnp.zeros((arr.c.shape[0],), bool) for arr in arrs)
+        zeros_i = tuple(jnp.zeros((), jnp.int32) for _ in arrs)
+        zeros_b = tuple(jnp.zeros((), bool) for _ in arrs)
+        carry0 = (states, infs, infs, falses, zeros_i, zeros_b, zeros_b)
+        (sts, pris, duas, dones, exs, _, rfs), stats = jax.lax.scan(
+            body, carry0, jnp.arange(n_iters, dtype=jnp.int32))
+        # stats is (n_iters, T, 6); pack tenant-major so each tenant's
+        # block reads exactly like a solo measurement prefix
+        parts = []
+        for t in range(T):
+            parts += [stats[:, t, :].T.reshape(-1),
+                      exs[t].astype(dt)[None], rfs[t].astype(dt)[None],
+                      pris[t].astype(dt), duas[t].astype(dt),
+                      dones[t].astype(dt)]
+        if bounds:
+            bl = jnp.asarray(
+                jnp.zeros((T,), bool) if bound_live is None else
+                bound_live, bool)
+            for t in range(T):
+                arr = arrs[t]
+                fsolve = (shared_frozen if arr.A.ndim == 2
+                          else frozen_solve)
+
+                def bounds_on(stf, arr=arr, fsolve=fsolve, t=t,
+                              fac=factors[t]):
+                    outer, inner, feas, sweeps = _bound_pass_terms(
+                        arr, stf, idx, settings, fsolve, fac,
+                        feas_tol, int_mask, xhat_threshold)
+                    return jnp.stack(
+                        [jnp.ones((), dt), outer, inner, feas, sweeps])
+
+                parts.append(jax.lax.cond(
+                    bl[t] & live_m[t], bounds_on,
+                    lambda _: jnp.zeros((BOUND_PACK_LEN,), dt), sts[t]))
+        return sts, jnp.concatenate(parts)
+
+    # AOT key: the slot count K rides the call signature (tuple avals),
+    # so the cache key is effectively (family, K) — one compile serves
+    # any tenant mix of the family at that K
+    return aot_cache.cached_program(
+        mega, "tenant_megastep",
+        key_extra=(settings, n_iters, bool(donate), axis,
+                   (float(xhat_threshold),
+                    None if int_mask is None
+                    else aot_cache.array_digest(int_mask))
                    if bounds else None,
                    aot_cache.array_digest(nonant_idx)))
 

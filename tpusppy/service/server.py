@@ -135,11 +135,17 @@ class SolveRequest:
         checkpoint banked) instead of burning scheduler quantum forever.
         The deadline is absolute — it keeps ticking across server
         restarts.
+      qos: QoS class ("interactive" < "standard" < "batch") — decides
+        SLOT ASSIGNMENT when several same-family tenants compete for a
+        continuous-batching slot (doc/serving.md "Continuous batching");
+        ties keep submission order, so same-class requests retain FIFO
+        semantics.  Scheduler-side only (popped from the canonical
+        settings key like rel_gap).
     """
 
     def __init__(self, model="farmer", num_scens=3, creator_kwargs=None,
                  options=None, request_id=None, scenario_creator=None,
-                 names=None, deadline_secs=None):
+                 names=None, deadline_secs=None, qos=None):
         self.model = str(model)
         self.num_scens = int(num_scens)
         self.creator_kwargs = dict(creator_kwargs or {})
@@ -154,6 +160,9 @@ class SolveRequest:
             deadline_secs = self.options.get("deadline_secs")
         self.deadline_secs = (None if deadline_secs is None
                               else float(deadline_secs))
+        if qos is None:
+            qos = self.options.get("qos")
+        self.qos = str(qos or "standard")
 
     @classmethod
     def from_dict(cls, d: dict) -> "SolveRequest":
@@ -162,7 +171,8 @@ class SolveRequest:
                    creator_kwargs=d.get("creator_kwargs"),
                    options=d.get("options"),
                    request_id=d.get("request_id"),
-                   deadline_secs=d.get("deadline_secs"))
+                   deadline_secs=d.get("deadline_secs"),
+                   qos=d.get("qos"))
 
     def to_dict(self) -> dict:
         """The journal/wire form.  Custom in-process creators are NOT
@@ -172,7 +182,8 @@ class SolveRequest:
                 "creator_kwargs": dict(self.creator_kwargs),
                 "options": dict(self.options),
                 "request_id": self.request_id,
-                "deadline_secs": self.deadline_secs}
+                "deadline_secs": self.deadline_secs,
+                "qos": self.qos}
 
 
 def _blank_record(rid, model, family, fingerprint) -> dict:
@@ -192,6 +203,10 @@ def _blank_record(rid, model, family, fingerprint) -> dict:
         "inner": None, "outer": None, "certified": False,
         "bounds_monotone": True, "error": None, "error_code": None,
         "recovered": None,
+        # continuous batching (doc/serving.md): QoS class, whether any
+        # execution ran inside a fused tenant batch, and the tenant's
+        # live-row share of the shared dispatches' model FLOPs
+        "qos": "standard", "batched": False, "attributed_flops": 0.0,
     }
 
 
@@ -226,6 +241,7 @@ class _Tenant:
         self.record = _blank_record(self.id, req.model,
                                     canon.family_digest,
                                     canon.fingerprint[:12])
+        self.record["qos"] = req.qos
 
     def past_deadline(self) -> bool:
         return self.deadline_at is not None and time.time() > self.deadline_at
@@ -303,12 +319,20 @@ class SolveServer:
         loudly (``service.recovered_cold``), and finished tenants'
         records stay fetchable by id.  :meth:`recover_from` is the
         explicit spelling.
+      batch_slots: continuous batching (doc/serving.md): K > 1 fuses up
+        to K concurrent SAME-FAMILY self-certifying tenants into one
+        tenant-batched megastep (``service/batching.py``) — joins and
+        evictions at window boundaries, per-tenant trajectories exactly
+        the solo wheel's.  None/1 keeps pure time-slicing.  A banked
+        "batched" tune verdict (``tune.batched_verdict``) CLAMPS K per
+        family when one exists.
     """
 
     def __init__(self, work_dir=None, quantum_secs=5.0, rel_gap=1e-3,
                  linger_secs=30.0, arm_caches=True, max_queue=None,
                  checkpoint_every_secs=20.0, recover=False,
-                 in_wheel_bounds=False, _start_executor=True):
+                 in_wheel_bounds=False, batch_slots=None,
+                 _start_executor=True):
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="tpusppy_srv_")
         os.makedirs(os.path.join(self.work_dir, "tenants"), exist_ok=True)
         self.quantum_secs = float(quantum_secs)
@@ -321,6 +345,8 @@ class SolveServer:
         # Server default; a request option "in_wheel_bounds" overrides
         # per tenant.
         self.in_wheel_bounds = bool(in_wheel_bounds)
+        self.batch_slots = (None if not batch_slots or int(batch_slots) < 2
+                            else int(batch_slots))
         self.max_queue = None if max_queue is None else int(max_queue)
         self.checkpoint_every_secs = float(checkpoint_every_secs)
         self._cv = threading.Condition()
@@ -632,7 +658,8 @@ class SolveServer:
         })
         opt_options.update(req.options)
         # hub-side knobs must not leak into the canonical settings key
-        for k in ("rel_gap", "abs_gap", "linger_secs", "deadline_secs"):
+        for k in ("rel_gap", "abs_gap", "linger_secs", "deadline_secs",
+                  "qos"):
             opt_options.pop(k, None)
         # the server-level self-certifying default resolves HERE so the
         # family key sees the effective value (a request that rode a
@@ -911,7 +938,10 @@ class SolveServer:
                         break
                     self._cv.wait()
             try:
-                self._run_slice(tenant)
+                if self._batch_viable(tenant):
+                    self._run_batch(tenant)
+                else:
+                    self._run_slice(tenant)
             except Exception as e:         # a tenant failure never kills
                 _CTR_FAILED.inc(1)         # the server
                 _log.warning("request %s failed: %r", tenant.id, e)
@@ -1043,6 +1073,27 @@ class SolveServer:
         return (fits(S, n, m, fb)
                 and segmented.megastep_cap(S, n, m, st, factor_batch=fb,
                                            bound_pass=True) >= 2)
+
+    def _batch_viable(self, t: _Tenant) -> bool:
+        """Whether this tenant may run inside a fused tenant batch
+        (doc/serving.md "Continuous batching").  The batched runner is
+        the SELF-CERTIFYING wheel generalized over a tenant axis, so the
+        gate is the in-wheel gate plus the batch-specific exclusions:
+        homogeneous batches only (the tenant kernel carries one shape
+        per slot, not a bucket tuple), and no integer nonants (the
+        batched integer sweep's global-argmin semantics have no
+        per-tenant masked form — integer families keep time-slicing).
+        """
+        from ..ir import BucketedBatch
+
+        if self.batch_slots is None or t.canonical is None:
+            return False
+        b = t.canonical.batch
+        if isinstance(b, BucketedBatch):
+            return False
+        if np.asarray(b.is_int, bool).any():
+            return False
+        return self._tenant_in_wheel(t) and self._in_wheel_viable(t)
 
     def _build_wheel(self, t: _Tenant, preempt_check, on_iter0_done):
         """Hub/spoke dicts for one slice of one tenant — the standard
@@ -1258,3 +1309,311 @@ class SolveServer:
                   "%d compiles)", t.id, rel_gap, rec["wall_s"], t.slices,
                   int(rec["aot_misses"]))
         t.done.set()
+
+    # ---- continuous batching ------------------------------------------------
+    def _run_batch(self, leader):
+        """One BATCHED slice: fuse up to ``batch_slots`` same-family
+        tenants into one tenant-batched megastep wheel (doc/serving.md
+        "Continuous batching").
+
+        The leader constructs the
+        :class:`~tpusppy.service.batching.BatchedFamilyRunner`; queued
+        same-family tenants JOIN free slots at window boundaries in QoS
+        order, a finishing/expiring tenant EVICTS only its own slot
+        (banked through the checkpoint seam), and the freed slot
+        backfills from the queue.  Each window report carries the
+        tenant's live-row-fraction share of the shared dispatch, so SLO
+        records stay comparable with the time-sliced path.  The batch
+        as a whole is ONE device occupant: a waiting DIFFERENT-family
+        tenant preempts it at the quantum exactly like a solo slice,
+        parking every member.
+        """
+        from ..solvers import aot as _aot
+        from ..spbase import make_admm_settings
+        from .. import tune as _tune
+        from .batching import BatchedFamilyRunner, qos_rank
+
+        if leader.past_deadline():
+            self._finish_deadline(leader)
+            return
+
+        def mark_running(t, joiner):
+            t.status = "running"
+            t.record["status"] = "running"
+            self._journal_safe(t.id, "running", t.record)
+            if t.first_exec is None:
+                t.first_exec = time.monotonic()
+                if t.record["queue_wait_s"] is None:
+                    t.record["queue_wait_s"] = t.first_exec - t.submitted
+                    _HIST_QUEUE_WAIT.add(t.record["queue_wait_s"])
+                if t.record["warm_hit"] is None:
+                    if joiner:
+                        # a joiner binds the batch's ALREADY-BUILT fused
+                        # program — warm by construction, so the
+                        # follower contract (zero compiles) holds even
+                        # before any family member COMPLETES
+                        warm = True
+                    else:
+                        with self._cv:
+                            warm = t.family in self._families_done
+                    t.record["warm_hit"] = warm
+                    (_CTR_WARM_HITS if warm else _CTR_COLD_FAMILIES).inc(1)
+                    _log.info("request %s starts %s (batched)", t.id,
+                              "WARM" if warm else "cold")
+
+        mark_running(leader, joiner=False)
+        if leader.slices == 0 and not leader.record["warm_hit"]:
+            # same prewarm-before-compile window as _run_slice
+            if _aot.enabled():
+                _aot.prewarm()
+
+        # K: the server's slot count, clamped by a banked "batched" tune
+        # verdict for this family when one exists (the verdict is the
+        # largest K whose measured window cost fits the dispatch budget)
+        b = leader.canonical.batch
+        k = int(self.batch_slots)
+        try:
+            st = make_admm_settings(dict(leader.opt_options),
+                                    leader.canonical.bundling)
+            kv = _tune.batched_verdict(b.num_scenarios, b.num_vars,
+                                       b.num_rows, settings=st)
+        except Exception:
+            kv = None
+        if kv:
+            k = max(2, min(k, int(kv)))
+
+        members: dict = {}
+        slice_start = time.monotonic()
+
+        def fail(t, e):
+            _CTR_FAILED.inc(1)
+            _log.warning("request %s failed: %r", t.id, e)
+            t.status = "failed"
+            t.record.update(status="failed", error_code="exception",
+                            error=repr(e))
+            t.canonical = None
+            self._journal_safe(t.id, "failed", t.record)
+            with self._cv:
+                self._close_tenant_locked(t)
+            t.done.set()
+
+        def admit(t, joiner):
+            if t.past_deadline():
+                # expired while queued/parked: fail WITHOUT a slot
+                self._finish_deadline(t)
+                return False
+            if joiner:
+                mark_running(t, joiner=True)
+            try:
+                info = runner.admit(
+                    t.id, t.canonical, t.dir,
+                    int(t.opt_options.get("PHIterLimit", 200)),
+                    resume=t.slices > 0,
+                    best_inner=t.last_inner, best_outer=t.last_outer)
+            except Exception as e:
+                fail(t, e)
+                return False
+            t.slices += 1
+            t.record["slices"] = t.slices
+            t.record["batched"] = True
+            _CTR_SLICES.inc(1)
+            if info["resumed"]:
+                t.record["iters"] = int(info["iteration"])
+            if t.record["ttfi_s"] is None:
+                # admit ran Iter0 (or the resume seed) synchronously
+                t.record["ttfi_s"] = time.monotonic() - t.first_exec
+                _HIST_TTFI.add(t.record["ttfi_s"])
+            members[t.id] = t
+            return True
+
+        def pull_joiners():
+            free = runner.free_slots()
+            if free <= 0:
+                return []
+            with self._cv:
+                cand = [t2 for t2 in self._runq
+                        if t2.family == leader.family
+                        and self._batch_viable(t2)]
+                # QoS decides who takes a free slot (the PR-12 debt);
+                # ties break on submission order so same-class requests
+                # keep FIFO semantics
+                cand.sort(key=lambda t2: (qos_rank(t2.req.qos), t2.seq))
+                take = cand[:free]
+                for t2 in take:
+                    self._runq.remove(t2)
+                    t2.status = "running"
+            return take
+
+        def park(t, stopping):
+            t.record["iters"] = int(runner.evict(t.id, bank=True))
+            t.record["preemptions"] += 1
+            members.pop(t.id, None)
+            t.status = "parked"
+            t.record["status"] = "parked"
+            self._journal_safe(t.id, "parked", t.record)
+            if stopping:
+                # shutdown(wait=False): the evict WAS the drain — the
+                # tenant stays parked on disk, waiters unblock now
+                with self._cv:
+                    self._close_tenant_locked(t)
+                t.done.set()
+                _log.info("request %s left PARKED by shutdown "
+                          "(checkpoint banked at iter %d)", t.id,
+                          t.record["iters"])
+            else:
+                with self._cv:
+                    self._runq.append(t)
+                    self._cv.notify_all()
+                _log.info("request %s parked at iter %d (batched, "
+                          "slice %d)", t.id, t.record["iters"], t.slices)
+
+        def finish_deadline_slot(t):
+            # a deadline crossing evicts ONLY this tenant's slot at the
+            # window boundary (state banked, bounds harvested) — it
+            # never parks the rest of the batch
+            t.record["iters"] = int(runner.evict(t.id, bank=True))
+            t.record["preemptions"] += 1
+            members.pop(t.id, None)
+            self._finish_deadline(t)
+
+        def complete(t, certified):
+            runner.complete(t.id)
+            members.pop(t.id, None)
+            rec = t.record
+            t.status = "done"
+            rec["status"] = "done"
+            rec["wall_s"] = time.monotonic() - t.submitted
+            rec["certified"] = bool(certified)
+            _HIST_WALL.add(rec["wall_s"])
+            _CTR_COMPLETED.inc(1)
+            self._journal_safe(t.id, "done", rec)
+            with self._cv:
+                self._families_done.add(t.family)
+                self._close_tenant_locked(t)
+                self._cv.notify_all()
+            t.canonical = None
+            t.opt_options = None
+            t.creator = None
+            _log.info("request %s done (batched): gap %s in %.2fs "
+                      "(%d slice(s))", t.id, rec["rel_gap"],
+                      rec["wall_s"], t.slices)
+            t.done.set()
+
+        with _metrics.window() as w:
+            try:
+                runner = BatchedFamilyRunner(leader.canonical,
+                                             leader.opt_options, k)
+            except Exception as e:
+                _log.warning("request %s: batched runner unavailable "
+                             "(%r) — time-slicing instead", leader.id, e)
+                self._run_slice(leader)
+                return
+
+            # compile/AOT deltas attribute to the LEADER: it is the
+            # tenant whose admission triggered every program build the
+            # batch binds (joiners are warm by construction).
+            # Incremental against the window snapshot so repeated
+            # flushes never double-count.
+            attr = {"aot.compile_s": 0.0, "aot.hits": 0.0,
+                    "aot.misses": 0.0}
+
+            def flush_compile(rec):
+                for name, key in (("aot.compile_s", "compile_s"),
+                                  ("aot.hits", "aot_hits"),
+                                  ("aot.misses", "aot_misses")):
+                    d = w.delta(name) - attr[name]
+                    if d:
+                        rec[key] += d
+                        attr[name] += d
+
+            if not admit(leader, joiner=False):
+                return
+            for t2 in pull_joiners():
+                admit(t2, joiner=True)
+
+            last_bank = time.monotonic()
+            while members:
+                # (a) deadline crossings — per-slot evictions only
+                for t in [t for t in members.values()
+                          if t.past_deadline()]:
+                    finish_deadline_slot(t)
+                # (b) forced preemption / shutdown
+                with self._cv:
+                    stopping = self._stop and not self._drain
+                    forced = set(members) & self._force_preempt
+                    self._force_preempt -= forced
+                if stopping:
+                    for t in list(members.values()):
+                        park(t, stopping=True)
+                    break
+                for rid in forced:
+                    park(members[rid], stopping=False)
+                # (c) cross-family quantum preemption: the batch is one
+                # device occupant — same-family waiters JOIN instead
+                if (members
+                        and time.monotonic() - slice_start
+                        >= self.quantum_secs):
+                    with self._cv:
+                        other = any(o.family != leader.family
+                                    for o in self._runq)
+                    if other:
+                        for t in list(members.values()):
+                            park(t, stopping=False)
+                        break
+                # (d) backfill freed slots from the queue
+                for t2 in pull_joiners():
+                    admit(t2, joiner=True)
+                if not members:
+                    break
+                # (e) ONE fused window over every live slot
+                reports = runner.window()
+                flush_compile(leader.record)
+                # (f) mid-run durability cadence (solo parity: a server
+                # crash costs each member at most this much work)
+                now = time.monotonic()
+                if now - last_bank >= self.checkpoint_every_secs:
+                    last_bank = now
+                    for rid in list(members):
+                        try:
+                            runner.bank(rid)
+                        except Exception as e:
+                            _log.warning("mid-run bank failed for %s: "
+                                         "%r", rid, e)
+                for rid, rep in reports.items():
+                    t = members.get(rid)
+                    if t is None:
+                        continue
+                    rec = t.record
+                    rec["iters"] = int(rep["iters"])
+                    rec["exec_s"] += rep["wall_s"]
+                    rec["attributed_flops"] += rep["flops"]
+                    if rec["exec_s"] > 0:
+                        rec["iters_per_sec"] = (rec["iters"]
+                                                / rec["exec_s"])
+                    ob, ib = float(rep["outer"]), float(rep["inner"])
+                    tol = 1e-9 * max(1.0, abs(t.last_outer) if
+                                     np.isfinite(t.last_outer) else 1.0)
+                    if ob < t.last_outer - tol or ib > t.last_inner + tol:
+                        rec["bounds_monotone"] = False
+                        _log.warning(
+                            "request %s: bounds regressed across resume "
+                            "(outer %s -> %s, inner %s -> %s)", t.id,
+                            t.last_outer, ob, t.last_inner, ib)
+                    t.last_outer = max(t.last_outer, ob)
+                    t.last_inner = min(t.last_inner, ib)
+                    rec["outer"], rec["inner"] = ob, ib
+                    rec["rel_gap"] = float(rep["rel_gap"])
+                    target = float(t.req.options.get("rel_gap",
+                                                     self.rel_gap))
+                    hit = (np.isfinite(rep["rel_gap"])
+                           and rep["rel_gap"] <= target + 1e-12)
+                    if not hit and "abs_gap" in t.req.options:
+                        hit = (np.isfinite(rep["abs_gap"])
+                               and rep["abs_gap"] <= float(
+                                   t.req.options["abs_gap"]) + 1e-12)
+                    if hit or rep["exhausted"]:
+                        # budget exhaustion completes UNCERTIFIED, like
+                        # the solo path — re-parking a spent wheel
+                        # would churn forever
+                        complete(t, certified=hit)
+            flush_compile(leader.record)

@@ -127,6 +127,22 @@ def bound_pass_flops(S, n, m, sweeps, sparse_factor=1.0, n_evals=1):
         * (max(1, int(n_evals)) * max(float(sweeps), 1.0) + 1.0)
 
 
+def tenant_shares(rows):
+    """Live-row-fraction attribution weights for a SHARED dispatch
+    (doc/serving.md "Continuous batching"): one fused tenant-batched
+    megastep serves K tenants at once, and the shared wall/FLOP cost is
+    split ``share_t = rows_t / sum(rows)`` where ``rows_t`` is the
+    tenant's live row count weighted by the iterations it actually ran
+    (``S_t * max(1, executed_t)``; 0 for ghost slots).  Returns one
+    float per entry, summing to 1.0 over live tenants (all zeros ->
+    all-zero shares)."""
+    rows = [max(0.0, float(r)) for r in rows]
+    total = sum(rows)
+    if total <= 0.0:
+        return [0.0] * len(rows)
+    return [r / total for r in rows]
+
+
 def ph_iteration_flops(S, n, m, sweeps, refresh_every=16, restarts=1,
                        factor_batch=1, sparse_factor=1.0):
     """Model flops of one PH iteration, refresh cost amortized over the

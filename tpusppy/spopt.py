@@ -169,6 +169,35 @@ def dispatch_A(b):
     return b.A if A_shared is None else A_shared
 
 
+def mega_arrays_for_batch(b, dt, sparse="auto"):
+    """Device-resident :class:`~tpusppy.parallel.sharded.PHArrays` for
+    one HOMOGENEOUS ScenarioBatch, built WITHOUT an opt instance — the
+    standalone twin of :meth:`SPOpt._mega_arrays` for callers that own
+    no PHBase (the continuous-batching runner,
+    :mod:`tpusppy.service.batching`, builds one per tenant slot).  Rides
+    the same content-keyed device-A cache (``_device_A``), so K tenants
+    of one family with identical shared A hold ONE device copy."""
+    import jax.numpy as jnp
+
+    from .parallel import sharded
+
+    A_shared = getattr(b, "A_shared", None)
+    A_src = b.A if A_shared is None else A_shared
+    if A_shared is None:
+        sparse = False            # per-scenario A: dense batched path
+    S = b.num_scenarios
+    tree = b.tree
+    return sharded.PHArrays(
+        c=jnp.asarray(b.c, dt), q2=jnp.asarray(b.q2, dt),
+        A=_device_A(A_src, dt, sparse=sparse),
+        cl=jnp.asarray(b.cl, dt), cu=jnp.asarray(b.cu, dt),
+        lb=jnp.asarray(b.lb, dt), ub=jnp.asarray(b.ub, dt),
+        const=jnp.asarray(np.broadcast_to(b.const, (S,)), dt),
+        probs=jnp.asarray(tree.scen_prob, dt),
+        onehot=jnp.asarray(tree.onehot_sk_n(), dt),
+        nid_sk=jnp.asarray(tree.nid_sk(), jnp.int32))
+
+
 def bucket_shared(sub) -> bool:
     """Whether a bucket's sub-batch runs the SHARED-A engine.  Sharing
     must be real: a singleton sub-batch trivially detects identity-shared

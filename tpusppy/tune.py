@@ -119,8 +119,12 @@ _PERSIST_VERSION = 2
 # verdict for the rounding-sweep width K (how many ladder thresholds the
 # integer bound pass evaluates) and its window cadence, picked from the
 # measured marginal pass cost.  Absent in older files, tolerated.
+# "batched" (continuous batching, doc/serving.md): per-family verdict
+# for the tenant-batched megastep's slot count K, picked so the fused
+# window's measured per-slot marginal cost keeps the whole dispatch
+# under the watchdog budget.  Absent in older files, tolerated.
 _PERSIST_KINDS = ("fused", "pipeline", "megastep", "aot", "bound_cadence",
-                  "integer")
+                  "integer", "batched")
 _persist: dict = {k: {} for k in _PERSIST_KINDS}
 _persist_lock = threading.Lock()
 _disk_loaded_from: str | None = None
@@ -1064,4 +1068,114 @@ def autotune_integer(run_window, shape, settings=None, k_full: int = 3,
             "k": int(k), "every": int(every),
             "sweep_secs": float(sweep_secs),
             "window_secs": float(window_secs)})
+    return res
+
+
+# ---------------------------------------------------------------------------
+# Batched stage (continuous batching, doc/serving.md "Continuous
+# batching"): pick the tenant-batched megastep's slot count K from the
+# MEASURED per-window cost.  One fused window runs every live slot's
+# frozen sweep back to back, so window wall grows ~linearly in K; the
+# verdict is the largest K whose modeled window wall stays inside
+# ``target_frac`` of the dispatch watchdog budget — the same budget the
+# static cap (segmented.megastep_cap_multi at K copies of the shape)
+# guards a priori, but measured, so a fast family batches wider than the
+# worst-case flop model would dare.  Verdicts persist under the
+# "batched" kind on the same shape+settings key family.
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class BatchedTune:
+    k: int                    # picked slot count
+    per_slot_secs: float      # marginal window cost per live slot
+    base_secs: float          # window wall at one live slot
+    window_secs_at_k: float   # modeled window wall at the pick
+
+
+_batched_cache: dict = {}
+
+
+def _batched_disk_lookup(key):
+    dk = _persist_get("batched", repr(key))
+    if dk is None:
+        return None
+    _metrics.inc("tune.disk_hits")
+    res = BatchedTune(
+        k=int(dk["k"]), per_slot_secs=float(dk["per_slot_secs"]),
+        base_secs=float(dk["base_secs"]),
+        window_secs_at_k=float(dk["window_secs_at_k"]))
+    _batched_cache[key] = res
+    return res
+
+
+def batched_verdict(S, n=None, m=None, settings=None) -> int | None:
+    """Banked autotuned slot count for a family shape (None = no verdict
+    — the server then runs its configured ``batch_slots``).  ``S`` may
+    be the full shape key, like :func:`megastep_verdict`."""
+    shape = (S, n, m) if n is not None else S
+    key = _mega_key(shape, settings)
+    hit = _batched_cache.get(key) or _batched_disk_lookup(key)
+    return hit.k if hit is not None else None
+
+
+def autotune_batched(run_window, shape, k_cap, target_frac: float = 0.5,
+                     k_probe: int | None = None, cache: bool = True,
+                     settings=None, target_secs: float | None = None):
+    """Measure the fused tenant window's per-slot marginal cost and pick
+    the max K whose modeled window wall ``base + (K-1) * per_slot`` stays
+    under ``target_frac`` of the dispatch watchdog budget, clamped to
+    ``k_cap``.
+
+    ``run_window(k)`` executes ONE fused window with ``k`` live slots
+    end to end (dispatch + packed fetch) and returns the executed
+    iteration count of its busiest slot.  Probe windows are REAL wheel
+    work (the autotune_megastep posture — callers apply each window's
+    measurements normally).  Three windows run: a compile-absorbing
+    k=1 warmup, a timed k=1, and a timed ``k_probe``; a degenerate probe
+    (nothing executed) returns the conservative K=1 WITHOUT banking.
+    """
+    from .solvers.segmented import _DISPATCH_TARGET_SECS
+
+    key = _mega_key(shape, settings)
+    if cache:
+        hit = _batched_cache.get(key) or _batched_disk_lookup(key)
+        if hit is not None:
+            return hit
+
+    k_cap = max(1, int(k_cap))
+    if k_probe is None:
+        k_probe = max(2, min(k_cap, 4))
+    k_probe = max(2, min(int(k_probe), max(2, k_cap)))
+    budget = (target_secs if target_secs is not None
+              else max(target_frac, 1e-3) * _DISPATCH_TARGET_SECS)
+    run_window(1)                       # compile-absorbing warmup window
+    t0 = time.time()
+    ex1 = int(run_window(1))
+    t1 = time.time() - t0               # one-slot window wall
+    t0 = time.time()
+    exK = int(run_window(k_probe))
+    tK = time.time() - t0               # k_probe-slot window wall
+    if ex1 <= 0 or exK <= 0:
+        _probe_event("batched", {"shape": repr(shape),
+                                 "skipped": "degenerate probe",
+                                 "executed": (ex1, exK)})
+        return BatchedTune(k=1, per_slot_secs=max(tK, 1e-9),
+                           base_secs=max(t1, 1e-9),
+                           window_secs_at_k=max(t1, 1e-9))
+    per_slot = max((tK - t1) / max(k_probe - 1, 1), 1e-9)
+    base = max(t1, 1e-9)
+    k = int((budget - base) // per_slot) + 1 if budget > base else 1
+    k = max(1, min(k, k_cap))
+    at_k = base + (k - 1) * per_slot
+    res = BatchedTune(k=k, per_slot_secs=per_slot, base_secs=base,
+                      window_secs_at_k=at_k)
+    _probe_event("batched", {"shape": repr(shape), "pick": k,
+                             "per_slot_secs": per_slot,
+                             "base_secs": base,
+                             "window_secs_at_k": at_k})
+    if cache:
+        _batched_cache[key] = res
+        _persist_put("batched", repr(key), {
+            "k": int(k), "per_slot_secs": float(per_slot),
+            "base_secs": float(base),
+            "window_secs_at_k": float(at_k)})
     return res
