@@ -846,6 +846,112 @@ def serving_segment():
             r["certified"] for r in recs_1)
     except Exception as e:   # batching SLOs are additive, never fatal
         entry["batching_error"] = repr(e)
+    # telemetry overhead (doc/observability.md): the SAME warm
+    # isomorphic burst with the trace ring recording request-scoped
+    # spans/counters vs with it off.  Two figures land in the entry:
+    # the wall-clock A/B delta (telemetry_overhead_pct — bounded by
+    # machine noise, see telemetry_noise_floor_pct) and the accounting
+    # bound (telemetry_overhead_accounted_pct = recorded events x
+    # measured per-event ring cost / traced wall — deterministic; the
+    # <2% budget is asserted against THIS one).
+    try:
+        from tpusppy.obs import trace as _tr
+
+        if _tr.enabled():
+            # bench --trace: no clean untraced baseline exists in this
+            # process — skip rather than bank a meaningless 0%
+            entry["telemetry_overhead_pct"] = None
+        else:
+            n_t = int(os.environ.get("BENCH_TELEMETRY_REQUESTS", "4"))
+            S_t = int(os.environ.get("BENCH_SERVING_SCENS", "4"))
+            # 3x the serving iterations: the delta being measured is
+            # ~0.1% (one lock+append per host-side event), so the burst
+            # must be long enough that fixed scheduling noise (tens of
+            # ms) stays under the 2% budget being asserted
+            iters_t = int(os.environ.get("BENCH_TELEMETRY_ITERS",
+                                         str(3 * iters)))
+
+            def _treq(rid, i):
+                # rel_gap 1e-12: gap-certified termination lands at a
+                # DIFFERENT iteration every run (async cylinder timing)
+                # — an unreachable target pins every request to exactly
+                # iters_t iterations so the two arms do identical work
+                return SolveRequest(
+                    model="farmer", num_scens=S_t, request_id=rid,
+                    creator_kwargs={"seedoffset": 53 * i},
+                    options={"PHIterLimit": iters_t,
+                             "rel_gap": 1e-12})
+
+            def _tburst(tag, traced):
+                wd = tempfile.mkdtemp(prefix=f"bench_srv_tel_{tag}_")
+                if traced:
+                    _tr.enable()
+                try:
+                    with SolveServer(work_dir=wd, quantum_secs=300.0,
+                                     linger_secs=0.0) as s3:
+                        s3.result(s3.submit(_treq(f"twarm-{tag}", 97)),
+                                  timeout=1200)
+                        t0 = time.time()
+                        rt = [s3.submit(_treq(f"t{tag}_{i}", i))
+                              for i in range(n_t)]
+                        for r in rt:
+                            s3.result(r, timeout=1200)
+                        wall = time.time() - t0
+                        n_ev = len(_tr.events()) if traced else 0
+                        return wall, n_ev
+                finally:
+                    if traced:
+                        _tr.disable()
+                        _tr.reset()
+
+            # min-of-reps with ALTERNATING arm order: single one-shot
+            # bursts wobble +/-10-30% on a contended CPU host, far
+            # above the overhead being measured, and a fixed off-then-on
+            # order folds monotone process drift into one arm — min
+            # over reps is the batching burst's steady-state protocol
+            reps_t = int(os.environ.get("BENCH_TELEMETRY_REPS", "4"))
+            offs, ons, ev_counts = [], [], []
+            for rep in range(reps_t):
+                order = ((False, True) if rep % 2 == 0
+                         else (True, False))
+                for traced in order:
+                    w, n_ev = _tburst(
+                        f"{'on' if traced else 'off'}{rep}", traced)
+                    (ons if traced else offs).append(w)
+                    if traced:
+                        ev_counts.append(n_ev)
+            w_off, w_on = min(offs), min(ons)
+            entry["telemetry_overhead_pct"] = round(
+                100.0 * (w_on - w_off) / max(w_off, 1e-9), 2)
+            # spread of the SAME arm across reps = what the A/B delta
+            # above can resolve on this host; a |delta| under this is
+            # indistinguishable from zero
+            entry["telemetry_noise_floor_pct"] = round(
+                100.0 * min(max(offs) - min(offs),
+                            max(ons) - min(ons)) / max(w_off, 1e-9), 2)
+            # accounting bound: measured per-event enabled-ring cost
+            # (lock + deque append, calibrated here) x the events a
+            # traced burst actually records, over the traced wall —
+            # deterministic where the wall A/B is noise-dominated
+            _tr.enable()
+            try:
+                n_cal = 20000
+                t0 = time.perf_counter()
+                for _ in range(n_cal):
+                    _tr.instant("bench", "telemetry_cal")
+                per_event_s = (time.perf_counter() - t0) / n_cal
+            finally:
+                _tr.disable()
+                _tr.reset()
+            entry["telemetry_event_cost_us"] = round(
+                per_event_s * 1e6, 3)
+            entry["telemetry_events_per_burst"] = int(
+                sum(ev_counts) / max(len(ev_counts), 1))
+            entry["telemetry_overhead_accounted_pct"] = round(
+                100.0 * entry["telemetry_events_per_burst"]
+                * per_event_s / max(w_on, 1e-9), 3)
+    except Exception as e:   # additive, never fatal
+        entry["telemetry_error"] = repr(e)
     return entry
 
 

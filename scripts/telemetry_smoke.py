@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Telemetry-plane smoke: live observability of a batched serving run.
+
+Nightly CI acceptance for doc/observability.md, runnable locally::
+
+    JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py
+
+Two phases:
+
+1. WATCHABLE SERVING (``SolveServer(batch_slots=3)`` + TCP frontend
+   with a scrape endpoint): three same-family farmer requests run
+   fused while one ``SolveClient.watch`` stream per tenant drains its
+   live progress events.  Asserts, per tenant: at least one
+   ``bound_update`` streamed, the terminal ``done`` is certified, and
+   the live gap series ENDS at the certified gap of the tenant's own
+   record.  Meanwhile ``GET /metrics`` is scraped MID-RUN and must
+   serve per-tenant gauges (Prometheus text format) while the batch is
+   still executing; the ``status`` RPC must answer with every
+   request's live row.
+2. MULTI-PROCESS TRACE MERGE: a 2-controller spokeless ``dist_wheel``
+   run (tests/dist_wheel_smoke_worker.py, ``DIST_TRACE_OUT``) exports
+   one Perfetto ring per process; ``scripts/trace_merge.py`` must
+   stitch them into one timeline — exit 0 (every B/E span matched),
+   both controllers' ``clock_sync``-derived process rows present.
+
+Prints one JSON line with the measured figures.  Exit 0 = pass.  A hard
+watchdog (``TELEMETRY_SMOKE_DEADLINE_SECS``, default 900) ``os._exit(2)``s
+a wedged run so CI never hangs.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEADLINE = float(os.environ.get("TELEMETRY_SMOKE_DEADLINE_SECS", "900"))
+S = int(os.environ.get("TELEMETRY_SMOKE_SCENS", "3"))
+ITERS = 400
+N_REQ = 3
+
+
+def _arm_watchdog():
+    def _bomb():
+        time.sleep(DEADLINE)
+        print(json.dumps({"ok": False, "error": "deadline exceeded"}),
+              flush=True)
+        os._exit(2)
+
+    threading.Thread(target=_bomb, daemon=True).start()
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def phase_serving():
+    """Batched 3-tenant run, watched end-to-end + scraped mid-run."""
+    import tempfile
+
+    from tpusppy.service import SolveRequest, SolveServer
+    from tpusppy.service.net import SolveClient, TcpServiceFrontend
+
+    out = {}
+    with tempfile.TemporaryDirectory() as work:
+        with SolveServer(work_dir=work, batch_slots=3,
+                         in_wheel_bounds=True, quantum_secs=300.0,
+                         linger_secs=0.0) as srv:
+            front = TcpServiceFrontend(srv, slots=N_REQ, scrape_port=0)
+            clients, streams, mid_scrapes = [], {}, []
+            running = threading.Event()
+            running.set()
+
+            def scraper():
+                url = (f"http://127.0.0.1:{front.scrape_port}"
+                       f"/metrics")
+                while running.is_set():
+                    try:
+                        with urllib.request.urlopen(url, timeout=5) as r:
+                            body = r.read().decode()
+                        if "tpusppy_tenant_rel_gap{" in body:
+                            mid_scrapes.append(body)
+                    except Exception:
+                        pass
+                    time.sleep(0.25)
+
+            def watcher(cli, rid):
+                evs = list(cli.watch(rid, timeout=DEADLINE))
+                streams[rid] = {"events": evs, "record": cli.last_record}
+
+            threads = [threading.Thread(target=scraper, daemon=True)]
+            threads[0].start()
+            try:
+                rids = []
+                for i in range(N_REQ):
+                    cli = SolveClient("127.0.0.1", front.port,
+                                      front.secret, slot=i + 1)
+                    clients.append(cli)
+                    rid = cli.submit({
+                        "model": "farmer", "num_scens": S,
+                        "creator_kwargs": {"seedoffset": 31 * i},
+                        "options": {"PHIterLimit": ITERS}})
+                    rids.append(rid)
+                    th = threading.Thread(target=watcher,
+                                          args=(cli, rid), daemon=True)
+                    th.start()
+                    threads.append(th)
+                for th in threads[1:]:
+                    th.join(timeout=DEADLINE)
+                running.clear()
+
+                # the status RPC serves every request's live row
+                snap = clients[0].status()
+                assert set(rids) <= set(snap["requests"]), snap
+                out["status_rows"] = len(snap["requests"])
+
+                bound_updates = {}
+                for rid in rids:
+                    st = streams.get(rid)
+                    assert st is not None, f"{rid}: watch never finished"
+                    evs, rec = st["events"], st["record"]
+                    assert rec and rec.get("status") == "done", rec
+                    assert rec.get("certified"), rec
+                    kinds = [e["kind"] for e in evs]
+                    bound_updates[rid] = kinds.count("bound_update")
+                    assert bound_updates[rid] >= 1, \
+                        f"{rid}: no bound_update streamed ({kinds})"
+                    gaps = [e for e in evs if e["kind"] == "gap"]
+                    assert gaps, f"{rid}: no gap points streamed"
+                    last = gaps[-1]["rel_gap"]
+                    want = rec["rel_gap"]
+                    assert abs(last - want) <= 1e-9 * max(
+                        1.0, abs(want)), \
+                        (f"{rid}: live gap series ends at {last}, "
+                         f"record says {want}")
+                out["bound_updates"] = bound_updates
+                out["batched"] = all(
+                    streams[r]["record"].get("batched") for r in rids)
+                assert out["batched"], {
+                    r: streams[r]["record"].get("batched")
+                    for r in rids}
+
+                assert mid_scrapes, \
+                    "scrape endpoint never served tenant gauges mid-run"
+                assert any(f'request_id="{rid}"' in body
+                           for body in mid_scrapes for rid in rids)
+                out["mid_scrapes"] = len(mid_scrapes)
+            finally:
+                running.clear()
+                for cli in clients:
+                    cli.close()
+                front.close()
+    return out
+
+
+def phase_trace_merge(tmp):
+    """2-controller dist_wheel -> per-process rings -> one timeline."""
+    port = _free_port()
+    script = os.path.join(REPO, "tests", "dist_wheel_smoke_worker.py")
+    rings = [os.path.join(tmp, f"ring{pid}.json") for pid in range(2)]
+    common = {
+        "DIST_COORD": f"127.0.0.1:{port}", "DIST_NPROC": "2",
+        "DIST_SCENS": "8", "JAX_PLATFORMS": "cpu",
+        "JAX_ENABLE_X64": "1", "PYTHONPATH": REPO,
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, script],
+            env={**os.environ, **common, "DIST_PID": str(pid),
+                 "DIST_TRACE_OUT": rings[pid]},
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for pid in range(2)
+    ]
+    try:
+        for p in procs:
+            _, err = p.communicate(timeout=DEADLINE)
+            assert p.returncode == 0, \
+                f"worker rc={p.returncode}\n{err[-3000:]}"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    merged = os.path.join(tmp, "merged.json")
+    rc = subprocess.call(
+        [sys.executable, os.path.join(REPO, "scripts", "trace_merge.py"),
+         "-o", merged] + rings)
+    assert rc == 0, "trace_merge found unmatched B/E spans"
+    with open(merged) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    roles = {e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert roles == {"controller0", "controller1"}, roles
+    spans = sum(1 for e in evs if e.get("ph") == "B")
+    assert spans > 0, "merged trace carries no spans"
+    return {"merged_events": len(evs), "merged_spans": spans}
+
+
+def main():
+    _arm_watchdog()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import tempfile
+
+    serving = phase_serving()
+    with tempfile.TemporaryDirectory() as tmp:
+        merge = phase_trace_merge(tmp)
+    print(json.dumps({"ok": True, "serving": serving, "merge": merge}),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

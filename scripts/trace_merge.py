@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Merge per-process Perfetto rings into ONE aligned timeline.
+
+Each tpusppy process exports its own trace ring as Perfetto JSON with
+timestamps relative to ITS OWN first event (``obs/perfetto.py``) — a
+client, a TCP frontend and the controllers of a ``dist_wheel`` mesh each
+produce a file that loads alone but says nothing about cross-process
+causality.  This tool stitches them (doc/observability.md "Merging
+multi-process traces"):
+
+1. **Clock alignment.**  Every process stamps a ``clock_sync`` instant
+   (track ``clock``, args ``{wall, perf, role, pid}``) into its ring at
+   startup (``telemetry.record_clock_sync``).  The instant's own ``ts``
+   plus its ``wall`` arg map the file's relative microseconds onto the
+   absolute wall clock: ``wall_of(ev) = wall_sync + (ev.ts - ts_sync)
+   * 1e-6``.  With ``--align handshake`` the file's first
+   ``clock_handshake`` instant (the NTP-style offset the client measured
+   over the status/watch RPC round trip) is ADDED, so traces from a
+   host with a skewed wall clock still land on the server's timeline.
+2. **Process separation.**  File *i* keeps its thread rows but moves to
+   ``pid=i+1`` with a ``process_name`` metadata row (the file's stem, or
+   its clock_sync role), so the merged view shows one process group per
+   ring: client -> frontend -> scheduler/slots -> device wheel.
+3. **Validation.**  ``--validate`` (default on) checks every ``B`` has
+   its matching ``E`` per (pid, tid) stack — the invariant the nightly
+   telemetry smoke asserts on the merged 2-process dist_wheel trace.
+
+Usage::
+
+    python scripts/trace_merge.py -o merged.json ring0.json ring1.json
+    python scripts/trace_merge.py -o merged.json --align handshake \
+        client.json server.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load(path: str) -> list:
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc.get("traceEvents") if isinstance(doc, dict) else doc
+    if not isinstance(evs, list):
+        raise ValueError(f"{path}: not a Perfetto trace-event document")
+    return evs
+
+
+def _first_instant(events: list, name: str):
+    """The lowest-ts instant event called ``name`` (None if absent)."""
+    best = None
+    for ev in events:
+        if ev.get("ph") == "i" and ev.get("name") == name:
+            if best is None or ev.get("ts", 0.0) < best.get("ts", 0.0):
+                best = ev
+    return best
+
+
+def file_offset(events: list, align: str = "clock"):
+    """``(wall_offset_s, role)`` placing this file on the absolute wall
+    timeline: ``wall_of(ev) = ev.ts * 1e-6 + wall_offset_s``.  None when
+    the file carries no ``clock_sync`` instant (pre-telemetry export)."""
+    sync = _first_instant(events, "clock_sync")
+    if sync is None:
+        return None, None
+    args = sync.get("args") or {}
+    off = float(args.get("wall", 0.0)) - float(sync.get("ts", 0.0)) * 1e-6
+    role = args.get("role")
+    if align == "handshake":
+        hs = _first_instant(events, "clock_handshake")
+        if hs is not None:
+            # offset_s measured (server - local): adding it moves this
+            # file's wall times onto the SERVER's clock
+            off += float((hs.get("args") or {}).get("offset_s", 0.0))
+    return off, role
+
+
+def validate_spans(events: list) -> list:
+    """Unmatched B/E begin-end pairs, as human-readable problem strings
+    (empty = every span is closed — no orphaned open spans)."""
+    stacks: dict = {}
+    problems = []
+    for ev in sorted(events, key=lambda e: (e.get("ts", 0.0),
+                                            0 if e.get("ph") != "E" else 1)):
+        ph = ev.get("ph")
+        if ph not in ("B", "E"):
+            continue
+        key = (ev.get("pid"), ev.get("tid"))
+        stack = stacks.setdefault(key, [])
+        if ph == "B":
+            stack.append(ev.get("name"))
+        elif not stack:
+            problems.append(f"pid={key[0]} tid={key[1]}: E "
+                            f"{ev.get('name')!r} with empty stack")
+        else:
+            stack.pop()
+    for key, stack in stacks.items():
+        for name in stack:
+            problems.append(f"pid={key[0]} tid={key[1]}: B {name!r} "
+                            f"never closed")
+    return problems
+
+
+def merge(paths, align: str = "clock"):
+    """Merge Perfetto files into one document; returns (doc, notes).
+
+    Files WITH clock_sync land on the shared absolute timeline; files
+    without one (noted) are left start-aligned to the merged origin —
+    visible, ordered internally, but not causally placed."""
+    notes = []
+    loaded = []
+    for path in paths:
+        evs = _load(path)
+        off, role = file_offset(evs, align=align)
+        if off is None:
+            notes.append(f"{path}: no clock_sync instant — "
+                         f"start-aligned only")
+        loaded.append((path, evs, off, role))
+    # the merged origin: earliest aligned wall instant (fallback 0)
+    walls = [off + min((e.get("ts", 0.0) for e in evs
+                        if e.get("ph") != "M"), default=0.0) * 1e-6
+             for _, evs, off, _ in loaded if off is not None]
+    origin = min(walls) if walls else 0.0
+    out = []
+    for i, (path, evs, off, role) in enumerate(loaded):
+        pid = i + 1
+        pname = role or os.path.splitext(os.path.basename(path))[0]
+        out.append({"name": "process_name", "ph": "M", "pid": pid,
+                    "args": {"name": pname}})
+        if off is None:
+            shift = -min((e.get("ts", 0.0) for e in evs
+                          if e.get("ph") != "M"), default=0.0)
+        else:
+            shift = (off - origin) * 1e6
+        for ev in evs:
+            ev = dict(ev)
+            ev["pid"] = pid
+            if ev.get("ph") != "M":
+                ev["ts"] = float(ev.get("ts", 0.0)) + shift
+            out.append(ev)
+    meta = [e for e in out if e.get("ph") == "M"]
+    rest = sorted((e for e in out if e.get("ph") != "M"),
+                  key=lambda e: (e["ts"], 0 if e.get("ph") != "E" else 1))
+    return {"traceEvents": meta + rest, "displayTimeUnit": "ms"}, notes
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("inputs", nargs="+", help="per-process Perfetto JSONs")
+    ap.add_argument("-o", "--out", required=True, help="merged output path")
+    ap.add_argument("--align", choices=("clock", "handshake"),
+                    default="clock",
+                    help="clock: wall-vs-perf clock_sync stamps (same "
+                         "host); handshake: additionally apply the "
+                         "measured NTP-style client/server offset")
+    ap.add_argument("--no-validate", action="store_true",
+                    help="skip the matched-B/E span check")
+    args = ap.parse_args(argv)
+
+    doc, notes = merge(args.inputs, align=args.align)
+    for note in notes:
+        print(f"trace_merge: NOTE: {note}", file=sys.stderr)
+    if not args.no_validate:
+        problems = validate_spans(doc["traceEvents"])
+        for p in problems:
+            print(f"trace_merge: UNMATCHED: {p}", file=sys.stderr)
+        if problems:
+            return 1
+    with open(args.out, "w") as f:
+        json.dump(doc, f)
+    n = sum(1 for e in doc["traceEvents"] if e.get("ph") != "M")
+    print(f"trace_merge: {len(args.inputs)} file(s) -> {args.out} "
+          f"({n} events, align={args.align})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

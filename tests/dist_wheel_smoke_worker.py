@@ -22,6 +22,18 @@ def main():
     initialize_backend(coord, nproc, pid)   # enables Gloo CPU collectives
     jax.config.update("jax_enable_x64", True)
 
+    # telemetry smoke hook: export this controller's trace ring as a
+    # Perfetto file on exit (scripts/telemetry_smoke.py merges the
+    # per-process rings with scripts/trace_merge.py)
+    if os.environ.get("DIST_TRACE_OUT"):
+        import atexit
+
+        from tpusppy.obs import perfetto, trace
+
+        trace.enable()
+        atexit.register(lambda: perfetto.export(
+            trace.events(), path=os.environ["DIST_TRACE_OUT"]))
+
     from tpusppy.models import farmer
     from tpusppy.parallel.dist_wheel import distributed_wheel_hub
 

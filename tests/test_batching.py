@@ -291,6 +291,46 @@ class TestRunner:
         assert reps["t"]["rel_gap"] <= 1e-3
         assert reps["t"]["iters"] > banked_iter
 
+    def test_evict_bank_rejoin_same_trace_no_orphan_spans(self, tmp_path):
+        """Trace continuity across the batching seams: an evict ->
+        bank -> rejoin cycle keeps EVERY event of the request on the
+        same trace id (one contiguous ``req:<rid>`` track), and the
+        exported timeline has no orphaned open spans."""
+        import os
+        import sys
+
+        from tpusppy.obs import perfetto, trace
+
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                        os.pardir, "scripts"))
+        import trace_merge
+
+        trace.enable()
+        canon = _ingest(self.OPT)
+        runner = BatchedFamilyRunner(canon, self.OPT, k_slots=2)
+        d = str(tmp_path / "t")
+        runner.admit("t", canon, d, 60, resume=False,
+                     trace_id="tr-cont")
+        for _ in range(2):
+            runner.window()
+        runner.evict("t", bank=True)
+        runner.admit("t", canon, d, 60, resume=True,
+                     trace_id="tr-cont")
+        runner.window()
+
+        evs = [e for e in trace.events() if e.track == "req:t"]
+        names = [e.name for e in evs]
+        assert names.count("batch_join") == 2
+        assert "batch_evict" in names and "batch_bank" in names
+        # the SAME trace across the seams — no event dropped its id
+        assert {e.payload.get("trace_id") for e in evs} == {"tr-cont"}
+        # per-window bound series landed on the request's track too
+        assert any(e.name == "rel_gap" and e.kind == "counter"
+                   for e in evs)
+        # exported timeline: every begin has its matching end
+        doc = perfetto.export(trace.events())
+        assert trace_merge.validate_spans(doc["traceEvents"]) == []
+
     def test_bound_tracker_hub_semantics(self):
         tr = BoundTracker()
         assert tr.gaps() == (float("inf"), float("inf"))

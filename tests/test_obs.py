@@ -106,6 +106,30 @@ def test_disabled_path_guard():
     assert trace.events() == []
 
 
+def test_disabled_path_guard_with_request_context():
+    """The SAME <5us/span pin with the telemetry plane's request context
+    active: a bound request scope must not push the disabled fast path
+    past its budget, and the tenant-* helpers must allocate nothing."""
+    from tpusppy.obs import telemetry
+
+    assert not trace.enabled()
+    with telemetry.request_scope("tr-abc", "req-1"):
+        # disabled tenant helpers: no events, the shared span singleton
+        telemetry.tenant_instant(None, None, "x", a=1)
+        telemetry.tenant_counter(None, None, "rel_gap", 0.5)
+        assert telemetry.tenant_span(None, None, "s") is trace._NULL
+        assert trace.events() == []
+        n = 200_000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with telemetry.tenant_span(None, None, "noop"):
+                pass
+        dt = time.perf_counter() - t0
+        assert dt < n * 5e-6, (f"disabled tenant-span path too slow: "
+                               f"{dt / n * 1e9:.0f}ns")
+    assert trace.events() == []
+
+
 # ---------------------------------------------------------------------------
 # Perfetto export
 # ---------------------------------------------------------------------------

@@ -1,0 +1,500 @@
+"""Request-scoped telemetry plane (doc/observability.md).
+
+The contract under test:
+
+- TRACE PROPAGATION: a ``trace_id`` minted once at the client edge is
+  carried in the wire payload, journaled first-class (it survives
+  journal replay and a restart's ``recover_from``), and lands on every
+  per-request event via the ``req:<request_id>`` track.
+- SCRAPE SURFACE: ``prometheus_text`` renders the metrics registry in
+  the text exposition format; ``tenant_gauge_lines`` renders the
+  server's ``status_snapshot()`` as per-tenant gauges; ``ScrapeServer``
+  serves both plus ``/status`` JSON over plain stdlib HTTP.
+- PROGRESS STREAMING: ``ProgressBus`` is a bounded per-request queue
+  (slow watchers lose the OLDEST events, never block the scheduler,
+  and the terminal state latches); ``SolveClient.watch`` long-polls it
+  into an ordered event stream ending at the certified gap, and
+  ``wait_result`` rides that stream instead of busy-polling.
+- CLOCK ALIGNMENT: ``clock_sync`` instants + the NTP-style handshake
+  offset let ``scripts/trace_merge.py`` stitch per-process rings onto
+  one absolute timeline with every B/E span matched.
+
+The live end-to-end over a real scrape + batched 3-tenant run is
+scripts/telemetry_smoke.py — the nightly ``telemetry-smoke`` job.
+"""
+
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpusppy.obs import metrics, perfetto, telemetry, trace
+from tpusppy.service import (RequestJournal, SolveRequest, SolveServer)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "scripts"))
+import trace_merge  # noqa: E402  (scripts/ is not a package)
+
+
+def _req(rid, n=3, seed=0, iters=150, deadline=None, **opts):
+    return SolveRequest(model="farmer", num_scens=n, request_id=rid,
+                        creator_kwargs={"seedoffset": seed},
+                        deadline_secs=deadline,
+                        options=dict({"PHIterLimit": iters}, **opts))
+
+
+# ---------------------------------------------------------------------------
+# pure units: ids, context, clock math
+# ---------------------------------------------------------------------------
+
+def test_mint_and_track_shapes():
+    a, b = telemetry.mint_trace_id(), telemetry.mint_trace_id()
+    assert a != b and a.startswith("tr-")
+    assert telemetry.req_track("req-1") == "req:req-1"
+
+
+def test_request_scope_resolution():
+    assert telemetry.current_context() is None
+    with telemetry.request_scope("tr-x", "req-x"):
+        assert telemetry.current_context() == ("tr-x", "req-x")
+        with telemetry.request_scope("tr-y", "req-y"):   # nests
+            assert telemetry.current_context() == ("tr-y", "req-y")
+        assert telemetry.current_context() == ("tr-x", "req-x")
+    assert telemetry.current_context() is None
+
+
+def test_tenant_events_resolve_context_and_tag_trace():
+    trace.enable()
+    with telemetry.request_scope("tr-ctx", "req-ctx"):
+        telemetry.tenant_instant(None, None, "hello", n=1)
+        telemetry.tenant_counter(None, None, "rel_gap", 0.5, source="B")
+    telemetry.tenant_instant("req-lit", "tr-lit", "hola")
+    evs = trace.events()
+    by_name = {e.name: e for e in evs}
+    assert by_name["hello"].track == "req:req-ctx"
+    assert by_name["hello"].payload["trace_id"] == "tr-ctx"
+    assert by_name["rel_gap"].payload["request_id"] == "req-ctx"
+    assert by_name["rel_gap"].payload["source"] == "B"
+    assert by_name["hola"].payload["trace_id"] == "tr-lit"
+    # no context + no explicit id: nothing to attribute, nothing emitted
+    telemetry.tenant_instant(None, None, "orphan")
+    assert "orphan" not in {e.name for e in trace.events()}
+
+
+def test_handshake_offset_math():
+    # server stamped 10.0 in the middle of a [9.9, 10.3] window whose
+    # midpoint is 10.1 -> offset (server - client) = -0.1
+    off = telemetry.handshake_offset(9.9, 10.3, 10.0)
+    assert off == pytest.approx(-0.1)
+
+
+def test_clock_sync_instants_land_on_clock_track():
+    trace.enable()
+    telemetry.record_clock_sync("tester", rank=3)
+    telemetry.record_clock_handshake("tester", -0.25, 0.004)
+    evs = {e.name: e for e in trace.events()}
+    sync = evs["clock_sync"]
+    assert sync.track == "clock" and sync.payload["role"] == "tester"
+    assert sync.payload["wall"] > 0 and sync.payload["rank"] == 3
+    hs = evs["clock_handshake"]
+    assert hs.payload["offset_s"] == pytest.approx(-0.25)
+
+
+# ---------------------------------------------------------------------------
+# ProgressBus
+# ---------------------------------------------------------------------------
+
+def test_progress_bus_cursor_loss_and_done_latch():
+    bus = telemetry.ProgressBus(maxlen=4)
+    for i in range(3):
+        bus.emit("r1", "gap", rel_gap=0.1 * i)
+    evs, cur, lost, done = bus.poll("r1", 0)
+    assert [e["seq"] for e in evs] == [0, 1, 2]
+    assert cur == 3 and lost == 0 and not done
+    # nothing new past the cursor
+    evs, cur2, lost, done = bus.poll("r1", cur)
+    assert evs == [] and cur2 == 3 and lost == 0
+    # overflow the bound: a slow watcher loses the OLDEST events
+    for i in range(6):
+        bus.emit("r1", "gap", i=i)
+    evs, cur3, lost, done = bus.poll("r1", cur)
+    assert lost == 2                      # seqs 3,4 evicted by maxlen=4
+    assert [e["seq"] for e in evs] == [5, 6, 7, 8]
+    bus.emit("r1", "done")
+    bus.mark_done("r1")
+    assert bus.is_done("r1")
+    *_, done = bus.poll("r1", cur3)
+    assert done
+    # done latches even for a cursor past everything
+    *_, done = bus.poll("r1", 10 ** 6)
+    assert done
+    bus.drop("r1")
+    assert not bus.known("r1")
+    assert bus.poll("r1", 0) == ([], 0, 0, False)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus rendering + the scrape endpoint
+# ---------------------------------------------------------------------------
+
+def test_prometheus_text_rendering():
+    reg = metrics.Registry()
+    reg.counter("service.requests").inc(3)
+    reg.gauge("queue.depth").set(2.0)
+    h = reg.histogram("slice.secs")
+    for v in (0.1, 0.2, 0.3):
+        h.add(v)
+    text = telemetry.prometheus_text(reg, extra_lines=["custom_line 1"])
+    assert "# TYPE tpusppy_service_requests_total counter" in text
+    assert "tpusppy_service_requests_total 3.0" in text
+    assert "tpusppy_queue_depth 2.0" in text
+    assert "# TYPE tpusppy_slice_secs summary" in text
+    assert 'tpusppy_slice_secs{quantile="0.5"}' in text
+    assert "tpusppy_slice_secs_count 3.0" in text
+    assert text.rstrip().endswith("custom_line 1")
+
+
+def test_prometheus_val_and_name_sanitization():
+    assert telemetry._prom_val(float("inf")) == "+Inf"
+    assert telemetry._prom_val(float("-inf")) == "-Inf"
+    assert telemetry._prom_val(float("nan")) == "NaN"
+    assert telemetry._prom_val("bogus") == "NaN"
+    assert telemetry._prom_name("a.b-c d") == "a_b_c_d"
+    assert telemetry._prom_name("9lives")[0] == "_"
+    assert telemetry._prom_label('he said "hi"\n') == r'he said \"hi\"\n'
+
+
+def test_tenant_gauge_lines_from_snapshot():
+    snap = {"queue_depth": 1, "requests_live": 2, "batch_slots": 4,
+            "batch_slots_occupied": 3,
+            "requests": {
+                "req-a": {"status": "running", "model": "farmer",
+                          "qos": "standard", "rel_gap": 0.01,
+                          "outer": -110.0, "inner": -100.0, "iters": 7,
+                          "deadline_headroom_s": None,
+                          "attributed_flops": 1e9, "mfu_pct": 2.5},
+                "req-b": {"status": "queued", "model": "farmer",
+                          "qos": "batch", "rel_gap": float("inf")},
+            }}
+    lines = telemetry.tenant_gauge_lines(snap)
+    text = "\n".join(lines)
+    assert "tpusppy_queue_depth 1.0" in text
+    assert "tpusppy_batch_slots_occupied 3.0" in text
+    assert ('tpusppy_tenant_rel_gap{request_id="req-a",model="farmer",'
+            'qos="standard",status="running"} 0.01') in text
+    assert 'request_id="req-b"' in text and "+Inf" in text
+    # TYPE headers emitted once per metric, not per tenant
+    assert text.count("# TYPE tpusppy_tenant_rel_gap gauge") == 1
+    # None fields (no deadline) are simply skipped
+    assert 'tpusppy_tenant_deadline_headroom_seconds{request_id="req-a"' \
+        not in text
+
+
+def test_json_safe_scrubs_nonfinite():
+    doc = telemetry.json_safe({"gap": float("inf"), "arr": [1.0, float("nan")],
+                               "np": np.float64(2.5), "ok": "s", "n": None})
+    s = json.dumps(doc)                   # strict JSON must accept it
+    assert doc["gap"] == "inf" and doc["arr"][1] == "nan"
+    assert doc["np"] == 2.5 and json.loads(s)["ok"] == "s"
+
+
+def test_scrape_server_http_endpoints():
+    reg = metrics.Registry()
+    reg.gauge("scrape.probe").set(7.0)
+    snap = {"queue_depth": 0, "requests_live": 0, "batch_slots": 2,
+            "batch_slots_occupied": None,
+            "requests": {"req-z": {"status": "done", "model": "farmer",
+                                   "qos": "standard",
+                                   "rel_gap": float("inf")}}}
+    srv = telemetry.ScrapeServer(status_fn=lambda: snap, registry=reg)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert "text/plain" in r.headers["Content-Type"]
+            body = r.read().decode()
+        assert "tpusppy_scrape_probe 7.0" in body
+        assert 'tpusppy_tenant_rel_gap{request_id="req-z"' in body
+        with urllib.request.urlopen(f"{base}/status", timeout=10) as r:
+            doc = json.loads(r.read().decode())   # strict JSON parses
+        assert doc["requests"]["req-z"]["rel_gap"] == "inf"
+        with urllib.request.urlopen(f"{base}/nope", timeout=10) as r:
+            pytest.fail("404 expected")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# trace continuity across the journal (restart seam)
+# ---------------------------------------------------------------------------
+
+def test_trace_id_survives_journal_replay(tmp_path):
+    p = str(tmp_path / "j.jsonl")
+    j = RequestJournal(p)
+    j.accepted(rid="r1", seq=0, request={"model": "farmer"}, family="f",
+               checkpoint_dir="/x", deadline_at=None,
+               record={"status": "queued"}, trace_id="tr-keepme")
+    j.transition("r1", "running", {"status": "running"})
+    jr = RequestJournal(p).replay()["r1"]
+    assert jr.trace_id == "tr-keepme"
+    # compaction rewrites the accepted line; the trace id must ride it
+    j.compact(j.replay().values())
+    assert RequestJournal(p).replay()["r1"].trace_id == "tr-keepme"
+
+
+def test_trace_id_replay_falls_back_to_request_payload(tmp_path):
+    """Pre-telemetry journals carried the id only inside the request
+    payload (the client put it on the wire): replay must still find it."""
+    p = str(tmp_path / "j.jsonl")
+    j = RequestJournal(p)
+    j.accepted(rid="r2", seq=0,
+               request={"model": "farmer", "trace_id": "tr-legacy"},
+               family="f", checkpoint_dir="/x", deadline_at=None,
+               record={"status": "queued"})
+    assert RequestJournal(p).replay()["r2"].trace_id == "tr-legacy"
+
+
+def test_trace_id_survives_restart_recovery(tmp_path):
+    """The SIGKILL seam: submit with an explicit trace, kill (simulated
+    by abandoning the server object), recover_from the same work dir —
+    the recovered tenant carries the SAME trace id end to end."""
+    work = str(tmp_path)
+    srv = SolveServer(work_dir=work, _start_executor=False,
+                      arm_caches=False)
+    req = _req("req-t", iters=50)
+    req.trace_id = "tr-durable"
+    srv.submit(req)
+    del srv    # no shutdown — the crash
+    srv2 = SolveServer.recover_from(work, _start_executor=False,
+                                    arm_caches=False)
+    t = srv2._tenants["req-t"]
+    assert t.trace == "tr-durable"
+    assert t.req.trace_id == "tr-durable"
+    assert t.record["trace_id"] == "tr-durable"
+    snap = srv2.status_snapshot()
+    assert snap["requests"]["req-t"]["trace_id"] == "tr-durable"
+
+
+def test_server_mints_trace_for_inprocess_submit(tmp_path):
+    srv = SolveServer(work_dir=str(tmp_path), _start_executor=False,
+                      arm_caches=False)
+    rid = srv.submit(_req("req-m", iters=10))
+    assert srv._tenants[rid].trace.startswith("tr-")
+
+
+# ---------------------------------------------------------------------------
+# live progress + status on a real (in-process) server
+# ---------------------------------------------------------------------------
+
+def test_progress_bus_streams_solve_to_certified_gap(tmp_path):
+    """End-to-end in process: the bus's event stream for one solve ends
+    at the terminal ``done`` whose gap matches the record — the live
+    series a watcher streams is the SAME number the certificate says."""
+    with SolveServer(work_dir=str(tmp_path), quantum_secs=60.0,
+                     linger_secs=30.0) as srv:
+        rid = srv.submit(_req("req-s", iters=150))
+        rec = srv.result(rid, timeout=300)
+        assert rec["status"] == "done" and rec["certified"]
+        evs, _, _, done = srv.progress.poll(rid, 0)
+        assert done
+        kinds = [e["kind"] for e in evs]
+        assert "running" in kinds
+        assert any(k in ("gap", "bound_update") for k in kinds)
+        assert kinds[-1] == "done"
+        term = evs[-1]
+        assert term["certified"]
+        assert term["rel_gap"] == pytest.approx(rec["rel_gap"])
+        # the sequence is contiguous and time-ordered
+        assert [e["seq"] for e in evs] == list(range(len(evs)))
+        # retirement releases the queue
+        srv.retire_finished()
+        assert not srv.progress.known(rid)
+
+
+def test_status_snapshot_forms(tmp_path):
+    with SolveServer(work_dir=str(tmp_path), quantum_secs=60.0,
+                     linger_secs=30.0) as srv:
+        rid = srv.submit(_req("req-q", iters=120))
+        rec = srv.result(rid, timeout=300)
+        one = srv.status_snapshot(rid)
+        assert one["request_id"] == rid and one["done"]
+        assert one["record"]["rel_gap"] == pytest.approx(rec["rel_gap"])
+        allofit = srv.status_snapshot()
+        row = allofit["requests"][rid]
+        assert row["status"] == "done" and row["certified"]
+        assert row["trace_id"].startswith("tr-")
+        assert "batch_slots" in allofit and "queue_depth" in allofit
+        missing = srv.status_snapshot("req-nope")
+        assert missing["done"] is False and missing["record"] is None
+
+
+# ---------------------------------------------------------------------------
+# TCP end to end: status RPC, watch streaming, wait_result, scrape
+# ---------------------------------------------------------------------------
+
+def test_tcp_status_watch_wait_result_and_scrape(tmp_path):
+    from tpusppy.service.net import SolveClient, TcpServiceFrontend
+
+    with SolveServer(work_dir=str(tmp_path), quantum_secs=60.0,
+                     linger_secs=30.0) as srv:
+        front = TcpServiceFrontend(srv, slots=2, scrape_port=0)
+        cli = None
+        try:
+            assert front.scrape_port
+            cli = SolveClient("127.0.0.1", front.port, front.secret,
+                              slot=1)
+            rid = cli.submit({"model": "farmer", "num_scens": 3,
+                              "options": {"PHIterLimit": 150}})
+            events = list(cli.watch(rid, timeout=300))
+            assert events, "watch() streamed nothing"
+            kinds = [e["kind"] for e in events]
+            assert any(k in ("gap", "bound_update") for k in kinds), \
+                "no per-window progress event streamed"
+            rec = cli.last_record
+            assert rec and rec["status"] == "done" and rec["certified"]
+            gaps = [e for e in events if e["kind"] == "gap"]
+            if gaps:            # live series ends at the certified gap
+                assert gaps[-1]["rel_gap"] == \
+                    pytest.approx(rec["rel_gap"], rel=1e-6, abs=1e-12)
+            # status RPC: per-request and whole-server forms
+            one = cli.status(rid)
+            assert one["done"] and one["record"]["certified"]
+            snap = cli.status()
+            assert snap["requests"][rid]["status"] == "done"
+            # wait_result rides the stream (done latched: returns now)
+            rec2 = cli.wait_result(rid, timeout=60)
+            assert rec2["inner"] == pytest.approx(rec["inner"])
+            # the scrape endpoint serves the same rows as gauges
+            url = f"http://127.0.0.1:{front.scrape_port}/metrics"
+            with urllib.request.urlopen(url, timeout=10) as r:
+                body = r.read().decode()
+            assert f'request_id="{rid}"' in body
+            assert "tpusppy_queue_depth" in body
+        finally:
+            if cli is not None:
+                cli.close()
+            front.close()
+
+
+def test_watch_unknown_request_errors(tmp_path):
+    from tpusppy.service.net import SolveClient, TcpServiceFrontend
+
+    with SolveServer(work_dir=str(tmp_path),
+                     _start_executor=False) as srv:
+        front = TcpServiceFrontend(srv, slots=2)
+        cli = None
+        try:
+            cli = SolveClient("127.0.0.1", front.port, front.secret,
+                              slot=1)
+            # terminal immediately: no events, the structured error
+            # record lands in last_record
+            assert list(cli.watch("req-ghost", timeout=30)) == []
+            rec = cli.last_record
+            assert rec is not None
+            assert rec.get("error_code") == "unknown_request"
+        finally:
+            if cli is not None:
+                cli.close()
+            front.close()
+
+
+# ---------------------------------------------------------------------------
+# trace_merge: multi-process rings onto one timeline
+# ---------------------------------------------------------------------------
+
+def _ring_file(tmp_path, name, role, wall0, spans):
+    """Synthesize one per-process Perfetto ring: a clock_sync instant
+    anchored at wall time ``wall0`` plus closed spans."""
+    trace.disable()
+    trace.reset()
+    trace.enable()
+    telemetry.record_clock_sync(role)
+    for track, nm in spans:
+        with trace.span(track, nm):
+            pass
+    doc = perfetto.export(trace.events())
+    # rewrite the anchor wall so two files disagree by a KNOWN offset
+    sync = next(e for e in doc["traceEvents"]
+                if e.get("name") == "clock_sync")
+    sync["args"]["wall"] = wall0 + sync["ts"] * 1e-6
+    path = tmp_path / name
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    trace.disable()
+    trace.reset()
+    return str(path)
+
+
+def test_trace_merge_aligns_and_validates(tmp_path):
+    f0 = _ring_file(tmp_path, "server.json", "frontend", 1000.0,
+                    [("req:a", "slice")])
+    f1 = _ring_file(tmp_path, "client.json", "client", 1002.5,
+                    [("req:a", "submit")])
+    out = tmp_path / "merged.json"
+    rc = trace_merge.main([f0, f1, "-o", str(out)])
+    assert rc == 0
+    doc = json.load(open(out))
+    evs = doc["traceEvents"]
+    pnames = {e["args"]["name"] for e in evs
+              if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert pnames == {"frontend", "client"}
+    # the 2.5s wall skew shows up as ~2.5e6 µs between the files' syncs
+    syncs = sorted((e for e in evs if e.get("name") == "clock_sync"),
+                   key=lambda e: e["ts"])
+    assert syncs[1]["ts"] - syncs[0]["ts"] == pytest.approx(2.5e6,
+                                                            rel=1e-3)
+    # every span closed in the merged doc
+    assert trace_merge.validate_spans(evs) == []
+    # ph!=M events are globally time-ordered
+    ts = [e["ts"] for e in evs if e.get("ph") != "M"]
+    assert ts == sorted(ts)
+
+
+def test_trace_merge_handshake_alignment(tmp_path):
+    f0 = _ring_file(tmp_path, "srv.json", "frontend", 1000.0,
+                    [("req:a", "slice")])
+    # client whose wall clock runs 5s FAST; its handshake measured -5s
+    f1 = _ring_file(tmp_path, "cli.json", "client", 1005.0,
+                    [("req:a", "submit")])
+    doc = json.load(open(f1))
+    hs = {"name": "clock_handshake", "ph": "i", "ts": 1.0, "pid": 1,
+          "tid": 1, "s": "t",
+          "args": {"role": "client", "offset_s": -5.0, "rtt_s": 0.002}}
+    doc["traceEvents"].append(hs)
+    with open(f1, "w") as f:
+        json.dump(doc, f)
+    merged, notes = trace_merge.merge([f0, f1], align="handshake")
+    assert notes == []
+    syncs = sorted((e for e in merged["traceEvents"]
+                    if e.get("name") == "clock_sync"),
+                   key=lambda e: e["ts"])
+    # handshake cancels the skew: the syncs land (near) coincident
+    assert abs(syncs[1]["ts"] - syncs[0]["ts"]) < 50e3   # < 50ms apart
+
+
+def test_trace_merge_flags_unmatched_spans():
+    evs = [{"name": "open", "ph": "B", "ts": 0.0, "pid": 1, "tid": 1},
+           {"name": "huh", "ph": "E", "ts": 1.0, "pid": 1, "tid": 2}]
+    problems = trace_merge.validate_spans(evs)
+    assert len(problems) == 2
+    assert any("never closed" in p for p in problems)
+    assert any("empty stack" in p for p in problems)
+
+
+def test_trace_merge_without_clock_sync_start_aligns(tmp_path):
+    p = tmp_path / "plain.json"
+    with open(p, "w") as f:
+        json.dump({"traceEvents": [
+            {"name": "x", "ph": "B", "ts": 10.0, "pid": 1, "tid": 1},
+            {"name": "x", "ph": "E", "ts": 20.0, "pid": 1, "tid": 1},
+        ]}, f)
+    merged, notes = trace_merge.merge([str(p)])
+    assert len(notes) == 1 and "no clock_sync" in notes[0]
+    ts = [e["ts"] for e in merged["traceEvents"] if e.get("ph") != "M"]
+    assert min(ts) == 0.0                  # start-aligned to the origin

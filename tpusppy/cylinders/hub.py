@@ -208,6 +208,17 @@ class Hub(SPCommunicator):
             # final certified gap (report.py collects "rel_gap"/"abs_gap")
             _trace.counter("hub", "rel_gap", rel_gap)
             _trace.counter("hub", "abs_gap", abs_gap)
+        # live progress seam (doc/observability.md): the solve service
+        # plants options["progress_cb"] the way it plants preempt_check;
+        # the callback dedupes, so calling on EVERY gap computation is
+        # fine — and a progress fault must never kill a solve
+        cb = self.options.get("progress_cb")
+        if cb is not None:
+            try:
+                cb(abs_gap, rel_gap, self.BestOuterBound,
+                   self.BestInnerBound, self.current_iteration())
+            except Exception:
+                pass
         return abs_gap, rel_gap
 
     def _check_preempt(self) -> bool:

@@ -14,13 +14,18 @@ One subsystem for every number and event the stack emits about itself:
 - :mod:`.report` — the post-run "flight recorder" summary: gap-vs-wall and
   bound-vs-wall arrays, per-track span totals, counter dump;
 - :mod:`.log` — ``get_logger(name)`` with the ``[track] message`` format
-  and the ``TPUSPPY_LOG_LEVEL`` knob (:mod:`tpusppy.log` re-exports it).
+  and the ``TPUSPPY_LOG_LEVEL`` knob (:mod:`tpusppy.log` re-exports it);
+- :mod:`.telemetry` — the LIVE serving plane: request-scoped trace
+  propagation (``trace_id`` context, per-request tracks, clock-sync
+  stamps for ``scripts/trace_merge.py``), Prometheus text exposition +
+  the zero-dependency scrape endpoint, and the bounded per-request
+  progress bus ``SolveClient.watch`` streams from.
 
 Grew out of the PR-3 fragments (hostsync fetch counters, per-segment
 ``mfu_pct`` / ``dispatch_overhead_pct``); see doc/observability.md for the
 event taxonomy and track naming.
 """
 
-from . import log, metrics, perfetto, report, trace  # noqa: F401
+from . import log, metrics, perfetto, report, telemetry, trace  # noqa: F401
 
-__all__ = ["log", "metrics", "perfetto", "report", "trace"]
+__all__ = ["log", "metrics", "perfetto", "report", "telemetry", "trace"]

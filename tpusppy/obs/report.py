@@ -50,6 +50,7 @@ def build_report(events, registry=None, counters=None,
     t0 = min((ev.t for ev in events), default=0.0)
 
     series: dict = {name: [] for name in SERIES}
+    tenants: dict = {}
     tracks: dict = {}
     instants: dict = {}
     for ev in events:
@@ -60,8 +61,23 @@ def build_report(events, registry=None, counters=None,
             agg["total_secs"] += ev.dur or 0.0
         elif ev.kind == "counter":
             if ev.name in series:
-                series[ev.name].append(
-                    [ev.t - t0, (ev.payload or {}).get("value")])
+                payload = ev.payload or {}
+                rid = payload.get("request_id")
+                if rid is None:
+                    # process-global series (the hub's compute_gaps
+                    # counters — one solve at a time)
+                    series[ev.name].append([ev.t - t0,
+                                            payload.get("value")])
+                else:
+                    # request-scoped sample (telemetry.tenant_counter):
+                    # batched-runner bounds (source 'B') and the
+                    # server's per-window progress land here — without
+                    # this bucket a batched run's gap_vs_wall was EMPTY
+                    row = tenants.setdefault(
+                        str(rid), {"trace_id": payload.get("trace_id"),
+                                   **{n: [] for n in SERIES}})
+                    row[ev.name].append([ev.t - t0,
+                                         payload.get("value")])
         else:
             per = instants.setdefault(ev.track, {})
             per[ev.name] = per.get(ev.name, 0) + 1
@@ -78,6 +94,12 @@ def build_report(events, registry=None, counters=None,
             "best_inner": series["best_inner"],
             "abs_gap": series["abs_gap"],
         },
+        # per-tenant gap/bound series keyed by request_id: {rid:
+        # {"trace_id", "rel_gap": [[t, v], ...], "abs_gap": ...,
+        #  "best_outer": ..., "best_inner": ...}} — each tenant's LAST
+        # rel_gap entry is its final certified gap, exactly like the
+        # global array for a solo run
+        "tenants": tenants,
         "tracks": tracks,
         "instants": instants,
         "counters": counters if counters is not None else registry.dump(),

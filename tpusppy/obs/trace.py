@@ -240,14 +240,20 @@ def instant(track: str | None, name: str, **payload):
                       payload or None))
 
 
-def counter(track: str | None, name: str, value):
+def counter(track: str | None, name: str, value, **payload):
     """Sampled numeric series (rendered as a counter track; the report
-    collects named series like ``rel_gap`` into *-vs-wall arrays)."""
+    collects named series like ``rel_gap`` into *-vs-wall arrays).
+    Extra ``payload`` keys ride alongside ``value`` — the telemetry
+    plane tags per-tenant samples with ``request_id``/``trace_id`` so
+    the report can bucket series per request."""
     if not _enabled:
         return
+    data = {"value": float(value)}
+    if payload:
+        data.update(payload)
     _buffer.add(Event(_perf(), threading.get_ident(),
                       track or thread_track(), name, "counter", None,
-                      {"value": float(value)}))
+                      data))
 
 
 # ---------------------------------------------------------------------------

@@ -35,6 +35,7 @@ from typing import NamedTuple
 import numpy as np
 
 from ..obs import metrics as _metrics
+from ..obs import telemetry as _telemetry
 from ..obs import trace as _trace
 from ..obs.log import get_logger
 from ..resilience import faults as _faults
@@ -174,6 +175,11 @@ def distributed_wheel_hub(all_scenario_names, scenario_creator,
     allgather = wd.wrap(allgather, "vote_allgather")
     writer = jax.process_index() == 0
     my_rank = jax.process_index()
+    # clock-sync stamp per controller ring: scripts/trace_merge.py reads
+    # it to place each process's perf_counter-relative events on one
+    # absolute wall timeline (multi-controller meshes included)
+    _telemetry.record_clock_sync(f"controller{my_rank}", rank=my_rank,
+                                 nproc=jax.process_count())
 
     setup = _setup_distributed(all_scenario_names, scenario_creator,
                                scenario_creator_kwargs, options, mesh, axis)

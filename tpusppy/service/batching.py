@@ -58,6 +58,8 @@ from math import inf
 import numpy as np
 
 from ..obs import metrics as _metrics
+from ..obs import telemetry as _telemetry
+from ..obs import trace as _trace
 from ..obs.log import get_logger
 from ..resilience import checkpoint as _ckpt
 from ..solvers import flops as _flops
@@ -125,10 +127,11 @@ class _Slot:
 
     __slots__ = ("rid", "dir", "arr", "state", "factors", "age", "iters",
                  "iter_limit", "convthresh", "tracker", "live", "batch",
-                 "gate_misses", "next_rescue", "declines")
+                 "gate_misses", "next_rescue", "declines", "trace_id")
 
     def __init__(self, rid, tenant_dir, arr, state, iter_limit,
-                 convthresh, tracker, iters=0, batch=None):
+                 convthresh, tracker, iters=0, batch=None,
+                 trace_id=None):
         self.rid = rid
         self.dir = tenant_dir
         self.arr = arr
@@ -144,6 +147,7 @@ class _Slot:
         self.gate_misses = 0    # feasibility-gate miss cadence state
         self.next_rescue = 0    # (PHBase._maybe_inwheel_rescue semantics)
         self.declines = 0
+        self.trace_id = trace_id
 
 
 class BatchedFamilyRunner:
@@ -233,7 +237,7 @@ class BatchedFamilyRunner:
 
     # ---- joins --------------------------------------------------------------
     def admit(self, rid, canon, tenant_dir, iter_limit, resume=True,
-              best_inner=inf, best_outer=-inf) -> dict:
+              best_inner=inf, best_outer=-inf, trace_id=None) -> dict:
         """Join ``rid`` into a free slot at this window boundary.
 
         ``resume=True`` seeds W/xbars/rho (+ banked bounds) from the
@@ -242,6 +246,12 @@ class BatchedFamilyRunner:
         the first prox-on refresh rebuilds the x/z/y/yx iterates, the
         adaptive-refresh resume idiom.  A fresh tenant runs Iter0 (plain
         objective, W=0, prox off) exactly like the solo wheel.
+
+        ``trace_id`` (optional) carries the request's distributed-trace
+        context into the slot: every per-window sample and lifecycle
+        instant the runner records lands on the request's own track
+        (``req:<rid>``) tagged with it, so evict->bank->rejoin keeps ONE
+        trace across slot generations.
 
         Returns ``{"iteration", "resumed"}``."""
         from .. import spopt
@@ -283,9 +293,12 @@ class BatchedFamilyRunner:
             state, _, _ = self._refresh(state, arr, 0.0)
         slot = _Slot(rid, tenant_dir, arr, state, iter_limit,
                      float(self.opt_options.get("convthresh", -1.0)),
-                     tracker, iters=it0, batch=canon.batch)
+                     tracker, iters=it0, batch=canon.batch,
+                     trace_id=trace_id)
         self.slots[idx] = slot
         _CTR_JOINS.inc(1)
+        _telemetry.tenant_instant(rid, trace_id, "batch_join", slot=idx,
+                                  resumed=resumed, iteration=it0)
         _log.info("batch join: %s -> slot %d (%s, iter %d)", rid, idx,
                   "resumed" if resumed else "fresh", it0)
         return {"iteration": it0, "resumed": resumed}
@@ -303,6 +316,8 @@ class BatchedFamilyRunner:
             best_outer=s.tracker.best_outer,
             meta={"batched": True, "source": BATCH_SOURCE_CHAR})
         _ckpt.save(ck, _ckpt.checkpoint_path(s.dir, s.iters))
+        _telemetry.tenant_instant(s.rid, s.trace_id, "batch_bank",
+                                  iteration=s.iters)
         return s.iters
 
     def bank(self, rid) -> int:
@@ -325,6 +340,8 @@ class BatchedFamilyRunner:
         s.live = False
         s.batch = None
         _CTR_EVICTIONS.inc(1)
+        _telemetry.tenant_instant(rid, s.trace_id, "batch_evict",
+                                  iteration=s.iters, banked=bank)
         _log.info("batch evict: %s at iter %d (%s)", rid, s.iters,
                   "banked" if bank else "unbanked")
         return s.iters
@@ -464,7 +481,9 @@ class BatchedFamilyRunner:
                 convthresh, n_live, self.accept_tol, live_mask]
         if self.in_wheel:
             args += [live_mask, self.feas_tol]
-        states, packed = self._mega(*args)
+        with _trace.span("batch", "window", live=len(live),
+                         k=self.k_slots):
+            states, packed = self._mega(*args)
         meas = sharded.tenant_megastep_unpack(
             np.asarray(packed), self.n_window, self.S, len(slots),
             bounds=self.in_wheel)
@@ -514,6 +533,16 @@ class BatchedFamilyRunner:
                 else:
                     self._maybe_rescue(s)
             abs_gap, rel_gap = s.tracker.gaps()
+            if _trace.enabled():
+                # per-request trace series (source 'B'): report.py
+                # buckets these by the payload's request_id, so a
+                # batched run's gap-vs-wall is no longer empty
+                for nm, v in (("rel_gap", rel_gap), ("abs_gap", abs_gap),
+                              ("best_outer", s.tracker.best_outer),
+                              ("best_inner", s.tracker.best_inner)):
+                    if np.isfinite(v):
+                        _telemetry.tenant_counter(s.rid, s.trace_id,
+                                                  nm, v, source="B")
             reports[s.rid] = {
                 "executed": ex, "iters": s.iters,
                 "outer": s.tracker.best_outer,

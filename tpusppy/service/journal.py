@@ -67,7 +67,8 @@ class JournalRecord:
     rid: str
     seq: int = 0
     request: dict = dataclasses.field(default_factory=dict)
-    family: str = ""                  # family digest (stable across runs)
+    trace_id: str = ""                # distributed-trace id (first-class:
+    family: str = ""                  # survives compaction + SIGKILL)
     checkpoint_dir: str = ""
     recoverable: bool = True
     deadline_at: float | None = None  # absolute epoch seconds (or None)
@@ -105,14 +106,18 @@ class RequestJournal:
 
     def accepted(self, rid: str, seq: int, request: dict, family: str,
                  checkpoint_dir: str, recoverable: bool = True,
-                 deadline_at: float | None = None, record: dict | None = None):
+                 deadline_at: float | None = None, record: dict | None = None,
+                 trace_id: str | None = None):
         """Journal an accepted request.  MUST run before ``submit``
-        returns — the write-ahead property the recovery path relies on."""
+        returns — the write-ahead property the recovery path relies on.
+        ``trace_id`` is journaled first-class so a SIGKILLed request's
+        distributed trace survives into the recovered lifetime."""
         self._append({"ev": "accepted", "rid": str(rid), "seq": int(seq),
                       "request": dict(request or {}), "family": str(family),
                       "checkpoint_dir": str(checkpoint_dir),
                       "recoverable": bool(recoverable),
                       "deadline_at": deadline_at,
+                      "trace_id": str(trace_id or ""),
                       "record": dict(record or {}), "t": time.time()})
 
     def transition(self, rid: str, status: str, record: dict | None = None):
@@ -197,6 +202,7 @@ class RequestJournal:
                  "checkpoint_dir": r.checkpoint_dir,
                  "recoverable": r.recoverable,
                  "deadline_at": r.deadline_at,
+                 "trace_id": r.trace_id,
                  "record": {}, "t": r.accepted_at}))
             if r.status != "queued" or r.record:
                 lines.append(json.dumps(
@@ -251,9 +257,14 @@ def replay(path: str) -> dict:
         kind = ev.get("ev")
         rid = str(ev.get("rid", ""))
         if kind == "accepted":
+            req = dict(ev.get("request") or {})
             out[rid] = JournalRecord(
                 rid=rid, seq=int(ev.get("seq", 0)),
-                request=dict(ev.get("request") or {}),
+                request=req,
+                # pre-telemetry journals carry no trace_id line-level
+                # key; the request payload is the fallback carrier
+                trace_id=str(ev.get("trace_id")
+                             or req.get("trace_id") or ""),
                 family=str(ev.get("family", "")),
                 checkpoint_dir=str(ev.get("checkpoint_dir", "")),
                 recoverable=bool(ev.get("recoverable", True)),

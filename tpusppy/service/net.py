@@ -42,6 +42,7 @@ import numpy as np
 import uuid
 
 from ..obs import metrics as _metrics
+from ..obs import telemetry as _telemetry
 from ..obs.log import get_logger
 from ..resilience import faults as _faults
 from .server import ServerClosed, ServerOverloaded, SolveRequest
@@ -116,11 +117,30 @@ class TcpServiceFrontend:
     ``{"op": "fetch", "request_id": ...}`` — answer a (possibly
     already-finished, possibly previous-lifetime) request's record by
     id.  Unknown ids answer a structured ``unknown_request`` error.
+
+    Telemetry ops (doc/observability.md):
+
+    - ``{"op": "status", "request_id"?}`` — answered IMMEDIATELY with
+      the scheduler's live snapshot (per-request record, or the whole
+      server's per-tenant gauge rows), stamped with the server's wall
+      clock so the client can record an NTP-style handshake offset.
+    - ``{"op": "watch", "request_id", "cursor"}`` — a LONG POLL over
+      the request's bounded progress queue: one response batch per op,
+      sent as soon as events past the cursor exist (or the terminal
+      latch is set, in which case the batch carries the final record).
+      One batch per op fits the latest-wins mailbox transport: the
+      client re-requests with the advanced cursor, so no pushed event
+      can be overwritten unread.
+
+    ``scrape_port`` (optional) additionally serves ``GET /metrics``
+    (Prometheus text format + per-tenant gauges) and ``GET /status``
+    on a zero-dependency HTTP endpoint (0 = ephemeral; the bound port
+    is ``self.scrape_port``).
     """
 
     def __init__(self, server, slots: int = 4, port: int = 0,
                  bind: str = "127.0.0.1", secret: int | None = None,
-                 poll_secs: float = 0.05):
+                 poll_secs: float = 0.05, scrape_port: int | None = None):
         from ..runtime.tcp_window_service import TcpWindowFabric
 
         self.server = server
@@ -132,8 +152,18 @@ class TcpServiceFrontend:
         self.poll_secs = float(poll_secs)
         self._last_ids = {i: 0 for i in range(1, slots + 1)}
         self._pending: dict = {}           # slot -> _Tenant (object ref)
+        self._watch: dict = {}             # slot -> {"rid", "cursor"}
+        self._ingesting: set = set()       # rids mid-decode/ingest
         self._lock = threading.Lock()
         self._stop = False
+        self._scrape = None
+        self.scrape_port = None
+        if scrape_port is not None:
+            self._scrape = _telemetry.ScrapeServer(
+                status_fn=server.status_snapshot, port=int(scrape_port),
+                bind=bind)
+            self.scrape_port = self._scrape.port
+        _telemetry.record_clock_sync("frontend", port=self.port)
         self._thread = threading.Thread(target=self._loop,
                                         name="service-tcp", daemon=True)
         self._thread.start()
@@ -161,6 +191,73 @@ class TcpServiceFrontend:
                 "error_code": "unknown_request",
                 "error": f"unknown (or fully retired) request id {rid!r}"})
 
+    def _handle_status(self, slot: int, payload: dict):
+        """Answer a status RPC immediately: the live scheduler snapshot
+        (whole-server, or one request's record), stamped with the
+        server's wall clock + the echo of the client's send stamp so the
+        client computes the NTP-style handshake offset."""
+        rid = str(payload.get("request_id") or "")
+        try:
+            snap = self.server.status_snapshot(rid or None)
+        except Exception as e:
+            self._answer(slot, {"op": "status", "request_id": rid,
+                                "status": "failed",
+                                "error_code": "exception",
+                                "error": repr(e)})
+            return
+        resp = {"op": "status", "request_id": rid,
+                "server_wall": time.time(), "snapshot": snap}
+        if payload.get("t_wall") is not None:
+            resp["t_wall"] = payload["t_wall"]
+        self._answer(slot, _telemetry.json_safe(resp))
+
+    def _watch_ready(self, rid: str, cursor: int):
+        """One watch long-poll's answer when one is due, else None.
+        Due = events past the cursor exist, the terminal latch is set,
+        or the id resolves to no live/streamable request at all (the
+        batch then carries the journaled record, or a structured
+        ``unknown_request``)."""
+        bus = self.server.progress
+        evs, nxt, lost, done = bus.poll(rid, cursor)
+        if evs or done:
+            resp = {"op": "watch", "request_id": rid, "events": evs,
+                    "cursor": nxt, "lost": lost, "done": done,
+                    "server_wall": time.time()}
+            if done:
+                t = self.server.lookup(rid)
+                rec = (dict(t.record) if t is not None
+                       else self.server._journal_record(rid))
+                if rec is not None:
+                    resp["record"] = rec
+            return _telemetry.json_safe(resp)
+        if not bus.known(rid):
+            with self._lock:
+                if rid in self._ingesting:
+                    return None    # ingest in flight: not unknown yet
+            t = self.server.lookup(rid)
+            if t is None or t.done.is_set():
+                rec = (dict(t.record) if t is not None
+                       else self.server._journal_record(rid)) or {
+                    "request_id": rid, "status": "failed",
+                    "error_code": "unknown_request",
+                    "error": f"unknown (or fully retired) request id "
+                             f"{rid!r}"}
+                return _telemetry.json_safe(
+                    {"op": "watch", "request_id": rid, "events": [],
+                     "cursor": cursor, "lost": 0, "done": True,
+                     "record": rec, "server_wall": time.time()})
+        return None
+
+    def _handle_watch(self, slot: int, payload: dict):
+        rid = str(payload.get("request_id") or "")
+        cursor = int(payload.get("cursor") or 0)
+        resp = self._watch_ready(rid, cursor)
+        if resp is not None:
+            self._answer(slot, resp)
+            return
+        with self._lock:       # quiet stream: the loop answers when due
+            self._watch[slot] = {"rid": rid, "cursor": cursor}
+
     def _submit_async(self, slot: int, data):
         """Decode + ingest + submit on a per-request thread: ingest is
         minutes of single-core numpy at reference scale, and running it
@@ -169,11 +266,26 @@ class TcpServiceFrontend:
         (not its id), so a ``retire_finished()`` sweep between
         completion and the next poll cannot orphan the response."""
         rid = ""
+        ing = ""
         try:
             payload = decode_payload(data)
             if isinstance(payload, dict) and payload.get("op") == "fetch":
                 self._handle_fetch(slot, str(payload.get("request_id")))
                 return
+            if isinstance(payload, dict) and payload.get("op") == "status":
+                self._handle_status(slot, payload)
+                return
+            if isinstance(payload, dict) and payload.get("op") == "watch":
+                self._handle_watch(slot, payload)
+                return
+            if isinstance(payload, dict):
+                # mark the id mid-ingest BEFORE the (seconds-long)
+                # decode+submit: a watch long-poll racing the ingest
+                # must stay quiet instead of answering unknown_request
+                ing = str(payload.get("request_id") or "")
+                if ing:
+                    with self._lock:
+                        self._ingesting.add(ing)
             req = SolveRequest.from_dict(payload)
             rid = req.request_id
             rid = self.server.submit(req)
@@ -206,6 +318,10 @@ class TcpServiceFrontend:
             self._answer(slot, {"request_id": rid, "status": "failed",
                                 "error_code": "bad_request",
                                 "error": repr(e)})
+        finally:
+            if ing:
+                with self._lock:
+                    self._ingesting.discard(ing)
 
     def _loop(self):
         while not self._stop:
@@ -227,6 +343,25 @@ class TcpServiceFrontend:
                     del self._pending[slot]
             for slot, t in ready:
                 self._answer(slot, dict(t.record))
+            # quiet watch long-polls: answer each registered stream as
+            # soon as events (or the terminal latch) show up
+            with self._lock:
+                watches = list(self._watch.items())
+            for slot, w in watches:
+                try:
+                    resp = self._watch_ready(w["rid"], w["cursor"])
+                except Exception as e:
+                    resp = {"op": "watch", "request_id": w["rid"],
+                            "events": [], "cursor": w["cursor"],
+                            "lost": 0, "done": True,
+                            "record": {"request_id": w["rid"],
+                                       "status": "failed",
+                                       "error_code": "exception",
+                                       "error": repr(e)}}
+                if resp is not None:
+                    with self._lock:
+                        self._watch.pop(slot, None)
+                    self._answer(slot, resp)
             time.sleep(self.poll_secs)
 
     def _answer(self, slot: int, payload: dict):
@@ -253,6 +388,8 @@ class TcpServiceFrontend:
     def close(self):
         self._stop = True
         self._thread.join(timeout=10.0)
+        if self._scrape is not None:
+            self._scrape.close()
         self.fabric.close()
 
 
@@ -298,6 +435,15 @@ class SolveClient:
             reconnect_backoff if reconnect_backoff is not None
             else os.environ.get("TPUSPPY_TCP_BACKOFF", "0.1"))
         self._last_resp = self.fabric.to_spoke[self.slot].write_id
+        #: terminal record captured by the last :meth:`watch` /
+        #: :meth:`wait_result` stream on this client
+        self.last_record = None
+        # recent solve submits (rid -> (t_put, payload)): the request
+        # box is latest-wins, so an op put racing the UNREAD submit can
+        # overwrite it — watch() uses this to settle before its first
+        # op and to re-submit (idempotent) if the id comes back unknown
+        self._inflight: dict = {}
+        _telemetry.record_clock_sync("client", slot=self.slot)
 
     def _op(self, fn):
         """One transport op under the client-level reconnect policy (on
@@ -343,14 +489,29 @@ class SolveClient:
         id makes that retry resolve idempotently server-side instead of
         starting a second solve."""
         request = dict(request)
-        if request.get("op") != "fetch" and not request.get("request_id"):
+        is_op = request.get("op") is not None
+        if not is_op and not request.get("request_id"):
             # not setdefault: an explicit ``request_id: None`` (natural
             # when plumbing an optional parameter) must be replaced too,
             # or the retried put starts a second solve after all
             request["request_id"] = f"req-{uuid.uuid4().hex[:10]}"
+        if not is_op and not request.get("trace_id"):
+            # the distributed trace starts HERE, at the outermost edge:
+            # the id rides the wire payload, the journal, every batch
+            # slot and every per-window event server-side
+            request["trace_id"] = _telemetry.mint_trace_id()
         self._op(lambda: self.fabric.to_hub[self.slot].put(
             encode_payload(request, REQ_SLOTS)))
-        return str(request.get("request_id") or "")
+        rid = str(request.get("request_id") or "")
+        if not is_op:
+            self._inflight[rid] = (time.time(), dict(request))
+            while len(self._inflight) > 8:     # bounded memory
+                self._inflight.pop(next(iter(self._inflight)))
+            _telemetry.tenant_instant(rid, request.get("trace_id"),
+                                      "submitted",
+                                      model=request.get("model"),
+                                      slot=self.slot)
+        return rid
 
     def wait(self, timeout: float = 600.0, poll_secs: float = 0.1,
              request_id: str | None = None) -> dict:
@@ -390,6 +551,146 @@ class SolveClient:
         at completion."""
         self.submit({"op": "fetch", "request_id": str(request_id)})
         return self.wait(timeout=timeout, request_id=str(request_id))
+
+    def _record_handshake(self, t_send: float, server_wall):
+        """Bank the NTP-style (server - client) wall offset measured by
+        one op round trip — ``trace_merge --align handshake`` applies it
+        to place this client's ring on the server's timeline."""
+        if server_wall is None:
+            return
+        t_recv = time.time()
+        off = _telemetry.handshake_offset(t_send, t_recv, server_wall)
+        _telemetry.record_clock_handshake("client", off, t_recv - t_send,
+                                          slot=self.slot)
+
+    def status(self, request_id: str | None = None,
+               timeout: float = 60.0) -> dict:
+        """Live scheduler snapshot via the ``status`` RPC: one request's
+        ``{"request_id", "done", "status", "record"}``, or (with no id)
+        the whole server's ``{"queue_depth", "requests_live",
+        "batch_slots", "batch_slots_occupied", "requests": {rid: row}}``
+        — the same rows the scrape endpoint renders as gauges.  Answered
+        immediately (never at completion) and stamped with the server's
+        wall clock, which this client records as a clock handshake for
+        ``scripts/trace_merge.py``.
+
+        Requires a telemetry-aware server for the WHOLE-SERVER form; the
+        per-request form degrades gracefully on an older server (the op
+        decodes as an idempotent duplicate submit of the same id and is
+        answered with the original record at completion — fetch
+        semantics)."""
+        rid = str(request_id) if request_id else ""
+        t_send = time.time()
+        self.submit({"op": "status", "request_id": rid,
+                     "t_wall": t_send})
+        resp = self.wait(timeout=timeout, request_id=rid or None)
+        if isinstance(resp, dict) and resp.get("op") == "status":
+            self._record_handshake(t_send, resp.get("server_wall"))
+            return resp["snapshot"]
+        # legacy server: the answer IS the terminal record
+        return resp
+
+    def watch(self, request_id: str, timeout: float = 600.0,
+              cursor: int = 0):
+        """Stream a request's live progress events — a generator of
+        event dicts ``{"seq", "t", "kind", ...}``: per-window ``gap``
+        points, ``bound_update``s (with the bound-source char),
+        ``running``/``parked``/``recovered`` verdicts, and the terminal
+        ``done``/``failed``/``deadline`` event.  Long-polls the ``watch``
+        RPC (one batch per op, cursor-advanced, so the latest-wins
+        mailbox can never overwrite an unread event); the final record
+        lands in ``self.last_record``.
+
+        On an OLD server the op degrades to fetch semantics (idempotent
+        duplicate submit answered at completion): the stream then yields
+        ONE synthetic terminal event carrying the record.  ``timeout``
+        bounds the whole stream.  A slow consumer may lose the OLDEST
+        events to the server's bounded queue — each batch's ``lost``
+        count is surfaced on the event dicts' ``_lost`` key."""
+        rid = str(request_id)
+        deadline = time.time() + float(timeout)
+        cursor = int(cursor)
+        sub = self._inflight.get(rid)
+        if sub is not None:
+            # the request box is latest-wins: an op put before the
+            # frontend's poll consumed the solve submit would overwrite
+            # it — give a just-submitted request a moment to land
+            settle = sub[0] + 0.5 - time.time()
+            if settle > 0:
+                time.sleep(min(settle, 0.5))
+        resubmits = 0
+        record_races = 0
+
+        def _unknown(rec):
+            return (isinstance(rec, dict)
+                    and rec.get("error_code") == "unknown_request")
+
+        while True:
+            t_send = time.time()
+            remaining = deadline - t_send
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"watch({rid!r}) exhausted its {timeout}s budget")
+            self.submit({"op": "watch", "request_id": rid,
+                         "cursor": cursor, "t_wall": t_send})
+            resp = self.wait(timeout=remaining, request_id=rid)
+            if not isinstance(resp, dict) or resp.get("op") != "watch":
+                if _unknown(resp) and sub is not None and resubmits < 4:
+                    # our own solve put was overwritten unread: replay
+                    # it (idempotent on the stable request id)
+                    resubmits += 1
+                    self.submit(dict(sub[1]))
+                    time.sleep(0.25)
+                    continue
+                if record_races == 0:
+                    # the solve's own completion answer (the slot's
+                    # pending response) raced a watch batch on the
+                    # latest-wins box: a telemetry-aware server still
+                    # owes the drained events + done batch — re-poll
+                    # once; a legacy server answers the record again
+                    record_races = 1
+                    self.last_record = resp
+                    continue
+                # legacy server: terminal record, no event stream
+                self.last_record = resp
+                yield {"seq": -1, "t": time.time(), "kind": "done",
+                       "legacy": True, "record": resp}
+                return
+            self._record_handshake(t_send, resp.get("server_wall"))
+            if (resp.get("done") and _unknown(resp.get("record"))
+                    and sub is not None and resubmits < 4):
+                resubmits += 1
+                self.submit(dict(sub[1]))
+                time.sleep(0.25)
+                continue
+            lost = int(resp.get("lost") or 0)
+            for ev in resp.get("events") or []:
+                if lost:
+                    ev["_lost"] = lost
+                yield ev
+            cursor = int(resp.get("cursor") or cursor)
+            if resp.get("done"):
+                self.last_record = (resp.get("record")
+                                    or self.last_record)
+                return
+
+    def wait_result(self, request_id: str,
+                    timeout: float = 600.0) -> dict:
+        """Terminal record for ``request_id`` — woken by the STREAMED
+        terminal event (the ``watch`` RPC's done batch) instead of
+        busy-polling ``fetch`` at ``poll_secs``; an old server degrades
+        to exactly the fetch path (watch's legacy answer IS the
+        record)."""
+        rid = str(request_id)
+        t0 = time.time()
+        for _ in self.watch(rid, timeout=timeout):
+            pass
+        if self.last_record is not None:
+            return self.last_record
+        # terminal batch without a record (retired mid-stream): the
+        # journal still has it — fall back to the poll path
+        return self.fetch(rid, timeout=max(1.0,
+                                           timeout - (time.time() - t0)))
 
     def solve(self, request: dict, timeout: float = 600.0) -> dict:
         rid = self.submit(request)

@@ -46,6 +46,8 @@ from math import inf
 import numpy as np
 
 from ..obs import metrics as _metrics
+from ..obs import telemetry as _telemetry
+from ..obs import trace as _trace
 from ..obs.log import get_logger
 from ..resilience import faults as _faults
 from . import canonical as _canonical
@@ -141,11 +143,18 @@ class SolveRequest:
         ties keep submission order, so same-class requests retain FIFO
         semantics.  Scheduler-side only (popped from the canonical
         settings key like rel_gap).
+      trace_id: request-scoped trace id (doc/observability.md "The
+        request telemetry plane").  Minted at the OUTERMOST edge —
+        ``SolveClient.submit`` — and carried here through the wire;
+        minted fresh only for requests that arrive without one
+        (in-process submits).  Persisted in the journal, so a
+        SIGKILL-recovered request keeps its trace.
     """
 
     def __init__(self, model="farmer", num_scens=3, creator_kwargs=None,
                  options=None, request_id=None, scenario_creator=None,
-                 names=None, deadline_secs=None, qos=None):
+                 names=None, deadline_secs=None, qos=None,
+                 trace_id=None):
         self.model = str(model)
         self.num_scens = int(num_scens)
         self.creator_kwargs = dict(creator_kwargs or {})
@@ -163,6 +172,7 @@ class SolveRequest:
         if qos is None:
             qos = self.options.get("qos")
         self.qos = str(qos or "standard")
+        self.trace_id = str(trace_id or _telemetry.mint_trace_id())
 
     @classmethod
     def from_dict(cls, d: dict) -> "SolveRequest":
@@ -172,7 +182,8 @@ class SolveRequest:
                    options=d.get("options"),
                    request_id=d.get("request_id"),
                    deadline_secs=d.get("deadline_secs"),
-                   qos=d.get("qos"))
+                   qos=d.get("qos"),
+                   trace_id=d.get("trace_id"))
 
     def to_dict(self) -> dict:
         """The journal/wire form.  Custom in-process creators are NOT
@@ -183,7 +194,8 @@ class SolveRequest:
                 "options": dict(self.options),
                 "request_id": self.request_id,
                 "deadline_secs": self.deadline_secs,
-                "qos": self.qos}
+                "qos": self.qos,
+                "trace_id": self.trace_id}
 
 
 def _blank_record(rid, model, family, fingerprint) -> dict:
@@ -207,6 +219,9 @@ def _blank_record(rid, model, family, fingerprint) -> dict:
         # execution ran inside a fused tenant batch, and the tenant's
         # live-row share of the shared dispatches' model FLOPs
         "qos": "standard", "batched": False, "attributed_flops": 0.0,
+        # request-scoped trace id (the telemetry plane's merge key —
+        # riding the record means journal replay restores it for free)
+        "trace_id": None,
     }
 
 
@@ -242,6 +257,8 @@ class _Tenant:
                                     canon.family_digest,
                                     canon.fingerprint[:12])
         self.record["qos"] = req.qos
+        self.trace = req.trace_id
+        self.record["trace_id"] = req.trace_id
 
     def past_deadline(self) -> bool:
         return self.deadline_at is not None and time.time() > self.deadline_at
@@ -285,6 +302,14 @@ class _Tenant:
         base = _blank_record(t.id, t.req.model, jr.family, "")
         base.update(rec)
         base["status"] = jr.status
+        # the trace survives the restart: the journal carries the id
+        # first-class (accepted line), with the request payload / record
+        # snapshot as legacy fallbacks — a recovered request's spans
+        # continue the SAME trace minted at the client
+        t.trace = (getattr(jr, "trace_id", "")
+                   or base.get("trace_id") or t.req.trace_id)
+        base["trace_id"] = t.trace
+        t.req.trace_id = t.trace
         t.record = base
         return t
 
@@ -361,6 +386,13 @@ class SolveServer:
         self._stop = False
         self._drain = True                 # shutdown(wait=True) semantics
         self._seq = 0
+        # the live telemetry plane (doc/observability.md): bounded
+        # per-request progress queues the TCP frontend streams from
+        # (SolveClient.watch), plus batch-occupancy bookkeeping for the
+        # scrape endpoint's status snapshot
+        self.progress = _telemetry.ProgressBus()
+        self._batch_live: dict = {}
+        _telemetry.record_clock_sync("scheduler", work_dir=self.work_dir)
         # the write-ahead request journal (service/journal.py): accepted
         # requests + status transitions persist under the work dir, so a
         # crashed server's obligations survive it
@@ -493,7 +525,8 @@ class SolveServer:
                             checkpoint_dir=t.dir,
                             recoverable=jr.recoverable,
                             deadline_at=t.deadline_at,
-                            record=t.record))
+                            record=t.record,
+                            trace_id=t.trace))
             except Exception as e:
                 t.status = "failed"
                 t.record.update(status="failed", error_code="exception",
@@ -543,6 +576,14 @@ class SolveServer:
             self._runq.append(t)           # seq-sorted iteration above
             _CTR_RECOVERED.inc(1)          # => original admission order
             self._journal_safe(t.id, "queued", t.record)
+            # same trace_id across the kill: the recovered lifetime's
+            # spans continue the trace the client minted
+            _telemetry.tenant_instant(
+                t.id, t.trace, "recovered",
+                mode=t.record["recovered"], seq=t.seq)
+            self.progress.emit(t.id, "recovered", status="queued",
+                               mode=t.record["recovered"],
+                               trace_id=t.trace)
         _log.info("recovery: %d journaled request(s) — %d re-admitted, "
                   "%d already finished", len(replayed), len(self._runq),
                   sum(1 for r in replayed.values() if r.finished))
@@ -595,6 +636,9 @@ class SolveServer:
                         t.canonical = None
                     self._journal_safe(t.id, t.record["status"], t.record)
                     self._close_tenant_locked(t)
+                    self.progress.emit(t.id, t.record["status"],
+                                       status=t.record["status"])
+                    self.progress.mark_done(t.id)
                     t.done.set()
                 self._runq.clear()
             self._cv.notify_all()
@@ -746,7 +790,8 @@ class SolveServer:
             rid=t.id, seq=t.seq, request=req_payload,
             family=canon.family_digest, checkpoint_dir=t.dir,
             recoverable=req.scenario_creator is None,
-            deadline_at=t.deadline_at, record=t.record))
+            deadline_at=t.deadline_at, record=t.record,
+            trace_id=t.trace))
         with self._cv:
             if self._stop:
                 # a shutdown landed while we journaled: the executor may
@@ -764,10 +809,21 @@ class SolveServer:
                 self._journal_safe(t.id, "cancelled", t.record)
                 # a racing result() waiter that already grabbed the
                 # tenant object must unblock, not hang
+                self.progress.emit(t.id, "cancelled", status="cancelled")
+                self.progress.mark_done(t.id)
                 t.done.set()
                 raise ServerClosed("server is shut down")
             self._runq.append(t)
             self._cv.notify_all()
+        # admission on the request's trace + progress stream: the first
+        # event a watcher sees, and the span boundary trace_merge joins
+        # to the client's submit instant
+        _telemetry.tenant_instant(t.id, t.trace, "admitted",
+                                  model=req.model, qos=req.qos,
+                                  family=canon.family_digest, seq=t.seq)
+        self.progress.emit(t.id, "queued", status="queued",
+                           model=req.model, qos=req.qos,
+                           trace_id=t.trace)
         # warm_hit is decided at FIRST EXECUTION, not here: only a family
         # whose compile leader actually COMPLETED has executables to bind
         # (family affinity guarantees the leader finishes first; a failed
@@ -826,6 +882,72 @@ class SolveServer:
         TCP frontend's non-blocking hook for fetch-by-id."""
         return self._tenants.get(request_id)
 
+    def status_snapshot(self, request_id: str | None = None) -> dict:
+        """The live status surface (the ``status`` RPC and the scrape
+        endpoint's per-tenant gauges both render this).
+
+        Whole-server form (``request_id=None``)::
+
+            {"queue_depth", "requests_live", "batch_slots",
+             "batch_slots_occupied", "requests": {rid: {status, model,
+             qos, batched, trace_id, rel_gap, outer, inner, iters,
+             certified, attributed_flops, mfu_pct,
+             deadline_headroom_s, queue_wait_s, exec_s}}}
+
+        Per-request form: ``{"request_id", "done", "status",
+        "record"}`` — the record snapshot is served from memory (live
+        tenants) or the journal (previous lifetimes), WITHOUT blocking
+        for completion: the answer a poll-free client wakes on."""
+        if request_id is not None:
+            t = self._tenants.get(str(request_id))
+            if t is not None:
+                return {"request_id": str(request_id),
+                        "done": t.done.is_set(), "status": t.status,
+                        "record": dict(t.record)}
+            rec = self._journal_record(str(request_id))
+            return {"request_id": str(request_id),
+                    "done": rec is not None,
+                    "status": (rec or {}).get("status"),
+                    "record": rec}
+        from ..solvers import flops as _flops
+
+        now = time.time()
+        with self._cv:
+            tenants = list(self._tenants.values())
+            qdepth = len(self._runq)
+            batch = dict(self._batch_live)
+        peak, _note = _flops.device_peak_flops()
+        reqs = {}
+        live = 0
+        for t in tenants:
+            r = t.record
+            if t.status in ("queued", "running", "parked"):
+                live += 1
+            mfu = None
+            if peak and r.get("attributed_flops") and r.get("exec_s"):
+                mfu = (100.0 * r["attributed_flops"]
+                       / (r["exec_s"] * peak))
+            reqs[t.id] = {
+                "status": t.status, "model": r.get("model"),
+                "qos": r.get("qos"), "batched": r.get("batched"),
+                "trace_id": r.get("trace_id"),
+                "rel_gap": r.get("rel_gap"),
+                "outer": r.get("outer"), "inner": r.get("inner"),
+                "iters": r.get("iters"),
+                "certified": r.get("certified"),
+                "attributed_flops": r.get("attributed_flops"),
+                "mfu_pct": mfu,
+                "queue_wait_s": r.get("queue_wait_s"),
+                "exec_s": r.get("exec_s"),
+                "deadline_headroom_s": (
+                    t.deadline_at - now
+                    if t.deadline_at is not None else None),
+            }
+        return {"queue_depth": qdepth, "requests_live": live,
+                "batch_slots": batch.get("k", self.batch_slots),
+                "batch_slots_occupied": batch.get("occupied"),
+                "requests": reqs}
+
     def retire_finished(self, keep: int = 0) -> int:
         """Drop finished tenants' bookkeeping (all but the newest
         ``keep``), returning how many were retired.  Completed tenants
@@ -844,6 +966,9 @@ class SolveServer:
             for t in drop:
                 del self._tenants[t.id]
             retained = set(self._tenants)
+        for t in drop:
+            # progress-bus memory tracks the retained-record window
+            self.progress.drop(t.id)
         try:
             # compact_keep folds + rewrites ATOMICALLY under the append
             # lock — a submit/transition racing this sweep serializes
@@ -953,6 +1078,9 @@ class SolveServer:
                 self._journal_safe(tenant.id, "failed", tenant.record)
                 with self._cv:
                     self._close_tenant_locked(tenant)
+                self.progress.emit(tenant.id, "failed", status="failed",
+                                   error=repr(e))
+                self.progress.mark_done(tenant.id)
                 tenant.done.set()
 
     def _want_preempt(self, tenant, slice_start) -> bool:
@@ -996,6 +1124,13 @@ class SolveServer:
         _log.warning("request %s failed its deadline (gap %s after %d "
                      "iter(s), %d slice(s))", t.id, t.record["rel_gap"],
                      t.record["iters"], t.slices)
+        _telemetry.tenant_instant(t.id, t.trace, "deadline_failed",
+                                  iters=t.record["iters"],
+                                  rel_gap=t.record["rel_gap"])
+        self.progress.emit(t.id, "deadline", status="failed",
+                           iters=t.record["iters"],
+                           rel_gap=t.record["rel_gap"])
+        self.progress.mark_done(t.id)
         t.done.set()
 
     def _tenant_in_wheel(self, t: _Tenant) -> bool:
@@ -1138,6 +1273,10 @@ class SolveServer:
             "linger_secs": float(t.req.options.get("linger_secs",
                                                    self.linger_secs)),
             "preempt_check": preempt_check,
+            # live per-window progress (doc/observability.md): the hub
+            # calls this on every gap computation; the server dedupes
+            # and feeds the request's progress stream + trace series
+            "progress_cb": self._progress_cb(t),
             "checkpoint_dir": t.dir,
             # mid-slice cadence on top of the terminal park capture: a
             # server CRASH (not just a park) loses at most this much of
@@ -1163,6 +1302,44 @@ class SolveServer:
         ]
         return hub_dict, spokes
 
+    def _progress_cb(self, t: _Tenant):
+        """Per-window progress hook for a SOLO slice's hub: dedupe the
+        compute_gaps call stream (the hub computes gaps more than once
+        per iteration) into the request's bounded progress queue — one
+        ``gap`` point per new iteration, one ``bound_update`` per actual
+        bound improvement — and mirror the same samples onto the
+        request's trace track (source char '*': the hub's own typed
+        updates)."""
+        state = {"iter": -1, "outer": None, "inner": None}
+        bus = self.progress
+
+        def cb(abs_gap, rel_gap, outer, inner, iteration):
+            improved = (outer, inner) != (state["outer"],
+                                          state["inner"])
+            fresh = iteration != state["iter"]
+            if not (improved or fresh):
+                return
+            state.update(iter=iteration, outer=outer, inner=inner)
+            if improved:
+                bus.emit(t.id, "bound_update", source="*",
+                         outer=float(outer), inner=float(inner),
+                         iteration=int(iteration))
+                if np.isfinite(outer):
+                    _telemetry.tenant_counter(t.id, t.trace,
+                                              "best_outer", outer)
+                if np.isfinite(inner):
+                    _telemetry.tenant_counter(t.id, t.trace,
+                                              "best_inner", inner)
+            if np.isfinite(rel_gap):
+                bus.emit(t.id, "gap", iteration=int(iteration),
+                         rel_gap=float(rel_gap),
+                         abs_gap=float(abs_gap), source="*")
+                _telemetry.tenant_counter(t.id, t.trace, "rel_gap",
+                                          rel_gap)
+                _telemetry.tenant_counter(t.id, t.trace, "abs_gap",
+                                          abs_gap)
+        return cb
+
     def _run_slice(self, t: _Tenant):
         from ..spin_the_wheel import WheelSpinner
 
@@ -1173,6 +1350,8 @@ class SolveServer:
         t.status = "running"
         t.record["status"] = "running"
         self._journal_safe(t.id, "running", t.record)
+        self.progress.emit(t.id, "running", status="running",
+                           slice=t.slices + 1)
         if t.first_exec is None:
             t.first_exec = time.monotonic()
             if t.record["queue_wait_s"] is None:
@@ -1218,7 +1397,10 @@ class SolveServer:
         # the executor is the ONLY thread doing device work, so registry
         # window deltas here are this slice's traffic (the wheel's own
         # cylinder threads are part of the slice)
-        with _metrics.window() as w:
+        with _metrics.window() as w, \
+                _telemetry.request_scope(t.trace, t.id), \
+                _telemetry.tenant_span(t.id, t.trace, "slice",
+                                       slice=t.slices + 1):
             ws = WheelSpinner(hub_dict, spokes).run()
         t.slices += 1
         if _faults.active():
@@ -1268,6 +1450,11 @@ class SolveServer:
             rec["status"] = "parked"
             rec["preemptions"] += 1
             self._journal_safe(t.id, "parked", rec)
+            _telemetry.tenant_instant(t.id, t.trace, "parked",
+                                      iters=rec["iters"])
+            self.progress.emit(t.id, "parked", status="parked",
+                               iters=rec["iters"],
+                               rel_gap=rec["rel_gap"])
             with self._cv:
                 if self._stop and not self._drain:
                     # shutdown(wait=False): the park WAS the drain — the
@@ -1275,6 +1462,7 @@ class SolveServer:
                     # server over this work_dir), and waiters unblock on
                     # the parked record instead of timing out
                     self._close_tenant_locked(t)
+                    self.progress.mark_done(t.id)
                     t.done.set()
                     _log.info("request %s left PARKED by shutdown "
                               "(checkpoint banked at iter %d)", t.id,
@@ -1308,6 +1496,21 @@ class SolveServer:
         _log.info("request %s done: gap %.3e in %.2fs (%d slice(s), "
                   "%d compiles)", t.id, rel_gap, rec["wall_s"], t.slices,
                   int(rec["aot_misses"]))
+        _telemetry.tenant_instant(t.id, t.trace, "complete",
+                                  certified=rec["certified"],
+                                  iters=rec["iters"])
+        if rec["rel_gap"] is not None and np.isfinite(rec["rel_gap"]):
+            # the live gap series ends AT the certified gap: the final
+            # certification can tighten past the last in-iteration point
+            self.progress.emit(t.id, "gap", source="C",
+                               rel_gap=rec["rel_gap"],
+                               outer=rec["outer"], inner=rec["inner"],
+                               iteration=rec["iters"])
+        self.progress.emit(t.id, "done", status="done",
+                           certified=rec["certified"],
+                           rel_gap=rec["rel_gap"], outer=rec["outer"],
+                           inner=rec["inner"], iters=rec["iters"])
+        self.progress.mark_done(t.id)
         t.done.set()
 
     # ---- continuous batching ------------------------------------------------
@@ -1395,6 +1598,9 @@ class SolveServer:
             self._journal_safe(t.id, "failed", t.record)
             with self._cv:
                 self._close_tenant_locked(t)
+            self.progress.emit(t.id, "failed", status="failed",
+                               error=repr(e))
+            self.progress.mark_done(t.id)
             t.done.set()
 
         def admit(t, joiner):
@@ -1409,10 +1615,15 @@ class SolveServer:
                     t.id, t.canonical, t.dir,
                     int(t.opt_options.get("PHIterLimit", 200)),
                     resume=t.slices > 0,
-                    best_inner=t.last_inner, best_outer=t.last_outer)
+                    best_inner=t.last_inner, best_outer=t.last_outer,
+                    trace_id=t.trace)
             except Exception as e:
                 fail(t, e)
                 return False
+            self.progress.emit(t.id, "running", status="running",
+                               batched=True, joiner=bool(joiner),
+                               resumed=bool(info["resumed"]),
+                               slice=t.slices + 1)
             t.slices += 1
             t.record["slices"] = t.slices
             t.record["batched"] = True
@@ -1451,11 +1662,15 @@ class SolveServer:
             t.status = "parked"
             t.record["status"] = "parked"
             self._journal_safe(t.id, "parked", t.record)
+            self.progress.emit(t.id, "parked", status="parked",
+                               batched=True, iters=t.record["iters"],
+                               rel_gap=t.record["rel_gap"])
             if stopping:
                 # shutdown(wait=False): the evict WAS the drain — the
                 # tenant stays parked on disk, waiters unblock now
                 with self._cv:
                     self._close_tenant_locked(t)
+                self.progress.mark_done(t.id)
                 t.done.set()
                 _log.info("request %s left PARKED by shutdown "
                           "(checkpoint banked at iter %d)", t.id,
@@ -1497,6 +1712,22 @@ class SolveServer:
             _log.info("request %s done (batched): gap %s in %.2fs "
                       "(%d slice(s))", t.id, rec["rel_gap"],
                       rec["wall_s"], t.slices)
+            _telemetry.tenant_instant(t.id, t.trace, "complete",
+                                      certified=rec["certified"],
+                                      iters=rec["iters"], batched=True)
+            if (rec["rel_gap"] is not None
+                    and np.isfinite(rec["rel_gap"])):
+                self.progress.emit(t.id, "gap", source="C",
+                                   rel_gap=rec["rel_gap"],
+                                   outer=rec["outer"],
+                                   inner=rec["inner"],
+                                   iteration=rec["iters"])
+            self.progress.emit(t.id, "done", status="done",
+                               certified=rec["certified"],
+                               rel_gap=rec["rel_gap"],
+                               outer=rec["outer"], inner=rec["inner"],
+                               iters=rec["iters"], batched=True)
+            self.progress.mark_done(t.id)
             t.done.set()
 
         with _metrics.window() as w:
@@ -1533,6 +1764,11 @@ class SolveServer:
 
             last_bank = time.monotonic()
             while members:
+                # live batch occupancy for the scrape endpoint / status
+                # RPC (read under self._cv by status_snapshot)
+                with self._cv:
+                    self._batch_live = {"k": k,
+                                        "occupied": len(members)}
                 # (a) deadline crossings — per-slot evictions only
                 for t in [t for t in members.values()
                           if t.past_deadline()]:
@@ -1591,6 +1827,7 @@ class SolveServer:
                         rec["iters_per_sec"] = (rec["iters"]
                                                 / rec["exec_s"])
                     ob, ib = float(rep["outer"]), float(rep["inner"])
+                    prev_outer, prev_inner = t.last_outer, t.last_inner
                     tol = 1e-9 * max(1.0, abs(t.last_outer) if
                                      np.isfinite(t.last_outer) else 1.0)
                     if ob < t.last_outer - tol or ib > t.last_inner + tol:
@@ -1603,6 +1840,20 @@ class SolveServer:
                     t.last_inner = min(t.last_inner, ib)
                     rec["outer"], rec["inner"] = ob, ib
                     rec["rel_gap"] = float(rep["rel_gap"])
+                    # per-window progress stream: one gap point per
+                    # window, one bound_update per actual improvement
+                    # (source 'B': the fused batched dispatch)
+                    if t.last_outer > prev_outer or \
+                            t.last_inner < prev_inner:
+                        self.progress.emit(
+                            rid, "bound_update", source="B",
+                            outer=ob, inner=ib, iteration=rec["iters"])
+                    if np.isfinite(rep["rel_gap"]):
+                        self.progress.emit(
+                            rid, "gap", source="B",
+                            iteration=rec["iters"],
+                            rel_gap=float(rep["rel_gap"]),
+                            abs_gap=float(rep["abs_gap"]))
                     target = float(t.req.options.get("rel_gap",
                                                      self.rel_gap))
                     hit = (np.isfinite(rep["rel_gap"])
@@ -1617,3 +1868,5 @@ class SolveServer:
                         # would churn forever
                         complete(t, certified=hit)
             flush_compile(leader.record)
+        with self._cv:
+            self._batch_live = {}
